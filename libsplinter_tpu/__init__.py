@@ -1,0 +1,35 @@
+"""libsplinter_tpu — a TPU-native shared-memory KV + embedding-vector
+framework with the capabilities of splinterhq/libsplinter.
+
+Layers:
+  native/           C11 seqlock store + coordination (host side)
+  store.py          first-class Python binding (ctypes over the C ABI)
+  models/           JAX/flax encoder + decoder models
+  ops/              Pallas TPU kernels (similarity top-k, ...)
+  engine/           event-driven inference daemons (embedder, completer)
+  parallel/         mesh / sharding / pod scale-out
+  cli/              splinterctl-style CLI + REPL
+"""
+from . import _native as native_abi
+from ._native import (
+    ADV_DONTNEED, ADV_NORMAL, ADV_RANDOM, ADV_SEQUENTIAL, ADV_WILLNEED,
+    IOP_ADD, IOP_AND, IOP_DEC, IOP_INC, IOP_NOT, IOP_OR, IOP_SUB, IOP_XOR,
+    MOP_FULL, MOP_HYBRID, MOP_OFF,
+    T_AUDIO, T_BIGINT, T_BIGUINT, T_BINARY, T_IMGDATA, T_JSON, T_MASK,
+    T_VARTEXT, T_VOID,
+)
+from .store import BidInfo, Eagain, HeaderInfo, SlotInfo, Store
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Store", "SlotInfo", "HeaderInfo", "BidInfo", "Eagain", "native_abi",
+    "T_VOID", "T_BIGINT", "T_BIGUINT", "T_JSON", "T_BINARY", "T_IMGDATA",
+    "T_AUDIO", "T_VARTEXT", "T_MASK",
+    "IOP_AND", "IOP_OR", "IOP_XOR", "IOP_NOT", "IOP_INC", "IOP_DEC",
+    "IOP_ADD", "IOP_SUB",
+    "ADV_NORMAL", "ADV_SEQUENTIAL", "ADV_RANDOM", "ADV_WILLNEED",
+    "ADV_DONTNEED",
+    "MOP_OFF", "MOP_HYBRID", "MOP_FULL",
+    "__version__",
+]

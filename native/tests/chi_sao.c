@@ -1,0 +1,123 @@
+/* chi_sao.c — MRMW disjoint-lane contention: up to 32 writer threads each
+ * own a private key lane (write-write contention is zero by construction,
+ * matching the store's 32-writer design ceiling), while reader threads
+ * sample the whole keyspace and validate payload integrity.
+ *
+ * Parity with the reference's splinter_chi_sao harness (SURVEY.md §4).
+ *
+ * Usage: spt_chi_sao [--writers N] [--readers N] [--keys-per-lane K]
+ *                    [--duration-ms D] [--slots S]
+ */
+#define _GNU_SOURCE
+#include "sptpu.h"
+
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+static _Atomic long g_writes, g_reads, g_eagain, g_corrupt;
+static _Atomic int g_stop;
+static int g_keys_per_lane = 256;
+static int g_writers = 4;
+static int g_valsz = 512;
+static spt_store *g_st;
+
+static void key_name(char *buf, int lane, int i) {
+  snprintf(buf, SPT_KEY_MAX, "lane%02d-key-%d", lane, i);
+}
+
+static void *writer(void *arg) {
+  int lane = (int)(intptr_t)arg;
+  char key[SPT_KEY_MAX];
+  char payload[1024];
+  long nonce = 0;
+  while (!atomic_load_explicit(&g_stop, memory_order_relaxed)) {
+    int i = (int)(nonce % g_keys_per_lane);
+    key_name(key, lane, i);
+    int len = snprintf(payload, sizeof payload,
+                       "lane:%d|nonce:%ld|tail:%0*ld", lane, nonce,
+                       (int)(nonce % 32) + 1, nonce);
+    if (len >= g_valsz) len = g_valsz - 1;
+    int rc = spt_set(g_st, key, payload, (uint32_t)len + 1);
+    if (rc == 0)
+      atomic_fetch_add_explicit(&g_writes, 1, memory_order_relaxed);
+    else
+      atomic_fetch_add_explicit(&g_eagain, 1, memory_order_relaxed);
+    nonce++;
+  }
+  return NULL;
+}
+
+static void *reader(void *arg) {
+  (void)arg;
+  char key[SPT_KEY_MAX];
+  char buf[1100];
+  unsigned seed = (unsigned)(uintptr_t)&key;
+  while (!atomic_load_explicit(&g_stop, memory_order_relaxed)) {
+    int lane = (int)(rand_r(&seed) % g_writers);
+    int i = (int)(rand_r(&seed) % g_keys_per_lane);
+    key_name(key, lane, i);
+    uint32_t len = 0;
+    int rc = spt_get(g_st, key, buf, sizeof buf, &len);
+    if (rc == 0 && len > 0) {
+      atomic_fetch_add_explicit(&g_reads, 1, memory_order_relaxed);
+      int got_lane = -1;
+      long nonce = -1;
+      if (sscanf(buf, "lane:%d|nonce:%ld|tail:", &got_lane, &nonce) != 2 ||
+          got_lane != lane) {
+        atomic_fetch_add_explicit(&g_corrupt, 1, memory_order_relaxed);
+        fprintf(stderr, "CORRUPT key=%s buf=%.60s\n", key, buf);
+      }
+    } else if (rc == -11) {
+      atomic_fetch_add_explicit(&g_eagain, 1, memory_order_relaxed);
+    }
+  }
+  return NULL;
+}
+
+int main(int argc, char **argv) {
+  int readers = 4, duration_ms = 5000, slots = 50000;
+  for (int i = 1; i < argc - 1; i++) {
+    if (!strcmp(argv[i], "--writers")) g_writers = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--readers")) readers = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--keys-per-lane"))
+      g_keys_per_lane = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--duration-ms")) duration_ms = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--slots")) slots = atoi(argv[++i]);
+  }
+  if (g_writers > 32) g_writers = 32;  /* the 32-writer design ceiling */
+  char name[64];
+  snprintf(name, sizeof name, "/spt-chisao-%d", getpid());
+  spt_unlink(name, 0);
+  g_st = spt_create(name, (uint32_t)slots, (uint32_t)g_valsz + 64, 0, 0);
+  if (!g_st) { perror("create"); return 2; }
+
+  pthread_t wt[32], rt[64];
+  for (int i = 0; i < g_writers; i++)
+    pthread_create(&wt[i], NULL, writer, (void *)(intptr_t)i);
+  for (int i = 0; i < readers && i < 64; i++)
+    pthread_create(&rt[i], NULL, reader, NULL);
+
+  struct timespec ts = {duration_ms / 1000, (duration_ms % 1000) * 1000000L};
+  nanosleep(&ts, NULL);
+  atomic_store(&g_stop, 1);
+  for (int i = 0; i < g_writers; i++) pthread_join(wt[i], NULL);
+  for (int i = 0; i < readers && i < 64; i++) pthread_join(rt[i], NULL);
+
+  long w = g_writes, r = g_reads, e = g_eagain, c = g_corrupt;
+  double secs = duration_ms / 1000.0;
+  printf("MRMW: writers=%d readers=%d dur=%.1fs\n", g_writers, readers,
+         secs);
+  printf("  writes=%ld (%.2fM/s)  reads=%ld (%.2fM/s)  total=%.2fM ops/s\n",
+         w, w / secs / 1e6, r, r / secs / 1e6, (w + r) / secs / 1e6);
+  printf("  eagain=%ld  corrupt=%ld\n", e, c);
+  spt_close(g_st);
+  spt_unlink(name, 0);
+  if (c) { fprintf(stderr, "INTEGRITY FAILURE\n"); return 1; }
+  printf("OK\n");
+  return 0;
+}

@@ -1,0 +1,141 @@
+/* stress.c — MRSW integrity stress: one writer thread hammers a hot key
+ * set while N reader threads validate a structured payload on every read.
+ * Any torn read (payload that doesn't parse back to ver|nonce|data) is an
+ * integrity failure and a nonzero exit.
+ *
+ * Parity with the reference's splinter_stress harness (SURVEY.md §4):
+ * same contract — readers count EAGAIN retries (expected under load) and
+ * corruption (never acceptable); reports ops/sec.
+ *
+ * Usage: spt_stress [--readers N] [--keys K] [--duration-ms D]
+ *                   [--slots S] [--val-size V] [--scrub MODE]
+ */
+#define _GNU_SOURCE
+#include "sptpu.h"
+
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+static _Atomic long g_writes, g_reads, g_eagain, g_miss, g_corrupt;
+static _Atomic int g_stop;
+static int g_nkeys = 2000;
+static int g_valsz = 1024;
+static spt_store *g_st;
+
+static void key_name(char *buf, int i) {
+  snprintf(buf, SPT_KEY_MAX, "stress-key-%d", i);
+}
+
+static void *writer(void *arg) {
+  (void)arg;
+  char key[SPT_KEY_MAX];
+  char *payload = malloc((size_t)g_valsz + 64);
+  long nonce = 0;
+  while (!atomic_load_explicit(&g_stop, memory_order_relaxed)) {
+    int i = (int)(nonce % g_nkeys);
+    key_name(key, i);
+    int head = snprintf(payload, (size_t)g_valsz, "ver:%d|nonce:%ld|data:",
+                        i, nonce);
+    int fill = (int)(nonce % 64);
+    for (int f = 0; f < fill && head + f < g_valsz - 1; f++)
+      payload[head + f] = 'x';
+    int len = head + (head + fill < g_valsz - 1 ? fill : 0);
+    payload[len] = '\0';
+    int rc = spt_set(g_st, key, payload, (uint32_t)len + 1);
+    if (rc == 0)
+      atomic_fetch_add_explicit(&g_writes, 1, memory_order_relaxed);
+    else if (rc == -11) /* EAGAIN */
+      atomic_fetch_add_explicit(&g_eagain, 1, memory_order_relaxed);
+    nonce++;
+  }
+  free(payload);
+  return NULL;
+}
+
+static int parse_payload(const char *buf, uint32_t len, int expect_key) {
+  /* format: ver:<i>|nonce:<n>|data:x* — returns 1 if intact */
+  int ver = -1;
+  long nonce = -1;
+  if (len < 8) return 0;
+  if (sscanf(buf, "ver:%d|nonce:%ld|data:", &ver, &nonce) != 2) return 0;
+  if (ver != expect_key || nonce < 0) return 0;
+  const char *p = strstr(buf, "data:");
+  if (!p) return 0;
+  for (p += 5; *p; p++)
+    if (*p != 'x') return 0;
+  return 1;
+}
+
+static void *reader(void *arg) {
+  (void)arg;
+  char key[SPT_KEY_MAX];
+  char *buf = malloc((size_t)g_valsz + 64);
+  unsigned seed = (unsigned)(uintptr_t)&buf;
+  while (!atomic_load_explicit(&g_stop, memory_order_relaxed)) {
+    int i = (int)(rand_r(&seed) % g_nkeys);
+    key_name(key, i);
+    uint32_t len = 0;
+    int rc = spt_get(g_st, key, buf, (uint32_t)g_valsz + 64, &len);
+    if (rc == 0) {
+      atomic_fetch_add_explicit(&g_reads, 1, memory_order_relaxed);
+      if (len > 0 && !parse_payload(buf, len, i)) {
+        atomic_fetch_add_explicit(&g_corrupt, 1, memory_order_relaxed);
+        fprintf(stderr, "CORRUPT key=%s len=%u buf=%.80s\n", key, len, buf);
+      }
+    } else if (rc == -11) {
+      atomic_fetch_add_explicit(&g_eagain, 1, memory_order_relaxed);
+    } else {
+      atomic_fetch_add_explicit(&g_miss, 1, memory_order_relaxed);
+    }
+  }
+  free(buf);
+  return NULL;
+}
+
+int main(int argc, char **argv) {
+  int readers = 7, duration_ms = 5000, slots = 50000;
+  uint32_t scrub = 1;
+  for (int i = 1; i < argc - 1; i++) {
+    if (!strcmp(argv[i], "--readers")) readers = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--keys")) g_nkeys = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--duration-ms")) duration_ms = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--slots")) slots = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--val-size")) g_valsz = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--scrub")) scrub = (uint32_t)atoi(argv[++i]);
+  }
+  char name[64];
+  snprintf(name, sizeof name, "/spt-stress-%d", getpid());
+  spt_unlink(name, 0);
+  g_st = spt_create(name, (uint32_t)slots, (uint32_t)g_valsz + 64, 0, 0);
+  if (!g_st) { perror("create"); return 2; }
+  spt_set_mop(g_st, scrub);
+
+  pthread_t wt, rt[64];
+  pthread_create(&wt, NULL, writer, NULL);
+  for (int i = 0; i < readers && i < 64; i++)
+    pthread_create(&rt[i], NULL, reader, NULL);
+
+  struct timespec ts = {duration_ms / 1000, (duration_ms % 1000) * 1000000L};
+  nanosleep(&ts, NULL);
+  atomic_store(&g_stop, 1);
+  pthread_join(wt, NULL);
+  for (int i = 0; i < readers && i < 64; i++) pthread_join(rt[i], NULL);
+
+  long w = g_writes, r = g_reads, e = g_eagain, m = g_miss, c = g_corrupt;
+  double secs = duration_ms / 1000.0;
+  printf("MRSW: writers=1 readers=%d dur=%.1fs\n", readers, secs);
+  printf("  writes=%ld (%.2fM/s)  reads=%ld (%.2fM/s)\n", w, w / secs / 1e6,
+         r, r / secs / 1e6);
+  printf("  total=%.2fM ops/s  eagain=%ld  miss=%ld  corrupt=%ld\n",
+         (w + r) / secs / 1e6, e, m, c);
+  spt_close(g_st);
+  spt_unlink(name, 0);
+  if (c) { fprintf(stderr, "INTEGRITY FAILURE\n"); return 1; }
+  printf("OK\n");
+  return 0;
+}

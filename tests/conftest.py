@@ -1,0 +1,34 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh (multi-chip
+sharding is validated without TPU hardware; the driver separately
+dry-run-compiles the multichip path) and provide per-test stores."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import uuid
+
+import pytest
+
+from libsplinter_tpu import Store
+
+
+@pytest.fixture
+def store():
+    name = f"/spt-test-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    st = Store.create(name, nslots=256, max_val=1024, vec_dim=32)
+    yield st
+    st.close()
+    Store.unlink(name)
+
+
+@pytest.fixture
+def store_novec():
+    name = f"/spt-test-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    st = Store.create(name, nslots=64, max_val=256, vec_dim=0)
+    yield st
+    st.close()
+    Store.unlink(name)

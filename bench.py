@@ -1,0 +1,125 @@
+"""Headline benchmark: end-to-end embedding throughput per chip.
+
+Drives the real pipeline on the real TPU: texts live in the native
+seqlock store, the embedding daemon drains them label-swept from the
+store, tokenizes on host, encodes with the flagship (Nomic-geometry)
+encoder in per-bucket jit programs, and commits vectors back epoch-gated.
+
+Prints ONE JSON line:
+  {"metric": "embeddings_per_sec_per_chip", "value": N, "unit":
+   "embeddings/s", "vs_baseline": N}
+
+Baseline: BASELINE.md targets >= 100k embeddings/s on a v5e-8 for
+Nomic-Embed-Text-v1.5, i.e. 12,500 embeddings/s/chip; vs_baseline is
+value / 12500 (>1.0 beats the target's per-chip share).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_PER_CHIP = 12_500.0
+
+N_TEXTS = int(os.environ.get("BENCH_TEXTS", "4096"))
+BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+BUCKET = int(os.environ.get("BENCH_BUCKET", "64"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_texts(n: int) -> list[str]:
+    rng = np.random.default_rng(0)
+    words = ["tpu", "vector", "store", "seqlock", "arena", "signal",
+             "epoch", "shard", "bloom", "label", "kernel", "mesh",
+             "gather", "commit", "batch", "embed"]
+    return [" ".join(rng.choice(words, size=int(rng.integers(4, 24))))
+            for _ in range(n)]
+
+
+def main() -> int:
+    import jax
+
+    from libsplinter_tpu import Store, T_VARTEXT
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.embedder import Embedder
+    from libsplinter_tpu.models import (EmbeddingModel, EncoderConfig,
+                                        default_tokenizer)
+
+    n_chips = len(jax.devices())
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    cfg = EncoderConfig(out_dim=768, max_len=2048)
+    model = EmbeddingModel(cfg, buckets=(BUCKET,))
+    tok = default_tokenizer(cfg.vocab_size)
+
+    log("warmup compile ...")
+    t0 = time.perf_counter()
+    ids = np.zeros((BATCH, BUCKET), np.int32)
+    lens = np.full((BATCH,), BUCKET, np.int32)
+    model.encode_ids(ids, lens)
+    log(f"compile: {time.perf_counter()-t0:.1f}s")
+
+    # -- stage the store ---------------------------------------------------
+    name = f"/spt-bench-{os.getpid()}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=max(8192, N_TEXTS * 2), max_val=2048,
+                      vec_dim=768)
+    texts = make_texts(N_TEXTS)
+    for i, t in enumerate(texts):
+        key = f"bench/{i}"
+        st.set(key, t)
+        st.set_type(key, T_VARTEXT)
+        st.label_or(key, P.LBL_EMBED_REQ)
+
+    emb = Embedder(st, model=model, tokenizer=tok, max_ctx=2048,
+                   batch_cap=BATCH)
+    emb.attach()
+
+    # -- timed drain -------------------------------------------------------
+    t0 = time.perf_counter()
+    done = emb.run_once()
+    dt = time.perf_counter() - t0
+    eps = done / dt if dt > 0 else 0.0
+
+    # -- p50 set->vector latency ------------------------------------------
+    lat = []
+    for i in range(20):
+        key = f"lat/{i}"
+        t1 = time.perf_counter()
+        st.set(key, "latency probe text sample")
+        st.set_type(key, T_VARTEXT)
+        st.label_or(key, P.LBL_EMBED_REQ)
+        st.bump(key)
+        emb.run_once()
+        lat.append((time.perf_counter() - t1) * 1000)
+    p50 = float(np.percentile(lat, 50))
+
+    log(f"embedded={done}/{N_TEXTS} in {dt:.2f}s -> {eps:,.0f} emb/s/chip")
+    log(f"p50 set->vector latency: {p50:.2f} ms "
+        f"(stats: {emb.stats})")
+
+    st.close()
+    Store.unlink(name)
+
+    print(json.dumps({
+        "metric": "embeddings_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "embeddings/s",
+        "vs_baseline": round(eps / BASELINE_PER_CHIP, 4),
+        "detail": {"n_chips_visible": n_chips, "bucket": BUCKET,
+                   "batch": BATCH, "n_texts": N_TEXTS,
+                   "p50_set_to_vector_ms": round(p50, 2)},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

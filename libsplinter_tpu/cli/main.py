@@ -1,0 +1,529 @@
+"""splinterctl-style CLI / REPL for the splinter-tpu store.
+
+Command-set parity with the reference CLI (SURVEY.md §2.3: module
+registry + dispatch, one-shot mode, quote-aware REPL, ~/.splinterrc label
+table, namespace prefix env).  Python replaces the reference's C module
+system; the vector-search command dispatches to the Pallas/TPU kernels
+instead of a scalar CPU scan.
+
+Environment:
+  SPTPU_DEFAULT_STORE  store name used when --store is omitted
+  SPTPU_NS_PREFIX      transparent key namespace prefix
+  SPTPU_HISTORY        REPL history file (default ~/.sptpu_history)
+  ~/.sptpurc           label name table:  name = 0xMASK  per line
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shlex
+import sys
+import time
+import uuid as uuidlib
+from pathlib import Path
+
+import numpy as np
+
+from .. import _native as N
+from ..store import Store
+from ..engine import protocol as P
+
+TYPE_NAMES = {
+    N.T_VOID: "VOID", N.T_BIGINT: "BIGINT", N.T_BIGUINT: "BIGUINT",
+    N.T_JSON: "JSON", N.T_BINARY: "BINARY", N.T_IMGDATA: "IMGDATA",
+    N.T_AUDIO: "AUDIO", N.T_VARTEXT: "VARTEXT",
+}
+NAME_TYPES = {v: k for k, v in TYPE_NAMES.items()}
+ADVICE_NAMES = {"normal": N.ADV_NORMAL, "sequential": N.ADV_SEQUENTIAL,
+                "random": N.ADV_RANDOM, "willneed": N.ADV_WILLNEED,
+                "dontneed": N.ADV_DONTNEED}
+IOP_NAMES = {"and": N.IOP_AND, "or": N.IOP_OR, "xor": N.IOP_XOR,
+             "not": N.IOP_NOT, "inc": N.IOP_INC, "dec": N.IOP_DEC,
+             "add": N.IOP_ADD, "sub": N.IOP_SUB}
+
+
+class CliError(Exception):
+    pass
+
+
+def load_labelrc() -> dict[str, int]:
+    table: dict[str, int] = {}
+    path = Path(os.environ.get("SPTPU_RC", Path.home() / ".sptpurc"))
+    if path.exists():
+        for line in path.read_text().splitlines():
+            line = line.split("#", 1)[0].strip()
+            if "=" in line:
+                name, _, val = line.partition("=")
+                try:
+                    table[name.strip()] = int(val.strip(), 0)
+                except ValueError:
+                    pass
+    return table
+
+
+class Session:
+    """CLI session state (mirrors the reference's cli_user_t)."""
+
+    def __init__(self, store_name: str | None = None,
+                 persistent: bool = False):
+        self.store_name = store_name or os.environ.get(
+            "SPTPU_DEFAULT_STORE", "/sptpu_default")
+        self.persistent = persistent
+        self.ns_prefix = os.environ.get("SPTPU_NS_PREFIX", "")
+        self.labels = load_labelrc()
+        self._store: Store | None = None
+
+    @property
+    def store(self) -> Store:
+        if self._store is None:
+            try:
+                self._store = Store.open(self.store_name,
+                                         persistent=self.persistent)
+            except OSError as e:
+                raise CliError(
+                    f"cannot open store {self.store_name!r}: {e} "
+                    f"(run `init` first?)") from e
+        return self._store
+
+    def key(self, k: str) -> str:
+        return self.ns_prefix + k
+
+    def label_mask(self, spec: str) -> int:
+        if spec in self.labels:
+            return self.labels[spec]
+        return int(spec, 0)
+
+    def close(self) -> None:
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+
+
+# ---------------------------------------------------------------- commands
+
+COMMANDS: dict[str, tuple] = {}
+
+
+def command(name, usage, help_):
+    def deco(fn):
+        COMMANDS[name] = (fn, usage, help_)
+        return fn
+    return deco
+
+
+@command("init", "init [nslots] [max_val] [vec_dim]",
+         "create the store (default 1024 slots, 4 KiB values, 768-d)")
+def cmd_init(ses, args):
+    nslots = int(args[0]) if len(args) > 0 else 1024
+    max_val = int(args[1]) if len(args) > 1 else 4096
+    vec_dim = int(args[2]) if len(args) > 2 else 768
+    st = Store.create(ses.store_name, nslots, max_val, vec_dim,
+                      persistent=ses.persistent)
+    ses._store = st
+    print(f"created {ses.store_name}: {nslots} slots x {st.max_val}B, "
+          f"vec {vec_dim}d")
+
+
+@command("set", "set KEY VALUE...", "set a key")
+def cmd_set(ses, args):
+    if len(args) < 2:
+        raise CliError("usage: set KEY VALUE")
+    ses.store.set(ses.key(args[0]), " ".join(args[1:]))
+
+
+@command("get", "get KEY", "print a key's value")
+def cmd_get(ses, args):
+    if not args:
+        raise CliError("usage: get KEY")
+    sys.stdout.write(ses.store.get_str(ses.key(args[0])))
+    sys.stdout.write("\n")
+
+
+@command("append", "append KEY VALUE...", "append to a key's value")
+def cmd_append(ses, args):
+    if len(args) < 2:
+        raise CliError("usage: append KEY VALUE")
+    ses.store.append(ses.key(args[0]), " ".join(args[1:]))
+
+
+@command("unset", "unset KEY [--tandem]",
+         "delete a key (--tandem removes the whole ordered set)")
+def cmd_unset(ses, args):
+    if not args:
+        raise CliError("usage: unset KEY")
+    if "--tandem" in args:
+        base = [a for a in args if not a.startswith("--")][0]
+        n = ses.store.tandem_unset(ses.key(base))
+        print(f"removed {n} keys")
+    else:
+        ses.store.unset(ses.key(args[0]))
+
+
+@command("list", "list [REGEX]", "list keys (optionally regex-filtered)")
+def cmd_list(ses, args):
+    keys = ses.store.list()
+    if args:
+        rx = re.compile(args[0])
+        keys = [k for k in keys if rx.search(k)]
+    for k in sorted(keys):
+        print(k)
+
+
+@command("head", "head KEY", "dump slot metadata incl. vector stats")
+def cmd_head(ses, args):
+    if not args:
+        raise CliError("usage: head KEY")
+    st = ses.store
+    s = st.slot(ses.key(args[0]))
+    print(f"key      {s.key}")
+    print(f"index    {s.index}")
+    print(f"epoch    {s.epoch}")
+    print(f"type     {TYPE_NAMES.get(s.type, hex(s.type))}")
+    print(f"len      {s.val_len}")
+    print(f"labels   {s.labels:#018x}")
+    print(f"watchers {s.watcher_mask:#018x}")
+    print(f"ctime    {s.ctime}  atime {s.atime}")
+    if st.vec_dim:
+        v = st.vec_get_at(s.index)
+        mag = float(np.linalg.norm(v))
+        csum = int(np.bitwise_xor.reduce(v.view(np.uint32))) \
+            if v.size else 0
+        print(f"vector   dim={st.vec_dim} |v|={mag:.4f} "
+              f"xor={csum:#010x}")
+
+
+@command("type", "type KEY [TYPENAME]", "get/set a slot's named type")
+def cmd_type(ses, args):
+    if not args:
+        raise CliError("usage: type KEY [TYPENAME]")
+    key = ses.key(args[0])
+    if len(args) == 1:
+        print(TYPE_NAMES.get(ses.store.get_type(key), "?"))
+    else:
+        t = NAME_TYPES.get(args[1].upper())
+        if t is None:
+            raise CliError(f"unknown type {args[1]} "
+                           f"(one of {', '.join(NAME_TYPES)})")
+        ses.store.set_type(key, t)
+
+
+@command("label", "label KEY [+MASK|-MASK]",
+         "get/set bloom labels (MASK may be a ~/.sptpurc name)")
+def cmd_label(ses, args):
+    if not args:
+        raise CliError("usage: label KEY [+MASK|-MASK]")
+    key = ses.key(args[0])
+    if len(args) == 1:
+        print(f"{ses.store.labels(key):#018x}")
+    else:
+        spec = args[1]
+        if spec.startswith("-"):
+            ses.store.label_clear(key, ses.label_mask(spec[1:]))
+        else:
+            ses.store.label_or(key, ses.label_mask(spec.lstrip("+")))
+
+
+@command("bump", "bump KEY|@GROUP",
+         "pulse a key's watcher groups (or a group directly)")
+def cmd_bump(ses, args):
+    if not args:
+        raise CliError("usage: bump KEY|@GROUP")
+    if args[0].startswith("@"):
+        ses.store.pulse(int(args[0][1:]))
+    else:
+        ses.store.bump(ses.key(args[0]))
+
+
+@command("math", "math KEY OP [OPERAND]",
+         "atomic integer op on a BIGUINT slot (and/or/xor/not/inc/dec/"
+         "add/sub)")
+def cmd_math(ses, args):
+    if len(args) < 2:
+        raise CliError("usage: math KEY OP [OPERAND]")
+    op = IOP_NAMES.get(args[1].lower())
+    if op is None:
+        raise CliError(f"unknown op {args[1]}")
+    operand = int(args[2], 0) if len(args) > 2 else 0
+    print(ses.store.integer_op(ses.key(args[0]), op, operand))
+
+
+@command("orders", "orders BASE", "show a tandem key set")
+def cmd_orders(ses, args):
+    if not args:
+        raise CliError("usage: orders BASE")
+    base = ses.key(args[0])
+    n = ses.store.tandem_count(base)
+    print(f"{base}: {n} orders")
+    for i in range(n):
+        k = base if i == 0 else f"{base}.{i}"
+        print(f"  [{i}] {k} ({ses.store.value_len(k)}B)")
+
+
+@command("watch", "watch KEY|@GROUP [TIMEOUT_MS]",
+         "block until a key changes (or a signal group pulses)")
+def cmd_watch(ses, args):
+    if not args:
+        raise CliError("usage: watch KEY|@GROUP [TIMEOUT_MS]")
+    timeout = int(args[1]) if len(args) > 1 else -1
+    if args[0].startswith("@"):
+        g = int(args[0][1:])
+        last = ses.store.signal_count(g)
+        got = ses.store.signal_wait(g, last, timeout)
+        print(f"group {g}: {last} -> {got}" if got is not None
+              else "timeout")
+    else:
+        ok = ses.store.poll(ses.key(args[0]), timeout)
+        print("changed" if ok else "timeout")
+
+
+@command("retrain", "retrain KEY",
+         "backward-epoch recovery of a stuck slot (scrubs its vector)")
+def cmd_retrain(ses, args):
+    if not args:
+        raise CliError("usage: retrain KEY")
+    ses.store.retrain(ses.key(args[0]))
+
+
+@command("config", "config [mop N | user N | purge]",
+         "store-level config and maintenance")
+def cmd_config(ses, args):
+    st = ses.store
+    if not args:
+        h = st.header()
+        print(f"store        {ses.store_name}")
+        print(f"geometry     {h.nslots} slots x {h.max_val}B, "
+              f"vec {h.vec_dim}d, map {h.map_size}B")
+        print(f"used         {h.used_slots}")
+        print(f"epoch        {h.global_epoch}")
+        print(f"mop          {h.mop_mode}")
+        print(f"user flags   {h.user_flags:#x}")
+        print(f"bus owner    {h.bus_pid or '-'}")
+        print(f"parse fails  {h.parse_failures}")
+    elif args[0] == "mop":
+        st.set_mop(int(args[1]))
+    elif args[0] == "user":
+        st.config_set_user(int(args[1], 0))
+    elif args[0] == "purge":
+        print(f"swept {st.purge()} slots")
+    else:
+        raise CliError("usage: config [mop N | user N | purge]")
+
+
+@command("caps", "caps", "print build capabilities")
+def cmd_caps(ses, args):
+    import jax
+    print(f"store format   v{N.get_lib() and 1}")
+    print(f"key max        {N.KEY_MAX}")
+    print(f"signal groups  {N.SIGNAL_GROUPS}")
+    print(f"bid slots      {N.MAX_BIDS}")
+    print("backends       shm, file (runtime flag)")
+    try:
+        print(f"jax            {jax.__version__} "
+              f"[{jax.default_backend()}]")
+    except Exception:
+        print("jax            unavailable")
+
+
+@command("uuid", "uuid [KEY]", "generate a uuid (optionally store it)")
+def cmd_uuid(ses, args):
+    u = str(uuidlib.uuid4())
+    if args:
+        ses.store.set(ses.key(args[0]), u)
+    print(u)
+
+
+@command("clear", "clear", "clear the terminal")
+def cmd_clear(ses, args):
+    sys.stdout.write("\x1b[2J\x1b[H")
+
+
+@command("use", "use STORE_NAME", "switch to another store")
+def cmd_use(ses, args):
+    if not args:
+        raise CliError("usage: use STORE_NAME")
+    ses.close()
+    ses.store_name = args[0]
+    print(f"using {args[0]}")
+
+
+@command("shard", "shard table|who|claim ID PRIO|rebid IDX|release IDX|"
+         "advise IDX ADVICE", "cooperative shard bid operations")
+def cmd_shard(ses, args):
+    st = ses.store
+    sub = args[0] if args else "table"
+    if sub == "table":
+        print(" idx pid      shard        intent prio claimed_at   live")
+        for b in st.bid_table():
+            if b.pid == 0:
+                continue
+            print(f" {b.index:3d} {b.pid:<8d} {b.shard_id:#012x} "
+                  f"{b.intent:6d} {b.priority:4d} {b.claimed_at:<12d} "
+                  f"{'yes' if b.live else 'no'}")
+    elif sub == "who":
+        w = st.shard_election()
+        if w is None:
+            print("no sovereign (no live bids)")
+        else:
+            b = st.bid_info(w)
+            print(f"sovereign: bid {w} pid {b.pid} "
+                  f"shard {b.shard_id:#x} prio {b.priority}")
+    elif sub == "claim":
+        if len(args) < 3:
+            raise CliError("usage: shard claim ID PRIO [ADVICE] [DUR_US]")
+        adv = ADVICE_NAMES.get(args[3].lower(), N.ADV_WILLNEED) \
+            if len(args) > 3 else N.ADV_WILLNEED
+        dur = int(args[4]) if len(args) > 4 else 30_000_000
+        idx = st.shard_claim(int(args[1], 0), adv, int(args[2]), dur)
+        print(f"bid {idx}")
+    elif sub == "rebid":
+        st.shard_rebid(int(args[1]))
+    elif sub == "release":
+        st.shard_release(int(args[1]))
+    elif sub == "advise":
+        adv = ADVICE_NAMES.get(args[2].lower())
+        if adv is None:
+            raise CliError(f"unknown advice {args[2]}")
+        ok = st.madvise(int(args[1]), adv, timeout_ms=0)
+        print("advised" if ok else "deferred (not sovereign)")
+    else:
+        raise CliError("usage: shard table|who|claim|rebid|release|advise")
+
+
+@command("hist", "hist", "show REPL history")
+def cmd_hist(ses, args):
+    path = os.environ.get("SPTPU_HISTORY",
+                          str(Path.home() / ".sptpu_history"))
+    if Path(path).exists():
+        sys.stdout.write(Path(path).read_text())
+
+
+@command("bind", "bind BLOOM_BIT GROUP [--remove]",
+         "bind a bloom label bit to a signal group")
+def cmd_bind(ses, args):
+    if len(args) < 2:
+        raise CliError("usage: bind BLOOM_BIT GROUP [--remove]")
+    bit, group = int(args[0]), int(args[1])
+    if "--remove" in args:
+        ses.store.watch_label_unregister(bit, group)
+    else:
+        ses.store.watch_label_register(bit, group)
+
+
+@command("help", "help [COMMAND]", "this help")
+def cmd_help(ses, args):
+    if args and args[0] in COMMANDS:
+        fn, usage, help_ = COMMANDS[args[0]]
+        print(f"{usage}\n  {help_}")
+    else:
+        width = max(len(u) for _, u, _ in COMMANDS.values())
+        for name in sorted(COMMANDS):
+            _, usage, help_ = COMMANDS[name]
+            print(f"  {usage:<{width}}  {help_}")
+
+
+# search / ingest / export live in their own modules
+from .search import cmd_search  # noqa: E402  (registers itself)
+from .ingest import cmd_ingest, cmd_export  # noqa: E402
+
+
+# ------------------------------------------------------------------- REPL
+
+def repl(ses: Session) -> int:
+    try:
+        import readline
+        hist = os.environ.get("SPTPU_HISTORY",
+                              str(Path.home() / ".sptpu_history"))
+        try:
+            readline.read_history_file(hist)
+        except OSError:
+            pass
+        readline.set_completer(_completer)
+        readline.parse_and_bind("tab: complete")
+    except ImportError:
+        readline = None
+        hist = None
+    print(f"splinter-tpu CLI — store {ses.store_name} "
+          f"(type 'help', ctrl-d to exit)")
+    while True:
+        try:
+            line = input("sptpu> ")
+        except EOFError:
+            print()
+            break
+        except KeyboardInterrupt:
+            print()
+            continue
+        line = line.strip()
+        if not line:
+            continue
+        if line in ("exit", "quit"):
+            break
+        try:
+            dispatch(ses, shlex.split(line))
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:   # a bad command must never kill the REPL
+            print(f"error: {e}", file=sys.stderr)
+    if readline and hist:
+        try:
+            readline.write_history_file(hist)
+        except OSError:
+            pass
+    return 0
+
+
+def _completer(text, state):
+    matches = [c for c in COMMANDS if c.startswith(text)]
+    return matches[state] if state < len(matches) else None
+
+
+def dispatch(ses: Session, argv: list[str]) -> None:
+    if not argv:
+        return
+    name, args = argv[0], argv[1:]
+    if name not in COMMANDS:
+        raise CliError(f"unknown command {name!r} (try 'help')")
+    COMMANDS[name][0](ses, args)
+
+
+def main(argv: list[str] | None = None) -> int:
+    # Default the CLI's jax to CPU: quick commands must not grab (or block
+    # on) the TPU, which a daemon usually holds.  SPTPU_CLI_TPU=1 opts the
+    # search scorer back onto the accelerator.
+    if os.environ.get("SPTPU_CLI_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    argv = list(sys.argv[1:] if argv is None else argv)
+    store_name = None
+    persistent = False
+    while argv and argv[0].startswith("--"):
+        if argv[0] == "--store" and len(argv) > 1:
+            store_name = argv[1]
+            argv = argv[2:]
+        elif argv[0] == "--persistent":
+            persistent = True
+            argv = argv[1:]
+        elif argv[0] == "--help":
+            print(__doc__)
+            cmd_help(None, [])
+            return 0
+        else:
+            print(f"unknown flag {argv[0]}", file=sys.stderr)
+            return 2
+    ses = Session(store_name, persistent)
+    try:
+        if argv:
+            try:
+                dispatch(ses, argv)
+                return 0
+            except (CliError, KeyError, OSError, ValueError,
+                    IndexError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+        return repl(ses)
+    finally:
+        ses.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,154 @@
+"""CLI `search` — semantic vector search over the store.
+
+Protocol parity with the reference search command (SURVEY.md §3.4):
+write the query to a scratch key __sqtmp_<pid>, label it 0x1 + bump so
+the embedding daemon picks it up, poll for the vector, then score every
+candidate — except the scoring is the Pallas/TPU fused cosine top-k over
+the zero-copy vector lane instead of a scalar C loop, and euclidean
+distances come from the same fused matmul.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+from ..engine import protocol as P
+from ..store import Store
+from .main import CliError, command
+
+
+@command("search", "search [--json] [--limit N] [--similarity S] "
+         "[--distance D] [--bloom MASK] [--regex RX] [--timeout MS] "
+         "[--cpu] QUERY...", "semantic vector search (TPU top-k)")
+def cmd_search(ses, args):
+    opts = {"json": False, "limit": 10, "similarity": None,
+            "distance": None, "bloom": 0, "regex": None, "timeout": 2000,
+            "cpu": False}
+    query_words = []
+    it = iter(args)
+
+    def arg_of(flag):
+        try:
+            return next(it)
+        except StopIteration:
+            raise CliError(f"{flag} requires a value") from None
+
+    try:
+        for a in it:
+            if a == "--json":
+                opts["json"] = True
+            elif a == "--cpu":
+                opts["cpu"] = True
+            elif a == "--limit":
+                opts["limit"] = int(arg_of(a))
+            elif a == "--similarity":
+                opts["similarity"] = float(arg_of(a))
+            elif a == "--distance":
+                opts["distance"] = float(arg_of(a))
+            elif a == "--bloom":
+                opts["bloom"] = ses.label_mask(arg_of(a))
+            elif a == "--regex":
+                opts["regex"] = arg_of(a)
+            elif a == "--timeout":
+                opts["timeout"] = int(arg_of(a))
+            elif a == "-":
+                query_words.append(sys.stdin.read())
+            elif a.startswith("--file"):
+                query_words.append(open(arg_of(a)).read())
+            else:
+                query_words.append(a)
+    except ValueError as e:
+        raise CliError(f"bad flag value: {e}") from None
+    query = " ".join(query_words).strip()
+    if not query:
+        raise CliError("usage: search [flags] QUERY")
+    st = ses.store
+    if st.vec_dim == 0:
+        raise CliError("store has no vector lane")
+
+    # 1. scratch key -> label 0x1 -> bump: wake the embedding daemon
+    scratch = f"{P.SEARCH_SCRATCH_PREFIX}{os.getpid()}"
+    st.set(scratch, query)
+    from .. import T_VARTEXT
+    st.set_type(scratch, T_VARTEXT)
+    st.label_or(scratch, P.LBL_EMBED_REQ)
+    st.bump(scratch)
+
+    # 2. wait for the vector
+    qvec = None
+    st.poll(scratch, timeout_ms=opts["timeout"])
+    v = st.vec_get(scratch)
+    if np.abs(v).max() > 0:
+        qvec = v
+    if qvec is None:
+        # degrade without scoring, like the reference: list candidates
+        print("warning: no embedding daemon answered; listing unscored "
+              "candidates", file=sys.stderr)
+
+    # 3. candidates: bloom prefilter + regex on keys
+    n = st.nslots
+    mask = np.zeros(n, np.float32)
+    if opts["bloom"]:
+        idxs = st.enumerate_indices(opts["bloom"])
+    else:
+        idxs = [i for i in range(n) if st.epoch_at(i) != 0]
+    rx = re.compile(opts["regex"]) if opts["regex"] else None
+    keys: dict[int, str] = {}
+    for i in idxs:
+        k = st.key_at(i)
+        if k is None or k.startswith(P.SEARCH_SCRATCH_PREFIX):
+            continue
+        if rx and not rx.search(k):
+            continue
+        keys[i] = k
+        mask[i] = 1.0
+
+    rows = []
+    if qvec is not None and keys:
+        from ..ops.similarity import (cosine_scores, euclidean_distances)
+        import jax
+        use_pallas = (not opts["cpu"]) and jax.default_backend() == "tpu"
+        lane = st.vectors
+        scores = np.asarray(cosine_scores(lane, qvec, mask,
+                                          use_pallas=use_pallas))[:, 0]
+        dists = np.asarray(euclidean_distances(lane, qvec, mask))[:, 0]
+        order = np.argsort(-scores)
+        for i in order:
+            i = int(i)
+            if i not in keys:
+                continue
+            sim, dist = float(scores[i]), float(dists[i])
+            if sim <= -1e29:
+                continue
+            if opts["similarity"] is not None and sim < opts["similarity"]:
+                continue
+            if opts["distance"] is not None and dist > opts["distance"]:
+                continue
+            rows.append({"key": keys[i], "similarity": round(sim, 6),
+                         "distance": round(dist, 6)})
+            if len(rows) >= opts["limit"]:
+                break
+    else:
+        rows = [{"key": k, "similarity": None, "distance": None}
+                for k in sorted(keys.values())[: opts["limit"]]]
+
+    # 4. cleanup + output
+    try:
+        st.unset(scratch)
+    except KeyError:
+        pass
+    if opts["json"]:
+        print(json.dumps(rows, indent=2))
+    else:
+        if not rows:
+            print("no matches")
+        for r in rows:
+            if r["similarity"] is None:
+                print(r["key"])
+            else:
+                print(f"{r['similarity']:+.4f}  {r['distance']:8.4f}  "
+                      f"{r['key']}")

@@ -1,0 +1,376 @@
+"""Event-driven micro-batching embedding daemon.
+
+The TPU-native replacement for the reference's splinference sidecar
+(splinference.cpp; SURVEY.md §2.2, §3.2).  Where the reference polls a
+signal counter every 50 ms and decodes ONE key at a time through llama.cpp
+on the CPU, this daemon:
+
+  - blocks on the store's event bus / signal group (C-side wait, no spin);
+  - drains the dirty mask per wake and gathers ALL pending candidates;
+  - snapshots (text, epoch) per candidate under the seqlock read protocol;
+  - pads each gather into per-bucket batches and runs one jit-compiled TPU
+    encoder call per bucket;
+  - commits the whole batch of vectors with a single epoch-gated native
+    call (spt_vec_commit_batch) — rows whose slot changed mid-flight are
+    dropped, mirroring the reference's post-decode epoch+2 verification
+    (splinference.cpp:275-287) but amortized over the batch.
+
+Protocol fidelity (all reference behaviors preserved):
+  label 0x1 wake, WAITING(0x40) clear, context-exceeded marker (zero
+  vector + diagnostic value + label 0x80 + bump), --vector-training
+  write-once gate, backfill sweep (SEQUENTIAL rebid + madvise), --oneshot,
+  cold-start epoch baselining of keys that already carry vectors.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import defaultdict
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .. import _native as N
+from ..store import Store
+from . import protocol as P
+
+log = logging.getLogger("libsplinter_tpu.embedder")
+
+# An encoder takes a list of texts and returns (B, dim) float32 vectors.
+EncoderFn = Callable[[Sequence[str]], np.ndarray]
+
+
+@dataclasses.dataclass
+class EmbedderStats:
+    wakes: int = 0
+    batches: int = 0
+    embedded: int = 0
+    raced: int = 0
+    skipped_write_once: int = 0
+    ctx_exceeded: int = 0
+    backfilled: int = 0
+
+
+class Embedder:
+    """The daemon object.  Drive it with run() (blocking loop), run_once()
+    (single drain — the reference's --oneshot), or embed tests through a
+    fake encoder_fn."""
+
+    def __init__(self, store: Store, encoder_fn: EncoderFn | None = None,
+                 *, model=None, tokenizer=None,
+                 max_ctx: int = 2048,
+                 vector_training: bool = False,
+                 group: int = P.GROUP_EMBED,
+                 batch_cap: int = 256):
+        self.store = store
+        self.max_ctx = max_ctx
+        self.vector_training = vector_training
+        self.group = group
+        self.batch_cap = batch_cap
+        self.stats = EmbedderStats()
+        self._known_epochs: dict[int, int] = {}
+        self._bid = -1
+        self._running = False
+
+        if encoder_fn is not None:
+            self.encoder_fn = encoder_fn
+            self._tok = tokenizer
+        else:
+            if model is None:
+                from ..models import EmbeddingModel, EncoderConfig
+                model = EmbeddingModel(
+                    EncoderConfig(out_dim=store.vec_dim, max_len=max_ctx))
+            if tokenizer is None:
+                from ..models import default_tokenizer
+                tokenizer = default_tokenizer(model.cfg.vocab_size)
+            self._model = model
+            self._tok = tokenizer
+            self.encoder_fn = self._model_encode
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self) -> None:
+        """Claim the shard, bind the wake label, arm/join the event bus,
+        and baseline epochs of already-embedded keys (cold start)."""
+        st = self.store
+        try:
+            self._bid = st.shard_claim(P.SHARD_EMBED, N.ADV_WILLNEED,
+                                       P.PRIO_EMBED_LIVE, 30_000_000)
+        except OSError:
+            self._bid = -1          # bid table full: run unadvised
+        st.watch_label_register(P.BIT_EMBED_REQ, self.group)
+        if st.header().bus_pid == 0:
+            st.bus_init()
+        else:
+            st.bus_open()
+        self._baseline_existing()
+
+    def _baseline_existing(self) -> None:
+        """Cold start: keys that already carry a non-zero vector are
+        treated as up to date at their current epoch
+        (reference: splinference.cpp:463-493)."""
+        st = self.store
+        vecs = st.vectors
+        live = np.abs(vecs).max(axis=1) > 0
+        for idx in np.nonzero(live)[0]:
+            self._known_epochs[int(idx)] = st.epoch_at(int(idx))
+
+    # -- encoding ----------------------------------------------------------
+
+    def _model_encode(self, texts: Sequence[str]) -> np.ndarray:
+        # tokenize first; the padding bucket comes from REAL token counts
+        # (a whitespace heuristic undercounts punctuation-dense text and
+        # would silently truncate it)
+        encs = [self._tok.encode(t, max_len=self._model.cfg.max_len)
+                for t in texts]
+        bucket = self._model.bucket_for(max(len(e) for e in encs))
+        ids = np.full((len(encs), bucket), self._tok.pad_id, np.int32)
+        lens = np.zeros(len(encs), np.int32)
+        for i, e in enumerate(encs):
+            e = e[:bucket]
+            ids[i, : len(e)] = e
+            lens[i] = len(e)
+        return self._model.encode_ids(ids, lens)
+
+    def _too_long(self, text: str) -> bool:
+        if self._tok is None:
+            return len(text.split()) >= int(self.max_ctx *
+                                            P.CTX_GUARD_FRACTION)
+        n = len(self._tok.encode(text))
+        return n >= int(self.max_ctx * P.CTX_GUARD_FRACTION)
+
+    # -- candidate gathering ----------------------------------------------
+
+    def _candidates(self, indices: Sequence[int]) -> list[int]:
+        st = self.store
+        out = []
+        for idx in indices:
+            labels = st.labels_at(idx)
+            if not labels & P.LBL_EMBED_REQ:
+                continue
+            e = st.epoch_at(idx)
+            if e & 1:
+                continue                      # writer active: next wake
+            if self._known_epochs.get(idx, -1) >= e:
+                continue                      # already embedded this epoch
+            out.append(idx)
+        return out
+
+    def _gather(self, rows: list[int]):
+        """Snapshot (text, epoch) per row under the read protocol."""
+        st = self.store
+        texts, epochs, keep = [], [], []
+        for idx in rows:
+            e = st.epoch_at(idx)
+            if e & 1:
+                continue
+            try:
+                raw = st.get_at(idx)
+            except Exception:
+                continue
+            if st.epoch_at(idx) != e:
+                continue                      # torn: re-queued by next wake
+            texts.append(raw.rstrip(b"\0").decode("utf-8", errors="replace"))
+            epochs.append(e)
+            keep.append(idx)
+        return keep, texts, epochs
+
+    # -- the drain ---------------------------------------------------------
+
+    def _mark_ctx_exceeded(self, idx: int) -> None:
+        st = self.store
+        key = st.key_at(idx)
+        if key is None:
+            return
+        st.vec_set_at(idx, np.zeros(st.vec_dim, np.float32))
+        st.set(key, P.CTX_EXCEEDED_DIAGNOSTIC)
+        st.label_or(key, P.LBL_CTX_EXCEEDED)
+        st.label_clear(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+        self._known_epochs[idx] = st.epoch_at(idx)
+        st.bump(key)
+        self.stats.ctx_exceeded += 1
+
+    def process_rows(self, rows: list[int]) -> int:
+        """Embed a set of candidate slot indices; returns committed count."""
+        st = self.store
+        rows = self._candidates(rows)
+        if not rows:
+            return 0
+        keep, texts, epochs = self._gather(rows)
+
+        # context-window guard (reference: splinference.cpp:226-233)
+        ok_rows, ok_texts, ok_epochs = [], [], []
+        for idx, text, e in zip(keep, texts, epochs):
+            if self._too_long(text):
+                self._mark_ctx_exceeded(idx)
+            else:
+                ok_rows.append(idx)
+                ok_texts.append(text)
+                ok_epochs.append(e)
+        if not ok_rows:
+            return 0
+
+        committed_total = 0
+        t_start = Store.now()
+        for lo in range(0, len(ok_rows), self.batch_cap):
+            sl = slice(lo, lo + self.batch_cap)
+            vecs = np.asarray(self.encoder_fn(ok_texts[sl]), np.float32)
+            results = st.vec_commit_batch(
+                np.asarray(ok_rows[sl], np.uint32),
+                np.asarray(ok_epochs[sl], np.uint64),
+                vecs, write_once=self.vector_training)
+            self.stats.batches += 1
+            for idx, e, r in zip(ok_rows[sl], ok_epochs[sl], results):
+                if r == 0:
+                    committed_total += 1
+                    expected = e + 2          # our commit's epoch bump
+                    key = st.key_at(idx)
+                    if key is not None:
+                        st.label_clear(key,
+                                       P.LBL_EMBED_REQ | P.LBL_WAITING)
+                        try:
+                            st.stamp(key, which=0,
+                                     ticks_ago=Store.now() - t_start)
+                            expected += 2     # stamp's epoch bump
+                        except Exception:
+                            pass
+                    # a content writer racing between our commit and here
+                    # must not be masked: only record the slot as done if
+                    # the epoch is exactly what OUR mutations produced
+                    # (the reference's epoch==pre+2 check,
+                    # splinference.cpp:275-287)
+                    if st.epoch_at(idx) == expected:
+                        self._known_epochs[idx] = expected
+                    else:
+                        self._known_epochs.pop(idx, None)
+                        if key is not None:
+                            try:  # restore the wake label we cleared
+                                st.label_or(key, P.LBL_EMBED_REQ)
+                            except KeyError:
+                                pass
+                elif r == -17:  # EEXIST: write-once gate
+                    self.stats.skipped_write_once += 1
+                    self._known_epochs[idx] = e
+                else:           # ESTALE: raced with a writer; retry later
+                    self.stats.raced += 1
+        self.stats.embedded += committed_total
+        if committed_total and P.KEY_DONE_LANE in st:
+            st.bump(P.KEY_DONE_LANE)
+        return committed_total
+
+    def run_once(self) -> int:
+        """One drain cycle (--oneshot): collect candidates from the dirty
+        mask + a label sweep and embed them."""
+        st = self.store
+        bits = st.drain_dirty()
+        rows = set(st.dirty_to_indices(bits))
+        rows.update(st.enumerate_indices(P.LBL_EMBED_REQ))
+        if self._bid >= 0:
+            try:
+                st.shard_rebid(self._bid)
+                st.madvise(self._bid, N.ADV_WILLNEED, timeout_ms=0)
+            except OSError:
+                pass
+        return self.process_rows(sorted(rows))
+
+    def run(self, *, idle_timeout_ms: int = 100,
+            stop_after: float | None = None) -> None:
+        """The daemon loop: block on the signal group, drain, repeat."""
+        self._running = True
+        last = self.store.signal_count(self.group)
+        deadline = (time.monotonic() + stop_after) if stop_after else None
+        next_sweep = time.monotonic() + 2.0
+        while self._running:
+            got = self.store.signal_wait(self.group, last,
+                                         timeout_ms=idle_timeout_ms)
+            now = time.monotonic()
+            if got is not None:
+                last = got
+                self.stats.wakes += 1
+                self.run_once()
+            elif now >= next_sweep:
+                # periodic reconciliation only — an idle daemon must not
+                # walk the whole label lane ten times a second
+                next_sweep = now + 2.0
+                self.run_once()
+            if deadline and now > deadline:
+                break
+
+    def stop(self) -> None:
+        self._running = False
+
+    # -- backfill ----------------------------------------------------------
+
+    def backfill(self) -> int:
+        """Sweep: embed every VARTEXT key whose vector is all zeros
+        (reference --backfill-text-keys, splinference.cpp:289-325).
+        Re-bids SEQUENTIAL at backfill priority for the sweep."""
+        st = self.store
+        bid = -1
+        try:
+            bid = st.shard_claim(P.SHARD_EMBED, N.ADV_SEQUENTIAL,
+                                 P.PRIO_EMBED_BACKFILL, 30_000_000)
+            st.madvise(bid, N.ADV_SEQUENTIAL, timeout_ms=0)
+        except OSError:
+            pass
+        vecs = st.vectors
+        zero = np.abs(vecs).max(axis=1) == 0
+        rows = []
+        for idx in np.nonzero(zero)[0]:
+            idx = int(idx)
+            if st.epoch_at(idx) == 0:
+                continue                      # empty slot
+            if not st.flags_at(idx) & N.T_VARTEXT:
+                continue
+            self._known_epochs.pop(idx, None)
+            key = st.key_at(idx)
+            if key is not None:
+                st.label_or(key, P.LBL_EMBED_REQ)
+            rows.append(idx)
+        n = self.process_rows(rows)
+        self.stats.backfilled += n
+        if bid >= 0:
+            st.shard_release(bid)
+        return n
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI entry: python -m libsplinter_tpu.engine.embedder --store NAME"""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="splinter-tpu embedding daemon (micro-batched TPU "
+                    "encoder over the store's event bus)")
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--persistent", action="store_true")
+    ap.add_argument("--oneshot", action="store_true")
+    ap.add_argument("--backfill-text-keys", action="store_true")
+    ap.add_argument("--vector-training", action="store_true",
+                    help="write-once vectors: never overwrite an existing "
+                         "non-zero embedding")
+    ap.add_argument("--max-ctx", type=int, default=2048)
+    ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    store = Store.open(args.store, persistent=args.persistent)
+    emb = Embedder(store, max_ctx=args.max_ctx,
+                   vector_training=args.vector_training)
+    emb.attach()
+    if args.backfill_text_keys:
+        n = emb.backfill()
+        log.info("backfill embedded %d keys", n)
+    if args.oneshot:
+        n = emb.run_once()
+        log.info("oneshot embedded %d keys", n)
+        return 0
+    try:
+        emb.run(idle_timeout_ms=args.idle_timeout_ms)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

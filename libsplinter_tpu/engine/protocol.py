@@ -1,0 +1,47 @@
+"""The coordination contract between clients and the inference daemons.
+
+Mirrors the reference's label state machine and well-known keys
+(splinterrc_example:83-85, splinter.h:477-491, splinference.cpp:50-89,
+splainference.cpp:51-109; SURVEY.md §2.2) so a client written against the
+reference's conventions finds identical behavior here.
+"""
+
+# --- bloom labels (bit masks) -------------------------------------------
+LBL_EMBED_REQ = 0x1            # "embed me" — wakes the embedding daemon
+LBL_WAITING = 0x40             # client is blocked on this key
+LBL_CTX_EXCEEDED = 0x80        # input exceeded the model context window
+LBL_CHUNK = 0x200              # ingest: document chunk
+LBL_META = 0x400               # ingest: metadata slot
+LBL_DEBUG = 0x1 << 59          # debug channel (sidecar watches this)
+LBL_INFER_REQ = 0x1 << 60      # "complete me" — wakes the completion daemon
+LBL_SERVICING = 0x1 << 61      # completion in progress
+LBL_READY = 0x1 << 62          # completion finished
+
+# --- bloom bit indices (for watch_label_register) -----------------------
+BIT_EMBED_REQ = 0
+BIT_WAITING = 6
+BIT_CTX_EXCEEDED = 7
+BIT_DEBUG = 59
+BIT_INFER_REQ = 60
+
+# --- signal groups -------------------------------------------------------
+GROUP_EMBED = 2                # embedding daemon wake group
+GROUP_INFER = 3                # completion daemon wake group
+GROUP_DEBUG = 63               # sidecar debug group
+
+# --- shard ids / priorities (cooperative advisement) --------------------
+SHARD_EMBED = 0x5F10
+SHARD_COMPLETE = 0x5F1A
+PRIO_EMBED_LIVE = 40
+PRIO_EMBED_BACKFILL = 20
+PRIO_COMPLETE = 200
+
+# --- well-known keys -----------------------------------------------------
+KEY_DONE_LANE = "__lane_dw_2"  # pulsed after each committed embedding
+KEY_DEBUG = "__debug"          # append-only shared debug log
+KEY_SYSTEM_PROMPT = "__system_prompt"
+SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
+
+# context guard: reject inputs >= this fraction of the model window
+CTX_GUARD_FRACTION = 0.9
+CTX_EXCEEDED_DIAGNOSTIC = b"[context exceeded: input too long for model]"

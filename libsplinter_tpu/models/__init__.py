@@ -1,0 +1,6 @@
+from .encoder import Encoder, EncoderConfig, EmbeddingModel
+from .tokenizer import (HashTokenizer, WordPieceTokenizer, batch_encode,
+                        default_tokenizer)
+
+__all__ = ["Encoder", "EncoderConfig", "EmbeddingModel", "HashTokenizer",
+           "WordPieceTokenizer", "batch_encode", "default_tokenizer"]

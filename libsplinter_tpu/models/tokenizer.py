@@ -1,0 +1,146 @@
+"""Host-side tokenizers for the embedding engine.
+
+The reference links llama.cpp and uses its GGUF tokenizer
+(splinference.cpp:209-217).  We tokenize on the TPU-VM host in Python:
+
+  - WordPieceTokenizer: a full WordPiece implementation (BERT family —
+    greedy longest-match-first with "##" continuations, basic whitespace +
+    punctuation pre-splitting, lowercasing).  Loads a standard vocab.txt.
+  - HashTokenizer: deterministic hashed-vocabulary fallback used when no
+    vocab file ships with the environment; keeps the whole pipeline
+    runnable and benchmarkable (embedding quality is weight-bound anyway
+    in this offline setting).
+"""
+from __future__ import annotations
+
+import hashlib
+import unicodedata
+from pathlib import Path
+
+import numpy as np
+
+CLS, SEP, PAD, UNK, MASK = "[CLS]", "[SEP]", "[PAD]", "[UNK]", "[MASK]"
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96 or
+            123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def basic_split(text: str, *, lower: bool = True) -> list[str]:
+    if lower:
+        text = text.lower()
+    text = unicodedata.normalize("NFD", text)
+    text = "".join(c for c in text if unicodedata.category(c) != "Mn")
+    out: list[str] = []
+    word: list[str] = []
+    for ch in text:
+        if ch.isspace():
+            if word:
+                out.append("".join(word))
+                word = []
+        elif _is_punct(ch):
+            if word:
+                out.append("".join(word))
+                word = []
+            out.append(ch)
+        else:
+            word.append(ch)
+    if word:
+        out.append("".join(word))
+    return out
+
+
+class WordPieceTokenizer:
+    def __init__(self, vocab_path: str | Path, *, lower: bool = True,
+                 max_chars_per_word: int = 100):
+        self.vocab: dict[str, int] = {}
+        with open(vocab_path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                self.vocab[line.rstrip("\n")] = i
+        self.lower = lower
+        self.max_chars = max_chars_per_word
+        self.cls_id = self.vocab[CLS]
+        self.sep_id = self.vocab[SEP]
+        self.pad_id = self.vocab.get(PAD, 0)
+        self.unk_id = self.vocab[UNK]
+        self.vocab_size = len(self.vocab)
+
+    def _wordpiece(self, word: str) -> list[int]:
+        if len(word) > self.max_chars:
+            return [self.unk_id]
+        ids: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = self.vocab[sub]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str, *, max_len: int | None = None) -> list[int]:
+        ids = [self.cls_id]
+        for w in basic_split(text, lower=self.lower):
+            ids.extend(self._wordpiece(w))
+        ids.append(self.sep_id)
+        if max_len is not None and len(ids) > max_len:
+            ids = ids[: max_len - 1] + [self.sep_id]
+        return ids
+
+
+class HashTokenizer:
+    """Deterministic fallback: word -> stable hash bucket.  Special ids:
+    0 PAD, 1 CLS, 2 SEP, 3 UNK; words occupy [4, vocab_size)."""
+
+    def __init__(self, vocab_size: int = 30528, *, lower: bool = True):
+        self.vocab_size = vocab_size
+        self.lower = lower
+        self.pad_id, self.cls_id, self.sep_id, self.unk_id = 0, 1, 2, 3
+
+    def _word_id(self, word: str) -> int:
+        h = hashlib.blake2s(word.encode(), digest_size=8).digest()
+        return 4 + int.from_bytes(h, "little") % (self.vocab_size - 4)
+
+    def encode(self, text: str, *, max_len: int | None = None) -> list[int]:
+        ids = [self.cls_id]
+        ids.extend(self._word_id(w)
+                   for w in basic_split(text, lower=self.lower))
+        ids.append(self.sep_id)
+        if max_len is not None and len(ids) > max_len:
+            ids = ids[: max_len - 1] + [self.sep_id]
+        return ids
+
+
+def default_tokenizer(vocab_size: int = 30528):
+    """WordPiece when a vocab file is discoverable, else HashTokenizer."""
+    for cand in (Path(__file__).parent / "vocab.txt",
+                 Path("/root/repo/assets/vocab.txt")):
+        if cand.exists():
+            return WordPieceTokenizer(cand)
+    return HashTokenizer(vocab_size)
+
+
+def batch_encode(tok, texts: list[str], bucket: int,
+                 pad_id: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Encode + pad a batch to `bucket` length.  Returns (ids, lengths)."""
+    pad = tok.pad_id if pad_id is None else pad_id
+    ids = np.full((len(texts), bucket), pad, dtype=np.int32)
+    lens = np.zeros(len(texts), dtype=np.int32)
+    for i, t in enumerate(texts):
+        e = tok.encode(t, max_len=bucket)
+        ids[i, : len(e)] = e
+        lens[i] = len(e)
+    return ids, lens

@@ -1,0 +1,5 @@
+from .similarity import (cosine_scores, cosine_topk, cosine_topk_batch,
+                         euclidean_distances)
+
+__all__ = ["cosine_scores", "cosine_topk", "cosine_topk_batch",
+           "euclidean_distances"]

@@ -1,0 +1,171 @@
+"""Pallas TPU similarity kernels over the store's vector lane.
+
+Replaces the reference CLI's brute-force scalar scan — cosine + euclidean
+per candidate computed one float at a time on the CPU
+(splinter_cli_cmd_search.c:43-62,374-412; SURVEY.md §3.4) — with a fused
+TPU kernel:
+
+  scores tile = (vectors tile  @  queries^T) combined with row norms,
+  bloom/regex prefilter applied as a -inf mask inside the kernel,
+  then jax.lax.top_k over the fused score matrix.
+
+The vector lane is the store's struct-of-arrays (nslots, dim) float32
+matrix, staged to HBM once and re-staged incrementally (dirty rows only)
+by the engine.  The kernel runs blocked over N rows; queries are small and
+live in VMEM for every block.
+
+On non-TPU backends the same math runs as plain jnp (XLA fuses it fine on
+CPU for tests); the pallas path is selected automatically on TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pad_to(x: jnp.ndarray, n: int, axis: int, value=0) -> jnp.ndarray:
+    pad = n - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _scores_kernel(vec_ref, q_ref, qnorm_ref, mask_ref, out_ref):
+    """One N-tile: fused cosine scores for all queries.
+
+    vec_ref:  (TN, D) f32 vectors tile
+    q_ref:    (Q, D)  f32 queries (replicated per block)
+    qnorm_ref:(1, Q)  f32 query L2 norms
+    mask_ref: (TN, 1) f32 1.0 = candidate, 0.0 = filtered out
+    out_ref:  (TN, Q) f32 cosine scores (NEG_INF where filtered)
+    """
+    v = vec_ref[:]
+    dots = jnp.dot(v, q_ref[:].T, preferred_element_type=jnp.float32)
+    vnorm = jnp.sqrt(jnp.sum(v * v, axis=1, keepdims=True))       # (TN,1)
+    denom = jnp.maximum(vnorm * qnorm_ref[:], 1e-12)              # (TN,Q)
+    cos = dots / denom
+    keep = mask_ref[:] > 0.0                                      # (TN,1)
+    out_ref[:] = jnp.where(keep, cos, NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _cosine_scores_pallas(vectors, queries, mask, *, block_n: int,
+                          interpret: bool):
+    n, d = vectors.shape
+    q = queries.shape[0]
+    qnorm = jnp.linalg.norm(queries, axis=-1, keepdims=True).T    # (1, Q)
+    grid = (n // block_n,)
+    return pl.pallas_call(
+        _scores_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, q), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_n, q), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, q), jnp.float32),
+        interpret=interpret,
+    )(vectors, queries, qnorm, mask)
+
+
+def _cosine_scores_jnp(vectors, queries, mask):
+    dots = vectors @ queries.T
+    vnorm = jnp.linalg.norm(vectors, axis=-1, keepdims=True)
+    qnorm = jnp.linalg.norm(queries, axis=-1, keepdims=True).T
+    cos = dots / jnp.maximum(vnorm * qnorm, 1e-12)
+    return jnp.where(mask > 0.0, cos, NEG_INF)
+
+
+def cosine_scores(vectors, queries, mask=None, *, block_n: int = 1024,
+                  use_pallas: bool | None = None) -> jnp.ndarray:
+    """(N, D) vectors x (Q, D) queries -> (N, Q) cosine scores.
+
+    mask: optional (N,) {0,1} prefilter (bloom/regex filtered candidates);
+    filtered rows score NEG_INF.  Rows of all zeros (empty slots) also
+    score NEG_INF via the norm guard + explicit zero-row mask.
+    """
+    vectors = jnp.asarray(vectors, jnp.float32)
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim == 1:
+        queries = queries[None, :]
+    n, d = vectors.shape
+    if mask is None:
+        mask_col = jnp.ones((n, 1), jnp.float32)
+    else:
+        mask_col = jnp.asarray(mask, jnp.float32).reshape(n, 1)
+    # zero vectors (un-embedded slots) are never candidates
+    nonzero = (jnp.abs(vectors).max(axis=1, keepdims=True) > 0)
+    mask_col = mask_col * nonzero.astype(jnp.float32)
+
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if not use_pallas:
+        return _cosine_scores_jnp(vectors, queries, mask_col)
+
+    # pad N to the block, Q to the lane width, D to 128 for clean tiling
+    q = queries.shape[0]
+    n_pad = -(-n // block_n) * block_n
+    q_pad = max(8, -(-q // 8) * 8)
+    d_pad = -(-d // 128) * 128
+    v = _pad_to(_pad_to(vectors, n_pad, 0), d_pad, 1)
+    qs = _pad_to(_pad_to(queries, q_pad, 0), d_pad, 1)
+    m = _pad_to(mask_col, n_pad, 0)
+    out = _cosine_scores_pallas(v, qs, m, block_n=min(block_n, n_pad),
+                                interpret=False)
+    return out[:n, :q]
+
+
+def euclidean_distances(vectors, queries, mask=None) -> jnp.ndarray:
+    """(N, D) x (Q, D) -> (N, Q) euclidean distances (inf where masked).
+    Computed from norms + dot so it reuses the same fused matmul shape."""
+    vectors = jnp.asarray(vectors, jnp.float32)
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim == 1:
+        queries = queries[None, :]
+    dots = vectors @ queries.T
+    v2 = jnp.sum(vectors * vectors, axis=-1, keepdims=True)
+    q2 = jnp.sum(queries * queries, axis=-1, keepdims=True).T
+    d2 = jnp.maximum(v2 + q2 - 2.0 * dots, 0.0)
+    dist = jnp.sqrt(d2)
+    if mask is not None:
+        keep = jnp.asarray(mask, jnp.float32).reshape(-1, 1) > 0
+        dist = jnp.where(keep, dist, jnp.inf)
+    return dist
+
+
+def cosine_topk(vectors, query, k: int, mask=None, *,
+                use_pallas: bool | None = None
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k most-similar rows for one query.  Returns (scores, indices),
+    scores NEG_INF-padded when fewer than k candidates exist."""
+    scores = cosine_scores(vectors, query, mask, use_pallas=use_pallas)
+    s = scores[:, 0]
+    k = min(k, s.shape[0])
+    top_s, top_i = jax.lax.top_k(s, k)
+    return np.asarray(top_s), np.asarray(top_i)
+
+
+def cosine_topk_batch(vectors, queries, k: int, mask=None, *,
+                      use_pallas: bool | None = None
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k per query.  Returns (Q, k) scores and indices."""
+    scores = cosine_scores(vectors, queries, mask, use_pallas=use_pallas)
+    k = min(k, scores.shape[0])
+    top_s, top_i = jax.lax.top_k(scores.T, k)
+    return np.asarray(top_s), np.asarray(top_i)

@@ -1,0 +1,10 @@
+from .mesh import (batch_sharding, make_mesh, param_shardings, replicated,
+                   shard_params)
+from .sharded_search import shard_vectors, sharded_topk
+from .train import TrainState, info_nce_loss, make_sharded_train_step, \
+    make_train_step
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params",
+           "param_shardings", "sharded_topk", "shard_vectors",
+           "TrainState", "info_nce_loss", "make_train_step",
+           "make_sharded_train_step"]

@@ -1,0 +1,79 @@
+"""Device mesh helpers for pod-scale execution.
+
+The reference is single-machine by design ("Multi-machine replication —
+use a real database", README.md:139-146); the scale-out path is net-new
+here (SURVEY.md §2.7): shard the arena per host, run the encoder and the
+similarity kernels over a jax.sharding.Mesh, and let XLA place
+collectives on ICI.
+
+Axes:
+  dp — data parallel (batch)
+  tp — tensor parallel (hidden/heads)
+  sp — sequence parallel (long-context; ring attention rides this axis)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int | None = None, tp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    """Build a (dp, tp, sp) mesh.  dp=None uses all remaining devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % (tp * sp):
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp*sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(f"dp*tp*sp={dp*tp*sp} != #devices={n}")
+    arr = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_pspec(path: tuple, leaf) -> P:
+    """Tensor-parallel partition spec for encoder parameters.
+
+    Megatron-style within each block: qkv/gate/up Dense kernels shard
+    their OUTPUT dim on tp (column parallel); out/down Dense kernels shard
+    their INPUT dim on tp (row parallel) so the pair needs one
+    psum per block, which XLA inserts from these shardings.  Embeddings
+    shard the vocab axis; everything else is replicated.
+    """
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    joined = "/".join(str(n) for n in names)
+    if leaf.ndim == 2:
+        if any(k in joined for k in ("qkv", "gate", "up")) \
+                and joined.endswith("kernel"):
+            return P(None, "tp")          # column parallel
+        if any(k in joined for k in ("attn/out", "mlp/down")) \
+                and joined.endswith("kernel"):
+            return P("tp", None)          # row parallel
+        if "tok_emb" in joined or "pos_emb" in joined:
+            return P("tp", None)          # vocab-sharded embedding
+    return P()
+
+
+def shard_params(params, mesh: Mesh):
+    """Apply param_pspec over the tree, returning sharded params."""
+    def place(path, leaf):
+        spec = param_pspec(path, leaf)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def param_shardings(params, mesh: Mesh):
+    """The NamedSharding tree matching shard_params (for jit in_shardings)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, param_pspec(path, leaf)),
+        params)

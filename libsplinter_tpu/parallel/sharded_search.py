@@ -1,0 +1,77 @@
+"""Pod-sharded similarity search: the arena's vector lane is sharded
+row-wise across the mesh; each device computes local top-k with the
+similarity kernel, then an all-gather over ICI merges the per-shard
+candidates — exactly the scale-out path the reference deliberately lacks
+(RDMA-hostile: splinter_stress.c:358-359; SURVEY.md §2.7 TPU mapping).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.similarity import cosine_scores
+
+
+def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
+                 axis: str = "dp") -> tuple[np.ndarray, np.ndarray]:
+    """Top-k over row-sharded vectors.
+
+    vectors: (N, D) logically; physically sharded (N/m, D) per device on
+    `axis`.  Returns (scores, GLOBAL indices) of the top k.
+    """
+    n, d = vectors.shape
+    m = mesh.shape[axis]
+    assert n % m == 0, "row count must divide the mesh axis"
+    local_n = n // m
+    # each shard can contribute at most local_n candidates; the merged
+    # result still returns up to min(k, n) rows
+    k_local = min(k, local_n)
+    k_final = min(k, n)
+
+    vspec = P(axis, None)
+    qspec = P()
+    mspec = P(axis)
+    out_spec = P()
+
+    def local_then_merge(v_local, q, m_local):
+        # local fused scores + top-k on this shard
+        scores = cosine_scores(v_local, q, m_local,
+                               use_pallas=jax.default_backend() == "tpu")
+        s, i = jax.lax.top_k(scores[:, 0], k_local)
+        # globalize indices by shard offset
+        shard = jax.lax.axis_index(axis)
+        gi = i + shard * local_n
+        # all-gather candidates over ICI, merge, re-top-k
+        all_s = jax.lax.all_gather(s, axis)      # (m, k_local)
+        all_i = jax.lax.all_gather(gi, axis)     # (m, k_local)
+        ms, mi = jax.lax.top_k(all_s.reshape(-1), k_final)
+        return ms, all_i.reshape(-1)[mi]
+
+    fn = shard_map(
+        local_then_merge, mesh=mesh,
+        in_specs=(vspec, qspec, mspec),
+        out_specs=(out_spec, out_spec),
+        check_vma=False,
+    )
+    if mask is None:
+        mask = jnp.ones((n,), jnp.float32)
+    query = jnp.asarray(query, jnp.float32)
+    if query.ndim == 1:
+        query = query[None, :]
+    s, i = jax.jit(fn)(jnp.asarray(vectors, jnp.float32), query,
+                       jnp.asarray(mask, jnp.float32))
+    return np.asarray(s), np.asarray(i)
+
+
+def shard_vectors(mesh: Mesh, vectors, axis: str = "dp"):
+    """Place a host (N, D) matrix row-sharded over the mesh axis."""
+    return jax.device_put(
+        vectors, NamedSharding(mesh, P(axis, None)))

@@ -3,11 +3,15 @@ sharding is validated without TPU hardware; the driver separately
 dry-run-compiles the multichip path) and provide per-test stores."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# The environment pre-sets JAX_PLATFORMS=axon (the tunneled TPU) and pytest
+# plugin autoload imports jax before this conftest runs — but the backend
+# initializes lazily, so jax.config still wins here.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import uuid
 

@@ -1,0 +1,239 @@
+"""CLI workflow tests — UX-level parity with splinterctl_tests.sh
+(init/set/get/head/list/type/unset/config/export/bump/append/uuid/math/
+label/shard/search), driven through the real entry point."""
+import json
+import os
+import threading
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.cli.main import main
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.embedder import Embedder
+
+
+@pytest.fixture
+def cli(monkeypatch):
+    name = f"/spt-cli-{os.getpid()}-{uuidlib.uuid4().hex[:8]}"
+    monkeypatch.setenv("SPTPU_DEFAULT_STORE", name)
+    monkeypatch.delenv("SPTPU_NS_PREFIX", raising=False)
+
+    def run(*args):
+        return main(list(args))
+
+    run("init", "128", "512", "32")
+    yield run, name
+    Store.unlink(name)
+
+
+def out_of(capsys):
+    return capsys.readouterr().out
+
+
+def test_set_get(cli, capsys):
+    run, _ = cli
+    assert run("set", "greet", "hello", "world") == 0
+    assert run("get", "greet") == 0
+    assert out_of(capsys).endswith("hello world\n")
+
+
+def test_get_missing_errors(cli, capsys):
+    run, _ = cli
+    assert run("get", "nope") == 1
+
+
+def test_append(cli, capsys):
+    run, _ = cli
+    run("set", "log", "a")
+    run("append", "log", "b")
+    run("get", "log")
+    assert out_of(capsys).endswith("ab\n")
+
+
+def test_list_regex(cli, capsys):
+    run, _ = cli
+    run("set", "apple", "1")
+    run("set", "banana", "2")
+    run("list", "^app")
+    out = out_of(capsys)
+    assert "apple" in out and "banana" not in out
+
+
+def test_type_roundtrip(cli, capsys):
+    run, _ = cli
+    run("set", "t", "text")
+    run("type", "t", "VARTEXT")
+    run("type", "t")
+    assert "VARTEXT" in out_of(capsys)
+
+
+def test_math(cli, capsys):
+    run, _ = cli
+    run("set", "n", "41")
+    run("type", "n", "BIGUINT")
+    run("math", "n", "inc")
+    assert out_of(capsys).strip().endswith("42")
+
+
+def test_label_names_from_rc(cli, capsys, tmp_path, monkeypatch):
+    rc = tmp_path / "rc"
+    rc.write_text("hot = 0x10\n# comment\n")
+    monkeypatch.setenv("SPTPU_RC", str(rc))
+    run, _ = cli
+    run("set", "k", "v")
+    run("label", "k", "+hot")
+    run("label", "k")
+    assert "0x" in out_of(capsys)
+    st = Store.open(os.environ["SPTPU_DEFAULT_STORE"])
+    assert st.labels("k") == 0x10
+    st.close()
+
+
+def test_head_shows_vector_stats(cli, capsys):
+    run, name = cli
+    run("set", "h", "x")
+    st = Store.open(name)
+    st.vec_set("h", np.ones(32, np.float32))
+    st.close()
+    run("head", "h")
+    out = out_of(capsys)
+    assert "epoch" in out and "|v|=" in out
+
+
+def test_config_dump_and_purge(cli, capsys):
+    run, _ = cli
+    run("config")
+    out = out_of(capsys)
+    assert "geometry" in out and "128 slots" in out
+    run("config", "purge")
+    assert "swept" in out_of(capsys)
+
+
+def test_unset_tandem(cli, capsys):
+    run, name = cli
+    st = Store.open(name)
+    st.tandem_set("doc", [b"a", b"b", b"c"])
+    st.close()
+    run("orders", "doc")
+    assert "3 orders" in out_of(capsys)
+    run("unset", "doc", "--tandem")
+    assert "removed 3" in out_of(capsys)
+
+
+def test_shard_workflow(cli, capsys):
+    run, _ = cli
+    run("shard", "claim", "0x5F10", "40")
+    assert "bid" in out_of(capsys)
+    run("shard", "who")
+    assert "sovereign" in out_of(capsys)
+    run("shard", "table")
+    assert "5f10" in out_of(capsys).lower()
+    run("shard", "advise", "0", "willneed")
+    assert "advised" in out_of(capsys)
+    run("shard", "release", "0")
+    run("shard", "who")
+    assert "no sovereign" in out_of(capsys)
+
+
+def test_uuid(cli, capsys):
+    run, name = cli
+    run("uuid", "myid")
+    u = out_of(capsys).strip()
+    st = Store.open(name)
+    assert st.get_str("myid") == u
+    st.close()
+
+
+def test_ns_prefix(cli, capsys, monkeypatch):
+    run, name = cli
+    monkeypatch.setenv("SPTPU_NS_PREFIX", "app1/")
+    run("set", "k", "scoped")
+    st = Store.open(name)
+    assert st.get_str("app1/k") == "scoped"
+    st.close()
+
+
+def test_ingest_and_export(cli, capsys, tmp_path):
+    run, name = cli
+    doc = tmp_path / "doc.txt"
+    doc.write_text("lorem ipsum " * 200)   # forces multiple chunks
+    run("ingest", "docs/d1", str(doc), "--no-embed")
+    out = out_of(capsys)
+    assert "ingested" in out
+    st = Store.open(name)
+    n = st.tandem_count("docs/d1")
+    assert n >= 2
+    meta = json.loads(st.get_str("docs/d1.meta"))
+    assert meta["chunks"] == n
+    assert st.labels("docs/d1") & P.LBL_CHUNK
+    st.close()
+    run("export", "--regex", "docs/")
+    recs = json.loads(out_of(capsys))
+    keys = {r["key"] for r in recs}
+    assert "docs/d1" in keys and "docs/d1.meta" in keys
+    # epoch-descending order
+    epochs = [r["epoch"] for r in recs]
+    assert epochs == sorted(epochs, reverse=True)
+
+
+def fake_encoder(texts):
+    out = np.zeros((len(texts), 32), np.float32)
+    for i, t in enumerate(texts):
+        h = abs(hash(t)) % 997
+        rng = np.random.default_rng(h)
+        out[i] = rng.normal(size=32)
+        out[i] /= np.linalg.norm(out[i])
+    return out
+
+
+def test_search_end_to_end(cli, capsys):
+    """search writes the scratch key, the daemon embeds it, and ranked
+    results come back — the reference's demo loop through the CLI."""
+    run, name = cli
+    st = Store.open(name)
+    emb = Embedder(st, encoder_fn=fake_encoder, max_ctx=512)
+    emb.attach()
+    docs = {f"doc{i}": f"document number {i}" for i in range(8)}
+    for k, v in docs.items():
+        st.set(k, v)
+        st.label_or(k, P.LBL_EMBED_REQ)
+    emb.run_once()
+
+    stop = threading.Event()
+
+    def daemon():
+        while not stop.is_set():
+            emb.run_once()
+            stop.wait(0.01)
+
+    t = threading.Thread(target=daemon)
+    t.start()
+    try:
+        rc = run("search", "--json", "--limit", "3", "document number 3")
+        assert rc == 0
+        rows = json.loads(out_of(capsys))
+        assert len(rows) == 3
+        assert rows[0]["key"] == "doc3"     # same text -> same fake vec
+        assert rows[0]["similarity"] == pytest.approx(1.0, abs=1e-4)
+        assert rows[0]["distance"] == pytest.approx(0.0, abs=1e-2)
+    finally:
+        stop.set()
+        t.join()
+    # scratch key cleaned up
+    assert not any(k.startswith(P.SEARCH_SCRATCH_PREFIX) for k in st.list())
+    st.close()
+
+
+def test_search_degrades_without_daemon(cli, capsys):
+    run, name = cli
+    st = Store.open(name)
+    st.set("alone", "no daemon here")
+    st.close()
+    rc = run("search", "--timeout", "50", "--json", "anything")
+    assert rc == 0
+    rows = json.loads(out_of(capsys))
+    assert any(r["key"] == "alone" for r in rows)
+    assert all(r["similarity"] is None for r in rows)

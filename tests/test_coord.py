@@ -189,31 +189,38 @@ def test_election_no_bids(store):
     assert store.shard_election() is None
 
 
+def _now_us():
+    return Store.now() // Store.ticks_per_us()
+
+
 def test_election_priority_wins(store):
+    now = _now_us()
     lo = store.shard_claim_ex(1, pid=100, intent=WILLNEED, priority=10,
-                              duration_us=HOUR_US, claimed_at_us=1000)
+                              duration_us=HOUR_US, claimed_at_us=now)
     hi = store.shard_claim_ex(2, pid=200, intent=WILLNEED, priority=200,
-                              duration_us=HOUR_US, claimed_at_us=2000)
+                              duration_us=HOUR_US, claimed_at_us=now + 1000)
     assert store.shard_election() == hi
     store.shard_release(hi)
     assert store.shard_election() == lo
 
 
 def test_election_tie_earliest_claim(store):
+    now = _now_us()
     late = store.shard_claim_ex(1, pid=100, intent=WILLNEED, priority=50,
-                                duration_us=HOUR_US, claimed_at_us=5000)
+                                duration_us=HOUR_US, claimed_at_us=now + 5000)
     early = store.shard_claim_ex(2, pid=200, intent=WILLNEED, priority=50,
-                                 duration_us=HOUR_US, claimed_at_us=1000)
+                                 duration_us=HOUR_US, claimed_at_us=now)
     assert store.shard_election() == early
     store.shard_release(early)
     assert store.shard_election() == late
 
 
 def test_election_tie_lowest_pid(store):
+    now = _now_us()
     b1 = store.shard_claim_ex(1, pid=999, intent=WILLNEED, priority=50,
-                              duration_us=HOUR_US, claimed_at_us=1000)
+                              duration_us=HOUR_US, claimed_at_us=now)
     b2 = store.shard_claim_ex(2, pid=111, intent=WILLNEED, priority=50,
-                              duration_us=HOUR_US, claimed_at_us=1000)
+                              duration_us=HOUR_US, claimed_at_us=now)
     assert store.shard_election() == b2
     store.shard_release(b2)
     assert store.shard_election() == b1
@@ -225,18 +232,18 @@ def test_expired_bid_cannot_win(store):
                                 claimed_at_us=1000)
     live = store.shard_claim_ex(2, pid=200, intent=WILLNEED, priority=10,
                                 duration_us=HOUR_US,
-                                claimed_at_us=Store.now() //
-                                Store.ticks_per_us())
+                                claimed_at_us=_now_us())
     assert store.shard_election() == live
     assert not store.bid_info(dead).live
 
 
 def test_dontneed_bumper_cannot_beat_live_real_bid(store):
+    now = _now_us()
     bumper = store.shard_claim_ex(1, pid=100, intent=DONTNEED,
                                   priority=255, duration_us=HOUR_US,
-                                  claimed_at_us=1000)
+                                  claimed_at_us=now)
     real = store.shard_claim_ex(2, pid=200, intent=WILLNEED, priority=1,
-                                duration_us=HOUR_US, claimed_at_us=2000)
+                                duration_us=HOUR_US, claimed_at_us=now + 1000)
     assert store.shard_election() == real
     # once the real bid is gone the bumper may win
     store.shard_release(real)
@@ -253,10 +260,11 @@ def test_rebid_revives(store):
 
 
 def test_enospc_on_33rd_bid(store):
+    now = _now_us()
     for i in range(32):
         assert store.shard_claim_ex(i, pid=100 + i, intent=WILLNEED,
                                     priority=1, duration_us=HOUR_US,
-                                    claimed_at_us=1000) >= 0
+                                    claimed_at_us=now) >= 0
     with pytest.raises(OSError):
         store.shard_claim(999, WILLNEED, 1, HOUR_US)
 
@@ -264,7 +272,7 @@ def test_enospc_on_33rd_bid(store):
 def test_release_frees_slot(store):
     for i in range(32):
         store.shard_claim_ex(i, pid=100 + i, intent=WILLNEED, priority=1,
-                             duration_us=HOUR_US, claimed_at_us=1000)
+                             duration_us=HOUR_US, claimed_at_us=_now_us())
     store.shard_release(17)
     assert store.shard_claim(1000, WILLNEED, 1, HOUR_US) == 17
 
@@ -278,8 +286,7 @@ def test_madvise_sovereign_issues(store):
 def test_madvise_non_sovereign_defers(store):
     # a forged higher-priority bid holds sovereignty
     store.shard_claim_ex(1, pid=424242, intent=WILLNEED, priority=250,
-                         duration_us=HOUR_US,
-                         claimed_at_us=Store.now() // Store.ticks_per_us())
+                         duration_us=HOUR_US, claimed_at_us=_now_us())
     mine = store.shard_claim(2, WILLNEED, priority=1, duration_us=HOUR_US)
     assert store.madvise(mine, sp.ADV_WILLNEED, timeout_ms=0) is False
     # bounded wait also times out while the usurper is live
@@ -289,7 +296,7 @@ def test_madvise_non_sovereign_defers(store):
 def test_madvise_requires_own_live_bid(store):
     forged = store.shard_claim_ex(1, pid=424242, intent=WILLNEED,
                                   priority=1, duration_us=HOUR_US,
-                                  claimed_at_us=1000)
+                                  claimed_at_us=_now_us())
     with pytest.raises(OSError):
         store.madvise(forged, sp.ADV_WILLNEED, timeout_ms=0)
 
@@ -312,7 +319,7 @@ def test_bid_table_dump(store):
 
 def test_forged_multiprocess_election_matrix(store):
     """Three 'processes' bid; every observer computes the same winner."""
-    now_us = Store.now() // Store.ticks_per_us()
+    now_us = _now_us()
     store.shard_claim_ex(0x5F10, pid=1001, intent=WILLNEED, priority=40,
                          duration_us=HOUR_US, claimed_at_us=now_us)
     store.shard_claim_ex(0x5F10, pid=1002, intent=SEQ, priority=20,

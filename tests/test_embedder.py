@@ -1,0 +1,180 @@
+"""Daemon-level tests of the embedding engine with a fake encoder — the
+test tier the reference lacks entirely (SURVEY.md §4 'Daemon-level
+testing: none automated — a gap we should close with a fake-encoder
+fixture')."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import libsplinter_tpu as sp
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.embedder import Embedder
+
+
+def fake_encoder(texts):
+    """Deterministic 'embedding': vec[0] = len(text), vec[1] = word count."""
+    out = np.zeros((len(texts), 32), np.float32)
+    for i, t in enumerate(texts):
+        out[i, 0] = len(t)
+        out[i, 1] = len(t.split())
+        out[i, 2] = 1.0
+    return out
+
+
+@pytest.fixture
+def embedder(store):
+    emb = Embedder(store, encoder_fn=fake_encoder, max_ctx=64)
+    emb.attach()
+    return emb
+
+
+def _request(store, key, text):
+    store.set(key, text)
+    store.set_type(key, sp.T_VARTEXT)
+    store.label_or(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+    store.bump(key)
+
+
+def test_oneshot_embeds_labelled_key(store, embedder):
+    _request(store, "doc1", "hello tpu world")
+    n = embedder.run_once()
+    assert n == 1
+    v = store.vec_get("doc1")
+    assert v[0] == len("hello tpu world")
+    assert v[1] == 3
+    # WAITING and EMBED_REQ cleared after the vector lands
+    assert store.labels("doc1") & (P.LBL_EMBED_REQ | P.LBL_WAITING) == 0
+
+
+def test_batch_drain_embeds_all(store, embedder):
+    for i in range(20):
+        _request(store, f"doc{i}", f"text number {i}")
+    n = embedder.run_once()
+    assert n == 20
+    assert embedder.stats.batches == 1        # one micro-batch, not 20
+    for i in range(20):
+        assert store.vec_get(f"doc{i}")[2] == 1.0
+
+
+def test_unlabelled_keys_ignored(store, embedder):
+    store.set("plain", "no label here")
+    n = embedder.run_once()
+    assert n == 0
+    assert store.vec_get("plain")[2] == 0.0
+
+
+def test_no_rembedding_at_same_epoch(store, embedder):
+    _request(store, "doc", "stable text")
+    assert embedder.run_once() == 1
+    store.label_or("doc", P.LBL_EMBED_REQ)    # re-label without rewrite
+    assert embedder.run_once() == 0           # epoch unchanged -> skip
+
+
+def test_rewrite_triggers_rembedding(store, embedder):
+    _request(store, "doc", "v1")
+    assert embedder.run_once() == 1
+    _request(store, "doc", "version two")
+    assert embedder.run_once() == 1
+    assert store.vec_get("doc")[0] == len("version two")
+
+
+def test_ctx_exceeded_protocol(store, embedder):
+    long_text = "word " * 100                  # >= 0.9 * max_ctx=64 words
+    _request(store, "huge", long_text)
+    n = embedder.run_once()
+    assert n == 0
+    assert embedder.stats.ctx_exceeded == 1
+    # marker label set, request labels cleared, vector zeroed, diagnostic
+    labels = store.labels("huge")
+    assert labels & P.LBL_CTX_EXCEEDED
+    assert not labels & P.LBL_EMBED_REQ
+    assert store.vec_get("huge")[2] == 0.0
+    assert store.get("huge") == P.CTX_EXCEEDED_DIAGNOSTIC
+
+
+def test_vector_training_write_once(store):
+    emb = Embedder(store, encoder_fn=fake_encoder, max_ctx=64,
+                   vector_training=True)
+    emb.attach()
+    _request(store, "doc", "first")
+    assert emb.run_once() == 1
+    first = store.vec_get("doc").copy()
+    _request(store, "doc", "second version")
+    assert emb.run_once() == 0                 # write-once gate holds
+    assert emb.stats.skipped_write_once == 1
+    np.testing.assert_array_equal(store.vec_get("doc"), first)
+
+
+def test_raced_write_not_committed(store, embedder):
+    """A slot rewritten between gather and commit must not get the stale
+    vector (the reference's epoch+2 check, batched)."""
+    _request(store, "doc", "short")
+    rows = [store.find_index("doc")]
+    keep, texts, epochs = embedder._gather(rows)
+    store.set("doc", "changed meanwhile!")     # invalidate the epoch
+    res = store.vec_commit_batch(
+        np.asarray(keep, np.uint32), np.asarray(epochs, np.uint64),
+        fake_encoder(texts))
+    assert res[0] != 0
+    assert store.vec_get("doc")[2] == 0.0
+
+
+def test_backfill_sweep(store, embedder):
+    for i in range(5):
+        store.set(f"bf{i}", f"backfill {i}")
+        store.set_type(f"bf{i}", sp.T_VARTEXT)
+    store.set("notext", b"binary")             # not VARTEXT: skipped
+    n = embedder.backfill()
+    assert n == 5
+    for i in range(5):
+        assert store.vec_get(f"bf{i}")[2] == 1.0
+    assert store.vec_get("notext")[2] == 0.0
+
+
+def test_cold_start_baseline(store):
+    """Keys already carrying vectors are not re-embedded on daemon start
+    (reference: splinference.cpp:463-493)."""
+    store.set("old", "already embedded")
+    store.label_or("old", P.LBL_EMBED_REQ)
+    store.vec_set("old", np.full(32, 9.0, np.float32))
+    emb = Embedder(store, encoder_fn=fake_encoder, max_ctx=64)
+    emb.attach()
+    assert emb.run_once() == 0
+    assert store.vec_get("old")[0] == 9.0
+
+
+def test_done_lane_pulsed(store, embedder):
+    store.set(P.KEY_DONE_LANE, b"")
+    store.watch_register(P.KEY_DONE_LANE, 5)
+    _request(store, "doc", "ping")
+    embedder.run_once()
+    assert store.signal_count(5) >= 1
+
+
+def test_event_driven_loop_end_to_end(store):
+    """Full daemon loop in a thread: client request -> signal wake ->
+    batched embed -> client observes vector."""
+    emb = Embedder(store, encoder_fn=fake_encoder, max_ctx=64)
+    emb.attach()
+    t = threading.Thread(target=emb.run,
+                         kwargs=dict(idle_timeout_ms=50, stop_after=3.0))
+    t.start()
+    try:
+        time.sleep(0.05)
+        client = Store.open(store.name)
+        _request(client, "live-doc", "event driven embedding")
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            if client.vec_get("live-doc")[2] == 1.0:
+                break
+            time.sleep(0.01)
+        v = client.vec_get("live-doc")
+        client.close()
+        assert v[0] == len("event driven embedding")
+        assert emb.stats.wakes >= 1
+    finally:
+        emb.stop()
+        t.join()

@@ -1,0 +1,105 @@
+"""Encoder model: shapes, determinism, masking invariance, bucketing,
+tokenizer behavior."""
+import numpy as np
+import pytest
+
+from libsplinter_tpu.models import (EmbeddingModel, EncoderConfig,
+                                    HashTokenizer, batch_encode,
+                                    default_tokenizer)
+from libsplinter_tpu.models.tokenizer import WordPieceTokenizer, basic_split
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = EncoderConfig.tiny(out_dim=32)
+    return EmbeddingModel(cfg, buckets=(16, 32, 64))
+
+
+def test_encode_shape_and_norm(model):
+    ids = np.random.default_rng(0).integers(0, 1024, (4, 16)).astype(np.int32)
+    lens = np.array([16, 10, 5, 1], dtype=np.int32)
+    out = model.encode_ids(ids, lens)
+    assert out.shape == (4, 32)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=-1), 1.0, atol=1e-4)
+
+
+def test_encode_deterministic(model):
+    ids = np.ones((2, 16), np.int32)
+    lens = np.array([16, 16], np.int32)
+    a = model.encode_ids(ids, lens)
+    b = model.encode_ids(ids, lens)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_padding_invariance(model):
+    """Padding tokens beyond the valid length must not change the vector."""
+    rng = np.random.default_rng(1)
+    base = rng.integers(4, 1024, 10).astype(np.int32)
+    a = np.zeros((1, 16), np.int32); a[0, :10] = base
+    b = np.zeros((1, 32), np.int32); b[0, :10] = base
+    b[0, 10:] = 999  # garbage in the padded tail
+    va = model.encode_ids(a, np.array([10], np.int32))
+    vb = model.encode_ids(b, np.array([10], np.int32))
+    np.testing.assert_allclose(va, vb, atol=2e-2)  # bf16 tolerance
+
+
+def test_bucket_for(model):
+    assert model.bucket_for(3) == 16
+    assert model.bucket_for(16) == 16
+    assert model.bucket_for(17) == 32
+    assert model.bucket_for(999) == 64  # clamps to largest
+
+
+def test_bert_variant_runs():
+    cfg = EncoderConfig.tiny(variant="bert", out_dim=16)
+    m = EmbeddingModel(cfg, buckets=(16,))
+    out = m.encode_ids(np.ones((1, 16), np.int32),
+                       np.array([8], np.int32))
+    assert out.shape == (1, 16)
+
+
+def test_basic_split():
+    assert basic_split("Hello, world!") == ["hello", ",", "world", "!"]
+    assert basic_split("a  b\tc\n") == ["a", "b", "c"]
+
+
+def test_hash_tokenizer_deterministic():
+    t = HashTokenizer(1024)
+    a = t.encode("the quick brown fox")
+    b = t.encode("the quick brown fox")
+    assert a == b
+    assert a[0] == t.cls_id and a[-1] == t.sep_id
+    assert all(4 <= i < 1024 for i in a[1:-1])
+
+
+def test_hash_tokenizer_truncation():
+    t = HashTokenizer(1024)
+    ids = t.encode("w " * 100, max_len=16)
+    assert len(ids) == 16
+    assert ids[-1] == t.sep_id
+
+
+def test_wordpiece(tmp_path):
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "un", "##aff", "##able", "hello", "world", ","]
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(vocab) + "\n")
+    t = WordPieceTokenizer(p)
+    ids = t.encode("unaffable hello, world")
+    toks = [vocab[i] for i in ids]
+    assert toks == ["[CLS]", "un", "##aff", "##able", "hello", ",",
+                    "world", "[SEP]"]
+    assert t.encode("xyzzy")[1] == t.unk_id
+
+
+def test_batch_encode_padding():
+    t = HashTokenizer(1024)
+    ids, lens = batch_encode(t, ["one two", "a b c d e"], bucket=16)
+    assert ids.shape == (2, 16)
+    assert lens[0] == 4 and lens[1] == 7  # CLS + words + SEP
+    assert (ids[0, lens[0]:] == t.pad_id).all()
+
+
+def test_default_tokenizer_falls_back():
+    t = default_tokenizer(2048)
+    assert t.encode("anything")  # runs regardless of vocab presence

@@ -1,0 +1,81 @@
+"""Mesh/sharding layer on the virtual 8-device CPU mesh: tp param
+sharding, dp batch sharding, sharded train step, sharded top-k with
+all-gather merge."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from libsplinter_tpu.models import EncoderConfig
+from libsplinter_tpu.parallel import (make_mesh, make_sharded_train_step,
+                                      make_train_step, shard_vectors,
+                                      sharded_topk)
+
+
+def test_make_mesh_shapes():
+    m = make_mesh(dp=4, tp=2)
+    assert m.shape == {"dp": 4, "tp": 2, "sp": 1}
+    m2 = make_mesh(tp=2)          # dp inferred = 4
+    assert m2.shape["dp"] == 4
+    with pytest.raises(ValueError):
+        make_mesh(dp=3, tp=3)
+
+
+def test_train_step_single_device():
+    cfg = EncoderConfig.tiny(out_dim=16)
+    init_fn, step_fn = make_train_step(cfg)
+    ids = np.ones((4, 16), np.int32)
+    mask = np.ones((4, 16), bool)
+    state = init_fn(jax.random.PRNGKey(0), ids, mask)
+    batch = {"ids_a": ids, "mask_a": mask,
+             "ids_b": ids + 1, "mask_b": mask}
+    state2, loss = jax.jit(step_fn)(state, batch)
+    assert np.isfinite(float(loss))
+    assert int(state2.step) == 1
+
+
+def test_sharded_train_step_dp_tp():
+    """Full train step jit over a 4x2 (dp, tp) mesh; params tp-sharded,
+    batch dp-sharded; one step must run and produce a finite loss."""
+    cfg = EncoderConfig.tiny(out_dim=16)
+    mesh = make_mesh(dp=4, tp=2)
+    sharded_init = make_sharded_train_step(cfg, mesh)
+    ids = np.ones((8, 16), np.int32)
+    mask = np.ones((8, 16), bool)
+    state, step = sharded_init(jax.random.PRNGKey(0), ids[:1], mask[:1])
+    batch = {"ids_a": ids, "mask_a": mask,
+             "ids_b": (ids + 1) % cfg.vocab_size, "mask_b": mask}
+    state2, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+    # a tp-sharded kernel is actually distributed over the tp axis
+    qkv = state2.params["params"]["layer_0"]["attn"]["qkv"]["kernel"]
+    spec = qkv.sharding.spec
+    assert "tp" in str(spec)
+    # second step reuses the compiled program
+    state3, loss3 = step(state2, batch)
+    assert int(state3.step) == 2
+
+
+def test_sharded_topk_matches_dense():
+    mesh = make_mesh(dp=8)
+    rng = np.random.default_rng(0)
+    vectors = rng.normal(size=(1024, 64)).astype(np.float32)
+    query = rng.normal(size=64).astype(np.float32)
+    v_sharded = shard_vectors(mesh, vectors)
+    s, i = sharded_topk(mesh, v_sharded, query, k=10)
+    # dense reference
+    vn = vectors / np.linalg.norm(vectors, axis=1, keepdims=True)
+    qn = query / np.linalg.norm(query)
+    ref = np.argsort(-(vn @ qn))[:10]
+    np.testing.assert_array_equal(np.sort(i), np.sort(ref))
+
+
+def test_sharded_topk_mask():
+    mesh = make_mesh(dp=8)
+    rng = np.random.default_rng(1)
+    vectors = rng.normal(size=(512, 32)).astype(np.float32)
+    query = vectors[100]
+    mask = np.ones(512, np.float32)
+    mask[100] = 0.0
+    s, i = sharded_topk(mesh, vectors, query, k=5, mask=mask)
+    assert 100 not in i

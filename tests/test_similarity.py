@@ -1,0 +1,111 @@
+"""Similarity ops: correctness vs numpy reference, masking, zero-vector
+exclusion, pallas-interpret parity with the jnp path."""
+import numpy as np
+import pytest
+
+from libsplinter_tpu.ops import (cosine_scores, cosine_topk,
+                                 cosine_topk_batch, euclidean_distances)
+from libsplinter_tpu.ops.similarity import NEG_INF
+
+
+def _np_cosine(vectors, query):
+    vn = np.linalg.norm(vectors, axis=-1)
+    qn = np.linalg.norm(query)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return (vectors @ query) / np.maximum(vn * qn, 1e-12)
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(42)
+    vectors = rng.normal(size=(200, 64)).astype(np.float32)
+    query = rng.normal(size=64).astype(np.float32)
+    return vectors, query
+
+
+def test_scores_match_numpy(data):
+    vectors, query = data
+    got = np.asarray(cosine_scores(vectors, query))[:, 0]
+    np.testing.assert_allclose(got, _np_cosine(vectors, query),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_topk_order(data):
+    vectors, query = data
+    scores, idx = cosine_topk(vectors, query, k=10)
+    ref = _np_cosine(vectors, query)
+    np.testing.assert_array_equal(idx, np.argsort(-ref)[:10])
+    assert (np.diff(scores) <= 1e-7).all()
+
+
+def test_exact_match_wins():
+    rng = np.random.default_rng(0)
+    vectors = rng.normal(size=(50, 32)).astype(np.float32)
+    query = vectors[17] * 3.0  # same direction, different magnitude
+    scores, idx = cosine_topk(vectors, query, k=1)
+    assert idx[0] == 17
+    assert scores[0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_mask_excludes(data):
+    vectors, query = data
+    mask = np.ones(200, np.float32)
+    ref = _np_cosine(vectors, query)
+    best = int(np.argmax(ref))
+    mask[best] = 0.0
+    _, idx = cosine_topk(vectors, query, k=1, mask=mask)
+    assert idx[0] != best
+    assert idx[0] == np.argsort(-np.where(mask > 0, ref, -np.inf))[0]
+
+
+def test_zero_vectors_excluded(data):
+    vectors, query = data
+    vectors = vectors.copy()
+    vectors[5] = 0.0  # un-embedded slot
+    scores = np.asarray(cosine_scores(vectors, query))[:, 0]
+    assert scores[5] == NEG_INF
+
+
+def test_batch_queries(data):
+    vectors, _ = data
+    rng = np.random.default_rng(7)
+    queries = rng.normal(size=(3, 64)).astype(np.float32)
+    scores, idx = cosine_topk_batch(vectors, queries, k=5)
+    assert scores.shape == (3, 5) and idx.shape == (3, 5)
+    for qi in range(3):
+        ref = _np_cosine(vectors, queries[qi])
+        np.testing.assert_array_equal(idx[qi], np.argsort(-ref)[:5])
+
+
+def test_euclidean(data):
+    vectors, query = data
+    got = np.asarray(euclidean_distances(vectors, query))[:, 0]
+    ref = np.linalg.norm(vectors - query, axis=-1)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_pallas_interpret_matches_jnp(data):
+    """Run the actual pallas kernel in interpreter mode on CPU and compare
+    with the jnp path."""
+    from libsplinter_tpu.ops.similarity import (_cosine_scores_pallas,
+                                                _pad_to)
+    import jax.numpy as jnp
+    vectors, query = data
+    # pad to kernel-friendly shapes
+    v = np.zeros((256, 128), np.float32); v[:200, :64] = vectors
+    q = np.zeros((8, 128), np.float32); q[0, :64] = query
+    mask = np.zeros((256, 1), np.float32); mask[:200] = 1.0
+    out = _cosine_scores_pallas(jnp.asarray(v), jnp.asarray(q),
+                                jnp.asarray(mask), block_n=128,
+                                interpret=True)
+    got = np.asarray(out)[:200, 0]
+    np.testing.assert_allclose(got, _np_cosine(vectors, query),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_k_larger_than_n():
+    rng = np.random.default_rng(3)
+    vectors = rng.normal(size=(4, 16)).astype(np.float32)
+    query = rng.normal(size=16).astype(np.float32)
+    scores, idx = cosine_topk(vectors, query, k=50)
+    assert len(idx) == 4

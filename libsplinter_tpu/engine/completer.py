@@ -1,0 +1,346 @@
+"""Streaming completion daemon — the splainference analog.
+
+The TPU-native replacement for the reference's completion sidecar
+(splainference.cpp; SURVEY.md §2.2, §3.3).  Clients write a prompt to a
+key, set the inference-waiting label (0x1<<60) and bump; this daemon:
+
+  - claims shard 0x5F1A at priority 200 and re-bids every 32 generated
+    tokens (splainference.cpp:51-62,355-364);
+  - wakes on its signal group, enumerates waiting keys
+    (splainference.cpp:582-589);
+  - per key: epoch-stable prompt read → fetches the system-prompt key
+    FRESH each request (splainference.cpp:114-128,212-215) → renders a
+    chat template with bare fallback (splainference.cpp:132-169) →
+    flips WAITING→SERVICING + bump → overwrites the slot with the
+    rendered prompt (splainference.cpp:266-269) → prefills the decoder
+    → token loop sampling top-p 0.9 / temp 0.7, streaming pieces into
+    the slot via append flushed at word boundaries or every 8 tokens
+    (splainference.cpp:86,102-109,306-365) so readers watch val_len
+    grow → truncates at max_val with an oom marker
+    (splainference.cpp:336-344) → clears the KV cache, backfills ctime,
+    flips SERVICING→READY + bump (splainference.cpp:378-392);
+  - appends debug chatter to the shared __debug key
+    (splainference.cpp:94-100);
+  - cold-start: drains any pre-existing waiting keys
+    (splainference.cpp:541-551).
+
+The decoder is a JAX causal LM with a device-resident KV cache
+(models/decoder.py); generation compiles once per bucket and never
+recompiles in the token loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+import errno
+import logging
+import time
+from typing import Callable, Iterator
+
+from .. import _native as N
+from ..store import Store
+from . import protocol as P
+
+log = logging.getLogger("libsplinter_tpu.completer")
+
+# A generator backend: (prompt_text) -> iterator of byte pieces.
+GenerateFn = Callable[[str], Iterator[bytes]]
+
+OOM_MARKER = b"\n[truncated: value buffer full]"
+
+
+def render_prompt(user: str, system: str | None,
+                  template: str = "chatml") -> str:
+    """Chat-template render with bare fallback
+    (splainference.cpp:132-169: llama_chat_apply_template else
+    'system\\n\\nuser' concatenation)."""
+    if template == "none" or not template:
+        return f"{system}\n\n{user}" if system else user
+    out = []
+    if system:
+        out.append(f"<|im_start|>system\n{system}<|im_end|>\n")
+    out.append(f"<|im_start|>user\n{user}<|im_end|>\n")
+    out.append("<|im_start|>assistant\n")
+    return "".join(out)
+
+
+@dataclasses.dataclass
+class CompleterStats:
+    wakes: int = 0
+    completions: int = 0
+    tokens: int = 0
+    truncated: int = 0
+    raced: int = 0
+
+
+class Completer:
+    """Drive with run() (blocking loop), run_once() (single drain), or
+    process_key() directly.  A fake generate_fn substitutes for the
+    decoder in tests (the daemon-level test gap called out in
+    SURVEY.md §4)."""
+
+    def __init__(self, store: Store, generate_fn: GenerateFn | None = None,
+                 *, model=None, tokenizer=None,
+                 max_new_tokens: int = 256,
+                 flush_tokens: int = 8,
+                 rebid_tokens: int = 32,
+                 template: str = "chatml",
+                 group: int = P.GROUP_INFER):
+        self.store = store
+        self.max_new = max_new_tokens
+        self.flush_tokens = flush_tokens
+        self.rebid_tokens = rebid_tokens
+        self.template = template
+        self.group = group
+        self.stats = CompleterStats()
+        self._bid = -1
+        self._running = False
+
+        if generate_fn is not None:
+            self.generate_fn = generate_fn
+        else:
+            if model is None:
+                from ..models import CompletionModel, DecoderConfig
+                # default vocab sized for the byte tokenizer (259 ids,
+                # padded to a lane-friendly 512); real checkpoints bring
+                # their own matching cfg+tokenizer pair
+                model = CompletionModel(DecoderConfig(vocab_size=512))
+            if tokenizer is None:
+                from ..models import ByteTokenizer
+                tokenizer = ByteTokenizer()
+            self._model = model
+            self._tok = tokenizer
+            self.generate_fn = self._model_generate
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self) -> None:
+        st = self.store
+        try:
+            self._bid = st.shard_claim(P.SHARD_COMPLETE, N.ADV_WILLNEED,
+                                       P.PRIO_COMPLETE, 30_000_000)
+        except OSError:
+            self._bid = -1
+        st.watch_label_register(P.BIT_INFER_REQ, self.group)
+        if st.header().bus_pid == 0:
+            st.bus_init()
+        else:
+            st.bus_open()
+
+    def _debug(self, msg: str) -> None:
+        """Append to the shared debug log key
+        (splainference.cpp:94-100)."""
+        st = self.store
+        try:
+            if P.KEY_DEBUG not in st:
+                st.set(P.KEY_DEBUG, b"")
+                st.label_or(P.KEY_DEBUG, P.LBL_DEBUG)
+            st.append(P.KEY_DEBUG, f"[completer] {msg}\n")
+        except OSError:
+            pass                      # debug channel full: not an error
+
+    # -- model backend -----------------------------------------------------
+
+    def _model_generate(self, prompt: str) -> Iterator[bytes]:
+        m, tok = self._model, self._tok
+        ids = tok.encode(prompt)
+        # keep the most recent context if the prompt overflows the window
+        budget = m.cfg.max_len - self.max_new - 1
+        if budget < 1:
+            budget = m.cfg.max_len // 2
+        if len(ids) > budget:
+            ids = ids[-budget:]
+        import numpy as np
+        logits = m.prefill(np.asarray(ids, np.int32))
+        try:
+            for _ in range(self.max_new):
+                t = m.sample(logits)
+                if t == tok.eos_id:
+                    break
+                yield tok.token_to_piece(t)
+                if m.pos >= m.cfg.max_len:
+                    break             # window full: the sampled token was
+                                      # still valid, only the NEXT step isn't
+                logits = m.decode_one(t)
+        finally:
+            m.reset()                 # llama_memory_clear analog
+
+    # -- the completion ----------------------------------------------------
+
+    def process_key(self, idx: int) -> bool:
+        """Run one completion for slot idx.  Returns True if serviced."""
+        st = self.store
+        e = st.epoch_at(idx)
+        if e & 1:
+            return False              # writer active: next wake
+        key = st.key_at(idx)
+        if key is None:
+            return False
+        try:
+            prompt = st.get_at(idx).rstrip(b"\0").decode(
+                "utf-8", errors="replace")
+        except Exception:
+            return False
+        if st.epoch_at(idx) != e:
+            self.stats.raced += 1
+            return False              # torn read: re-queued by next wake
+
+        # system prompt fetched fresh each request
+        system = None
+        try:
+            system = st.get(P.KEY_SYSTEM_PROMPT).decode(
+                "utf-8", errors="replace")
+        except KeyError:
+            pass
+        rendered = render_prompt(prompt, system, self.template)
+
+        # WAITING → SERVICING, visible to watchers immediately
+        st.label_clear(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+        st.label_or(key, P.LBL_SERVICING)
+        st.bump(key)
+
+        # slot now holds the rendered prompt; generation appends after it
+        t0 = Store.now()
+        data = rendered.encode("utf-8")
+        try:
+            st.set(key, data)
+        except OSError:               # rendered prompt alone overflows —
+            st.set(key, data[: st.max_val - 1])   # slice BYTES, not chars
+        n_tok, pending, truncated = 0, b"", False
+        try:
+            for piece in self.generate_fn(rendered):
+                pending += piece
+                n_tok += 1
+                boundary = piece.endswith((b" ", b"\n", b"\t"))
+                if boundary or n_tok % self.flush_tokens == 0:
+                    if not self._flush(key, pending):
+                        truncated = True
+                        break
+                    pending = b""
+                if self.rebid_tokens and n_tok % self.rebid_tokens == 0 \
+                        and self._bid >= 0:
+                    try:
+                        st.shard_rebid(self._bid)
+                    except OSError:
+                        pass
+            if pending and not truncated:
+                truncated = not self._flush(key, pending)
+        except Exception as ex:       # model failure must not wedge WAITING
+            self._debug(f"generation failed for {key!r}: {ex}")
+        if truncated:
+            self.stats.truncated += 1
+            self._debug(f"completion for {key!r} truncated at max_val")
+
+        # ctime backfill with tick delta (splainference.cpp:282,383-387)
+        try:
+            st.stamp(key, which=0, ticks_ago=Store.now() - t0)
+        except Exception:
+            pass
+        st.label_clear(key, P.LBL_SERVICING)
+        st.label_or(key, P.LBL_READY)
+        st.bump(key)
+        self.stats.completions += 1
+        self.stats.tokens += n_tok
+        return True
+
+    def _flush(self, key: str, data: bytes) -> bool:
+        """Append a flushed run; on overflow truncate-and-mark
+        (splainference.cpp:336-344).  Returns False when full."""
+        st = self.store
+        try:
+            st.append(key, data)
+            return True
+        except OSError as ex:
+            if ex.errno != errno.EMSGSIZE:
+                raise
+            room = st.max_val - 1 - st.value_len(key)
+            tail = data[: max(0, room - len(OOM_MARKER))] + OOM_MARKER
+            try:
+                st.append(key, tail[: max(0, room)])
+            except OSError:
+                pass
+            return False
+
+    # -- drain loop --------------------------------------------------------
+
+    def run_once(self) -> int:
+        """Enumerate waiting keys and service each (cold-start drain and
+        per-wake drain are the same sweep, splainference.cpp:541-551)."""
+        st = self.store
+        n = 0
+        for idx in st.enumerate_indices(P.LBL_INFER_REQ):
+            if self._bid >= 0:
+                try:
+                    st.shard_rebid(self._bid)
+                    st.madvise(self._bid, N.ADV_WILLNEED, timeout_ms=0)
+                except OSError:
+                    pass
+            if self.process_key(idx):
+                n += 1
+        return n
+
+    def run(self, *, idle_timeout_ms: int = 100,
+            stop_after: float | None = None) -> None:
+        self._running = True
+        last = self.store.signal_count(self.group)
+        deadline = (time.monotonic() + stop_after) if stop_after else None
+        next_sweep = time.monotonic() + 2.0
+        self.run_once()               # cold start
+        while self._running:
+            got = self.store.signal_wait(self.group, last,
+                                         timeout_ms=idle_timeout_ms)
+            now = time.monotonic()
+            if got is not None:
+                last = got
+                self.stats.wakes += 1
+                self.run_once()
+            elif now >= next_sweep:
+                next_sweep = now + 2.0
+                self.run_once()
+            if deadline and now > deadline:
+                break
+
+    def stop(self) -> None:
+        self._running = False
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI entry: python -m libsplinter_tpu.engine.completer --store NAME"""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="splinter-tpu completion daemon (streaming JAX "
+                    "decoder over the store's label protocol)")
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--persistent", action="store_true")
+    ap.add_argument("--oneshot", action="store_true")
+    ap.add_argument("--max-new-tokens", type=int, default=256)
+    ap.add_argument("--template", default="chatml",
+                    help="chat template ('chatml' or 'none' for bare "
+                         "system\\n\\nprompt concatenation)")
+    ap.add_argument("--temp", type=float, default=0.7)
+    ap.add_argument("--top-p", type=float, default=0.9)
+    ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    store = Store.open(args.store, persistent=args.persistent)
+    from ..models import CompletionModel, DecoderConfig
+    model = CompletionModel(DecoderConfig(), top_p=args.top_p,
+                            temp=args.temp)
+    comp = Completer(store, model=model,
+                     max_new_tokens=args.max_new_tokens,
+                     template=args.template)
+    comp.attach()
+    if args.oneshot:
+        n = comp.run_once()
+        log.info("oneshot serviced %d completions", n)
+        return 0
+    try:
+        comp.run(idle_timeout_ms=args.idle_timeout_ms)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,6 +1,10 @@
+from .decoder import (CompletionModel, Decoder, DecoderConfig, init_cache,
+                      sample_top_p)
 from .encoder import Encoder, EncoderConfig, EmbeddingModel
-from .tokenizer import (HashTokenizer, WordPieceTokenizer, batch_encode,
-                        default_tokenizer)
+from .tokenizer import (ByteTokenizer, HashTokenizer, WordPieceTokenizer,
+                        batch_encode, default_tokenizer)
 
 __all__ = ["Encoder", "EncoderConfig", "EmbeddingModel", "HashTokenizer",
-           "WordPieceTokenizer", "batch_encode", "default_tokenizer"]
+           "WordPieceTokenizer", "ByteTokenizer", "batch_encode",
+           "default_tokenizer", "CompletionModel", "Decoder",
+           "DecoderConfig", "init_cache", "sample_top_p"]

@@ -1,0 +1,288 @@
+"""TPU-native causal decoder LM (flax) for the completion daemon.
+
+Replaces the reference's llama.cpp completion compute
+(splainference.cpp:414-470 loads a GGUF chat model; the token loop at
+splainference.cpp:306-365 samples with a top-p 0.9 / temp 0.7 / dist
+chain, splainference.cpp:272-279).  Here the decoder is a JAX/flax
+module designed for XLA:
+
+  - llama-family geometry: pre-norm RMSNorm, rotary positions, SwiGLU
+    MLP, causal attention;
+  - a **static-shape KV cache** of length `max_len` carried as an
+    explicit pytree — one compiled program per (batch, chunk) shape
+    serves both bucketed prefill (chunk = bucket) and token-at-a-time
+    decode (chunk = 1), so the generation hot loop never recompiles;
+  - bfloat16 activations (MXU-native), float32 logits for sampling;
+  - a jit-compiled top-p/temperature sampler (the reference's chain:
+    top-p 0.9 → temp 0.7 → dist, splainference.cpp:272-279).
+
+Weights are seeded-random by default (protocol and benchmarks do not
+depend on weight values); real checkpoints load through the same param
+tree.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .encoder import _apply_rotary, _rotary_angles  # shared rotary math
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32000
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    kv_heads: int = 12            # grouped-query attention when < heads
+    mlp_dim: int = 2048
+    max_len: int = 2048           # KV cache length = context window
+    rope_base: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls, **kw) -> "DecoderConfig":
+        """Small config for tests and CPU CI."""
+        kw = {"vocab_size": 1024, "hidden": 64, "layers": 2, "heads": 4,
+              "kv_heads": 2, "mlp_dim": 128, "max_len": 128, **kw}
+        return cls(**kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+def init_cache(cfg: DecoderConfig, batch: int):
+    """Fresh zeroed KV cache: list of (k, v) per layer, each
+    (B, max_len, kv_heads, head_dim).  The llama.cpp analog of
+    llama_memory_clear (splainference.cpp:378)."""
+    shape = (batch, cfg.max_len, cfg.kv_heads, cfg.head_dim)
+    z = jnp.zeros(shape, cfg.dtype)
+    return [(z, z) for _ in range(cfg.layers)]
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        xf = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True)
+                               + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+class CausalAttention(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, pos):
+        """x: (B, S, H) chunk at absolute positions pos..pos+S-1.
+        cache_kv: (k, v) each (B, T, KH, D).  Returns (out, new_cache)."""
+        cfg = self.cfg
+        B, S, _ = x.shape
+        D = cfg.head_dim
+        q = nn.Dense(cfg.heads * D, use_bias=False, dtype=cfg.dtype,
+                     name="q")(x).reshape(B, S, cfg.heads, D)
+        k = nn.Dense(cfg.kv_heads * D, use_bias=False, dtype=cfg.dtype,
+                     name="k")(x).reshape(B, S, cfg.kv_heads, D)
+        v = nn.Dense(cfg.kv_heads * D, use_bias=False, dtype=cfg.dtype,
+                     name="v")(x).reshape(B, S, cfg.kv_heads, D)
+
+        # rotary at absolute positions (dynamic under jit)
+        cos_t, sin_t = _rotary_angles(cfg.max_len, D, cfg.rope_base)
+        idx = pos + jnp.arange(S)
+        cos, sin = cos_t[idx], sin_t[idx]          # (S, D/2)
+        q = _apply_rotary(q, cos, sin)
+        k = _apply_rotary(k, cos, sin)
+
+        ck, cv = cache_kv
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+
+        # GQA: repeat kv heads up to query heads
+        rep = cfg.heads // cfg.kv_heads
+        kk = jnp.repeat(ck, rep, axis=2) if rep > 1 else ck
+        vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
+
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(D)
+        # key j visible to query at abs position pos+i iff j <= pos+i
+        jpos = jnp.arange(cfg.max_len)[None, :]
+        visible = jpos <= idx[:, None]             # (S, T)
+        logits = jnp.where(visible[None, None], logits.astype(jnp.float32),
+                           -1e9)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(
+            B, S, cfg.heads * D)
+        out = nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
+                       name="out")(out)
+        return out, (ck, cv)
+
+
+class DecoderLayer(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, pos):
+        cfg = self.cfg
+        a, cache_kv = CausalAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_attn")(x),
+            cache_kv, pos)
+        x = x + a
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_mlp")(x)
+        gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                        name="gate")(h)
+        up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                      name="up")(h)
+        x = x + nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
+                         name="down")(nn.silu(gate) * up)
+        return x, cache_kv
+
+
+class Decoder(nn.Module):
+    """Causal LM over a static KV cache.  One program serves prefill
+    (S = bucket) and decode (S = 1)."""
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, token_ids, cache, pos):
+        """token_ids: (B, S) int32; cache: list of per-layer (k, v);
+        pos: scalar int32 — absolute position of token_ids[:, 0].
+        Returns (logits (B, S, V) float32, new_cache)."""
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     name="tok_emb")(token_ids)
+        new_cache = []
+        for i in range(cfg.layers):
+            x, kv = DecoderLayer(cfg, name=f"layer_{i}")(x, cache[i], pos)
+            new_cache.append(kv)
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_out")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=jnp.float32, name="lm_head")(x)
+        return logits, new_cache
+
+
+# ---------------------------------------------------------------- sampling
+
+@functools.partial(jax.jit, static_argnames=("top_p", "temp"))
+def sample_top_p(rng, logits, *, top_p: float = 0.9, temp: float = 0.7):
+    """The reference's sampler chain (splainference.cpp:272-279):
+    top-p nucleus filter → temperature → categorical draw.
+    logits: (V,) float32.  temp <= 0 means greedy."""
+    if temp <= 0:
+        return jnp.argmax(logits).astype(jnp.int32)
+    order = jnp.argsort(-logits)
+    sorted_logits = logits[order] / temp
+    probs = jax.nn.softmax(sorted_logits)
+    cum = jnp.cumsum(probs)
+    keep = (cum - probs) < top_p          # always keeps the top token
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    choice = jax.random.categorical(rng, masked)
+    return order[choice].astype(jnp.int32)
+
+
+# ------------------------------------------------------------- front end
+
+class CompletionModel:
+    """Bucketed prefill + token-at-a-time decode with persistent cache.
+
+    The generation surface the completion daemon drives:
+        pos, logits = model.prefill(prompt_ids)
+        tok = model.sample(logits)
+        while ...: logits = model.decode_one(tok); tok = model.sample(...)
+    Cache state lives on device between calls (no host round-trip of the
+    KV tensors).
+    """
+
+    def __init__(self, cfg: DecoderConfig, *, seed: int = 0,
+                 buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
+                 params: Any = None,
+                 top_p: float = 0.9, temp: float = 0.7):
+        self.cfg = cfg
+        self.module = Decoder(cfg)
+        self.buckets = tuple(b for b in buckets if b <= cfg.max_len)
+        self.top_p, self.temp = top_p, temp
+        if not self.buckets or self.buckets[-1] < cfg.max_len:
+            # a prompt longer than the largest bucket (but inside the
+            # window) must still have a program to land in
+            self.buckets = self.buckets + (cfg.max_len,)
+        if params is None:
+            cache = init_cache(cfg, 1)
+            params = self.module.init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1, self.buckets[0]), jnp.int32), cache,
+                jnp.int32(0))
+        self.params = params
+        self._fn = jax.jit(self.module.apply)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._cache = None
+        self._pos = 0
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.buckets[-1]
+
+    def reset(self) -> None:
+        """llama_memory_clear analog (splainference.cpp:378)."""
+        self._cache = None
+        self._pos = 0
+
+    def prefill(self, prompt_ids: np.ndarray) -> np.ndarray:
+        """prompt_ids: (P,) int32, P < max_len.  Pads to a bucket, runs
+        one prefill program, returns the last real token's logits (V,)."""
+        P = len(prompt_ids)
+        if P == 0:
+            raise ValueError("empty prompt")
+        if P >= self.cfg.max_len:
+            raise ValueError("prompt exceeds context window")
+        b = self.bucket_for(P)
+        ids = np.zeros((1, b), np.int32)
+        ids[0, :P] = prompt_ids[:P]
+        cache = init_cache(self.cfg, 1)
+        logits, cache = self._fn(self.params, jnp.asarray(ids), cache,
+                                 jnp.int32(0))
+        # cache rows P..b-1 hold pad-token k/v, but they can never leak:
+        # a query at absolute position p attends only j <= p, and every
+        # row <= p is rewritten with real data (prompt or decoded token)
+        # before the first query that could see it.
+        self._cache, self._pos = cache, P
+        return np.asarray(logits[0, P - 1])
+
+    def decode_one(self, token: int) -> np.ndarray:
+        """Append one token at the current position; returns logits (V,)."""
+        if self._cache is None:
+            raise RuntimeError("prefill first")
+        if self._pos >= self.cfg.max_len:
+            raise RuntimeError("context window full")
+        ids = jnp.full((1, 1), int(token), jnp.int32)
+        logits, self._cache = self._fn(self.params, ids, self._cache,
+                                       jnp.int32(self._pos))
+        self._pos += 1
+        return np.asarray(logits[0, 0])
+
+    def sample(self, logits: np.ndarray) -> int:
+        self._rng, sub = jax.random.split(self._rng)
+        return int(sample_top_p(sub, jnp.asarray(logits),
+                                top_p=self.top_p, temp=self.temp))
+
+    @property
+    def pos(self) -> int:
+        return self._pos
+
+    def warmup(self) -> None:
+        """Pre-compile prefill buckets + the decode-one program."""
+        for b in self.buckets:
+            self.prefill(np.ones((max(1, b - 1),), np.int32))
+            self.decode_one(1)
+        self.reset()

@@ -124,6 +124,38 @@ class HashTokenizer:
         return ids
 
 
+class ByteTokenizer:
+    """Reversible byte-level tokenizer for the completion decoder.
+
+    The reference streams pieces via llama_token_to_piece
+    (splainference.cpp:333-354); completion needs an exact id→text
+    inverse, which the hashed fallback can't provide.  Ids: 0 PAD,
+    1 BOS, 2 EOS, bytes at [3, 259).  vocab_size is the model's
+    embedding rows (>= 259; the slack is harmless)."""
+
+    vocab_size = 259
+    pad_id, bos_id, eos_id = 0, 1, 2
+
+    def encode(self, text: str, *, max_len: int | None = None,
+               bos: bool = True) -> list[int]:
+        ids = ([self.bos_id] if bos else [])
+        ids.extend(3 + b for b in text.encode("utf-8"))
+        if max_len is not None and len(ids) > max_len:
+            ids = ids[:max_len]
+        return ids
+
+    def decode(self, ids) -> str:
+        return bytes(i - 3 for i in ids if 3 <= i < 259).decode(
+            "utf-8", errors="replace")
+
+    def token_to_piece(self, tok: int) -> bytes:
+        """Raw byte piece for one token (may be mid-UTF-8; the streamer
+        flushes on word boundaries so partial runes never hit readers).
+        Ids outside [3, 259) — specials, or lm-head slack rows when the
+        model's vocab is wider than the byte table — map to b''."""
+        return bytes([tok - 3]) if 3 <= tok < 259 else b""
+
+
 def default_tokenizer(vocab_size: int = 30528):
     """WordPiece when a vocab file is discoverable, else HashTokenizer."""
     for cand in (Path(__file__).parent / "vocab.txt",

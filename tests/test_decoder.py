@@ -1,0 +1,123 @@
+"""Decoder LM: KV-cache correctness, sampler chain, byte tokenizer."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libsplinter_tpu.models import (ByteTokenizer, CompletionModel,
+                                    DecoderConfig, init_cache, sample_top_p)
+from libsplinter_tpu.models.decoder import Decoder
+
+CFG = DecoderConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return CompletionModel(CFG, buckets=(16, 32), temp=0.0)
+
+
+def test_prefill_then_decode_matches_full_forward(model):
+    """Bucketed prefill + N single-token decode steps must produce the
+    same logits as one full causal forward over the whole sequence."""
+    rng = np.random.default_rng(0)
+    seq = rng.integers(3, CFG.vocab_size, size=14).astype(np.int32)
+    P = 9
+
+    # incremental: prefill 9, decode tokens 9..13
+    logits = model.prefill(seq[:P])
+    inc = [logits]
+    for t in seq[P:]:
+        inc.append(model.decode_one(int(t)))
+    model.reset()
+
+    # one-shot reference: full causal forward, no padding
+    mod = Decoder(CFG)
+    cache = init_cache(CFG, 1)
+    full, _ = mod.apply(model.params, jnp.asarray(seq[None, :]), cache,
+                        jnp.int32(0))
+    full = np.asarray(full[0])
+
+    # inc[i] is the prediction after consuming seq[:P+i]
+    for i, got in enumerate(inc):
+        np.testing.assert_allclose(got, full[P - 1 + i], rtol=2e-4,
+                                   atol=2e-4)
+
+
+def test_prefill_bucket_padding_is_invisible(model):
+    """The same prompt through different bucket sizes gives identical
+    logits — pad rows in the KV cache never become visible."""
+    prompt = np.arange(3, 13).astype(np.int32)     # len 10 → bucket 16
+    a = model.prefill(prompt)
+    model.reset()
+    big = CompletionModel(CFG, buckets=(32,), params=model.params,
+                          temp=0.0)
+    b = big.prefill(prompt)                        # len 10 → bucket 32
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def test_decode_position_tracking(model):
+    model.prefill(np.ones(5, np.int32))
+    assert model.pos == 5
+    model.decode_one(7)
+    assert model.pos == 6
+    model.reset()
+    assert model.pos == 0
+    with pytest.raises(RuntimeError):
+        model.decode_one(1)
+
+
+def test_sampler_greedy_and_top_p():
+    logits = jnp.asarray(np.array([0.0, 5.0, 1.0, -2.0], np.float32))
+    key = jax.random.PRNGKey(0)
+    assert int(sample_top_p(key, logits, temp=0.0)) == 1
+    # dominant token holds ~97% mass: top_p=0.5 nucleus is {1} alone
+    for i in range(20):
+        k = jax.random.PRNGKey(i)
+        assert int(sample_top_p(k, logits, top_p=0.5, temp=1.0)) == 1
+
+
+def test_sampler_top_p_excludes_tail():
+    """Tokens outside the nucleus must never be drawn."""
+    logits = jnp.asarray(np.array([4.0, 4.0, -10.0, -10.0], np.float32))
+    seen = {int(sample_top_p(jax.random.PRNGKey(i), logits,
+                             top_p=0.9, temp=1.0)) for i in range(50)}
+    assert seen <= {0, 1}
+    assert len(seen) == 2          # both nucleus members reachable
+
+
+def test_byte_tokenizer_round_trip():
+    tok = ByteTokenizer()
+    text = "Hello, wörld! ☃"
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == text
+    # streaming pieces concatenate to the same bytes
+    pieces = b"".join(tok.token_to_piece(i) for i in ids)
+    assert pieces.decode("utf-8") == text
+    assert tok.encode("abc", max_len=2) == [tok.bos_id, 3 + ord("a")]
+
+
+def test_prompt_longer_than_largest_bucket():
+    """A prompt between the largest bucket and max_len must still land
+    in a program (regression: broadcast crash for bucket < P < max_len)."""
+    m = CompletionModel(CFG, buckets=(16,), temp=0.0)
+    assert m.buckets[-1] == CFG.max_len
+    logits = m.prefill(np.ones(40, np.int32))      # 16 < 40 < 128
+    assert logits.shape == (CFG.vocab_size,)
+    assert m.pos == 40
+
+
+def test_byte_tokenizer_out_of_range_ids_are_empty():
+    """Lm-head slack rows (vocab wider than the byte table) must stream
+    as empty pieces, not crash (regression: ValueError in bytes())."""
+    tok = ByteTokenizer()
+    assert tok.token_to_piece(300) == b""
+    assert tok.token_to_piece(tok.pad_id) == b""
+    assert tok.decode([1, 3 + ord("a"), 5000, 2]) == "a"
+
+
+def test_context_window_guard(model):
+    with pytest.raises(ValueError):
+        model.prefill(np.ones(CFG.max_len, np.int32))
+    with pytest.raises(ValueError):
+        model.prefill(np.zeros(0, np.int32))

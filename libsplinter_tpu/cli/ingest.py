@@ -15,7 +15,7 @@ import json
 import sys
 import time
 
-from .. import T_VARTEXT, T_JSON
+from .. import T_BIGUINT, T_JSON, T_VARTEXT
 from ..engine import protocol as P
 from .main import CliError, command
 
@@ -82,32 +82,70 @@ def cmd_ingest(ses, args):
     print(f"ingested {len(chunks)} chunks -> {base} (+{meta_key})")
 
 
-@command("export", "export [--regex RX] [--values]",
-         "JSON dump of slot metadata (epoch-desc), VARTEXT values inline")
+@command("export", "export [REGEX] [--out FILE] [--values]",
+         "JSON dump of all slots, newest epoch first (VARTEXT/JSON "
+         "values inline; --values forces values for every type)")
 def cmd_export(ses, args):
+    """Logical store dump (reference: splinter_cli_cmd_export.c:47-141 —
+    slot metadata sorted by epoch desc, VARTEXT values escaped inline)."""
     import re
 
-    rx = None
-    if "--regex" in args:
-        rx = re.compile(args[args.index("--regex") + 1])
-    with_values = "--values" in args
+    from pathlib import Path
+
+    import numpy as np
+
+    from .main import TYPE_NAMES
+
+    rx, out_path, with_values = None, None, "--values" in args
+    rest, it = [], iter(args)
+    for a in it:
+        if a == "--out":
+            out_path = next(it, None)
+            if out_path is None:
+                raise CliError("--out needs a file argument")
+        elif a == "--regex":
+            pat = next(it, None)
+            if pat is None:
+                raise CliError("--regex needs a pattern argument")
+            rx = re.compile(pat)
+        elif not a.startswith("--"):
+            rest.append(a)
+    if rest and rx is None:
+        rx = re.compile(rest[0])
     st = ses.store
-    out = []
+    slots = []
     for key in st.list():
         if rx and not rx.search(key):
             continue
         s = st.slot(key)
         rec = {
             "key": s.key, "index": s.index, "epoch": s.epoch,
-            "type": s.type, "len": s.val_len,
-            "labels": f"{s.labels:#x}", "ctime": s.ctime,
-            "atime": s.atime,
+            "type": TYPE_NAMES.get(s.type, hex(s.type)),
+            "val_len": s.val_len, "labels": f"{s.labels:#x}",
+            "ctime": s.ctime, "atime": s.atime,
         }
-        if s.type & T_VARTEXT or with_values:
-            try:
+        try:
+            if s.type == T_BIGUINT:
+                rec["value"] = st.get_uint(key)
+            elif s.type in (T_VARTEXT, T_JSON) or with_values:
                 rec["value"] = st.get_str(key)
-            except (KeyError, OSError):
-                pass
-        out.append(rec)
-    out.sort(key=lambda r: -r["epoch"])
-    print(json.dumps(out, indent=2))
+        except (KeyError, OSError, ValueError):
+            pass
+        if st.vec_dim:
+            mag = float(np.linalg.norm(st.vec_get_at(s.index)))
+            if mag > 0:
+                rec["vec_magnitude"] = round(mag, 6)
+        slots.append(rec)
+    slots.sort(key=lambda r: -r["epoch"])
+    h = st.header()
+    payload = json.dumps({
+        "store": ses.store_name, "nslots": st.nslots,
+        "max_val": st.max_val, "vec_dim": st.vec_dim,
+        "global_epoch": h.global_epoch, "count": len(slots),
+        "slots": slots,
+    }, indent=2)
+    if out_path:
+        Path(out_path).write_text(payload + "\n")
+        print(f"exported {len(slots)} slots to {out_path}")
+    else:
+        print(payload)

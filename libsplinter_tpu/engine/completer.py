@@ -172,6 +172,9 @@ class Completer:
         e = st.epoch_at(idx)
         if e & 1:
             return False              # writer active: next wake
+        if not st.labels_at(idx) & P.LBL_INFER_REQ:
+            return False              # slot recycled since enumeration:
+                                      # never service a key that didn't ask
         key = st.key_at(idx)
         if key is None:
             return False

@@ -1,0 +1,95 @@
+#!/bin/sh
+# CLI workflow regression — the reference's splinterctl_tests.sh analog
+# (SURVEY.md §4: "shell script exercising init/set/get/head/list/type/
+# unset/config/export/bump/append/uuid as workflow UX tests, explicitly
+# not re-testing the library").  Exercises the one-shot CLI the way an
+# operator would.  Exit 0 = pass.
+set -eu
+
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+STORE="/spt-clireg-$$"
+CLI="python -m libsplinter_tpu.cli --store $STORE"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS=cpu
+FAILED=0
+N=0
+
+check() {  # check NAME EXPECTED ACTUAL
+    N=$((N + 1))
+    if [ "$2" = "$3" ]; then
+        echo "ok $N - $1"
+    else
+        echo "not ok $N - $1: expected [$2] got [$3]"
+        FAILED=1
+    fi
+}
+
+fail() { N=$((N + 1)); echo "not ok $N - $1"; FAILED=1; }
+pass() { N=$((N + 1)); echo "ok $N - $1"; }
+
+cleanup() { rm -f "/dev/shm$STORE"; }
+trap cleanup EXIT
+
+# --- init / set / get ---------------------------------------------------
+$CLI init 64 512 8 >/dev/null
+check "set+get round trip" "hello world" "$($CLI set greet hello world && $CLI get greet)"
+
+# --- append -------------------------------------------------------------
+$CLI append greet ", again" >/dev/null
+check "append grows value" "hello world, again" "$($CLI get greet)"
+
+# --- type / math --------------------------------------------------------
+$CLI set counter 41 >/dev/null
+$CLI type counter BIGUINT >/dev/null
+check "type readback" "BIGUINT" "$($CLI type counter)"
+check "math inc" "42" "$($CLI math counter inc)"
+check "math add" "52" "$($CLI math counter add 10)"
+
+# --- list ---------------------------------------------------------------
+check "list shows both keys" "counter
+greet" "$($CLI list | sort)"
+
+# --- head ---------------------------------------------------------------
+$CLI head greet | grep -q "^key " && pass "head dumps metadata" || fail "head output"
+
+# --- label / bump -------------------------------------------------------
+$CLI label greet +0x40 >/dev/null
+check "label readback" "0x0000000000000040" "$($CLI label greet)"
+$CLI bump greet >/dev/null && pass "bump" || fail "bump"
+
+# --- export -------------------------------------------------------------
+$CLI type greet VARTEXT >/dev/null
+EXPORT=$($CLI export)
+echo "$EXPORT" | grep -q '"key": "greet"' && pass "export contains greet" || fail "export contains greet"
+echo "$EXPORT" | grep -q '"value": "hello world, again"' && pass "export inlines VARTEXT value" || fail "export inlines VARTEXT value"
+check "export count" "2" "$(echo "$EXPORT" | python -c 'import json,sys; print(json.load(sys.stdin)["count"])')"
+
+# --- uuid ---------------------------------------------------------------
+$CLI uuid ukey >/dev/null
+check "uuid length" "36" "$($CLI get ukey | tr -d '\n' | wc -c | tr -d ' ')"
+
+# --- config -------------------------------------------------------------
+$CLI config user 0x3 >/dev/null
+$CLI config | grep -q "user flags   0x3" && pass "config user flags" || fail "config dump"
+$CLI config mop 2 >/dev/null
+$CLI config | grep -q "mop          2" && pass "config mop" || fail "config mop"
+
+# --- orders (tandem) ----------------------------------------------------
+$CLI set doc part0 >/dev/null
+$CLI set doc.1 part1 >/dev/null
+$CLI set doc.2 part2 >/dev/null
+check "orders count" "doc: 3 orders" "$($CLI orders doc 2>/dev/null | head -1)"
+
+# --- unset --------------------------------------------------------------
+$CLI unset greet >/dev/null
+if $CLI get greet >/dev/null 2>&1; then fail "unset removed key"; else pass "unset removed key"; fi
+
+# --- one-shot error discipline -----------------------------------------
+if $CLI get nonexistent >/dev/null 2>&1; then
+    fail "missing key must exit nonzero"
+else
+    pass "missing key exits nonzero"
+fi
+
+echo "cli regression: $N checks, FAILED=$FAILED"
+exit $FAILED

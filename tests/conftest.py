@@ -36,3 +36,7 @@ def store_novec():
     yield st
     st.close()
     Store.unlink(name)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: longer-running stress tiers")

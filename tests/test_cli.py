@@ -171,7 +171,9 @@ def test_ingest_and_export(cli, capsys, tmp_path):
     assert st.labels("docs/d1") & P.LBL_CHUNK
     st.close()
     run("export", "--regex", "docs/")
-    recs = json.loads(out_of(capsys))
+    dump = json.loads(out_of(capsys))
+    recs = dump["slots"]
+    assert dump["count"] == len(recs)
     keys = {r["key"] for r in recs}
     assert "docs/d1" in keys and "docs/d1.meta" in keys
     # epoch-descending order
@@ -237,3 +239,16 @@ def test_search_degrades_without_daemon(cli, capsys):
     rows = json.loads(out_of(capsys))
     assert any(r["key"] == "alone" for r in rows)
     assert all(r["similarity"] is None for r in rows)
+
+
+@pytest.mark.slow
+def test_cli_regression_script():
+    """The shell workflow regression (reference: splinterctl_tests.sh run
+    under CTest) — exercises the one-shot CLI as an operator would."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "cli_regression.sh"
+    r = subprocess.run(["sh", str(script)], capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

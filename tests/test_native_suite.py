@@ -1,0 +1,35 @@
+"""Run the native C test tiers from pytest so `pytest tests/` covers the
+whole stack (reference: CTest wires splinter_test + stress + chi_sao,
+CMakeLists.txt:267-329)."""
+import pathlib
+import subprocess
+
+import pytest
+
+NATIVE = pathlib.Path(__file__).parent.parent / "native"
+
+
+def _build(target: str) -> None:
+    subprocess.run(["make", "-s", target], cwd=NATIVE, check=True,
+                   capture_output=True, timeout=300)
+
+
+def test_native_tap_unit_suite():
+    """The C TAP behavioral suite, both shm and file backends."""
+    _build("tests")
+    r = subprocess.run([str(NATIVE / "build" / "spt_unit")],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"TAP failures:\n{r.stdout}"
+    assert "0 failed" in r.stdout
+
+
+@pytest.mark.slow
+def test_native_stress_short():
+    """MRSW integrity under fire, short run (CTest runs 7.5 s;
+    CI-speed 2 s here — the full duration is `make check`)."""
+    _build("tests")
+    r = subprocess.run([str(NATIVE / "build" / "spt_stress"),
+                        "--duration-ms", "2000"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "corrupt=0" in r.stdout

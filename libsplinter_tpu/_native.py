@@ -107,6 +107,7 @@ def _declare(lib: C.CDLL) -> None:
     sigs = {
         "spt_create": (P, [cs, u32, u32, u32, u32]),
         "spt_open": (P, [cs, u32]),
+        "spt_open_numa": (P, [cs, u32, i32, C.POINTER(i32)]),
         "spt_close": (i32, [P]),
         "spt_unlink": (i32, [cs, u32]),
         "spt_nslots": (u32, [P]),

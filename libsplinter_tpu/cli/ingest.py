@@ -117,7 +117,10 @@ def cmd_export(ses, args):
     for key in st.list():
         if rx and not rx.search(key):
             continue
-        s = st.slot(key)
+        try:
+            s = st.slot(key)
+        except (KeyError, OSError):
+            continue  # key unset by a concurrent writer since list()
         rec = {
             "key": s.key, "index": s.index, "epoch": s.epoch,
             "type": TYPE_NAMES.get(s.type, hex(s.type)),

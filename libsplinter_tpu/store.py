@@ -147,6 +147,22 @@ class Store:
             raise OSError(e, os.strerror(e), name)
         return cls(h, name, flags)
 
+    @classmethod
+    def open_numa(cls, name: str, node: int, *,
+                  persistent: bool = False) -> tuple["Store", int]:
+        """Open and mbind the mapping to a NUMA node (reference parity:
+        splinter_open_numa, splinter.c:250-264).  Returns (store, bind_rc);
+        bind_rc is 0 on success or -errno — advisory, the store is usable
+        either way (e.g. -ENOSYS on kernels without NUMA)."""
+        lib = N.get_lib()
+        flags = N.BACKEND_FILE if persistent else N.BACKEND_SHM
+        rc = C.c_int32(0)
+        h = lib.spt_open_numa(name.encode(), flags, node, C.byref(rc))
+        if not h:
+            e = lib.spt_last_error()
+            raise OSError(e, os.strerror(e), name)
+        return cls(h, name, flags), int(rc.value)
+
     @staticmethod
     def unlink(name: str, *, persistent: bool = False) -> None:
         lib = N.get_lib()

@@ -116,6 +116,11 @@ typedef struct {
 spt_store *spt_create(const char *name, uint32_t nslots, uint32_t max_val,
                       uint32_t vec_dim, uint32_t flags);
 spt_store *spt_open(const char *name, uint32_t flags);
+/* Open + mbind(MPOL_BIND) the mapping to a NUMA node (reference parity:
+ * splinter.c:250-264).  *bind_rc gets 0 or -errno for the bind itself;
+ * the open succeeds either way (bind failure is advisory). */
+spt_store *spt_open_numa(const char *name, uint32_t flags, int node,
+                         int *bind_rc);
 int  spt_close(spt_store *st);                    /* unmap; store survives  */
 int  spt_unlink(const char *name, uint32_t flags);/* destroy backing object */
 

@@ -158,6 +158,49 @@ spt_store *spt_open(const char *name, uint32_t flags) {
   return st;
 }
 
+/* NUMA-bound open (parity with the reference's SPLINTER_NUMA_AFFINITY
+ * variant, splinter.c:250-264): open the store, then mbind(MPOL_BIND) the
+ * whole mapping to one node so the arena's pages — and the vector lane the
+ * TPU runtime DMAs from — are allocated on the memory controller closest to
+ * the accelerator's PCIe root.  Raw syscall: no libnuma dependency.  A
+ * kernel without NUMA support returns -ENOSYS from the bind; the mapping
+ * itself is still valid, so we surface the error and let the caller decide
+ * (the Python tier treats it as advisory). */
+#include <sys/syscall.h>
+#ifndef SYS_mbind
+#  if defined(__x86_64__)
+#    define SYS_mbind 237
+#  elif defined(__aarch64__)
+#    define SYS_mbind 235
+#  endif
+#endif
+#define SPT_MPOL_BIND 2
+#define SPT_MPOL_MF_MOVE 2 /* migrate this process's existing pages too;
+                              pages other processes pinned need
+                              MPOL_MF_MOVE_ALL + CAP_SYS_NICE and stay put */
+
+spt_store *spt_open_numa(const char *name, uint32_t flags, int node,
+                         int *bind_rc) {
+  spt_store *st = spt_open(name, flags);
+  if (!st) return NULL;
+  int rc = -ENOSYS;
+#ifdef SYS_mbind
+  if (node >= 0 && node < 1024) {
+    unsigned long mask[1024 / (8 * sizeof(unsigned long))] = {0};
+    mask[node / (8 * sizeof(unsigned long))] =
+        1ul << (node % (8 * sizeof(unsigned long)));
+    long r = syscall(SYS_mbind, st->base, st->map_size, SPT_MPOL_BIND,
+                     mask, (unsigned long)(sizeof(mask) * 8 + 1),
+                     (unsigned long)SPT_MPOL_MF_MOVE);
+    rc = r < 0 ? -errno : 0;
+  } else {
+    rc = -EINVAL;
+  }
+#endif
+  if (bind_rc) *bind_rc = rc;
+  return st;
+}
+
 int spt_close(spt_store *st) {
   if (!st) return -EINVAL;
   spt_bus_close(st);

@@ -326,6 +326,23 @@ static void suite(const char *name, uint32_t flags) {
   spt_header_snapshot(st, &hv);
   TEST(hv.parse_failures == 1, "parse failure visible in header");
 
+  /* ---- NUMA-bound open (advisory bind; mapping valid regardless) ---- */
+  {
+    int brc = 1;
+    spt_store *sn = spt_open_numa(name, flags, 0, &brc);
+    TEST(sn != NULL, "numa open maps the store");
+    TEST(brc == 0 || brc == -ENOSYS || brc == -EPERM || brc == -EINVAL,
+         "numa bind returns 0 or a sane advisory errno");
+    uint32_t l2 = 0;
+    TEST(spt_get(sn, "k1", buf, sizeof buf, &l2) == 0,
+         "numa-opened handle reads data");
+    spt_close(sn);
+    int brc2 = 0;
+    sn = spt_open_numa(name, flags, -1, &brc2);
+    TEST(sn != NULL && brc2 == -EINVAL, "numa open rejects bad node");
+    spt_close(sn);
+  }
+
   /* ---- persistence across close/reopen ---- */
   spt_close(st);
   st = spt_open(name, flags);

@@ -8,7 +8,8 @@ set -eu
 
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 STORE="/spt-clireg-$$"
-CLI="python -m libsplinter_tpu.cli --store $STORE"
+PYTHON="${PYTHON:-python3}"
+CLI="$PYTHON -m libsplinter_tpu.cli --store $STORE"
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
 export JAX_PLATFORMS=cpu
 FAILED=0

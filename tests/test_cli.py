@@ -3,6 +3,7 @@
 label/shard/search), driven through the real entry point."""
 import json
 import os
+import sys
 import threading
 import uuid as uuidlib
 
@@ -249,6 +250,7 @@ def test_cli_regression_script():
     import subprocess
 
     script = pathlib.Path(__file__).parent / "cli_regression.sh"
+    env = dict(os.environ, PYTHON=sys.executable)
     r = subprocess.run(["sh", str(script)], capture_output=True, text=True,
-                       timeout=600)
+                       timeout=600, env=env)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

@@ -410,3 +410,20 @@ def test_parse_failure_diag(store):
     store.report_parse_failure()
     h = store.header()
     assert h.parse_failures == 1
+
+
+def test_open_numa(store):
+    """NUMA-bound open maps the store; bind result is advisory
+    (reference parity: splinter_open_numa, splinter.c:250-264)."""
+    import errno
+
+    store.set("numa-k", b"v")
+    st2, bind_rc = type(store).open_numa(store.name, 0)
+    try:
+        assert bind_rc in (0, -errno.ENOSYS, -errno.EPERM, -errno.EINVAL)
+        assert st2.get("numa-k") == b"v"
+    finally:
+        st2.close()
+    st3, bad_rc = type(store).open_numa(store.name, -1)
+    st3.close()
+    assert bad_rc == -errno.EINVAL

@@ -42,20 +42,33 @@ class EncoderConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16     # activation dtype
     out_dim: int = 768            # matryoshka truncation target
+    # Sequence parallelism: when set, inputs are the LOCAL chunk of a
+    # sequence sharded over this mesh axis and attention runs as ring
+    # attention (must be applied inside shard_map with the axis bound).
+    ring_axis: str | None = None
 
     @classmethod
     def tiny(cls, **kw) -> "EncoderConfig":
-        """Small config for tests and CPU CI."""
-        return cls(vocab_size=1024, hidden=64, layers=2, heads=4,
-                   mlp_dim=128, max_len=128, **kw)
+        """Small config for tests and CPU CI; kw overrides any field."""
+        base = dict(vocab_size=1024, hidden=64, layers=2, heads=4,
+                    mlp_dim=128, max_len=128)
+        base.update(kw)
+        return cls(**base)
 
 
 def _rotary_angles(seq_len: int, head_dim: int,
                    base: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    return _rotary_angles_at(pos, head_dim, base)
+
+
+def _rotary_angles_at(pos: jnp.ndarray, head_dim: int,
+                      base: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotary cos/sin at explicit (possibly offset) positions — sequence-
+    parallel shards need GLOBAL positions for their local chunk."""
     half = head_dim // 2
     freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    pos = jnp.arange(seq_len, dtype=jnp.float32)
-    ang = jnp.einsum("s,d->sd", pos, freqs)          # (S, half)
+    ang = jnp.einsum("s,d->sd", pos.astype(jnp.float32), freqs)  # (S, half)
     return jnp.cos(ang), jnp.sin(ang)
 
 
@@ -84,15 +97,25 @@ class SelfAttention(nn.Module):
         k = k.reshape(B, S, cfg.heads, head_dim)
         v = v.reshape(B, S, cfg.heads, head_dim)
         if cfg.variant == "nomic":
-            cos, sin = _rotary_angles(S, head_dim)
+            if cfg.ring_axis:
+                # S here is the LOCAL chunk; rotary needs global positions
+                shard = jax.lax.axis_index(cfg.ring_axis)
+                pos = shard * S + jnp.arange(S)
+                cos, sin = _rotary_angles_at(pos, head_dim)
+            else:
+                cos, sin = _rotary_angles(S, head_dim)
             q = _apply_rotary(q, cos, sin)
             k = _apply_rotary(k, cos, sin)
-        scale = 1.0 / np.sqrt(head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9)
-        probs = jax.nn.softmax(
-            logits.astype(jnp.float32) + bias, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.ring_axis:
+            from ..parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, mask, axis_name=cfg.ring_axis)
+        else:
+            scale = 1.0 / np.sqrt(head_dim)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            bias = jnp.where(mask[:, None, None, :], 0.0, -1e9)
+            probs = jax.nn.softmax(
+                logits.astype(jnp.float32) + bias, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(B, S, cfg.hidden)
         return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="out")(out)
 
@@ -141,6 +164,8 @@ class Encoder(nn.Module):
                      name="tok_emb")(token_ids)
         if cfg.variant == "bert":
             pos = jnp.arange(token_ids.shape[1])[None, :]
+            if cfg.ring_axis:   # local chunk -> global absolute positions
+                pos = pos + jax.lax.axis_index(cfg.ring_axis) * pos.shape[1]
             x = x + nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
                              name="pos_emb")(pos)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
@@ -150,7 +175,14 @@ class Encoder(nn.Module):
         # masked mean pool in f32 for stable norms
         xf = x.astype(jnp.float32)
         m = attn_mask.astype(jnp.float32)[..., None]
-        pooled = (xf * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+        sums = (xf * m).sum(axis=1)
+        counts = m.sum(axis=1)
+        if cfg.ring_axis:
+            # pool over the full sequence: reduce across shards so every
+            # sp member holds the replicated global embedding
+            sums = jax.lax.psum(sums, cfg.ring_axis)
+            counts = jax.lax.psum(counts, cfg.ring_axis)
+        pooled = sums / jnp.maximum(counts, 1.0)
         pooled = pooled[:, : cfg.out_dim]          # matryoshka truncation
         norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
         return pooled / jnp.maximum(norm, 1e-9)

@@ -17,6 +17,11 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    from jax import shard_map
+except ImportError:                           # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
 
 def make_mesh(dp: int | None = None, tp: int = 1, sp: int = 1,
               devices=None) -> Mesh:

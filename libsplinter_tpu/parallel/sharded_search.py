@@ -12,12 +12,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
 from ..ops.similarity import cosine_scores
+from .mesh import shard_map
 
 
 def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,

@@ -165,6 +165,13 @@ class Encoder(nn.Module):
         if cfg.variant == "bert":
             pos = jnp.arange(token_ids.shape[1])[None, :]
             if cfg.ring_axis:   # local chunk -> global absolute positions
+                sp = jax.lax.axis_size(cfg.ring_axis)
+                if sp * token_ids.shape[1] > cfg.max_len:
+                    raise ValueError(
+                        f"bert variant: global sequence {sp}x"
+                        f"{token_ids.shape[1]} exceeds the learned position "
+                        f"table max_len={cfg.max_len}; raise max_len or use "
+                        "the rotary 'nomic' variant for long context")
                 pos = pos + jax.lax.axis_index(cfg.ring_axis) * pos.shape[1]
             x = x + nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
                              name="pos_emb")(pos)

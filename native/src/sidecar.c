@@ -1,0 +1,497 @@
+/* spt_sidecar — terminal "side car" monitor for a splinter-tpu host.
+ *
+ * Capability parity with the reference's sidecar tool (sidecar.c: htop-style
+ * CPU/mem/swap/iowait/loadavg/battery graphs from /proc + /sys, a tail -f
+ * file mode OR a store-attach mode that label-watches the debug bloom bit on
+ * signal group 63 and prints changed keys, number keys forking `.sidecar.N`
+ * job scripts), re-designed for this store:
+ *
+ *   - store attach uses the native bloom-bit -> signal-group binding
+ *     (spt_watch_label_register) plus the event bus when armed, instead of
+ *     per-key watch registration over an enumeration;
+ *   - an extra STORE panel renders header telemetry the reference lacks:
+ *     used slots, global-epoch rate (ops/s observed from the monitor seat),
+ *     parse failures, live shard bids and the current election sovereign;
+ *   - changed-key detection is per-slot-epoch diffing over the index-based
+ *     accessors, so a burst of writes between refreshes is never missed.
+ *
+ * Usage:
+ *   spt_sidecar                  graphs only
+ *   spt_sidecar spt:NAME         attach to store NAME (shm backend)
+ *   spt_sidecar sptf:PATH        attach to file-backed store at PATH
+ *   spt_sidecar /path/to/log     tail a text file into the chatter panel
+ *
+ * Keys: q quit, 1..9 fork ./.sidecar.N (a user job script), c clear chatter.
+ */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+#include <signal.h>
+#include <time.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <termios.h>
+#include <dirent.h>
+#include <sys/ioctl.h>
+#include <sys/stat.h>
+#include <sys/wait.h>
+
+#include "sptpu.h"
+
+#define REFRESH_US      500000
+#define HIST_MAX        512
+#define CHATTER_MAX     12
+#define CHATTER_WIDTH   500
+#define DEBUG_GROUP     63u
+#define DEBUG_BLOOM_BIT 59u   /* 0x0800000000000000 — reference debug label */
+
+/* ---------------- sampled system state ---------------- */
+
+typedef struct {
+  unsigned long long user, nice, sys, idle, iowait, irq, softirq, steal;
+} cpu_sample;
+
+typedef struct {
+  double cpu_pct, mem_pct, swap_pct, io_pct;
+  double load1, load5, load15;
+  int procs_running, procs_total;
+  int battery_pct;   /* -1 when no battery exposed */
+  int on_ac;
+} sys_sample;
+
+static int g_cols = 80, g_rows = 24, g_graphw = 60;
+static volatile sig_atomic_t g_resized = 0, g_quit = 0;
+static double g_hist_cpu[HIST_MAX], g_hist_mem[HIST_MAX];
+static int g_hist_len = 0;
+
+static char *g_chatter[CHATTER_MAX];
+static int g_chatter_n = 0;
+
+static struct termios g_tio_orig;
+
+static void chatter_push(const char *line) {
+  char *dup = strndup(line, CHATTER_WIDTH);
+  if (!dup) return;
+  if (g_chatter_n == CHATTER_MAX) {
+    free(g_chatter[0]);
+    memmove(g_chatter, g_chatter + 1, (CHATTER_MAX - 1) * sizeof(char *));
+    g_chatter_n--;
+  }
+  g_chatter[g_chatter_n++] = dup;
+}
+
+static void chatter_clear(void) {
+  for (int i = 0; i < g_chatter_n; i++) free(g_chatter[i]);
+  g_chatter_n = 0;
+}
+
+/* ---------------- /proc + /sys sampling ---------------- */
+
+static int read_cpu_sample(cpu_sample *s) {
+  memset(s, 0, sizeof *s);
+  FILE *f = fopen("/proc/stat", "r");
+  if (!f) return -1;
+  int n = fscanf(f, "cpu %llu %llu %llu %llu %llu %llu %llu %llu",
+                 &s->user, &s->nice, &s->sys, &s->idle,
+                 &s->iowait, &s->irq, &s->softirq, &s->steal);
+  fclose(f);
+  return n == 8 ? 0 : -1;
+}
+
+static void sample_cpu(cpu_sample *prev, sys_sample *out) {
+  cpu_sample cur;
+  if (read_cpu_sample(&cur) < 0) { out->cpu_pct = out->io_pct = 0; return; }
+  unsigned long long pidle = prev->idle + prev->iowait;
+  unsigned long long cidle = cur.idle + cur.iowait;
+  unsigned long long pbusy = prev->user + prev->nice + prev->sys +
+                             prev->irq + prev->softirq + prev->steal;
+  unsigned long long cbusy = cur.user + cur.nice + cur.sys +
+                             cur.irq + cur.softirq + cur.steal;
+  unsigned long long dtot = (cidle + cbusy) - (pidle + pbusy);
+  if (dtot) {
+    out->cpu_pct = 100.0 * (double)(cbusy - pbusy) / (double)dtot;
+    out->io_pct  = 100.0 * (double)(cur.iowait - prev->iowait) / (double)dtot;
+  } else {
+    out->cpu_pct = out->io_pct = 0.0;
+  }
+  *prev = cur;
+}
+
+static void sample_mem(sys_sample *out) {
+  FILE *f = fopen("/proc/meminfo", "r");
+  unsigned long total = 1, avail = 0, stotal = 0, sfree = 0, v;
+  char key[64];
+  if (!f) { out->mem_pct = out->swap_pct = 0; return; }
+  while (fscanf(f, "%63s %lu kB\n", key, &v) == 2) {
+    if (!strcmp(key, "MemTotal:")) total = v;
+    else if (!strcmp(key, "MemAvailable:")) avail = v;
+    else if (!strcmp(key, "SwapTotal:")) stotal = v;
+    else if (!strcmp(key, "SwapFree:")) sfree = v;
+  }
+  fclose(f);
+  out->mem_pct  = total ? 100.0 * (double)(total - avail) / (double)total : 0;
+  out->swap_pct = stotal ? 100.0 * (double)(stotal - sfree) / (double)stotal : 0;
+}
+
+static void sample_load(sys_sample *out) {
+  FILE *f = fopen("/proc/loadavg", "r");
+  if (!f) return;
+  if (fscanf(f, "%lf %lf %lf %d/%d", &out->load1, &out->load5, &out->load15,
+             &out->procs_running, &out->procs_total) != 5) {
+    out->load1 = out->load5 = out->load15 = 0;
+  }
+  fclose(f);
+}
+
+static int read_int_file(const char *path) {
+  FILE *f = fopen(path, "r");
+  int v = -1;
+  if (f) { if (fscanf(f, "%d", &v) != 1) v = -1; fclose(f); }
+  return v;
+}
+
+static void sample_power(sys_sample *out) {
+  out->battery_pct = -1;
+  out->on_ac = 0;
+  DIR *d = opendir("/sys/class/power_supply");
+  if (!d) return;
+  struct dirent *e;
+  char path[512];
+  while ((e = readdir(d))) {
+    if (e->d_name[0] == '.') continue;
+    snprintf(path, sizeof path, "/sys/class/power_supply/%s/type", e->d_name);
+    FILE *f = fopen(path, "r");
+    char kind[32] = "";
+    if (f) { if (!fgets(kind, sizeof kind, f)) kind[0] = 0; fclose(f); }
+    if (!strncmp(kind, "Battery", 7)) {
+      snprintf(path, sizeof path, "/sys/class/power_supply/%s/capacity",
+               e->d_name);
+      out->battery_pct = read_int_file(path);
+    } else if (!strncmp(kind, "Mains", 5)) {
+      snprintf(path, sizeof path, "/sys/class/power_supply/%s/online",
+               e->d_name);
+      out->on_ac = read_int_file(path) == 1;
+    }
+  }
+  closedir(d);
+}
+
+/* ---------------- terminal handling ---------------- */
+
+static void restore_term(void) {
+  tcsetattr(STDIN_FILENO, TCSAFLUSH, &g_tio_orig);
+  printf("\x1b[?25h\x1b[0m\n");  /* cursor back on */
+  fflush(stdout);
+}
+
+static void raw_term(void) {
+  tcgetattr(STDIN_FILENO, &g_tio_orig);
+  atexit(restore_term);
+  struct termios raw = g_tio_orig;
+  raw.c_lflag &= (tcflag_t)~(ECHO | ICANON);
+  raw.c_cc[VMIN] = 0;
+  raw.c_cc[VTIME] = 0;
+  tcsetattr(STDIN_FILENO, TCSAFLUSH, &raw);
+  printf("\x1b[?25l");  /* hide cursor */
+}
+
+static void on_winch(int sig) { (void)sig; g_resized = 1; }
+static void on_int(int sig)   { (void)sig; g_quit = 1; }
+
+static void measure_term(void) {
+  struct winsize ws;
+  if (ioctl(STDOUT_FILENO, TIOCGWINSZ, &ws) == 0 && ws.ws_col > 0) {
+    g_cols = ws.ws_col;
+    g_rows = ws.ws_row;
+  }
+  g_graphw = g_cols - 14;
+  if (g_graphw > HIST_MAX) g_graphw = HIST_MAX;
+  if (g_graphw < 20) g_graphw = 20;
+}
+
+/* ---------------- rendering ---------------- */
+
+static void push_hist(double *hist, double v) {
+  /* hist is a rolling window of the most recent HIST_MAX samples */
+  if (g_hist_len == HIST_MAX)
+    memmove(hist, hist + 1, (HIST_MAX - 1) * sizeof(double));
+  hist[g_hist_len == HIST_MAX ? HIST_MAX - 1 : g_hist_len] = v;
+}
+
+static void draw_bar(const char *tag, double pct, const char *color) {
+  int fill = (int)(pct / 100.0 * g_graphw + 0.5);
+  if (fill > g_graphw) fill = g_graphw;
+  printf(" %-4s %s", tag, color);
+  for (int i = 0; i < g_graphw; i++) putchar(i < fill ? '|' : ' ');
+  printf("\x1b[0m %5.1f%%\x1b[K\n", pct);
+}
+
+static void draw_spark(const char *tag, const double *hist, const char *color) {
+  static const char *lvl = " .:-=+*#%@";
+  int n = g_hist_len < g_graphw ? g_hist_len : g_graphw;
+  int start = g_hist_len - n;
+  printf(" %-4s %s", tag, color);
+  for (int i = 0; i < g_graphw - n; i++) putchar(' ');
+  for (int i = 0; i < n; i++) {
+    int l = (int)(hist[start + i] / 100.0 * 9.0 + 0.5);
+    if (l < 0) l = 0;
+    if (l > 9) l = 9;
+    putchar(lvl[l]);
+  }
+  printf("\x1b[0m\x1b[K\n");
+}
+
+/* ---------------- store attachment ---------------- */
+
+typedef struct {
+  spt_store *st;
+  uint64_t  *epochs;        /* last seen per-slot epoch */
+  uint32_t  *idx_buf;       /* enumeration scratch, nslots entries */
+  uint32_t   nslots;
+  uint64_t   last_signal;
+  uint64_t   last_global_epoch;
+  double     ops_rate;      /* global-epoch delta per second */
+  int        bus_ok;
+} attach_t;
+
+static int attach_store(attach_t *a, const char *name, uint32_t flags) {
+  memset(a, 0, sizeof *a);
+  a->st = spt_open(name, flags);
+  if (!a->st) return -1;
+  a->nslots = spt_nslots(a->st);
+  a->epochs = calloc(a->nslots, sizeof(uint64_t));
+  a->idx_buf = calloc(a->nslots, sizeof(uint32_t));
+  if (!a->epochs || !a->idx_buf) {
+    free(a->epochs);
+    free(a->idx_buf);
+    spt_close(a->st);
+    a->st = NULL;
+    return -1;
+  }
+  for (uint32_t i = 0; i < a->nslots; i++)
+    a->epochs[i] = spt_epoch_at(a->st, i);
+  spt_watch_label_register(a->st, DEBUG_BLOOM_BIT, DEBUG_GROUP);
+  a->last_signal = spt_signal_count(a->st, DEBUG_GROUP);
+  a->bus_ok = spt_bus_open(a->st) == 0;
+  spt_header_view hv;
+  if (spt_header_snapshot(a->st, &hv) == 0)
+    a->last_global_epoch = hv.global_epoch;
+  return 0;
+}
+
+/* Pull changed debug-labeled keys into the chatter panel. */
+static void drain_debug(attach_t *a) {
+  if (!a->st) return;
+  uint64_t sig = spt_signal_count(a->st, DEBUG_GROUP);
+  if (sig == a->last_signal) return;
+  a->last_signal = sig;
+
+  uint32_t *idx = a->idx_buf;
+  int n = spt_enumerate(a->st, 1ull << DEBUG_BLOOM_BIT, idx, a->nslots);
+  for (int i = 0; i < n; i++) {
+    uint64_t e = spt_epoch_at(a->st, idx[i]);
+    if (e == a->epochs[idx[i]]) continue;
+    a->epochs[idx[i]] = e;
+    char key[SPT_KEY_MAX] = "", val[CHATTER_WIDTH] = "";
+    uint32_t len = 0;
+    spt_key_at(a->st, idx[i], key);
+    int rc = spt_get_at(a->st, idx[i], val, sizeof val - 1, &len);
+    if (rc == 0) val[len < sizeof val - 1 ? len : sizeof val - 1] = 0;
+    char line[CHATTER_WIDTH + 160];
+    snprintf(line, sizeof line, "(%llu) %s: %s",
+             (unsigned long long)e, key, rc == 0 ? val : "(unreadable)");
+    chatter_push(line);
+  }
+}
+
+static void draw_store_panel(attach_t *a, double dt) {
+  spt_header_view hv;
+  if (!a->st || spt_header_snapshot(a->st, &hv) != 0) return;
+  if (dt > 0) {
+    double inst = (double)(hv.global_epoch - a->last_global_epoch) / dt;
+    /* EWMA keeps the readout steady between refreshes */
+    a->ops_rate = a->ops_rate * 0.7 + inst * 0.3;
+  }
+  a->last_global_epoch = hv.global_epoch;
+
+  int sovereign = spt_shard_election(a->st);
+  int live_bids = 0;
+  for (int i = 0; i < SPT_MAX_BIDS; i++) {
+    spt_bid_view bv;
+    if (spt_bid_info(a->st, i, &bv) == 0 && bv.live) live_bids++;
+  }
+  printf(" \x1b[1mSTORE\x1b[0m slots %u/%u  epoch %llu  %.0f ops/s  "
+         "parse-fail %llu  bids %d",
+         hv.used_slots, hv.nslots, (unsigned long long)hv.global_epoch,
+         a->ops_rate, (unsigned long long)hv.parse_failures, live_bids);
+  if (sovereign >= 0) {
+    spt_bid_view bv;
+    if (spt_bid_info(a->st, sovereign, &bv) == 0)
+      printf("  sovereign pid %lld", (long long)bv.pid);
+  }
+  printf("  bus %s\x1b[K\n", a->bus_ok ? "armed" : "poll");
+}
+
+/* ---------------- file tail ---------------- */
+
+static FILE *g_tail_fp = NULL;
+
+static int tail_open(const char *path) {
+  g_tail_fp = fopen(path, "r");
+  if (!g_tail_fp) return -1;
+  setvbuf(g_tail_fp, NULL, _IONBF, 0);
+  fseek(g_tail_fp, 0, SEEK_END);
+  return 0;
+}
+
+static void tail_drain(void) {
+  if (!g_tail_fp) return;
+  char line[1024];
+  while (fgets(line, sizeof line, g_tail_fp)) {
+    line[strcspn(line, "\r\n")] = 0;
+    chatter_push(line);
+  }
+  clearerr(g_tail_fp);
+}
+
+/* ---------------- job hotkeys ---------------- */
+
+static void spawn_job(int n) {
+  char path[64];
+  snprintf(path, sizeof path, "./.sidecar.%d", n);
+  if (access(path, X_OK) != 0) {
+    char msg[96];
+    snprintf(msg, sizeof msg, "[job %d] %s not executable", n, path);
+    chatter_push(msg);
+    return;
+  }
+  pid_t pid = fork();
+  if (pid == 0) {
+    int devnull = open("/dev/null", O_RDWR);
+    if (devnull >= 0) {
+      dup2(devnull, STDIN_FILENO);
+      dup2(devnull, STDOUT_FILENO);
+      dup2(devnull, STDERR_FILENO);
+      if (devnull > 2) close(devnull);
+    }
+    execl(path, path, (char *)NULL);
+    _exit(127);
+  }
+  char msg[96];
+  snprintf(msg, sizeof msg, "[job %d] forked pid %d", n, (int)pid);
+  chatter_push(msg);
+}
+
+/* ---------------- main ---------------- */
+
+int main(int argc, char **argv) {
+  attach_t at = {0};
+  const char *title = "system";
+
+  if (argc > 1) {
+    if (!strncmp(argv[1], "spt:", 4)) {
+      if (attach_store(&at, argv[1] + 4, SPT_BACKEND_SHM) < 0) {
+        fprintf(stderr, "spt_sidecar: cannot open store %s: %s\n",
+                argv[1] + 4, strerror(spt_last_error()));
+        return 1;
+      }
+      title = argv[1];
+    } else if (!strncmp(argv[1], "sptf:", 5)) {
+      if (attach_store(&at, argv[1] + 5, SPT_BACKEND_FILE) < 0) {
+        fprintf(stderr, "spt_sidecar: cannot open store file %s: %s\n",
+                argv[1] + 5, strerror(spt_last_error()));
+        return 1;
+      }
+      title = argv[1];
+    } else {
+      if (tail_open(argv[1]) < 0) {
+        fprintf(stderr, "spt_sidecar: cannot tail %s\n", argv[1]);
+        return 1;
+      }
+      title = argv[1];
+    }
+  }
+
+  signal(SIGWINCH, on_winch);
+  signal(SIGINT, on_int);
+  signal(SIGTERM, on_int);
+  signal(SIGCHLD, SIG_IGN);  /* auto-reap forked jobs */
+  measure_term();
+  raw_term();
+
+  cpu_sample prev_cpu;
+  read_cpu_sample(&prev_cpu);
+  struct timespec prev_ts;
+  clock_gettime(CLOCK_MONOTONIC, &prev_ts);
+
+  printf("\x1b[2J");
+  while (!g_quit) {
+    if (g_resized) { measure_term(); g_resized = 0; printf("\x1b[2J"); }
+
+    sys_sample s = {0};
+    sample_cpu(&prev_cpu, &s);
+    sample_mem(&s);
+    sample_load(&s);
+    sample_power(&s);
+    push_hist(g_hist_cpu, s.cpu_pct);
+    push_hist(g_hist_mem, s.mem_pct);
+    if (g_hist_len < HIST_MAX) g_hist_len++;
+
+    struct timespec now_ts;
+    clock_gettime(CLOCK_MONOTONIC, &now_ts);
+    double dt = (double)(now_ts.tv_sec - prev_ts.tv_sec) +
+                (double)(now_ts.tv_nsec - prev_ts.tv_nsec) / 1e9;
+    prev_ts = now_ts;
+
+    drain_debug(&at);
+    tail_drain();
+
+    printf("\x1b[H");
+    printf(" \x1b[1mspt_sidecar\x1b[0m — %s   load %.2f %.2f %.2f  "
+           "procs %d/%d", title, s.load1, s.load5, s.load15,
+           s.procs_running, s.procs_total);
+    if (s.battery_pct >= 0)
+      printf("  batt %d%%%s", s.battery_pct, s.on_ac ? "+" : "");
+    printf("\x1b[K\n");
+
+    draw_bar("cpu", s.cpu_pct, "\x1b[32m");
+    draw_bar("mem", s.mem_pct, "\x1b[36m");
+    draw_bar("swap", s.swap_pct, "\x1b[35m");
+    draw_bar("io", s.io_pct, "\x1b[33m");
+    draw_spark("cpu~", g_hist_cpu, "\x1b[32m");
+    draw_spark("mem~", g_hist_mem, "\x1b[36m");
+    draw_store_panel(&at, dt);
+
+    printf(" \x1b[1mchatter\x1b[0m (q quit, c clear, 1-9 jobs)\x1b[K\n");
+    int room = g_rows - 10 - (at.st ? 1 : 0);
+    if (room > CHATTER_MAX) room = CHATTER_MAX;
+    int first = g_chatter_n > room ? g_chatter_n - room : 0;
+    for (int i = first; i < g_chatter_n; i++) {
+      int w = g_cols - 3;
+      printf("  %.*s\x1b[K\n", w > 0 ? w : 0, g_chatter[i]);
+    }
+    printf("\x1b[J");
+    fflush(stdout);
+
+    char ch;
+    while (read(STDIN_FILENO, &ch, 1) == 1) {
+      if (ch == 'q') g_quit = 1;
+      else if (ch == 'c') chatter_clear();
+      else if (ch >= '1' && ch <= '9') spawn_job(ch - '0');
+    }
+    usleep(REFRESH_US);
+  }
+
+  chatter_clear();
+  if (g_tail_fp) fclose(g_tail_fp);
+  if (at.st) {
+    spt_watch_label_unregister(at.st, DEBUG_BLOOM_BIT, DEBUG_GROUP);
+    spt_bus_close(at.st);
+    spt_close(at.st);
+  }
+  free(at.epochs);
+  free(at.idx_buf);
+  return 0;
+}

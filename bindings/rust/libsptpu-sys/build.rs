@@ -1,0 +1,18 @@
+fn main() {
+    println!("cargo:rerun-if-changed=csrc/store.c");
+    println!("cargo:rerun-if-changed=csrc/coord.c");
+    println!("cargo:rerun-if-changed=csrc/internal.h");
+    println!("cargo:rerun-if-changed=csrc/sptpu.h");
+
+    cc::Build::new()
+        .file("csrc/store.c")
+        .file("csrc/coord.c")
+        .include("csrc")
+        .flag_if_supported("-std=c11")
+        .flag_if_supported("-pthread")
+        .opt_level(2)
+        .compile("sptpu");
+
+    // librt for shm_open on older glibc; harmless elsewhere on Linux
+    println!("cargo:rustc-link-lib=rt");
+}

@@ -1,0 +1,472 @@
+/* coord.c — coordination protocols of the splinter-tpu store:
+ *   - signal arena: 64 cache-line-aligned atomic counters (pub/sub)
+ *   - bloom-label -> signal-group routing
+ *   - event bus: eventfd armed by an owner, re-opened cross-process via
+ *     pidfd_getfd, with a 1024-bit dirty mask (slot idx % 1024)
+ *   - shard bid table + deterministic read-only election + cooperative
+ *     posix_madvise gated on sovereignty
+ *   - raw tick clock (rdtsc / cntvct / CLOCK_MONOTONIC_RAW) + calibration
+ *
+ * Capability parity with the reference (splinter.c:889-1403, SURVEY.md
+ * §2.1 L3 rows); TPU-first deltas: each bloom bit routes to a *mask* of
+ * groups (reference: one group per bit), and spt_signal_wait gives FFI
+ * callers a C-side blocking wait so the Python engine never spins.
+ */
+#include "internal.h"
+
+#include <poll.h>
+#include <stdio.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+/* ------------------------------------------------------------------ time */
+
+uint64_t spt_now(void) {
+#if defined(__x86_64__)
+  uint32_t lo, hi;
+  __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((uint64_t)hi << 32) | lo;
+#elif defined(__aarch64__)
+  uint64_t v;
+  __asm__ __volatile__("mrs %0, cntvct_el0" : "=r"(v));
+  return v;
+#else
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC_RAW, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+#endif
+}
+
+static uint64_t calibrate_ticks_per_us(void) {
+  struct timespec a, b, req = {0, 2000000}; /* 2 ms */
+  clock_gettime(CLOCK_MONOTONIC_RAW, &a);
+  uint64_t t0 = spt_now();
+  nanosleep(&req, NULL);
+  uint64_t t1 = spt_now();
+  clock_gettime(CLOCK_MONOTONIC_RAW, &b);
+  uint64_t ns = (uint64_t)(b.tv_sec - a.tv_sec) * 1000000000ull +
+                (uint64_t)(b.tv_nsec - a.tv_nsec);
+  if (ns == 0 || t1 <= t0) return 1;
+  uint64_t tpu = (t1 - t0) * 1000ull / ns;
+  return tpu ? tpu : 1;
+}
+
+uint64_t spt_ticks_per_us(void) {
+  static _Atomic uint64_t cached;
+  uint64_t v = atomic_load_explicit(&cached, memory_order_relaxed);
+  if (v) return v;
+  v = calibrate_ticks_per_us();
+  atomic_store_explicit(&cached, v, memory_order_relaxed);
+  return v;
+}
+
+uint64_t spt__now_us(void) { return spt_now() / spt_ticks_per_us(); }
+
+/* ---------------------------------------------------------- signal arena */
+
+int spt_signal_pulse(spt_store *st, uint32_t group) {
+  if (!st || group >= SPT_SIGNAL_GROUPS) return -EINVAL;
+  atomic_fetch_add_explicit(&st->h->signals[group].v, 1,
+                            memory_order_acq_rel);
+  return 0;
+}
+
+uint64_t spt_signal_count(spt_store *st, uint32_t group) {
+  if (!st || group >= SPT_SIGNAL_GROUPS) return 0;
+  return atomic_load_explicit(&st->h->signals[group].v,
+                              memory_order_acquire);
+}
+
+int spt_watch_register(spt_store *st, const char *key, uint32_t group) {
+  if (!st || !key || group >= SPT_SIGNAL_GROUPS) return -EINVAL;
+  int idx = spt_find_index(st, key);
+  if (idx < 0) return idx;
+  atomic_fetch_or_explicit(&st->slots[idx].watcher_mask, 1ull << group,
+                           memory_order_acq_rel);
+  return 0;
+}
+
+int spt_watch_unregister(spt_store *st, const char *key, uint32_t group) {
+  if (!st || !key || group >= SPT_SIGNAL_GROUPS) return -EINVAL;
+  int idx = spt_find_index(st, key);
+  if (idx < 0) return idx;
+  atomic_fetch_and_explicit(&st->slots[idx].watcher_mask,
+                            ~(1ull << group), memory_order_acq_rel);
+  return 0;
+}
+
+int spt_watch_label_register(spt_store *st, uint32_t bloom_bit,
+                             uint32_t group) {
+  if (!st || bloom_bit >= SPT_BLOOM_BITS || group >= SPT_SIGNAL_GROUPS)
+    return -EINVAL;
+  atomic_fetch_or_explicit(&st->h->bloom_groups[bloom_bit], 1ull << group,
+                           memory_order_acq_rel);
+  return 0;
+}
+
+int spt_watch_label_unregister(spt_store *st, uint32_t bloom_bit,
+                               uint32_t group) {
+  if (!st || bloom_bit >= SPT_BLOOM_BITS || group >= SPT_SIGNAL_GROUPS)
+    return -EINVAL;
+  atomic_fetch_and_explicit(&st->h->bloom_groups[bloom_bit],
+                            ~(1ull << group), memory_order_acq_rel);
+  return 0;
+}
+
+static void pulse_mask(spt_store *st, uint64_t groups) {
+  while (groups) {
+    uint32_t g = (uint32_t)__builtin_ctzll(groups);
+    groups &= groups - 1;
+    atomic_fetch_add_explicit(&st->h->signals[g].v, 1,
+                              memory_order_acq_rel);
+  }
+}
+
+static void bus_notify(spt_store *st, uint32_t idx);
+
+/* Post-write fanout: pulse the slot's watcher groups, the groups bound to
+ * each of its label bits, bump the store epoch, and ring the event bus. */
+void spt__fanout(spt_store *st, uint32_t idx, spt_slot *s) {
+  uint64_t groups =
+      atomic_load_explicit(&s->watcher_mask, memory_order_acquire);
+  uint64_t labels = atomic_load_explicit(&s->labels, memory_order_acquire);
+  while (labels) {
+    uint32_t b = (uint32_t)__builtin_ctzll(labels);
+    labels &= labels - 1;
+    groups |= atomic_load_explicit(&st->h->bloom_groups[b],
+                                   memory_order_acquire);
+  }
+  pulse_mask(st, groups);
+  atomic_fetch_add_explicit(&st->h->global_epoch, 1, memory_order_acq_rel);
+  bus_notify(st, idx);
+}
+
+int spt_bump(spt_store *st, const char *key) {
+  if (!st || !key) return -EINVAL;
+  int idx = spt_find_index(st, key);
+  if (idx < 0) return idx;
+  spt__fanout(st, (uint32_t)idx, &st->slots[idx]);
+  return 0;
+}
+
+int spt_signal_wait(spt_store *st, uint32_t group, uint64_t last,
+                    int timeout_ms, uint64_t *count_out) {
+  if (!st || group >= SPT_SIGNAL_GROUPS) return -EINVAL;
+  uint64_t tpu = spt_ticks_per_us();
+  uint64_t deadline =
+      timeout_ms < 0 ? 0 : spt_now() + (uint64_t)timeout_ms * 1000 * tpu;
+  struct timespec ts = {0, 1000000};
+  for (;;) {
+    uint64_t c = spt_signal_count(st, group);
+    if (c != last) {
+      if (count_out) *count_out = c;
+      return 0;
+    }
+    if (timeout_ms >= 0 && spt_now() >= deadline) return -ETIMEDOUT;
+    if (spt__bus_ensure_open(st) == 0)
+      spt_bus_wait(st, 1);
+    else
+      nanosleep(&ts, NULL);
+  }
+}
+
+/* -------------------------------------------------------------- event bus */
+
+static void bus_notify(spt_store *st, uint32_t idx) {
+  spt_hdr *h = st->h;
+  if (atomic_load_explicit(&h->bus_pid, memory_order_acquire) == 0)
+    return;                              /* bus not armed: free fast path */
+  uint32_t bit = idx % (SPT_DIRTY_WORDS * 64);
+  atomic_fetch_or_explicit(&h->dirty[bit / 64], 1ull << (bit % 64),
+                           memory_order_acq_rel);
+  if (spt__bus_ensure_open(st) == 0) {
+    uint64_t one = 1;
+    ssize_t r = write(st->my_bus_fd, &one, sizeof one);
+    (void)r;
+  }
+}
+
+int spt_bus_init(spt_store *st) {
+  if (!st) return -EINVAL;
+  int fd = eventfd(0, EFD_NONBLOCK);
+  if (fd < 0) return -errno;
+  spt_hdr *h = st->h;
+  if (st->my_bus_fd >= 0) close(st->my_bus_fd);
+  st->my_bus_fd = fd;
+  atomic_store_explicit(&h->bus_fd, fd, memory_order_release);
+  atomic_store_explicit(&h->bus_pid, (int64_t)getpid(),
+                        memory_order_release);
+  st->my_bus_gen =
+      atomic_fetch_add_explicit(&h->bus_gen, 1, memory_order_acq_rel) + 1;
+  st->bus_owner = 1;
+  return 0;
+}
+
+#ifndef SYS_pidfd_open
+#define SYS_pidfd_open 434
+#endif
+#ifndef SYS_pidfd_getfd
+#define SYS_pidfd_getfd 438
+#endif
+
+int spt_bus_open(spt_store *st) {
+  if (!st) return -EINVAL;
+  spt_hdr *h = st->h;
+  int64_t owner = atomic_load_explicit(&h->bus_pid, memory_order_acquire);
+  if (owner == 0) return -ENOTCONN;
+  if (owner == getpid()) {
+    /* same process as the owner: the fd number in the header is valid
+     * here — dup it for this handle */
+    if (st->my_bus_fd >= 0) return 0;
+    int fd = dup(atomic_load_explicit(&h->bus_fd, memory_order_acquire));
+    if (fd < 0) return -EBADF;
+    st->my_bus_fd = fd;
+    st->my_bus_gen = atomic_load_explicit(&h->bus_gen, memory_order_acquire);
+    return 0;
+  }
+  int pidfd = (int)syscall(SYS_pidfd_open, (pid_t)owner, 0);
+  if (pidfd < 0) return errno == ENOSYS ? -ENOSYS : -errno;
+  int target = atomic_load_explicit(&h->bus_fd, memory_order_acquire);
+  int fd = (int)syscall(SYS_pidfd_getfd, pidfd, target, 0);
+  int saved = errno;
+  close(pidfd);
+  if (fd < 0) return saved == ENOSYS ? -ENOSYS : -saved;
+  if (st->my_bus_fd >= 0) close(st->my_bus_fd);
+  st->my_bus_fd = fd;
+  st->my_bus_gen = atomic_load_explicit(&h->bus_gen, memory_order_acquire);
+  return 0;
+}
+
+int spt__bus_ensure_open(spt_store *st) {
+  spt_hdr *h = st->h;
+  if (atomic_load_explicit(&h->bus_pid, memory_order_acquire) == 0)
+    return -ENOTCONN;
+  uint32_t gen = atomic_load_explicit(&h->bus_gen, memory_order_acquire);
+  if (st->my_bus_fd >= 0 && st->my_bus_gen == gen) return 0;
+  if (st->my_bus_fd < 0 && st->my_bus_gen == gen && gen != 0)
+    return -ENOSYS;   /* attach already failed for this arming: don't
+                         re-run pidfd syscalls on every write */
+  int rc = spt_bus_open(st);
+  if (rc < 0) st->my_bus_gen = gen;   /* cache the failure per-generation */
+  return rc;
+}
+
+int spt_bus_wait(spt_store *st, int timeout_ms) {
+  if (!st) return -EINVAL;
+  int rc = spt__bus_ensure_open(st);
+  if (rc < 0) return rc;
+  struct pollfd p = {.fd = st->my_bus_fd, .events = POLLIN};
+  int n = poll(&p, 1, timeout_ms);
+  if (n < 0) return -errno;
+  if (n == 0) return -ETIMEDOUT;
+  uint64_t v;
+  ssize_t r = read(st->my_bus_fd, &v, sizeof v); /* drain the counter */
+  (void)r;
+  return 0;
+}
+
+int spt_bus_close(spt_store *st) {
+  if (!st) return -EINVAL;
+  spt_hdr *h = st->h;
+  if (st->my_bus_fd >= 0) {
+    if (st->bus_owner &&
+        atomic_load_explicit(&h->bus_pid, memory_order_acquire) ==
+            getpid()) {
+      atomic_store_explicit(&h->bus_pid, 0, memory_order_release);
+      atomic_store_explicit(&h->bus_fd, -1, memory_order_release);
+    }
+    close(st->my_bus_fd);
+    st->my_bus_fd = -1;
+    st->bus_owner = 0;
+  }
+  return 0;
+}
+
+int spt_bus_drain(spt_store *st, uint64_t dirty_out[SPT_DIRTY_WORDS]) {
+  if (!st || !dirty_out) return -EINVAL;
+  int bits = 0;
+  for (int w = 0; w < SPT_DIRTY_WORDS; w++) {
+    uint64_t v = atomic_exchange_explicit(&st->h->dirty[w], 0,
+                                          memory_order_acq_rel);
+    dirty_out[w] = v;
+    bits += __builtin_popcountll(v);
+  }
+  return bits;
+}
+
+int spt_bus_peek(spt_store *st, uint64_t dirty_out[SPT_DIRTY_WORDS]) {
+  if (!st || !dirty_out) return -EINVAL;
+  int bits = 0;
+  for (int w = 0; w < SPT_DIRTY_WORDS; w++) {
+    uint64_t v =
+        atomic_load_explicit(&st->h->dirty[w], memory_order_acquire);
+    dirty_out[w] = v;
+    bits += __builtin_popcountll(v);
+  }
+  return bits;
+}
+
+/* ----------------------------------------------------- shard bid election */
+
+static int bid_live(const spt_bid *b, uint64_t now_us) {
+  if (atomic_load_explicit((_Atomic int64_t *)&b->pid,
+                           memory_order_acquire) == 0)
+    return 0;
+  uint64_t dur =
+      atomic_load_explicit((_Atomic uint64_t *)&b->duration_us,
+                           memory_order_acquire);
+  if (dur == 0) return 0;                    /* born expired */
+  uint64_t at = atomic_load_explicit((_Atomic uint64_t *)&b->claimed_at,
+                                     memory_order_acquire);
+  return now_us < at + dur;
+}
+
+int spt_shard_claim_ex(spt_store *st, uint64_t shard_id, int64_t pid,
+                       spt_advice_t intent, uint32_t priority,
+                       uint64_t duration_us, uint64_t claimed_at_us) {
+  if (!st) return -EINVAL;
+  for (int i = 0; i < SPT_MAX_BIDS; i++) {
+    spt_bid *b = &st->h->bids[i];
+    int64_t expect = 0;
+    if (atomic_compare_exchange_strong_explicit(&b->pid, &expect, pid,
+                                                memory_order_acq_rel,
+                                                memory_order_acquire)) {
+      atomic_store_explicit(&b->shard_id, shard_id, memory_order_relaxed);
+      atomic_store_explicit(&b->intent, (uint32_t)intent,
+                            memory_order_relaxed);
+      atomic_store_explicit(&b->priority, priority, memory_order_relaxed);
+      atomic_store_explicit(&b->duration_us, duration_us,
+                            memory_order_relaxed);
+      atomic_store_explicit(&b->claimed_at, claimed_at_us,
+                            memory_order_release);
+      return i;
+    }
+  }
+  return -ENOSPC;
+}
+
+int spt_shard_claim(spt_store *st, uint64_t shard_id, spt_advice_t intent,
+                    uint32_t priority, uint64_t duration_us) {
+  return spt_shard_claim_ex(st, shard_id, (int64_t)getpid(), intent,
+                            priority, duration_us, spt__now_us());
+}
+
+int spt_shard_rebid(spt_store *st, int bid_idx) {
+  if (!st || bid_idx < 0 || bid_idx >= SPT_MAX_BIDS) return -EINVAL;
+  spt_bid *b = &st->h->bids[bid_idx];
+  if (atomic_load_explicit(&b->pid, memory_order_acquire) == 0)
+    return -ENOENT;
+  atomic_store_explicit(&b->claimed_at, spt__now_us(),
+                        memory_order_release);
+  return 0;
+}
+
+int spt_shard_release(spt_store *st, int bid_idx) {
+  if (!st || bid_idx < 0 || bid_idx >= SPT_MAX_BIDS) return -EINVAL;
+  atomic_store_explicit(&st->h->bids[bid_idx].pid, 0,
+                        memory_order_release);
+  return 0;
+}
+
+/* Deterministic, read-only election over the bid table:
+ *   - only live (unexpired, pid!=0) bids compete;
+ *   - DONTNEED bids ("soft bumpers") cannot win while any live non-DONTNEED
+ *     bid exists;
+ *   - winner = highest priority, ties -> earliest claimed_at -> lowest pid.
+ * Every process computes the same winner from the same table. */
+int spt_shard_election(spt_store *st) {
+  if (!st) return -EINVAL;
+  uint64_t now = spt__now_us();
+  int winner = -1;
+  int winner_bumper = 0;
+  uint32_t w_prio = 0;
+  uint64_t w_at = 0;
+  int64_t w_pid = 0;
+  for (int i = 0; i < SPT_MAX_BIDS; i++) {
+    spt_bid *b = &st->h->bids[i];
+    if (!bid_live(b, now)) continue;
+    int is_bumper =
+        atomic_load_explicit(&b->intent, memory_order_acquire) ==
+        (uint32_t)SPT_ADV_DONTNEED;
+    uint32_t prio = atomic_load_explicit(&b->priority, memory_order_acquire);
+    uint64_t at = atomic_load_explicit(&b->claimed_at, memory_order_acquire);
+    int64_t pid = atomic_load_explicit(&b->pid, memory_order_acquire);
+    int better;
+    if (winner < 0) better = 1;
+    else if (winner_bumper && !is_bumper) better = 1;   /* real beats bumper */
+    else if (!winner_bumper && is_bumper) better = 0;
+    else if (prio != w_prio) better = prio > w_prio;
+    else if (at != w_at) better = at < w_at;
+    else better = pid < w_pid;
+    if (better) {
+      winner = i;
+      winner_bumper = is_bumper;
+      w_prio = prio;
+      w_at = at;
+      w_pid = pid;
+    }
+  }
+  return winner >= 0 ? winner : -ENOENT;
+}
+
+int spt_bid_info(spt_store *st, int bid_idx, spt_bid_view *out) {
+  if (!st || !out || bid_idx < 0 || bid_idx >= SPT_MAX_BIDS) return -EINVAL;
+  spt_bid *b = &st->h->bids[bid_idx];
+  out->pid = atomic_load_explicit(&b->pid, memory_order_acquire);
+  out->shard_id = atomic_load_explicit(&b->shard_id, memory_order_acquire);
+  out->claimed_at =
+      atomic_load_explicit(&b->claimed_at, memory_order_acquire);
+  out->duration = atomic_load_explicit(&b->duration_us, memory_order_acquire);
+  out->intent = atomic_load_explicit(&b->intent, memory_order_acquire);
+  out->priority = atomic_load_explicit(&b->priority, memory_order_acquire);
+  out->live = bid_live(b, spt__now_us());
+  return 0;
+}
+
+static int advice_to_posix(spt_advice_t a) {
+  switch (a) {
+    case SPT_ADV_SEQUENTIAL: return POSIX_MADV_SEQUENTIAL;
+    case SPT_ADV_RANDOM:     return POSIX_MADV_RANDOM;
+    case SPT_ADV_WILLNEED:   return POSIX_MADV_WILLNEED;
+    case SPT_ADV_DONTNEED:   return POSIX_MADV_DONTNEED;
+    default:                 return POSIX_MADV_NORMAL;
+  }
+}
+
+int spt_madvise(spt_store *st, int bid_idx, uint64_t offset, uint64_t len,
+                spt_advice_t advice, int timeout_ms) {
+  if (!st || bid_idx < 0 || bid_idx >= SPT_MAX_BIDS) return -EINVAL;
+  spt_bid *b = &st->h->bids[bid_idx];
+  if (atomic_load_explicit(&b->pid, memory_order_acquire) !=
+      (int64_t)getpid())
+    return -EPERM;                          /* must hold the bid yourself */
+  if (!bid_live(b, spt__now_us())) return -EPERM;
+  if (len == 0) { offset = 0; len = st->map_size; }
+  if (offset + len > st->map_size) return -EINVAL;
+  /* page-align the window */
+  uint64_t page = 4096;
+  uint64_t start = offset & ~(page - 1);
+  uint64_t end = (offset + len + page - 1) & ~(page - 1);
+
+  uint64_t tpu = spt_ticks_per_us();
+  uint64_t deadline =
+      timeout_ms <= 0 ? 0 : spt_now() + (uint64_t)timeout_ms * 1000 * tpu;
+  struct timespec ts = {0, 5000000};        /* 5 ms */
+  for (;;) {
+    int sovereign = spt_shard_election(st);
+    if (sovereign == bid_idx) {
+      int rc = posix_madvise(st->base + start, end - start,
+                             advice_to_posix(advice));
+      return rc == 0 ? 0 : -rc;
+    }
+    if (timeout_ms == 0) return -EAGAIN;    /* defer */
+    if (timeout_ms > 0 && spt_now() >= deadline) return -ETIMEDOUT;
+    if (spt__bus_ensure_open(st) == 0)
+      spt_bus_wait(st, 5);
+    else
+      nanosleep(&ts, NULL);
+  }
+}

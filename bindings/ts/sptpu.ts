@@ -1,0 +1,575 @@
+/* TypeScript FFI bindings for the splinter-tpu native store (libsptpu.so).
+ *
+ * Capability parity with the reference's Bun/Deno bindings
+ * (bindings/ts/splinter.ts: SplinterStore interface + SplinterWatcher async
+ * poller), re-designed for this store's handle-based C ABI:
+ *
+ *   - every call carries an explicit store handle (the reference ABI holds
+ *     one implicit global store per process);
+ *   - negative-errno returns surface as plain numbers (0 ok, -N errno);
+ *   - the embedding dimension is read from the store geometry instead of
+ *     being compiled in (reference hardcodes 768);
+ *   - extra surface the reference lacks: tandem keys, integer ops, bloom
+ *     enumeration, event-bus drain, header stats.
+ *
+ * Works under BOTH Bun (bun:ffi) and Deno (Deno.dlopen); the `openStore` /
+ * `createStore` factories pick the right backend at runtime.
+ *
+ * Usage (either runtime):
+ *   import { createStore, SptWatcher } from "./sptpu.ts";
+ *   const st = createStore("/my_bus", { nslots: 1024, maxVal: 4096, vecDim: 768 });
+ *   st.set("greeting", "hello");
+ *   st.setLabel("greeting", 1n);      // bloom bit 0 => wake the embedder
+ *   st.bump("greeting");
+ *   const vec = st.getEmbedding("greeting");   // Float32Array | null
+ */
+
+const KEY_MAX = 128;
+const DIRTY_WORDS = 16;
+
+export interface SptEntry {
+  key: string;
+  epoch: bigint;
+}
+
+export interface CreateOpts {
+  nslots?: number;
+  maxVal?: number;
+  vecDim?: number;
+  file?: boolean; // file-backed (persistent) instead of POSIX shm
+}
+
+/** Common store surface implemented by both runtime backends. */
+export interface SptStore {
+  close(): void;
+  // KV
+  set(key: string, value: string | Uint8Array): number;
+  get(key: string): Uint8Array | null;
+  getString(key: string): string | null;
+  unset(key: string): number;
+  append(key: string, value: string | Uint8Array): number;
+  list(maxKeys?: number): SptEntry[];
+  poll(key: string, timeoutMs: number): number;
+  // metadata
+  getEpoch(key: string): bigint;
+  setLabel(key: string, mask: bigint): number;
+  clearLabel(key: string, mask: bigint): number;
+  getLabels(key: string): bigint;
+  setType(key: string, typeFlag: number): number;
+  getType(key: string): number;
+  integerOp(key: string, op: number, operand: bigint): bigint | null;
+  // tandem (ordered) keys: base, base.1, base.2, ...
+  tandemSet(base: string, order: number, value: string | Uint8Array): number;
+  tandemGet(base: string, order: number): Uint8Array | null;
+  tandemCount(base: string): number;
+  // signals
+  getSignalCount(group: number): bigint;
+  pulse(group: number): number;
+  bump(key: string): number;
+  watchRegister(key: string, group: number): number;
+  watchUnregister(key: string, group: number): number;
+  watchLabelRegister(bloomBit: number, group: number): number;
+  watchLabelUnregister(bloomBit: number, group: number): number;
+  // bloom enumeration: slot indices where (labels & mask) === mask
+  enumerate(mask: bigint, maxOut?: number): Uint32Array;
+  keyAt(idx: number): string | null;
+  // embeddings
+  vecDim(): number;
+  getEmbedding(key: string): Float32Array | null;
+  setEmbedding(key: string, vec: Float32Array): number;
+  // event bus
+  busInit(): number;
+  busOpen(): number;
+  busWait(timeoutMs: number): number;
+  busDrain(): BigUint64Array; // 16-word dirty mask (fetch-and-clear)
+  // geometry / stats
+  nslots(): number;
+  maxVal(): number;
+}
+
+/* ------------------------------------------------------------------ */
+/* symbol table (shared shape between the two runtimes)               */
+/* ------------------------------------------------------------------ */
+
+// p = pointer, b = buffer (byte array in), c = cstring in, u32/u64/i32 ints
+const SYMBOLS: Record<string, { args: string[]; ret: string }> = {
+  spt_create: { args: ["b", "u32", "u32", "u32", "u32"], ret: "p" },
+  spt_open: { args: ["b", "u32"], ret: "p" },
+  spt_close: { args: ["p"], ret: "i32" },
+  spt_unlink: { args: ["b", "u32"], ret: "i32" },
+  spt_nslots: { args: ["p"], ret: "u32" },
+  spt_max_val: { args: ["p"], ret: "u32" },
+  spt_vec_dim: { args: ["p"], ret: "u32" },
+  spt_set: { args: ["p", "b", "b", "u32"], ret: "i32" },
+  spt_get: { args: ["p", "b", "b", "u32", "b"], ret: "i32" },
+  spt_unset: { args: ["p", "b"], ret: "i32" },
+  spt_append: { args: ["p", "b", "b", "u32"], ret: "i32" },
+  spt_list: { args: ["p", "b", "u32"], ret: "i32" },
+  spt_poll: { args: ["p", "b", "i32"], ret: "i32" },
+  spt_find_index: { args: ["p", "b"], ret: "i32" },
+  spt_key_at: { args: ["p", "u32", "b"], ret: "i32" },
+  spt_epoch_at: { args: ["p", "u32"], ret: "u64" },
+  spt_set_type: { args: ["p", "b", "u32"], ret: "i32" },
+  spt_get_type: { args: ["p", "b", "b"], ret: "i32" },
+  spt_integer_op: { args: ["p", "b", "i32", "u64", "b"], ret: "i32" },
+  spt_tandem_set: { args: ["p", "b", "u32", "b", "u32"], ret: "i32" },
+  spt_tandem_get: { args: ["p", "b", "u32", "b", "u32", "b"], ret: "i32" },
+  spt_tandem_count: { args: ["p", "b"], ret: "i32" },
+  spt_label_or: { args: ["p", "b", "u64"], ret: "i32" },
+  spt_label_andnot: { args: ["p", "b", "u64"], ret: "i32" },
+  spt_get_labels: { args: ["p", "b", "b"], ret: "i32" },
+  spt_enumerate: { args: ["p", "u64", "b", "u32"], ret: "i32" },
+  spt_watch_register: { args: ["p", "b", "u32"], ret: "i32" },
+  spt_watch_unregister: { args: ["p", "b", "u32"], ret: "i32" },
+  spt_watch_label_register: { args: ["p", "u32", "u32"], ret: "i32" },
+  spt_watch_label_unregister: { args: ["p", "u32", "u32"], ret: "i32" },
+  spt_signal_count: { args: ["p", "u32"], ret: "u64" },
+  spt_signal_pulse: { args: ["p", "u32"], ret: "i32" },
+  spt_bump: { args: ["p", "b"], ret: "i32" },
+  spt_vec_set: { args: ["p", "b", "b", "u32"], ret: "i32" },
+  spt_vec_get: { args: ["p", "b", "b", "u32"], ret: "i32" },
+  spt_bus_init: { args: ["p"], ret: "i32" },
+  spt_bus_open: { args: ["p"], ret: "i32" },
+  spt_bus_wait: { args: ["p", "i32"], ret: "i32" },
+  spt_bus_close: { args: ["p"], ret: "i32" },
+  spt_bus_drain: { args: ["p", "b"], ret: "i32" },
+};
+
+const enc = new TextEncoder();
+const dec = new TextDecoder();
+
+function cstr(s: string): Uint8Array {
+  return enc.encode(s + "\0");
+}
+
+function toBytes(v: string | Uint8Array): Uint8Array {
+  return typeof v === "string" ? enc.encode(v) : v;
+}
+
+/* ------------------------------------------------------------------ */
+/* runtime adapters                                                    */
+/* ------------------------------------------------------------------ */
+
+type RawCall = (...args: unknown[]) => unknown;
+
+interface Runtime {
+  symbols: Record<string, RawCall>;
+  close(): void;
+}
+
+declare const Bun: { version: string } | undefined;
+// deno-lint-ignore no-explicit-any
+declare const Deno: any;
+
+function isBun(): boolean {
+  return typeof Bun !== "undefined";
+}
+
+function isDeno(): boolean {
+  // @ts-ignore: cross-runtime probe
+  return typeof Deno !== "undefined" && !!Deno.dlopen;
+}
+
+async function loadBun(libPath: string): Promise<Runtime> {
+  // @ts-ignore: bun-only module
+  const { dlopen, FFIType, ptr } = await import("bun:ffi");
+  const t: Record<string, unknown> = {
+    p: FFIType.ptr,
+    b: FFIType.ptr,
+    u32: FFIType.u32,
+    u64: FFIType.u64,
+    i32: FFIType.i32,
+  };
+  const defs: Record<string, unknown> = {};
+  for (const [name, sig] of Object.entries(SYMBOLS)) {
+    defs[name] = { args: sig.args.map((a) => t[a]), returns: t[sig.ret] };
+  }
+  const lib = dlopen(libPath, defs);
+  const symbols: Record<string, RawCall> = {};
+  for (const name of Object.keys(SYMBOLS)) {
+    const sig = SYMBOLS[name];
+    symbols[name] = (...args: unknown[]) => {
+      const conv = args.map((a, i) =>
+        sig.args[i] === "b" && a instanceof Uint8Array ? ptr(a) : a
+      );
+      return lib.symbols[name](...conv);
+    };
+  }
+  return { symbols, close: () => lib.close() };
+}
+
+function loadDeno(libPath: string): Runtime {
+  const t: Record<string, string> = {
+    p: "pointer",
+    b: "buffer",
+    u32: "u32",
+    u64: "u64",
+    i32: "i32",
+  };
+  const defs: Record<string, unknown> = {};
+  for (const [name, sig] of Object.entries(SYMBOLS)) {
+    defs[name] = {
+      parameters: sig.args.map((a) => t[a]),
+      result: t[sig.ret],
+    };
+  }
+  const lib = Deno.dlopen(libPath, defs);
+  return { symbols: lib.symbols, close: () => lib.close() };
+}
+
+/* ------------------------------------------------------------------ */
+/* the store wrapper                                                   */
+/* ------------------------------------------------------------------ */
+
+export class Store implements SptStore {
+  private rt: Runtime;
+  private h: unknown; // spt_store*
+  private dim: number;
+
+  constructor(rt: Runtime, handle: unknown) {
+    if (!handle) throw new Error("sptpu: null store handle");
+    this.rt = rt;
+    this.h = handle;
+    this.dim = Number(this.rt.symbols.spt_vec_dim(this.h));
+  }
+
+  close(): void {
+    this.rt.symbols.spt_close(this.h);
+  }
+
+  set(key: string, value: string | Uint8Array): number {
+    const v = toBytes(value);
+    return Number(this.rt.symbols.spt_set(this.h, cstr(key), v, v.length));
+  }
+
+  get(key: string): Uint8Array | null {
+    const cap = this.maxVal();
+    const buf = new Uint8Array(cap);
+    const lenOut = new Uint8Array(4);
+    const rc = Number(
+      this.rt.symbols.spt_get(this.h, cstr(key), buf, cap, lenOut),
+    );
+    if (rc !== 0) return null;
+    const len = new DataView(lenOut.buffer).getUint32(0, true);
+    return buf.subarray(0, len);
+  }
+
+  getString(key: string): string | null {
+    const b = this.get(key);
+    return b === null ? null : dec.decode(b);
+  }
+
+  unset(key: string): number {
+    return Number(this.rt.symbols.spt_unset(this.h, cstr(key)));
+  }
+
+  append(key: string, value: string | Uint8Array): number {
+    const v = toBytes(value);
+    return Number(this.rt.symbols.spt_append(this.h, cstr(key), v, v.length));
+  }
+
+  list(maxKeys = 4096): SptEntry[] {
+    const buf = new Uint8Array(maxKeys * KEY_MAX);
+    const n = Number(this.rt.symbols.spt_list(this.h, buf, maxKeys));
+    const out: SptEntry[] = [];
+    for (let i = 0; i < n; i++) {
+      const row = buf.subarray(i * KEY_MAX, (i + 1) * KEY_MAX);
+      const nul = row.indexOf(0);
+      const key = dec.decode(row.subarray(0, nul < 0 ? KEY_MAX : nul));
+      out.push({ key, epoch: this.getEpoch(key) });
+    }
+    return out;
+  }
+
+  poll(key: string, timeoutMs: number): number {
+    return Number(this.rt.symbols.spt_poll(this.h, cstr(key), timeoutMs));
+  }
+
+  getEpoch(key: string): bigint {
+    const idx = Number(this.rt.symbols.spt_find_index(this.h, cstr(key)));
+    if (idx < 0) return -1n;
+    return BigInt(this.rt.symbols.spt_epoch_at(this.h, idx) as bigint);
+  }
+
+  setLabel(key: string, mask: bigint): number {
+    return Number(this.rt.symbols.spt_label_or(this.h, cstr(key), mask));
+  }
+
+  clearLabel(key: string, mask: bigint): number {
+    return Number(this.rt.symbols.spt_label_andnot(this.h, cstr(key), mask));
+  }
+
+  getLabels(key: string): bigint {
+    const out = new Uint8Array(8);
+    const rc = Number(this.rt.symbols.spt_get_labels(this.h, cstr(key), out));
+    if (rc !== 0) return 0n;
+    return new DataView(out.buffer).getBigUint64(0, true);
+  }
+
+  setType(key: string, typeFlag: number): number {
+    return Number(this.rt.symbols.spt_set_type(this.h, cstr(key), typeFlag));
+  }
+
+  getType(key: string): number {
+    const out = new Uint8Array(4);
+    const rc = Number(this.rt.symbols.spt_get_type(this.h, cstr(key), out));
+    if (rc !== 0) return rc;
+    return new DataView(out.buffer).getUint32(0, true);
+  }
+
+  integerOp(key: string, op: number, operand: bigint): bigint | null {
+    const out = new Uint8Array(8);
+    const rc = Number(
+      this.rt.symbols.spt_integer_op(this.h, cstr(key), op, operand, out),
+    );
+    if (rc !== 0) return null;
+    return new DataView(out.buffer).getBigUint64(0, true);
+  }
+
+  tandemSet(base: string, order: number, value: string | Uint8Array): number {
+    const v = toBytes(value);
+    return Number(
+      this.rt.symbols.spt_tandem_set(this.h, cstr(base), order, v, v.length),
+    );
+  }
+
+  tandemGet(base: string, order: number): Uint8Array | null {
+    const cap = this.maxVal();
+    const buf = new Uint8Array(cap);
+    const lenOut = new Uint8Array(4);
+    const rc = Number(
+      this.rt.symbols.spt_tandem_get(this.h, cstr(base), order, buf, cap, lenOut),
+    );
+    if (rc !== 0) return null;
+    const len = new DataView(lenOut.buffer).getUint32(0, true);
+    return buf.subarray(0, len);
+  }
+
+  tandemCount(base: string): number {
+    return Number(this.rt.symbols.spt_tandem_count(this.h, cstr(base)));
+  }
+
+  getSignalCount(group: number): bigint {
+    return BigInt(this.rt.symbols.spt_signal_count(this.h, group) as bigint);
+  }
+
+  pulse(group: number): number {
+    return Number(this.rt.symbols.spt_signal_pulse(this.h, group));
+  }
+
+  bump(key: string): number {
+    return Number(this.rt.symbols.spt_bump(this.h, cstr(key)));
+  }
+
+  watchRegister(key: string, group: number): number {
+    return Number(this.rt.symbols.spt_watch_register(this.h, cstr(key), group));
+  }
+
+  watchUnregister(key: string, group: number): number {
+    return Number(
+      this.rt.symbols.spt_watch_unregister(this.h, cstr(key), group),
+    );
+  }
+
+  watchLabelRegister(bloomBit: number, group: number): number {
+    return Number(
+      this.rt.symbols.spt_watch_label_register(this.h, bloomBit, group),
+    );
+  }
+
+  watchLabelUnregister(bloomBit: number, group: number): number {
+    return Number(
+      this.rt.symbols.spt_watch_label_unregister(this.h, bloomBit, group),
+    );
+  }
+
+  enumerate(mask: bigint, maxOut = 4096): Uint32Array {
+    const buf = new Uint32Array(maxOut);
+    const n = Number(
+      this.rt.symbols.spt_enumerate(
+        this.h,
+        mask,
+        new Uint8Array(buf.buffer),
+        maxOut,
+      ),
+    );
+    return buf.subarray(0, Math.max(n, 0));
+  }
+
+  keyAt(idx: number): string | null {
+    const buf = new Uint8Array(KEY_MAX);
+    const rc = Number(this.rt.symbols.spt_key_at(this.h, idx, buf));
+    if (rc !== 0) return null;
+    const nul = buf.indexOf(0);
+    return dec.decode(buf.subarray(0, nul < 0 ? KEY_MAX : nul));
+  }
+
+  vecDim(): number {
+    return this.dim;
+  }
+
+  getEmbedding(key: string): Float32Array | null {
+    const vec = new Float32Array(this.dim);
+    const rc = Number(
+      this.rt.symbols.spt_vec_get(
+        this.h,
+        cstr(key),
+        new Uint8Array(vec.buffer),
+        this.dim,
+      ),
+    );
+    return rc === 0 ? vec : null;
+  }
+
+  setEmbedding(key: string, vec: Float32Array): number {
+    if (vec.length !== this.dim) return -22; // -EINVAL
+    return Number(
+      this.rt.symbols.spt_vec_set(
+        this.h,
+        cstr(key),
+        new Uint8Array(vec.buffer),
+        this.dim,
+      ),
+    );
+  }
+
+  busInit(): number {
+    return Number(this.rt.symbols.spt_bus_init(this.h));
+  }
+
+  busOpen(): number {
+    return Number(this.rt.symbols.spt_bus_open(this.h));
+  }
+
+  busWait(timeoutMs: number): number {
+    return Number(this.rt.symbols.spt_bus_wait(this.h, timeoutMs));
+  }
+
+  busDrain(): BigUint64Array {
+    const mask = new BigUint64Array(DIRTY_WORDS);
+    this.rt.symbols.spt_bus_drain(this.h, new Uint8Array(mask.buffer));
+    return mask;
+  }
+
+  nslots(): number {
+    return Number(this.rt.symbols.spt_nslots(this.h));
+  }
+
+  maxVal(): number {
+    return Number(this.rt.symbols.spt_max_val(this.h));
+  }
+}
+
+/* ------------------------------------------------------------------ */
+/* async watcher (reference parity: SplinterWatcher)                   */
+/* ------------------------------------------------------------------ */
+
+/** Polls a signal group and yields the new count each time it advances.
+ *
+ *   const w = new SptWatcher(store, 2);
+ *   for await (const count of w) { ... }   // w.stop() to end
+ */
+export class SptWatcher implements AsyncIterable<bigint> {
+  private store: SptStore;
+  private group: number;
+  private intervalMs: number;
+  private running = false;
+
+  constructor(store: SptStore, group: number, intervalMs = 25) {
+    this.store = store;
+    this.group = group;
+    this.intervalMs = intervalMs;
+  }
+
+  stop(): void {
+    this.running = false;
+  }
+
+  async *[Symbol.asyncIterator](): AsyncIterator<bigint> {
+    this.running = true;
+    let last = this.store.getSignalCount(this.group);
+    while (this.running) {
+      const now = this.store.getSignalCount(this.group);
+      if (now !== last) {
+        last = now;
+        yield now;
+      } else {
+        await new Promise((r) => setTimeout(r, this.intervalMs));
+      }
+    }
+  }
+}
+
+/* ------------------------------------------------------------------ */
+/* factories                                                           */
+/* ------------------------------------------------------------------ */
+
+const BACKEND_FILE = 1;
+const CREATE_EXCL = 2;
+
+async function loadRuntime(libPath: string): Promise<Runtime> {
+  if (isBun()) return await loadBun(libPath);
+  if (isDeno()) return loadDeno(libPath);
+  throw new Error("sptpu.ts requires Bun or Deno");
+}
+
+export async function openStore(
+  libPath: string,
+  name: string,
+  opts: { file?: boolean } = {},
+): Promise<Store> {
+  const rt = await loadRuntime(libPath);
+  const flags = opts.file ? BACKEND_FILE : 0;
+  const h = rt.symbols.spt_open(cstr(name), flags);
+  return new Store(rt, h);
+}
+
+export async function createStore(
+  libPath: string,
+  name: string,
+  opts: CreateOpts = {},
+): Promise<Store> {
+  const rt = await loadRuntime(libPath);
+  const flags = (opts.file ? BACKEND_FILE : 0) | CREATE_EXCL;
+  const h = rt.symbols.spt_create(
+    cstr(name),
+    opts.nslots ?? 1024,
+    opts.maxVal ?? 4096,
+    opts.vecDim ?? 768,
+    flags,
+  );
+  return new Store(rt, h);
+}
+
+export async function unlinkStore(
+  libPath: string,
+  name: string,
+  opts: { file?: boolean } = {},
+): Promise<number> {
+  const rt = await loadRuntime(libPath);
+  const rc = Number(
+    rt.symbols.spt_unlink(cstr(name), opts.file ? BACKEND_FILE : 0),
+  );
+  rt.close();
+  return rc;
+}
+
+/* type flags (sptpu.h) */
+export const T_VOID = 0x00;
+export const T_BIGINT = 0x01;
+export const T_BIGUINT = 0x02;
+export const T_JSON = 0x04;
+export const T_BINARY = 0x08;
+export const T_IMGDATA = 0x10;
+export const T_AUDIO = 0x20;
+export const T_VARTEXT = 0x40;
+
+/* integer ops (spt_iop_t) */
+export const IOP_AND = 0;
+export const IOP_OR = 1;
+export const IOP_XOR = 2;
+export const IOP_NOT = 3;
+export const IOP_INC = 4;
+export const IOP_DEC = 5;
+export const IOP_ADD = 6;
+export const IOP_SUB = 7;

@@ -1,0 +1,120 @@
+/* Smoke tests for the TS FFI binding (reference parity:
+ * bindings/ts/splinter_test.ts — set/get, epoch increment, named types,
+ * signal counts, bump, embeddings round-trip).
+ *
+ * Run under Deno:
+ *   deno test --allow-ffi --allow-env bindings/ts/sptpu_test.ts
+ * or under Bun:
+ *   bun test bindings/ts/sptpu_test.ts
+ *
+ * Env: SPTPU_LIB — path to libsptpu.so (default ../../native/build/libsptpu.so
+ * relative to this file).
+ */
+import {
+  createStore,
+  IOP_INC,
+  SptWatcher,
+  T_BIGUINT,
+  T_VARTEXT,
+  unlinkStore,
+} from "./sptpu.ts";
+
+declare const Deno: {
+  env: { get(k: string): string | undefined };
+  test(name: string, fn: () => void | Promise<void>): void;
+} | undefined;
+
+const LIB = (typeof Deno !== "undefined" && Deno?.env.get("SPTPU_LIB")) ||
+  (typeof process !== "undefined" && process.env?.SPTPU_LIB) ||
+  new URL("../../native/build/libsptpu.so", import.meta.url).pathname;
+
+function assert(cond: boolean, msg: string): void {
+  if (!cond) throw new Error("FAIL: " + msg);
+}
+
+function assertEq<T>(a: T, b: T, msg: string): void {
+  assert(a === b, `${msg} (${String(a)} !== ${String(b)})`);
+}
+
+export async function runAll(): Promise<void> {
+  const name = `/sptpu-ts-test-${Math.floor(Math.random() * 1e9)}`;
+  const st = await createStore(LIB, name, {
+    nslots: 128,
+    maxVal: 512,
+    vecDim: 16,
+  });
+  try {
+    // set/get round-trip
+    assertEq(st.set("greeting", "hello ts"), 0, "set rc");
+    assertEq(st.getString("greeting"), "hello ts", "get round-trip");
+
+    // epoch increments by 2 per write (seqlock: odd while held)
+    const e1 = st.getEpoch("greeting");
+    st.set("greeting", "rewritten");
+    const e2 = st.getEpoch("greeting");
+    assertEq(e2 - e1, 2n, "epoch +2 per write");
+
+    // named types + BIGUINT promotion + integer op
+    st.set("counter", "41");
+    assertEq(st.setType("counter", T_BIGUINT), 0, "biguint promote rc");
+    const v = st.integerOp("counter", IOP_INC, 0n);
+    assertEq(v, 42n, "INC over promoted biguint");
+
+    // labels + enumeration
+    st.set("doc", "labelled");
+    st.setType("doc", T_VARTEXT);
+    st.setLabel("doc", 1n << 9n);
+    const hits = st.enumerate(1n << 9n);
+    assertEq(hits.length, 1, "enumerate finds the labelled slot");
+    assertEq(st.keyAt(hits[0]), "doc", "keyAt resolves index");
+
+    // signals: bump pulses the watcher group
+    st.watchRegister("doc", 7);
+    const c0 = st.getSignalCount(7);
+    st.bump("doc");
+    assertEq(st.getSignalCount(7) - c0, 1n, "bump pulses group");
+
+    // embedding round-trip through the contiguous vector lane
+    const vec = new Float32Array(16).map((_, i) => i / 16);
+    assertEq(st.setEmbedding("doc", vec), 0, "vec set rc");
+    const got = st.getEmbedding("doc");
+    assert(got !== null, "vec get");
+    assert(Math.abs(got![5] - 5 / 16) < 1e-6, "vec content");
+
+    // tandem keys
+    st.tandemSet("chunks", 1, "part one");
+    st.tandemSet("chunks", 2, "part two");
+    assertEq(st.tandemCount("chunks"), 2, "tandem count");
+
+    // append grows the value
+    st.set("log", "a");
+    st.append("log", "bc");
+    assertEq(st.getString("log"), "abc", "append");
+
+    // async watcher observes a pulse
+    const w = new SptWatcher(st, 7, 5);
+    const seen: bigint[] = [];
+    const task = (async () => {
+      for await (const c of w) {
+        seen.push(c);
+        w.stop();
+      }
+    })();
+    st.bump("doc");
+    await task;
+    assertEq(seen.length, 1, "watcher yielded");
+
+    console.log("sptpu_test: all assertions passed");
+  } finally {
+    st.close();
+    await unlinkStore(LIB, name);
+  }
+}
+
+declare const process: { env?: Record<string, string> } | undefined;
+
+if (typeof Deno !== "undefined" && Deno?.test) {
+  Deno.test("sptpu ffi smoke", runAll);
+} else {
+  await runAll();
+}

@@ -422,9 +422,10 @@ def cmd_help(ses, args):
             print(f"  {usage:<{width}}  {help_}")
 
 
-# search / ingest / export live in their own modules
+# search / ingest / export / scripting hosts live in their own modules
 from .search import cmd_search  # noqa: E402  (registers itself)
 from .ingest import cmd_ingest, cmd_export  # noqa: E402
+from .script import cmd_lua, cmd_wasm  # noqa: E402
 
 
 # ------------------------------------------------------------------- REPL
@@ -515,6 +516,13 @@ def main(argv: list[str] | None = None) -> int:
         if argv:
             try:
                 dispatch(ses, argv)
+                return 0
+            except BrokenPipeError:
+                # downstream pager/head closed; exit quietly like cat(1)
+                try:
+                    sys.stdout.close()
+                except OSError:
+                    pass
                 return 0
             except (CliError, KeyError, OSError, ValueError,
                     IndexError) as e:

@@ -1,0 +1,70 @@
+"""CLI scripting hosts: the `lua` and `wasm` commands.
+
+Reference parity: splinter_cli_cmd_lua.c (embedded Lua 5.4 with splinter.*
+host functions) and splinter_cli_cmd_wasm.c (WasmEdge VM with splinter.get/
+set host imports).  Neither runtime ships in this image, so both hosts run
+on in-tree interpreters (libsplinter_tpu.scripting).
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from .main import CliError, command
+
+
+@command("lua", "lua SCRIPT.lua [ARGS...] | lua -e 'CHUNK'",
+         "run a Lua script against the store (splinter.* host API)")
+def cmd_lua(ses, args):
+    from ..scripting.lua_host import make_runtime
+    from ..scripting.microlua import LuaError
+
+    if not args:
+        raise CliError("usage: lua SCRIPT.lua [ARGS...] | lua -e 'CHUNK'")
+    if args[0] == "-e":
+        if len(args) < 2:
+            raise CliError("lua -e needs a chunk")
+        src, chunk_name, script_args = args[1], "=(command line)", args[2:]
+    else:
+        path = Path(args[0])
+        if not path.exists():
+            raise CliError(f"no such script: {path}")
+        src, chunk_name, script_args = (path.read_text(), str(path),
+                                        list(args[1:]))
+    rt = make_runtime(ses.store)
+    try:
+        rt.run(src, script_args=script_args, chunk_name=chunk_name)
+    except LuaError as e:
+        raise CliError(f"lua: {e}") from None
+
+
+@command("wasm", "wasm MODULE.wasm [FUNC] [ARGS...]",
+         "run a WebAssembly module against the store (splinter host imports)")
+def cmd_wasm(ses, args):
+    from ..scripting.microwasm import WasmError, instantiate
+    from ..scripting.wasm_host import make_host_imports
+
+    if not args:
+        raise CliError("usage: wasm MODULE.wasm [FUNC] [ARGS...]")
+    path = Path(args[0])
+    if not path.exists():
+        raise CliError(f"no such module: {path}")
+    func = args[1] if len(args) > 1 else None
+    call_args = [int(a, 0) for a in args[2:]]
+    try:
+        inst = instantiate(path.read_bytes(),
+                           make_host_imports(ses.store,
+                                             out=sys.stdout.write))
+        if func is None:
+            for cand in ("_start", "main", "run"):
+                if cand in inst.exports:
+                    func = cand
+                    break
+        if func is None or func not in inst.exports:
+            raise CliError(
+                f"no runnable export (have: {', '.join(inst.exports)})")
+        res = inst.invoke(func, call_args)
+        if res:
+            print(" ".join(str(v) for v in res))
+    except WasmError as e:
+        raise CliError(f"wasm: {e}") from None

@@ -1,0 +1,928 @@
+"""microwasm — a minimal WebAssembly (MVP) interpreter for the CLI host.
+
+The reference embeds a WasmEdge VM with splinter.get/set host functions and
+the SIMD proposal enabled (splinter_cli_cmd_wasm.c:85-143).  This image has
+no wasm runtime, so the host executes binary modules with a from-scratch
+interpreter covering the MVP core:
+
+  sections    type, import, function, table, memory, global, export, start,
+              elem, code, data (+ custom, skipped)
+  control     block, loop, if/else, br, br_if, br_table, return, call,
+              call_indirect
+  parametric  drop, select
+  variables   local.get/set/tee, global.get/set
+  memory      all i32/i64/f32/f64 loads & stores (incl. 8/16/32 partial
+              widths), memory.size, memory.grow
+  numeric     full i32/i64 ALU (clz..rotr), f32/f64 arithmetic & compares,
+              the conversion/reinterpret matrix, sign-extension ops
+
+Out of scope (raise WasmError): SIMD, threads, reference types, multi-value
+block signatures, bulk memory.  Scripts that heavy-compute belong in the
+JAX tier; wasm here is a portable *protocol* client, like the reference's.
+
+Host functions are supplied as a dict {("module","name"): python_callable};
+callables receive (Instance, *args) so they can touch linear memory.
+"""
+from __future__ import annotations
+
+import math
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class WasmError(Exception):
+    pass
+
+
+class Trap(WasmError):
+    pass
+
+
+MAGIC = b"\x00asm\x01\x00\x00\x00"
+PAGE = 65536
+
+I32, I64, F32, F64 = 0x7F, 0x7E, 0x7D, 0x7C
+_VALNAMES = {I32: "i32", I64: "i64", F32: "f32", F64: "f64"}
+
+
+# -------------------------------------------------------------- byte reader
+
+class _Reader:
+    __slots__ = ("b", "p")
+
+    def __init__(self, b: bytes, p: int = 0):
+        self.b = b
+        self.p = p
+
+    def u8(self) -> int:
+        v = self.b[self.p]
+        self.p += 1
+        return v
+
+    def bytes_(self, n: int) -> bytes:
+        v = self.b[self.p:self.p + n]
+        if len(v) < n:
+            raise WasmError("truncated module")
+        self.p += n
+        return v
+
+    def uleb(self) -> int:
+        out = shift = 0
+        while True:
+            byte = self.u8()
+            out |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return out
+            shift += 7
+
+    def sleb(self, bits: int) -> int:
+        out = shift = 0
+        while True:
+            byte = self.u8()
+            out |= (byte & 0x7F) << shift
+            shift += 7
+            if not byte & 0x80:
+                if shift < bits and (byte & 0x40):
+                    out |= -(1 << shift)
+                return out
+
+    def f32(self) -> float:
+        return struct.unpack("<f", self.bytes_(4))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self.bytes_(8))[0]
+
+    def name(self) -> str:
+        return self.bytes_(self.uleb()).decode("utf-8")
+
+    def eof(self) -> bool:
+        return self.p >= len(self.b)
+
+
+# ------------------------------------------------------------- module model
+
+@dataclass
+class FuncType:
+    params: tuple
+    results: tuple
+
+
+@dataclass
+class Function:
+    type: FuncType
+    locals: list
+    body: list           # decoded instruction list
+    name: str = "?"
+
+
+@dataclass
+class Module:
+    types: list = field(default_factory=list)
+    imports: list = field(default_factory=list)   # (mod, name, kind, extra)
+    funcs: list = field(default_factory=list)     # local funcs
+    n_imported_funcs: int = 0
+    table_min: int = 0
+    elem: dict = field(default_factory=dict)      # table idx -> func idx
+    mem_min: int = 0
+    mem_max: Optional[int] = None
+    globals: list = field(default_factory=list)   # (valtype, mutable, init)
+    exports: dict = field(default_factory=dict)   # name -> (kind, idx)
+    start: Optional[int] = None
+    data: list = field(default_factory=list)      # (offset_expr, bytes)
+
+
+def _decode_valtype(r: _Reader) -> int:
+    t = r.u8()
+    if t not in _VALNAMES:
+        raise WasmError(f"unsupported value type 0x{t:02x}")
+    return t
+
+
+def _decode_blocktype(r: _Reader) -> tuple:
+    """() or (valtype,) — MVP block signatures only."""
+    t = r.b[r.p]
+    if t == 0x40:
+        r.p += 1
+        return ()
+    if t in _VALNAMES:
+        r.p += 1
+        return (t,)
+    raise WasmError("multi-value block signatures are not supported")
+
+
+# opcode name tables keep the decoder readable; executor dispatches on int.
+
+def _decode_expr(r: _Reader) -> list:
+    """Decode instructions until the matching 0x0B end (depth balanced)."""
+    out = []
+    depth = 0
+    while True:
+        op = r.u8()
+        if op in (0x02, 0x03, 0x04):            # block, loop, if
+            out.append((op, _decode_blocktype(r)))
+            depth += 1
+        elif op == 0x05:                        # else
+            out.append((op,))
+        elif op == 0x0B:                        # end
+            out.append((op,))
+            if depth == 0:
+                return out
+            depth -= 1
+        elif op in (0x0C, 0x0D):                # br, br_if
+            out.append((op, r.uleb()))
+        elif op == 0x0E:                        # br_table
+            n = r.uleb()
+            targets = [r.uleb() for _ in range(n)]
+            out.append((op, targets, r.uleb()))
+        elif op == 0x0F:                        # return
+            out.append((op,))
+        elif op == 0x10:                        # call
+            out.append((op, r.uleb()))
+        elif op == 0x11:                        # call_indirect
+            out.append((op, r.uleb(), r.uleb()))
+        elif op in (0x00, 0x01):                # unreachable, nop
+            out.append((op,))
+        elif op in (0x1A, 0x1B):                # drop, select
+            out.append((op,))
+        elif op in (0x20, 0x21, 0x22, 0x23, 0x24):  # local/global access
+            out.append((op, r.uleb()))
+        elif 0x28 <= op <= 0x3E:                # loads & stores
+            align, offset = r.uleb(), r.uleb()
+            out.append((op, align, offset))
+        elif op in (0x3F, 0x40):                # memory.size, memory.grow
+            r.uleb()                            # reserved 0x00
+            out.append((op,))
+        elif op == 0x41:
+            # canonical value representation is unsigned (ALU ops wrap)
+            out.append((op, r.sleb(32) & 0xFFFFFFFF))
+        elif op == 0x42:
+            out.append((op, r.sleb(64) & 0xFFFFFFFFFFFFFFFF))
+        elif op == 0x43:
+            out.append((op, r.f32()))
+        elif op == 0x44:
+            out.append((op, r.f64()))
+        elif 0x45 <= op <= 0xC4:                # numeric ops, no immediates
+            out.append((op,))
+        else:
+            raise WasmError(f"unsupported opcode 0x{op:02x}")
+
+
+def decode_module(data: bytes) -> Module:
+    if not data.startswith(MAGIC):
+        raise WasmError("bad magic (not a wasm binary, or not version 1)")
+    r = _Reader(data, len(MAGIC))
+    m = Module()
+    func_type_idx: list[int] = []
+    bodies: list[tuple] = []
+
+    while not r.eof():
+        sec = r.u8()
+        size = r.uleb()
+        body = _Reader(r.bytes_(size))
+        if sec == 1:                                     # type
+            for _ in range(body.uleb()):
+                if body.u8() != 0x60:
+                    raise WasmError("bad functype tag")
+                params = tuple(_decode_valtype(body)
+                               for _ in range(body.uleb()))
+                results = tuple(_decode_valtype(body)
+                                for _ in range(body.uleb()))
+                if len(results) > 1:
+                    raise WasmError("multi-value returns not supported")
+                m.types.append(FuncType(params, results))
+        elif sec == 2:                                   # import
+            for _ in range(body.uleb()):
+                mod, name = body.name(), body.name()
+                kind = body.u8()
+                if kind == 0x00:                         # func
+                    ti = body.uleb()
+                    m.imports.append((mod, name, "func", ti))
+                    m.n_imported_funcs += 1
+                elif kind == 0x02:                       # memory import
+                    flags = body.u8()
+                    mn = body.uleb()
+                    mx = body.uleb() if flags & 1 else None
+                    m.imports.append((mod, name, "memory", (mn, mx)))
+                    m.mem_min = max(m.mem_min, mn)
+                else:
+                    raise WasmError(
+                        f"unsupported import kind {kind} for {mod}.{name}")
+        elif sec == 3:                                   # function
+            func_type_idx = [body.uleb() for _ in range(body.uleb())]
+        elif sec == 4:                                   # table
+            for _ in range(body.uleb()):
+                if body.u8() != 0x70:
+                    raise WasmError("only funcref tables supported")
+                flags = body.u8()
+                m.table_min = body.uleb()
+                if flags & 1:
+                    body.uleb()
+        elif sec == 5:                                   # memory
+            for _ in range(body.uleb()):
+                flags = body.u8()
+                m.mem_min = body.uleb()
+                if flags & 1:
+                    m.mem_max = body.uleb()
+        elif sec == 6:                                   # global
+            for _ in range(body.uleb()):
+                vt = _decode_valtype(body)
+                mut = body.u8()
+                init = _decode_expr(body)
+                m.globals.append((vt, bool(mut), init))
+        elif sec == 7:                                   # export
+            for _ in range(body.uleb()):
+                name = body.name()
+                kind, idx = body.u8(), body.uleb()
+                m.exports[name] = (("func", "table", "memory",
+                                    "global")[kind], idx)
+        elif sec == 8:                                   # start
+            m.start = body.uleb()
+        elif sec == 9:                                   # elem
+            for _ in range(body.uleb()):
+                if body.uleb() != 0:
+                    raise WasmError("only active table-0 elem segments")
+                off_expr = _decode_expr(body)
+                off = _const_expr_value(off_expr)
+                for i in range(body.uleb()):
+                    m.elem[off + i] = body.uleb()
+        elif sec == 10:                                  # code
+            for _ in range(body.uleb()):
+                sz = body.uleb()
+                fr = _Reader(body.bytes_(sz))
+                locals_: list[int] = []
+                for _ in range(fr.uleb()):
+                    count, vt = fr.uleb(), _decode_valtype(fr)
+                    locals_.extend([vt] * count)
+                bodies.append((locals_, _decode_expr(fr)))
+        elif sec == 11:                                  # data
+            for _ in range(body.uleb()):
+                if body.uleb() != 0:
+                    raise WasmError("only active memory-0 data segments")
+                off_expr = _decode_expr(body)
+                m.data.append((_const_expr_value(off_expr),
+                               body.bytes_(body.uleb())))
+        # custom (0) and unknown sections are skipped
+
+    if len(func_type_idx) != len(bodies):
+        raise WasmError("function/code section mismatch")
+    for ti, (locals_, code) in zip(func_type_idx, bodies):
+        m.funcs.append(Function(m.types[ti], locals_, code))
+    for name, (kind, idx) in m.exports.items():
+        if kind == "func" and idx >= m.n_imported_funcs:
+            m.funcs[idx - m.n_imported_funcs].name = name
+    return m
+
+
+def _const_expr_value(expr: list) -> int:
+    if len(expr) >= 1 and expr[0][0] in (0x41, 0x42):
+        return expr[0][1]
+    raise WasmError("unsupported constant expression")
+
+
+# ---------------------------------------------------------------- execution
+
+def _wrap32(v: int) -> int:
+    return v & 0xFFFFFFFF
+
+
+def _wrap64(v: int) -> int:
+    return v & 0xFFFFFFFFFFFFFFFF
+
+
+def _sign32(v: int) -> int:
+    v &= 0xFFFFFFFF
+    return v - 0x100000000 if v & 0x80000000 else v
+
+
+def _sign64(v: int) -> int:
+    v &= 0xFFFFFFFFFFFFFFFF
+    return v - (1 << 64) if v & (1 << 63) else v
+
+
+def _trunc(v: float, lo: int, hi: int, name: str) -> int:
+    if math.isnan(v) or math.isinf(v):
+        raise Trap(f"invalid conversion to integer ({name})")
+    t = math.trunc(v)
+    if t < lo or t > hi:
+        raise Trap(f"integer overflow in {name}")
+    return t
+
+
+def _f32(v: float) -> float:
+    return struct.unpack("<f", struct.pack("<f", v))[0]
+
+
+class _Label:
+    __slots__ = ("arity", "stack_h", "cont", "is_loop")
+
+    def __init__(self, arity, stack_h, cont, is_loop):
+        self.arity = arity
+        self.stack_h = stack_h
+        self.cont = cont          # instruction index to jump to on br
+        self.is_loop = is_loop
+
+
+class Instance:
+    """An instantiated module: memory, globals, and callable exports."""
+
+    MAX_STEPS = 200_000_000
+
+    def __init__(self, module: Module,
+                 host: dict[tuple[str, str], Callable]):
+        self.m = module
+        self.mem = bytearray(module.mem_min * PAGE)
+        self.mem_max = module.mem_max
+        self.globals: list[Any] = []
+        for vt, _mut, init in module.globals:
+            self.globals.append(_const_expr_value(init)
+                                if init[0][0] in (0x41, 0x42)
+                                else (init[0][1] if init[0][0] in
+                                      (0x43, 0x44) else 0))
+        self.host: list[Optional[Callable]] = []
+        self.host_types: list[FuncType] = []
+        for mod, name, kind, extra in module.imports:
+            if kind == "func":
+                fn = host.get((mod, name))
+                if fn is None:
+                    raise WasmError(f"unresolved import {mod}.{name}")
+                self.host.append(fn)
+                self.host_types.append(module.types[extra])
+        for off, payload in module.data:
+            end = off + len(payload)
+            if end > len(self.mem):
+                raise WasmError("data segment out of bounds")
+            self.mem[off:end] = payload
+        self.steps = 0
+        if module.start is not None:
+            self._call_function(module.start, [])
+
+    # -- public API ------------------------------------------------------
+    @property
+    def exports(self) -> list[str]:
+        return [n for n, (k, _) in self.m.exports.items() if k == "func"]
+
+    def invoke(self, name: str, args: list) -> list:
+        if name not in self.m.exports or self.m.exports[name][0] != "func":
+            raise WasmError(f"no exported function '{name}'")
+        self.steps = 0
+        return self._call_function(self.m.exports[name][1], list(args))
+
+    # memory helpers for host functions
+    def mem_read(self, ptr: int, n: int) -> bytes:
+        if ptr < 0 or ptr + n > len(self.mem):
+            raise Trap("host memory read out of bounds")
+        return bytes(self.mem[ptr:ptr + n])
+
+    def mem_write(self, ptr: int, data: bytes) -> None:
+        if ptr < 0 or ptr + len(data) > len(self.mem):
+            raise Trap("host memory write out of bounds")
+        self.mem[ptr:ptr + len(data)] = data
+
+    def mem_read_cstr(self, ptr: int, maxlen: int = 1 << 20) -> bytes:
+        end = self.mem.find(b"\0", ptr, min(ptr + maxlen, len(self.mem)))
+        if end < 0:
+            raise Trap("unterminated string in wasm memory")
+        return bytes(self.mem[ptr:end])
+
+    # -- function invocation ---------------------------------------------
+    def _call_function(self, idx: int, args: list) -> list:
+        n_imp = self.m.n_imported_funcs
+        if idx < n_imp:
+            ft = self.host_types[idx]
+            res = self.host[idx](self, *args)
+            if res is None:
+                return []
+            if isinstance(res, tuple):
+                return list(res)
+            return [res] if ft.results else []
+        fn = self.m.funcs[idx - n_imp]
+        locals_: list[Any] = [
+            _wrap32(a) if t == I32 else (_wrap64(a) if t == I64 else a)
+            for a, t in zip(args, fn.type.params)]
+        for vt in fn.locals:
+            locals_.append(0.0 if vt in (F32, F64) else 0)
+        return self._exec(fn, locals_)
+
+    # -- the interpreter loop --------------------------------------------
+    def _exec(self, fn: Function, locals_: list) -> list:
+        code = fn.body
+        stack: list[Any] = []
+        labels: list[_Label] = [
+            _Label(len(fn.type.results), 0, len(code) - 1, False)]
+        pc = 0
+        mem = self.mem
+
+        def grow_check() -> None:
+            self.steps += 1
+            if self.steps > self.MAX_STEPS:
+                raise Trap("execution budget exceeded (runaway loop?)")
+
+        def find_matching(from_pc: int) -> tuple[int, int]:
+            """For block/loop/if at from_pc: (else_pc|-1, end_pc)."""
+            depth = 0
+            else_pc = -1
+            i = from_pc + 1
+            while i < len(code):
+                op2 = code[i][0]
+                if op2 in (0x02, 0x03, 0x04):
+                    depth += 1
+                elif op2 == 0x05 and depth == 0:
+                    else_pc = i
+                elif op2 == 0x0B:
+                    if depth == 0:
+                        return else_pc, i
+                    depth -= 1
+                i += 1
+            raise WasmError("unbalanced block")
+
+        def do_branch(n: int) -> int:
+            # br n targets the n-th enclosing label: a loop branch re-enters
+            # (its label survives), a block branch exits (label popped too)
+            lbl = labels[-1 - n]
+            keep = stack[len(stack) - lbl.arity:] if lbl.arity else []
+            del stack[lbl.stack_h:]
+            stack.extend(keep)
+            if lbl.is_loop:
+                del labels[len(labels) - n:]
+            else:
+                del labels[len(labels) - n - 1:]
+            return lbl.cont
+
+        while pc < len(code):
+            ins = code[pc]
+            op = ins[0]
+            grow_check()
+
+            if op == 0x0B:                       # end
+                if len(labels) > 1:
+                    labels.pop()
+                pc += 1
+                continue
+            if op == 0x01:                       # nop
+                pc += 1
+                continue
+            if op == 0x00:
+                raise Trap("unreachable executed")
+            if op == 0x02:                       # block
+                _else, end = find_matching(pc)
+                labels.append(_Label(len(ins[1]), len(stack), end + 1,
+                                     False))
+                pc += 1
+                continue
+            if op == 0x03:                       # loop
+                # cont = first instruction INSIDE: a br re-enters the body
+                # without re-executing the loop opcode (label is kept live
+                # by do_branch, so it is pushed exactly once)
+                labels.append(_Label(0, len(stack), pc + 1, True))
+                pc += 1
+                continue
+            if op == 0x04:                       # if
+                else_pc, end = find_matching(pc)
+                cond = stack.pop()
+                labels.append(_Label(len(ins[1]), len(stack), end + 1,
+                                     False))
+                if cond:
+                    pc += 1
+                else:
+                    pc = (else_pc + 1) if else_pc >= 0 else end
+                continue
+            if op == 0x05:                       # else (end of then-arm)
+                pc = labels[-1].cont             # jump past end
+                labels.pop()
+                continue
+            if op == 0x0C:                       # br
+                pc = do_branch(ins[1])
+                continue
+            if op == 0x0D:                       # br_if
+                if stack.pop():
+                    pc = do_branch(ins[1])
+                else:
+                    pc += 1
+                continue
+            if op == 0x0E:                       # br_table
+                i = stack.pop()
+                targets, default = ins[1], ins[2]
+                n = targets[i] if 0 <= i < len(targets) else default
+                pc = do_branch(n)
+                continue
+            if op == 0x0F:                       # return
+                arity = len(fn.type.results)
+                return stack[len(stack) - arity:] if arity else []
+            if op == 0x10:                       # call
+                callee_idx = ins[1]
+                ft = (self.host_types[callee_idx]
+                      if callee_idx < self.m.n_imported_funcs
+                      else self.m.funcs[
+                          callee_idx - self.m.n_imported_funcs].type)
+                argn = len(ft.params)
+                args = stack[len(stack) - argn:] if argn else []
+                del stack[len(stack) - argn:]
+                stack.extend(self._call_function(callee_idx, args))
+                pc += 1
+                continue
+            if op == 0x11:                       # call_indirect
+                ti = ins[1]
+                elem_i = stack.pop()
+                target = self.m.elem.get(elem_i)
+                if target is None:
+                    raise Trap("undefined table element")
+                ft = self.m.types[ti]
+                argn = len(ft.params)
+                args = stack[len(stack) - argn:] if argn else []
+                del stack[len(stack) - argn:]
+                stack.extend(self._call_function(target, args))
+                pc += 1
+                continue
+            if op == 0x1A:                       # drop
+                stack.pop()
+            elif op == 0x1B:                     # select
+                c = stack.pop()
+                b = stack.pop()
+                a = stack.pop()
+                stack.append(a if c else b)
+            elif op == 0x20:
+                stack.append(locals_[ins[1]])
+            elif op == 0x21:
+                locals_[ins[1]] = stack.pop()
+            elif op == 0x22:
+                locals_[ins[1]] = stack[-1]
+            elif op == 0x23:
+                stack.append(self.globals[ins[1]])
+            elif op == 0x24:
+                self.globals[ins[1]] = stack.pop()
+            elif 0x28 <= op <= 0x35:             # loads
+                addr = _wrap32(stack.pop()) + ins[2]
+                stack.append(self._load(op, addr))
+            elif 0x36 <= op <= 0x3E:             # stores
+                val = stack.pop()
+                addr = _wrap32(stack.pop()) + ins[2]
+                self._store(op, addr, val)
+            elif op == 0x3F:                     # memory.size
+                stack.append(len(mem) // PAGE)
+            elif op == 0x40:                     # memory.grow
+                delta = _wrap32(stack.pop())
+                old = len(self.mem) // PAGE
+                new = old + delta
+                # wasm32 hard ceiling (65536 pages = 4 GiB) applies even
+                # with no declared max; failure pushes -1, never raises
+                cap = self.mem_max if self.mem_max is not None else 65536
+                if new > min(cap, 65536):
+                    stack.append(_wrap32(-1))
+                else:
+                    try:
+                        self.mem.extend(b"\0" * (delta * PAGE))
+                    except MemoryError:
+                        stack.append(_wrap32(-1))
+                    else:
+                        mem = self.mem
+                        stack.append(old)
+            elif op in (0x41, 0x42, 0x43, 0x44):  # consts
+                stack.append(ins[1])
+            else:
+                stack.append(self._numeric(op, stack))
+                # _numeric pops its own operands and returns the result
+            pc += 1
+
+        arity = len(fn.type.results)
+        return stack[len(stack) - arity:] if arity else []
+
+    # -- memory ----------------------------------------------------------
+    _LOADS = {
+        0x28: ("<i", 4, False), 0x29: ("<q", 8, False),
+        0x2A: ("<f", 4, False), 0x2B: ("<d", 8, False),
+        0x2C: ("<b", 1, False), 0x2D: ("<B", 1, False),
+        0x2E: ("<h", 2, False), 0x2F: ("<H", 2, False),
+        0x30: ("<b", 1, True), 0x31: ("<B", 1, True),
+        0x32: ("<h", 2, True), 0x33: ("<H", 2, True),
+        0x34: ("<i", 4, True), 0x35: ("<I", 4, True),
+    }
+
+    def _load(self, op: int, addr: int):
+        fmtc, n, to64 = self._LOADS[op]
+        if addr + n > len(self.mem):
+            raise Trap("out-of-bounds memory access")
+        v = struct.unpack_from(fmtc, self.mem, addr)[0]
+        if op in (0x28,):
+            return _wrap32(v)
+        if op in (0x29,):
+            return _wrap64(v)
+        if to64:
+            return _wrap64(v) if fmtc in ("<i", "<b", "<h") else v
+        if fmtc in ("<b", "<h"):
+            return _wrap32(v)
+        return v
+
+    _STORES = {
+        0x36: ("<I", 4), 0x37: ("<Q", 8), 0x38: ("<f", 4), 0x39: ("<d", 8),
+        0x3A: ("<B", 1), 0x3B: ("<H", 2), 0x3C: ("<B", 1), 0x3D: ("<H", 2),
+        0x3E: ("<I", 4),
+    }
+
+    def _store(self, op: int, addr: int, val) -> None:
+        fmtc, n = self._STORES[op]
+        if addr + n > len(self.mem):
+            raise Trap("out-of-bounds memory access")
+        if fmtc == "<B":
+            val = int(val) & 0xFF
+        elif fmtc == "<H":
+            val = int(val) & 0xFFFF
+        elif fmtc == "<I":
+            val = int(val) & 0xFFFFFFFF
+        elif fmtc == "<Q":
+            val = int(val) & 0xFFFFFFFFFFFFFFFF
+        struct.pack_into(fmtc, self.mem, addr, val)
+
+    # -- numeric ops ------------------------------------------------------
+    def _numeric(self, op: int, stack: list):
+        # i32 compares / ALU --------------------------------------------
+        if op == 0x45:                            # i32.eqz
+            return int(stack.pop() == 0)
+        if 0x46 <= op <= 0x4F:
+            b, a = stack.pop(), stack.pop()
+            sa, sb = _sign32(a), _sign32(b)
+            ua, ub = _wrap32(a), _wrap32(b)
+            return int({
+                0x46: ua == ub, 0x47: ua != ub,
+                0x48: sa < sb, 0x49: ua < ub,
+                0x4A: sa > sb, 0x4B: ua > ub,
+                0x4C: sa <= sb, 0x4D: ua <= ub,
+                0x4E: sa >= sb, 0x4F: ua >= ub,
+            }[op])
+        if op == 0x50:                            # i64.eqz
+            return int(stack.pop() == 0)
+        if 0x51 <= op <= 0x5A:
+            b, a = stack.pop(), stack.pop()
+            sa, sb = _sign64(a), _sign64(b)
+            ua, ub = _wrap64(a), _wrap64(b)
+            return int({
+                0x51: ua == ub, 0x52: ua != ub,
+                0x53: sa < sb, 0x54: ua < ub,
+                0x55: sa > sb, 0x56: ua > ub,
+                0x57: sa <= sb, 0x58: ua <= ub,
+                0x59: sa >= sb, 0x5A: ua >= ub,
+            }[op])
+        if 0x5B <= op <= 0x60:                    # f32 compares
+            b, a = stack.pop(), stack.pop()
+            return int({0x5B: a == b, 0x5C: a != b, 0x5D: a < b,
+                        0x5E: a > b, 0x5F: a <= b, 0x60: a >= b}[op])
+        if 0x61 <= op <= 0x66:                    # f64 compares
+            b, a = stack.pop(), stack.pop()
+            return int({0x61: a == b, 0x62: a != b, 0x63: a < b,
+                        0x64: a > b, 0x65: a <= b, 0x66: a >= b}[op])
+
+        if op == 0x67:                            # i32.clz
+            v = _wrap32(stack.pop())
+            return 32 if v == 0 else 32 - v.bit_length()
+        if op == 0x68:                            # i32.ctz
+            v = _wrap32(stack.pop())
+            return 32 if v == 0 else (v & -v).bit_length() - 1
+        if op == 0x69:                            # i32.popcnt
+            return bin(_wrap32(stack.pop())).count("1")
+        if 0x6A <= op <= 0x78:                    # i32 binary ALU
+            b, a = stack.pop(), stack.pop()
+            ua, ub = _wrap32(a), _wrap32(b)
+            sa, sb = _sign32(a), _sign32(b)
+            if op == 0x6A:
+                return _wrap32(ua + ub)
+            if op == 0x6B:
+                return _wrap32(ua - ub)
+            if op == 0x6C:
+                return _wrap32(ua * ub)
+            if op == 0x6D:                        # div_s
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                q = abs(sa) // abs(sb)
+                q = -q if (sa < 0) != (sb < 0) else q
+                if q == 0x80000000:
+                    raise Trap("integer overflow")
+                return _wrap32(q)
+            if op == 0x6E:
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                return ua // ub
+            if op == 0x6F:                        # rem_s
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                r = abs(sa) % abs(sb)
+                return _wrap32(-r if sa < 0 else r)
+            if op == 0x70:
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                return ua % ub
+            if op == 0x71:
+                return ua & ub
+            if op == 0x72:
+                return ua | ub
+            if op == 0x73:
+                return ua ^ ub
+            if op == 0x74:
+                return _wrap32(ua << (ub % 32))
+            if op == 0x75:
+                return _wrap32(sa >> (ub % 32))
+            if op == 0x76:
+                return ua >> (ub % 32)
+            if op == 0x77:                        # rotl
+                k = ub % 32
+                return _wrap32((ua << k) | (ua >> (32 - k))) if k else ua
+            if op == 0x78:                        # rotr
+                k = ub % 32
+                return _wrap32((ua >> k) | (ua << (32 - k))) if k else ua
+
+        if op == 0x79:                            # i64.clz
+            v = _wrap64(stack.pop())
+            return 64 if v == 0 else 64 - v.bit_length()
+        if op == 0x7A:
+            v = _wrap64(stack.pop())
+            return 64 if v == 0 else (v & -v).bit_length() - 1
+        if op == 0x7B:
+            return bin(_wrap64(stack.pop())).count("1")
+        if 0x7C <= op <= 0x8A:                    # i64 binary ALU
+            b, a = stack.pop(), stack.pop()
+            ua, ub = _wrap64(a), _wrap64(b)
+            sa, sb = _sign64(a), _sign64(b)
+            if op == 0x7C:
+                return _wrap64(ua + ub)
+            if op == 0x7D:
+                return _wrap64(ua - ub)
+            if op == 0x7E:
+                return _wrap64(ua * ub)
+            if op == 0x7F:
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                q = abs(sa) // abs(sb)
+                q = -q if (sa < 0) != (sb < 0) else q
+                if q == 1 << 63:
+                    raise Trap("integer overflow")
+                return _wrap64(q)
+            if op == 0x80:
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                return ua // ub
+            if op == 0x81:
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                r = abs(sa) % abs(sb)
+                return _wrap64(-r if sa < 0 else r)
+            if op == 0x82:
+                if ub == 0:
+                    raise Trap("integer divide by zero")
+                return ua % ub
+            if op == 0x83:
+                return ua & ub
+            if op == 0x84:
+                return ua | ub
+            if op == 0x85:
+                return ua ^ ub
+            if op == 0x86:
+                return _wrap64(ua << (ub % 64))
+            if op == 0x87:
+                return _wrap64(sa >> (ub % 64))
+            if op == 0x88:
+                return ua >> (ub % 64)
+            if op == 0x89:
+                k = ub % 64
+                return _wrap64((ua << k) | (ua >> (64 - k))) if k else ua
+            if op == 0x8A:
+                k = ub % 64
+                return _wrap64((ua >> k) | (ua << (64 - k))) if k else ua
+
+        # f32/f64 unary & binary ----------------------------------------
+        if 0x8B <= op <= 0x91:                    # f32 unary
+            a = stack.pop()
+            return _f32({0x8B: abs(a), 0x8C: -a,
+                         0x8D: float(math.ceil(a)),
+                         0x8E: float(math.floor(a)),
+                         0x8F: float(math.trunc(a)),
+                         0x90: float(round(a)),
+                         0x91: math.sqrt(a) if a >= 0 else math.nan}[op])
+        if 0x92 <= op <= 0x98:                    # f32 binary
+            b, a = stack.pop(), stack.pop()
+            return _f32({0x92: a + b, 0x93: a - b, 0x94: a * b,
+                         0x95: (a / b) if b != 0 else
+                         (math.inf if a > 0 else
+                          (-math.inf if a < 0 else math.nan)),
+                         0x96: min(a, b), 0x97: max(a, b),
+                         0x98: math.copysign(abs(a), b)}[op])
+        if 0x99 <= op <= 0x9F:                    # f64 unary
+            a = stack.pop()
+            return {0x99: abs(a), 0x9A: -a,
+                    0x9B: float(math.ceil(a)),
+                    0x9C: float(math.floor(a)),
+                    0x9D: float(math.trunc(a)),
+                    0x9E: float(round(a)),
+                    0x9F: math.sqrt(a) if a >= 0 else math.nan}[op]
+        if 0xA0 <= op <= 0xA6:                    # f64 binary
+            b, a = stack.pop(), stack.pop()
+            return {0xA0: a + b, 0xA1: a - b, 0xA2: a * b,
+                    0xA3: (a / b) if b != 0 else
+                    (math.inf if a > 0 else
+                     (-math.inf if a < 0 else math.nan)),
+                    0xA4: min(a, b), 0xA5: max(a, b),
+                    0xA6: math.copysign(abs(a), b)}[op]
+
+        # conversions ----------------------------------------------------
+        if op == 0xA7:                            # i32.wrap_i64
+            return _wrap32(stack.pop())
+        if op in (0xA8, 0xAA):                    # i32.trunc_f32/f64_s
+            return _wrap32(_trunc(stack.pop(), -(1 << 31), (1 << 31) - 1,
+                                  "i32.trunc_s"))
+        if op in (0xA9, 0xAB):                    # i32.trunc_f32/f64_u
+            return _trunc(stack.pop(), 0, (1 << 32) - 1, "i32.trunc_u")
+        if op == 0xAC:                            # i64.extend_i32_s
+            return _wrap64(_sign32(stack.pop()))
+        if op == 0xAD:                            # i64.extend_i32_u
+            return _wrap32(stack.pop())
+        if op in (0xAE, 0xB0):                    # i64.trunc_f32/f64_s
+            return _wrap64(_trunc(stack.pop(), -(1 << 63), (1 << 63) - 1,
+                                  "i64.trunc_s"))
+        if op in (0xAF, 0xB1):                    # i64.trunc_f32/f64_u
+            return _trunc(stack.pop(), 0, (1 << 64) - 1, "i64.trunc_u")
+        if op in (0xB2, 0xB4):                    # f32.convert_i32/i64_s
+            return _f32(float(_sign32(stack.pop()) if op == 0xB2
+                              else _sign64(stack.pop())))
+        if op in (0xB3, 0xB5):                    # f32.convert_u
+            return _f32(float(_wrap32(stack.pop()) if op == 0xB3
+                              else _wrap64(stack.pop())))
+        if op == 0xB6:                            # f32.demote_f64
+            return _f32(stack.pop())
+        if op in (0xB7, 0xB9):                    # f64.convert_i32/i64_s
+            return float(_sign32(stack.pop()) if op == 0xB7
+                         else _sign64(stack.pop()))
+        if op in (0xB8, 0xBA):                    # f64.convert_u
+            return float(_wrap32(stack.pop()) if op == 0xB8
+                         else _wrap64(stack.pop()))
+        if op == 0xBB:                            # f64.promote_f32
+            return float(stack.pop())
+        if op == 0xBC:                            # i32.reinterpret_f32
+            return struct.unpack("<I", struct.pack("<f", stack.pop()))[0]
+        if op == 0xBD:                            # i64.reinterpret_f64
+            return struct.unpack("<Q", struct.pack("<d", stack.pop()))[0]
+        if op == 0xBE:                            # f32.reinterpret_i32
+            return struct.unpack("<f", struct.pack("<I",
+                                                   _wrap32(stack.pop())))[0]
+        if op == 0xBF:                            # f64.reinterpret_i64
+            return struct.unpack("<d", struct.pack("<Q",
+                                                   _wrap64(stack.pop())))[0]
+        if op == 0xC0:                            # i32.extend8_s
+            return _wrap32(struct.unpack(
+                "<b", struct.pack("<B", _wrap32(stack.pop()) & 0xFF))[0])
+        if op == 0xC1:                            # i32.extend16_s
+            return _wrap32(struct.unpack(
+                "<h", struct.pack("<H", _wrap32(stack.pop()) & 0xFFFF))[0])
+        if op == 0xC2:                            # i64.extend8_s
+            return _wrap64(struct.unpack(
+                "<b", struct.pack("<B", _wrap64(stack.pop()) & 0xFF))[0])
+        if op == 0xC3:                            # i64.extend16_s
+            return _wrap64(struct.unpack(
+                "<h", struct.pack("<H", _wrap64(stack.pop()) & 0xFFFF))[0])
+        if op == 0xC4:                            # i64.extend32_s
+            return _wrap64(_sign32(stack.pop()))
+
+        raise WasmError(f"unsupported numeric opcode 0x{op:02x}")
+
+
+def instantiate(data: bytes,
+                host: Optional[dict[tuple[str, str], Callable]] = None
+                ) -> Instance:
+    return Instance(decode_module(data), host or {})

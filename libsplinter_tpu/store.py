@@ -26,8 +26,16 @@ from . import _native as N
 _RETRIES = 1024
 
 
-class Eagain(Exception):
-    """Seqlock contention persisted past the retry budget."""
+class Eagain(OSError):
+    """Seqlock contention persisted past the retry budget.
+
+    An OSError (errno EAGAIN) so generic `except OSError` handlers — the
+    CLI, the scripting hosts — degrade gracefully under contention instead
+    of crashing; callers that care retry by catching Eagain itself.
+    """
+
+    def __init__(self, key: str = ""):
+        super().__init__(errno.EAGAIN, os.strerror(errno.EAGAIN), key)
 
 
 @dataclass
@@ -371,11 +379,16 @@ class Store:
 
     def tandem_set(self, base: str, chunks: Sequence[bytes | str]) -> int:
         for i, ch in enumerate(chunks):
-            if isinstance(ch, str):
-                ch = ch.encode()
-            _retry(self._lib.spt_tandem_set, self._h, base.encode(), i, ch,
-                   len(ch), key=base)
+            self.tandem_set_at(base, i, ch)
         return len(chunks)
+
+    def tandem_set_at(self, base: str, order: int,
+                      val: bytes | str) -> None:
+        """Write a single tandem order (0 = the base key itself)."""
+        if isinstance(val, str):
+            val = val.encode()
+        _retry(self._lib.spt_tandem_set, self._h, base.encode(), order,
+               val, len(val), key=base)
 
     def tandem_get(self, base: str, order: int) -> bytes:
         cap = self.max_val

@@ -298,6 +298,10 @@ static void drain_debug(attach_t *a) {
     uint32_t len = 0;
     spt_key_at(a->st, idx[i], key);
     int rc = spt_get_at(a->st, idx[i], val, sizeof val - 1, &len);
+    if (rc == -EMSGSIZE) {      /* value longer than the panel: truncate */
+      len = sizeof val - 1;
+      rc = 0;
+    }
     if (rc == 0) val[len < sizeof val - 1 ? len : sizeof val - 1] = 0;
     char line[CHATTER_WIDTH + 160];
     snprintf(line, sizeof line, "(%llu) %s: %s",

@@ -1,0 +1,14 @@
+#!/bin/sh
+# Sync the native C sources into the Rust -sys crate's vendored csrc/
+# (reference parity: scripts/sync-rust-vendor.sh keeps libsplinter-sys'
+# csrc/ copy of the core in lockstep with the top-level sources).
+set -eu
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+DST="$ROOT/bindings/rust/libsptpu-sys/csrc"
+mkdir -p "$DST"
+cp "$ROOT/native/src/store.c" \
+   "$ROOT/native/src/coord.c" \
+   "$ROOT/native/src/internal.h" \
+   "$DST/"
+cp "$ROOT/native/include/sptpu.h" "$DST/"
+echo "synced native sources -> $DST"

@@ -1,0 +1,87 @@
+"""Binding-surface smoke tests (reference parity: Deno/Bun FFI test suite
+bindings/ts/splinter_test.ts + the Rust -sys crates built by cc in build.rs).
+
+Neither a JS runtime nor rustc is guaranteed in the build image, so:
+  - the vendored-source sync check always runs (a stale csrc/ is the classic
+    -sys crate failure mode);
+  - the TS symbol table is cross-checked against the C header so the FFI
+    declarations cannot drift silently;
+  - the real runtime suites execute only when deno / bun / cargo exist.
+"""
+from __future__ import annotations
+
+import filecmp
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+CSRC = ROOT / "bindings" / "rust" / "libsptpu-sys" / "csrc"
+TS = ROOT / "bindings" / "ts" / "sptpu.ts"
+HDR = ROOT / "native" / "include" / "sptpu.h"
+
+
+def test_rust_vendor_in_sync():
+    pairs = [
+        (ROOT / "native" / "src" / "store.c", CSRC / "store.c"),
+        (ROOT / "native" / "src" / "coord.c", CSRC / "coord.c"),
+        (ROOT / "native" / "src" / "internal.h", CSRC / "internal.h"),
+        (HDR, CSRC / "sptpu.h"),
+    ]
+    for src, dst in pairs:
+        assert dst.exists(), f"{dst} missing — run scripts/sync_rust_vendor.sh"
+        assert filecmp.cmp(src, dst, shallow=False), (
+            f"{dst} is stale — run scripts/sync_rust_vendor.sh"
+        )
+
+
+def test_rust_decls_exist_in_header():
+    lib_rs = (ROOT / "bindings" / "rust" / "libsptpu-sys" / "src" /
+              "lib.rs").read_text()
+    header = HDR.read_text()
+    declared = set(re.findall(r"pub fn (spt_\w+)", lib_rs))
+    assert len(declared) > 60
+    for fn in sorted(declared):
+        assert re.search(rf"\b{fn}\s*\(", header), (
+            f"lib.rs declares {fn} which is not in sptpu.h"
+        )
+
+
+def test_ts_symbols_exist_in_header():
+    ts = TS.read_text()
+    header = HDR.read_text()
+    declared = set(re.findall(r"^  (spt_\w+):", ts, re.M))
+    assert len(declared) > 35
+    for fn in sorted(declared):
+        assert re.search(rf"\b{fn}\s*\(", header), (
+            f"sptpu.ts binds {fn} which is not in sptpu.h"
+        )
+
+
+@pytest.mark.skipif(shutil.which("deno") is None, reason="deno not installed")
+def test_ts_suite_under_deno():
+    subprocess.run(
+        ["deno", "test", "--allow-ffi", "--allow-env",
+         str(ROOT / "bindings" / "ts" / "sptpu_test.ts")],
+        check=True, timeout=120,
+    )
+
+
+@pytest.mark.skipif(shutil.which("bun") is None, reason="bun not installed")
+def test_ts_suite_under_bun():
+    subprocess.run(
+        ["bun", str(ROOT / "bindings" / "ts" / "sptpu_test.ts")],
+        check=True, timeout=120,
+    )
+
+
+@pytest.mark.skipif(shutil.which("cargo") is None, reason="cargo not installed")
+def test_rust_suite_under_cargo():
+    subprocess.run(
+        ["cargo", "test", "--quiet"],
+        cwd=ROOT / "bindings" / "rust" / "libsptpu-sys",
+        check=True, timeout=600,
+    )

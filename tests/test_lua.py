@@ -1,0 +1,308 @@
+"""Lua scripting host tests.
+
+Interpreter-level coverage of the microlua subset, then store-backed host
+coverage mirroring the reference's smoke script (test.lua: require, arg
+table, get-or-default, set, math/inc — plus tandem, labels, embeddings
+through the host API of splinter_cli_cmd_lua.c:365-386).
+"""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from libsplinter_tpu.scripting.microlua import (
+    LuaError, LuaRuntime, LuaTable,
+)
+
+
+def run_lua(src, **kw):
+    lines = []
+    rt = LuaRuntime(output=lines.append)
+    result = rt.run(src, **kw)
+    return lines, result
+
+
+class TestInterpreter:
+    def test_arith_and_print(self):
+        out, _ = run_lua("print(1 + 2 * 3, 10 / 4, 7 // 2, 2^10, 7 % 3)")
+        assert out == ["7\t2.5\t3\t1024.0\t1"]
+
+    def test_int_float_semantics(self):
+        out, _ = run_lua("print(1 == 1.0, 3 / 1, 4 // 1)")
+        assert out == ["true\t3.0\t4"]
+
+    def test_strings_concat_len(self):
+        out, _ = run_lua('local s = "ab" .. "cd" .. 12 print(s, #s)')
+        assert out == ["abcd12\t6"]
+
+    def test_locals_and_scoping(self):
+        src = """
+        local x = 1
+        do local x = 2 end
+        print(x)
+        """
+        assert run_lua(src)[0] == ["1"]
+
+    def test_if_elseif_else(self):
+        src = """
+        local function grade(n)
+          if n > 89 then return "A" elseif n > 79 then return "B"
+          else return "C" end
+        end
+        print(grade(95), grade(85), grade(10))
+        """
+        assert run_lua(src)[0] == ["A\tB\tC"]
+
+    def test_while_repeat_break(self):
+        src = """
+        local i, total = 0, 0
+        while true do
+          i = i + 1
+          if i > 10 then break end
+          total = total + i
+        end
+        local j = 0
+        repeat j = j + 1 until j >= 3
+        print(total, j)
+        """
+        assert run_lua(src)[0] == ["55\t3"]
+
+    def test_numeric_for_with_step(self):
+        src = """
+        local acc = {}
+        for i = 10, 1, -3 do table.insert(acc, i) end
+        print(table.concat(acc, ","))
+        """
+        assert run_lua(src)[0] == ["10,7,4,1"]
+
+    def test_generic_for_ipairs_pairs(self):
+        src = """
+        local t = {"a", "b", "c", x = 1}
+        local items = {}
+        for i, v in ipairs(t) do items[#items + 1] = i .. v end
+        local count = 0
+        for k, v in pairs(t) do count = count + 1 end
+        print(table.concat(items, " "), count)
+        """
+        assert run_lua(src)[0] == ["1a 2b 3c\t4"]
+
+    def test_functions_closures_recursion(self):
+        src = """
+        local function counter()
+          local n = 0
+          return function() n = n + 1 return n end
+        end
+        local c = counter()
+        c() c()
+        local function fib(n)
+          if n < 2 then return n end
+          return fib(n - 1) + fib(n - 2)
+        end
+        print(c(), fib(10))
+        """
+        assert run_lua(src)[0] == ["3\t55"]
+
+    def test_multiple_returns_and_adjustment(self):
+        src = """
+        local function two() return 1, 2 end
+        local a, b = two()
+        local c, d = two(), 10      -- first call truncated to one value
+        local t = {two(), two()}    -- last call expands
+        print(a, b, c, d, #t)
+        """
+        assert run_lua(src)[0] == ["1\t2\t1\t10\t3"]
+
+    def test_varargs(self):
+        src = """
+        local function pack(...) return select("#", ...), ... end
+        print(pack("x", "y"))
+        """
+        assert run_lua(src)[0] == ["2\tx\ty"]
+
+    def test_method_calls(self):
+        src = """
+        local obj = { n = 5 }
+        function obj:bump(k) self.n = self.n + k return self.n end
+        print(obj:bump(3))
+        """
+        assert run_lua(src)[0] == ["8"]
+
+    def test_table_length_border(self):
+        src = """
+        local t = {1, 2, 3}
+        t[5] = 9            -- hole at 4: border stays 3
+        print(#t)
+        t[4] = 8
+        print(#t)
+        """
+        assert run_lua(src)[0] == ["3", "5"]
+
+    def test_string_library(self):
+        src = """
+        print(string.format("%s=%d (%.2f) %x", "k", 42, 1.5, 255))
+        print(("hello"):upper(), string.sub("hello", 2, 4))
+        print(string.rep("ab", 3), string.find("hello world", "wor"))
+        local s, n = string.gsub("a-b-c", "-", "+")
+        print(s, n)
+        """
+        out, _ = run_lua(src)
+        assert out == [
+            "k=42 (1.50) ff",
+            "HELLO\tell",
+            "ababab\t7\t9",
+            "a+b+c\t2",
+        ]
+
+    def test_andor_idioms(self):
+        out, _ = run_lua(
+            'local x = nil print(x or "dflt", x and 1, 0 or "zerotruthy")')
+        assert out == ["dflt\tnil\t0"]
+
+    def test_comparison_and_equality(self):
+        out, _ = run_lua('print("a" < "b", 2 >= 2, "1" == 1, nil == false)')
+        assert out == ["true\ttrue\tfalse\tfalse"]
+
+    def test_pcall_and_error(self):
+        src = """
+        local ok, err = pcall(function() error("boom") end)
+        print(ok, err)
+        print(pcall(function() return 1 + nil end))
+        """
+        out, _ = run_lua(src)
+        assert out[0] == "false\tboom"
+        assert out[1].startswith("false")
+
+    def test_arg_table(self):
+        src = """
+        print(arg[0], #arg)
+        for i = 1, #arg do print(arg[i]) end
+        """
+        out, _ = run_lua(src, script_args=["mykey", "42"],
+                         chunk_name="test.lua")
+        assert out == ["test.lua\t2", "mykey", "42"]
+
+    def test_comments_and_long_strings(self):
+        src = """
+        -- a line comment
+        --[[ a block
+             comment ]]
+        local s = [[line one]]
+        print(s)
+        """
+        assert run_lua(src)[0] == ["line one"]
+
+    def test_runaway_loop_guard(self):
+        rt = LuaRuntime(output=lambda s: None, max_steps=10_000)
+        with pytest.raises(LuaError, match="exceeded"):
+            rt.run("while true do end")
+
+    def test_parse_errors_carry_line(self):
+        with pytest.raises(LuaError, match="line 2"):
+            run_lua("local x = 1\nlocal = 3")
+
+    def test_require_unknown_module(self):
+        with pytest.raises(LuaError, match="not found"):
+            run_lua('require("nope")')
+
+    def test_tostring_tonumber(self):
+        out, _ = run_lua(
+            'print(tostring(nil), tonumber("0x10"), tonumber("3.5"),'
+            ' tonumber("zz"))')
+        assert out == ["nil\t16\t3.5\tnil"]
+
+
+class TestStoreHost:
+    @pytest.fixture
+    def store(self):
+        from libsplinter_tpu.store import Store
+        name = f"lua-host-{os.getpid()}"
+        st = Store.create(name, nslots=128, max_val=512, vec_dim=8)
+        yield st
+        st.close()
+        Store.unlink(name)
+
+    def run_host(self, store, src, args=None):
+        from libsplinter_tpu.scripting.lua_host import make_runtime
+        lines = []
+        rt = make_runtime(store, output=lines.append)
+        rt.run(src, script_args=args or [])
+        return lines
+
+    def test_reference_smoke_script_shape(self, store):
+        # the reference's test.lua flow: require, get-or-default, set, math
+        src = """
+        local bus = require("splinter")
+        local test = bus.get("test_key") or 0
+        print("Test result:" .. test)
+        bus.set("test_multi", "1, 2, 3, 4, 5")
+        bus.set("test_integer", 1)
+        bus.math("test_integer", "inc", 0)
+        print(bus.get("test_integer"))
+        """
+        out = self.run_host(store, src)
+        assert out == ["Test result:0", "2"]
+        assert store.get("test_multi") == b"1, 2, 3, 4, 5"
+        assert store.get_uint("test_integer") == 2
+
+    def test_tandem_roundtrip(self, store):
+        src = """
+        local bus = require("splinter")
+        bus.set_tandem("doc", 1, "chunk one")
+        bus.set_tandem("doc", 2, "chunk two")
+        print(bus.get_tandem("doc", 2))
+        """
+        assert self.run_host(store, src) == ["chunk two"]
+
+    def test_labels_and_bump_signaccording(self, store):
+        src = """
+        local bus = require("splinter")
+        bus.set("task", "payload")
+        bus.watch("task", 5)
+        local before = bus.signal_count(5)
+        bus.label("task", 64)
+        bus.bump("task")
+        print(bus.signal_count(5) - before)
+        """
+        out = self.run_host(store, src)
+        assert out == ["1"]  # label set is metadata-only; only bump pulses
+
+    def test_embedding_roundtrip(self, store):
+        src = """
+        local bus = require("splinter")
+        bus.set("vec_key", "has a vector")
+        bus.set_embedding("vec_key", {0.5, 1.0, 0, 0, 0, 0, 0, 0.25})
+        local v = bus.get_embedding("vec_key")
+        print(#v, v[1], v[8])
+        """
+        out = self.run_host(store, src)
+        assert out == ["8\t0.5\t0.25"]
+
+    def test_unset_and_epoch(self, store):
+        src = """
+        local bus = require("splinter")
+        bus.set("gone", "x")
+        local e1 = bus.epoch("gone")
+        bus.set("gone", "y")
+        print(bus.epoch("gone") - e1)
+        bus.unset("gone")
+        print(bus.get("gone"))
+        """
+        assert self.run_host(store, src) == ["2", "nil"]
+
+    def test_cli_lua_command(self, store, tmp_path, capsys):
+        from libsplinter_tpu.cli.main import Session, dispatch
+        script = tmp_path / "s.lua"
+        script.write_text(
+            'local bus = require("splinter")\n'
+            'bus.set(arg[1], "from cli lua")\n'
+            'print("wrote " .. arg[1])\n')
+        ses = Session.__new__(Session)
+        ses.store_name = store.name
+        ses.ns_prefix = ""
+        ses.persistent = False
+        ses._store = store
+        ses.labels = {}
+        dispatch(ses, ["lua", str(script), "cli_key"])
+        assert capsys.readouterr().out.strip() == "wrote cli_key"
+        assert store.get("cli_key") == b"from cli lua"

@@ -1,0 +1,315 @@
+"""WASM scripting host tests.
+
+Modules are hand-assembled binary wasm (no wat toolchain in the image) via
+the tiny builder below, then run through the microwasm interpreter — pure
+compute first, then store-backed host imports mirroring the reference's
+splinter.get/set wasm surface (splinter_cli_cmd_wasm.c:85-143).
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import pytest
+
+from libsplinter_tpu.scripting.microwasm import (
+    Trap, WasmError, instantiate,
+)
+
+I32, I64, F32, F64 = 0x7F, 0x7E, 0x7D, 0x7C
+
+
+# ------------------------------------------------------- binary wasm builder
+
+def uleb(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def sleb(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        done = (v == 0 and not b & 0x40) or (v == -1 and b & 0x40)
+        out.append(b | (0 if done else 0x80))
+        if done:
+            return bytes(out)
+
+
+def vec(items: list[bytes]) -> bytes:
+    return uleb(len(items)) + b"".join(items)
+
+
+def section(sid: int, payload: bytes) -> bytes:
+    return bytes([sid]) + uleb(len(payload)) + payload
+
+
+def functype(params: list[int], results: list[int]) -> bytes:
+    return (b"\x60" + vec([bytes([p]) for p in params]) +
+            vec([bytes([r]) for r in results]))
+
+
+def name(s: str) -> bytes:
+    b = s.encode()
+    return uleb(len(b)) + b
+
+
+def code_entry(local_groups: list[tuple[int, int]], body: bytes) -> bytes:
+    locals_ = vec([uleb(n) + bytes([t]) for n, t in local_groups])
+    payload = locals_ + body
+    return uleb(len(payload)) + payload
+
+
+def module(sections: list[bytes]) -> bytes:
+    return b"\x00asm\x01\x00\x00\x00" + b"".join(sections)
+
+
+def simple_module(params, results, body, locals_=()):
+    """One exported function 'run' with the given raw body bytes."""
+    return module([
+        section(1, vec([functype(params, results)])),
+        section(3, vec([uleb(0)])),
+        section(7, vec([name("run") + b"\x00" + uleb(0)])),
+        section(10, vec([code_entry(list(locals_), body)])),
+    ])
+
+
+# opcodes used below
+END = b"\x0b"
+
+
+def i32c(v):
+    return b"\x41" + sleb(v)
+
+
+def i64c(v):
+    return b"\x42" + sleb(v)
+
+
+LOCAL_GET = lambda i: b"\x20" + uleb(i)      # noqa: E731
+LOCAL_SET = lambda i: b"\x21" + uleb(i)      # noqa: E731
+CALL = lambda i: b"\x10" + uleb(i)           # noqa: E731
+
+
+class TestInterpreterCore:
+    def test_add(self):
+        inst = instantiate(simple_module(
+            [I32, I32], [I32],
+            LOCAL_GET(0) + LOCAL_GET(1) + b"\x6a" + END))
+        assert inst.invoke("run", [2, 40]) == [42]
+
+    def test_loop_sum(self):
+        # sum 1..n with loop + br_if: locals i(1), acc(2)
+        body = (
+            b"\x02\x40"                              # block void
+            b"\x03\x40" +                            # loop void
+            LOCAL_GET(1) + LOCAL_GET(0) + b"\x4a" +  # i > n ?
+            b"\x0d\x01" +                            # br_if 1 (exit block)
+            LOCAL_GET(2) + LOCAL_GET(1) + b"\x6a" + LOCAL_SET(2) +
+            LOCAL_GET(1) + i32c(1) + b"\x6a" + LOCAL_SET(1) +
+            b"\x0c\x00" +                            # br 0 (continue loop)
+            END + END +
+            LOCAL_GET(2) + END)
+        m = module([
+            section(1, vec([functype([I32], [I32])])),
+            section(3, vec([uleb(0)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry([(2, I32)],
+                                        LOCAL_GET(0) + b"\x1a" +  # warm drop
+                                        i32c(1) + LOCAL_SET(1) +
+                                        i32c(0) + LOCAL_SET(2) + body)])),
+        ])
+        inst = instantiate(m)
+        assert inst.invoke("run", [10]) == [55]
+        assert inst.invoke("run", [100]) == [5050]
+
+    def test_if_else(self):
+        body = (LOCAL_GET(0) + i32c(0) + b"\x48" +   # n < 0 (signed)
+                b"\x04\x7f" +                        # if (result i32)
+                i32c(-1) + b"\x05" + i32c(1) + END + END)
+        inst = instantiate(simple_module([I32], [I32], body))
+        assert inst.invoke("run", [-5]) == [4294967295]  # -1 as u32
+        assert inst.invoke("run", [5]) == [1]
+
+    def test_recursion_factorial(self):
+        # fact(n) = n<2 ? 1 : n*fact(n-1)
+        fact_body = (
+            LOCAL_GET(0) + i32c(2) + b"\x48" +       # n < 2
+            b"\x04\x7f" + i32c(1) +                  # then 1
+            b"\x05" +                                # else
+            LOCAL_GET(0) + LOCAL_GET(0) + i32c(1) + b"\x6b" +
+            CALL(0) + b"\x6c" +
+            END + END)
+        m = module([
+            section(1, vec([functype([I32], [I32])])),
+            section(3, vec([uleb(0)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry([], fact_body)])),
+        ])
+        assert instantiate(m).invoke("run", [10]) == [3628800]
+
+    def test_i64_and_div_trap(self):
+        inst = instantiate(simple_module(
+            [I64, I64], [I64],
+            LOCAL_GET(0) + LOCAL_GET(1) + b"\x7e" + END))
+        assert inst.invoke("run", [1 << 40, 4]) == [1 << 42]
+        div = instantiate(simple_module(
+            [I32, I32], [I32],
+            LOCAL_GET(0) + LOCAL_GET(1) + b"\x6d" + END))
+        with pytest.raises(Trap, match="divide by zero"):
+            div.invoke("run", [1, 0])
+
+    def test_f64_math(self):
+        inst = instantiate(simple_module(
+            [F64], [F64], LOCAL_GET(0) + b"\x9f" + END))  # f64.sqrt
+        assert inst.invoke("run", [81.0]) == [9.0]
+
+    def test_memory_store_load(self):
+        # store arg at [16], load it back
+        body = (i32c(16) + LOCAL_GET(0) + b"\x36\x02\x00" +  # i32.store
+                i32c(16) + b"\x28\x02\x00" + END)            # i32.load
+        m = module([
+            section(1, vec([functype([I32], [I32])])),
+            section(3, vec([uleb(0)])),
+            section(5, vec([b"\x00" + uleb(1)])),            # 1 page
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry([], body)])),
+        ])
+        assert instantiate(m).invoke("run", [0xDEAD]) == [0xDEAD]
+
+    def test_memory_oob_traps(self):
+        body = (i32c(70000) + i32c(1) + b"\x36\x02\x00" + i32c(0) + END)
+        m = module([
+            section(1, vec([functype([], [I32])])),
+            section(3, vec([uleb(0)])),
+            section(5, vec([b"\x00" + uleb(1)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry([], body)])),
+        ])
+        with pytest.raises(Trap, match="out-of-bounds"):
+            instantiate(m).invoke("run", [])
+
+    def test_data_segment_and_export(self):
+        m = module([
+            section(1, vec([functype([], [I32])])),
+            section(3, vec([uleb(0)])),
+            section(5, vec([b"\x00" + uleb(1)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry(
+                [], i32c(8) + b"\x2d\x00\x00" + END)])),     # load8_u @8
+            section(11, vec([b"\x00" + i32c(8) + END +
+                             uleb(1) + b"\x2a"])),           # byte 42 @8
+        ])
+        assert instantiate(m).invoke("run", []) == [42]
+
+    def test_unreachable_and_unsupported(self):
+        m = simple_module([], [], b"\x00" + END)
+        with pytest.raises(Trap, match="unreachable"):
+            instantiate(m).invoke("run", [])
+        with pytest.raises(WasmError, match="magic"):
+            instantiate(b"\x00asm\x02\x00\x00\x00")
+
+    def test_runaway_guard(self):
+        body = b"\x03\x40" + b"\x0c\x00" + END + END  # loop { br 0 }
+        m = simple_module([], [], body)
+        inst = instantiate(m)
+        inst.MAX_STEPS = 10_000
+        with pytest.raises(Trap, match="budget"):
+            inst.invoke("run", [])
+
+
+# --------------------------------------------------------- store host tests
+
+def host_module() -> bytes:
+    """imports splinter.set/get + env.print; data: key@0 "wkey" (4), val@8
+    "hello wasm" (10); run(): set(key, val); n = get(key -> @64 cap 32);
+    print(@64, n); return n."""
+    t_set = functype([I32, I32, I32, I32], [I32])
+    t_get = functype([I32, I32, I32, I32], [I32])
+    t_print = functype([I32, I32], [])
+    t_run = functype([], [I32])
+    run_body = (
+        i32c(0) + i32c(4) + i32c(8) + i32c(10) + CALL(0) + b"\x1a" +
+        i32c(0) + i32c(4) + i32c(64) + i32c(32) + CALL(1) +
+        LOCAL_SET(0) +
+        i32c(64) + LOCAL_GET(0) + CALL(2) +
+        LOCAL_GET(0) + END)
+    return module([
+        section(1, vec([t_set, t_get, t_print, t_run])),
+        section(2, vec([
+            name("splinter") + name("set") + b"\x00" + uleb(0),
+            name("splinter") + name("get") + b"\x00" + uleb(1),
+            name("env") + name("print") + b"\x00" + uleb(2),
+        ])),
+        section(3, vec([uleb(3)])),                   # run : type 3
+        section(5, vec([b"\x00" + uleb(1)])),
+        section(7, vec([name("run") + b"\x00" + uleb(3)])),
+        section(10, vec([code_entry([(1, I32)], run_body)])),
+        section(11, vec([
+            b"\x00" + i32c(0) + END + uleb(4) + b"wkey",
+            b"\x00" + i32c(8) + END + uleb(10) + b"hello wasm",
+        ])),
+    ])
+
+
+class TestStoreHost:
+    @pytest.fixture
+    def store(self):
+        from libsplinter_tpu.store import Store
+        nm = f"wasm-host-{os.getpid()}"
+        st = Store.create(nm, nslots=64, max_val=256, vec_dim=4)
+        yield st
+        st.close()
+        Store.unlink(nm)
+
+    def test_set_get_print_roundtrip(self, store):
+        from libsplinter_tpu.scripting.wasm_host import make_host_imports
+        printed = []
+        inst = instantiate(host_module(),
+                           make_host_imports(store, out=printed.append))
+        assert inst.invoke("run", []) == [10]
+        assert store.get("wkey") == b"hello wasm"
+        assert printed == ["hello wasm"]
+
+    def test_get_missing_returns_negative_errno(self, store):
+        from libsplinter_tpu.scripting.wasm_host import make_host_imports
+        # run() gets before any set: patch module to call get only
+        t_get = functype([I32, I32, I32, I32], [I32])
+        t_run = functype([], [I32])
+        body = i32c(0) + i32c(4) + i32c(64) + i32c(32) + CALL(0) + END
+        m = module([
+            section(1, vec([t_get, t_run])),
+            section(2, vec([name("splinter") + name("get") +
+                            b"\x00" + uleb(0)])),
+            section(3, vec([uleb(1)])),
+            section(5, vec([b"\x00" + uleb(1)])),
+            section(7, vec([name("run") + b"\x00" + uleb(1)])),
+            section(10, vec([code_entry([], body)])),
+            section(11, vec([b"\x00" + i32c(0) + END + uleb(4) + b"nope"])),
+        ])
+        inst = instantiate(m, make_host_imports(store))
+        rc = inst.invoke("run", [])[0]
+        assert rc == (-2 & 0xFFFFFFFF) or rc == -2   # -ENOENT
+
+    def test_cli_wasm_command(self, store, tmp_path, capsys):
+        from libsplinter_tpu.cli.main import Session, dispatch
+        mod = tmp_path / "m.wasm"
+        mod.write_bytes(host_module())
+        ses = Session.__new__(Session)
+        ses.store_name = store.name
+        ses.ns_prefix = ""
+        ses.persistent = False
+        ses._store = store
+        ses.labels = {}
+        dispatch(ses, ["wasm", str(mod), "run"])
+        out = capsys.readouterr().out
+        assert "hello wasm" in out and "10" in out
+        assert store.get("wkey") == b"hello wasm"

@@ -205,7 +205,7 @@ class CompletionModel:
 
     def __init__(self, cfg: DecoderConfig, *, seed: int = 0,
                  buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
-                 params: Any = None,
+                 params: Any = None, weights: str | None = None,
                  top_p: float = 0.9, temp: float = 0.7):
         self.cfg = cfg
         self.module = Decoder(cfg)
@@ -215,6 +215,8 @@ class CompletionModel:
             # a prompt longer than the largest bucket (but inside the
             # window) must still have a program to land in
             self.buckets = self.buckets + (cfg.max_len,)
+        if params is None and weights is not None:
+            params = load_safetensors_params(weights, cfg)
         if params is None:
             cache = init_cache(cfg, 1)
             params = self.module.init(
@@ -286,3 +288,109 @@ class CompletionModel:
             self.prefill(np.ones((max(1, b - 1),), np.int32))
             self.decode_one(1)
         self.reset()
+
+
+# ------------------------------------------------------ checkpoint loading
+
+def load_safetensors_params(path: str, cfg: DecoderConfig):
+    """Map a HF llama-family safetensors checkpoint onto the flax tree.
+
+    Expected naming (the llama/mistral export convention):
+    model.embed_tokens.weight, model.layers.{i}.self_attn.{q,k,v,o}_proj,
+    model.layers.{i}.mlp.{gate,up,down}_proj,
+    model.layers.{i}.input_layernorm / post_attention_layernorm,
+    model.norm.weight, lm_head.weight (tied to embeddings when absent).
+    torch Linear weights are (out, in) and transpose into flax kernels.
+
+    Validated in-tree against synthetic checkpoints written by
+    `export_safetensors_params` (tests/test_decoder.py); upstream name
+    parity cannot be re-verified in this offline image.
+    """
+    from safetensors import safe_open
+
+    tensors: dict[str, np.ndarray] = {}
+    with safe_open(path, framework="np") as f:
+        for k in f.keys():
+            tensors[k] = f.get_tensor(k)
+
+    def take(name: str):
+        if name not in tensors:
+            raise KeyError(f"checkpoint {path} lacks {name}; present keys "
+                           f"include {sorted(tensors)[:8]}...")
+        return np.asarray(tensors[name])
+
+    def kern(name: str):
+        return {"kernel": take(name).T.astype(np.float32)}
+
+    tok = take("model.embed_tokens.weight")
+    if tok.shape[0] < cfg.vocab_size:
+        raise ValueError(
+            f"checkpoint vocab {tok.shape[0]} < cfg.vocab_size "
+            f"{cfg.vocab_size} — out-of-range rows would gather-clamp "
+            "silently; shrink cfg.vocab_size to the checkpoint's")
+    p: dict[str, Any] = {
+        "tok_emb": {"embedding":
+                    tok[:cfg.vocab_size].astype(np.float32)},
+        "ln_out": {"scale": take("model.norm.weight").astype(np.float32)},
+    }
+    if "lm_head.weight" in tensors:
+        # same vocab truncation as the embedding (padded-vocab exports),
+        # on the ROWS of the (out, in) torch tensor
+        head = take("lm_head.weight")
+        if head.shape[0] < cfg.vocab_size:
+            raise ValueError(
+                f"checkpoint lm_head vocab {head.shape[0]} < "
+                f"cfg.vocab_size {cfg.vocab_size}")
+        p["lm_head"] = {"kernel":
+                        head[:cfg.vocab_size].T.astype(np.float32)}
+    else:   # tied embeddings
+        p["lm_head"] = {"kernel":
+                        p["tok_emb"]["embedding"].T.copy()}
+    for i in range(cfg.layers):
+        n = f"model.layers.{i}"
+        p[f"layer_{i}"] = {
+            "ln_attn": {"scale":
+                        take(f"{n}.input_layernorm.weight")
+                        .astype(np.float32)},
+            "attn": {
+                "q": kern(f"{n}.self_attn.q_proj.weight"),
+                "k": kern(f"{n}.self_attn.k_proj.weight"),
+                "v": kern(f"{n}.self_attn.v_proj.weight"),
+                "out": kern(f"{n}.self_attn.o_proj.weight"),
+            },
+            "ln_mlp": {"scale":
+                       take(f"{n}.post_attention_layernorm.weight")
+                       .astype(np.float32)},
+            "gate": kern(f"{n}.mlp.gate_proj.weight"),
+            "up": kern(f"{n}.mlp.up_proj.weight"),
+            "down": kern(f"{n}.mlp.down_proj.weight"),
+        }
+    return {"params": jax.tree.map(
+        lambda x: jnp.asarray(x, jnp.float32), p)}
+
+
+def export_safetensors_params(params, cfg: DecoderConfig, path: str) -> None:
+    """Inverse of load_safetensors_params (llama naming); used by the
+    round-trip tests and for interop with torch tooling."""
+    from safetensors.numpy import save_file
+
+    p = jax.tree.map(lambda x: np.asarray(x, np.float32), params["params"])
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": p["tok_emb"]["embedding"],
+        "model.norm.weight": p["ln_out"]["scale"],
+        "lm_head.weight": p["lm_head"]["kernel"].T.copy(),
+    }
+    for i in range(cfg.layers):
+        n = f"model.layers.{i}"
+        layer = p[f"layer_{i}"]
+        out[f"{n}.input_layernorm.weight"] = layer["ln_attn"]["scale"]
+        out[f"{n}.post_attention_layernorm.weight"] = \
+            layer["ln_mlp"]["scale"]
+        for src, dst in (("q", "q_proj"), ("k", "k_proj"),
+                         ("v", "v_proj"), ("out", "o_proj")):
+            out[f"{n}.self_attn.{dst}.weight"] = \
+                layer["attn"][src]["kernel"].T.copy()
+        for name in ("gate", "up", "down"):
+            out[f"{n}.mlp.{name}_proj.weight"] = \
+                layer[name]["kernel"].T.copy()
+    save_file(out, path)

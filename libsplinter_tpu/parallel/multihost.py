@@ -1,0 +1,77 @@
+"""Multi-host (pod) bootstrap and host-local arena conventions.
+
+The reference's communication backend is shared memory + atomics on ONE
+machine (SURVEY.md §2.7; multi-node is explicitly out of scope there).
+The pod story here follows the TPU shape instead:
+
+  - every TPU-VM worker runs its own host-local store (same bus name),
+    serving its local clients over shm exactly like the single-host case;
+  - device compute spans hosts through ONE global mesh: jax.distributed
+    wires the hosts, XLA places collectives on ICI/DCN;
+  - cross-host data flow rides the device mesh (all_gather of per-shard
+    top-k candidates, psum of stats) — the host stores never talk to each
+    other directly, so there is no cross-host coherence protocol to get
+    wrong; DCN carries only job control.
+
+`init_distributed()` is idempotent and a no-op in single-process runs, so
+daemons can call it unconditionally.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
+    """Wire this process into the pod's global device mesh.
+
+    Arguments default from the standard env (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID — or their TPU-metadata fallbacks
+    handled inside jax.distributed).  Returns True when a multi-process
+    runtime was initialized, False for the single-process fast path.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num = num_processes if num_processes is not None else \
+        int(os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("JAX_PROCESS_ID", "-1") or -1)
+    if coordinator is None and num <= 1:
+        return False        # single host, nothing to wire
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num if num > 0 else None,
+        process_id=pid if pid >= 0 else None,
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def host_store_name(base: str) -> str:
+    """Host-local bus name: identical on every worker by convention, so
+    one deployment manifest serves the whole pod.  (Per-host isolation is
+    automatic — /dev/shm is not shared across hosts.)"""
+    return base
+
+
+def process_span() -> tuple[int, int]:
+    """(process_id, process_count) of this worker in the pod."""
+    return jax.process_index(), jax.process_count()
+
+
+def local_rows(n_rows: int) -> slice:
+    """The contiguous slice of a length-n_rows global arena that this host
+    owns (block partition; the last host absorbs the remainder).  Used to
+    place each host's vector lane rows into the global sharded matrix."""
+    pid, pcount = process_span()
+    per = n_rows // pcount
+    start = pid * per
+    stop = n_rows if pid == pcount - 1 else start + per
+    return slice(start, stop)

@@ -54,10 +54,10 @@ def make_splinter_module(store) -> LuaTable:
         try:
             if isinstance(value, bool):
                 store.set(key, b"1" if value else b"0")
-            elif isinstance(value, int) and value >= 0:
-                # non-negative numbers become BIGUINT so splinter.math
-                # works right away; negatives stay text (BIGUINT is
-                # unsigned — promotion would fail after the write)
+            elif isinstance(value, int) and 0 <= value < 2**64:
+                # uint64-range numbers become BIGUINT so splinter.math
+                # works right away; negatives and >=2^64 stay text
+                # (promotion would fail or wrap after the write)
                 store.set(key, str(value).encode())
                 store.set_type(key, N.T_BIGUINT)
             elif isinstance(value, int):

@@ -306,12 +306,9 @@ def load_safetensors_params(path: str, cfg: DecoderConfig):
     `export_safetensors_params` (tests/test_decoder.py); upstream name
     parity cannot be re-verified in this offline image.
     """
-    from safetensors import safe_open
+    from .encoder import read_safetensors_f32
 
-    tensors: dict[str, np.ndarray] = {}
-    with safe_open(path, framework="np") as f:
-        for k in f.keys():
-            tensors[k] = f.get_tensor(k)
+    tensors = read_safetensors_f32(path)
 
     def take(name: str):
         if name not in tensors:

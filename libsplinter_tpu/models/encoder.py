@@ -243,6 +243,24 @@ class EmbeddingModel:
                 self.encode_ids(ids, lens)
 
 
+def read_safetensors_f32(path: str) -> dict[str, np.ndarray]:
+    """Read every tensor in a safetensors file as float32 numpy.
+
+    Real HF exports ship bf16/fp16 (bf16 is the llama default), which the
+    numpy framework of safetensors cannot represent — so tensors load
+    through the flax framework (jax handles bfloat16 natively) and are
+    cast to float32 masters here.
+    """
+    from safetensors import safe_open
+
+    out: dict[str, np.ndarray] = {}
+    with safe_open(path, framework="flax") as f:
+        for k in f.keys():
+            t = f.get_tensor(k)
+            out[k] = np.asarray(jnp.asarray(t, jnp.float32))
+    return out
+
+
 def _hf_layer_names(cfg: EncoderConfig, i: int) -> dict[str, list[str]]:
     """Logical slot -> candidate HF tensor names for layer i, covering both
     checkpoint families this encoder loads:
@@ -314,12 +332,7 @@ def load_safetensors_params(path: str, cfg: EncoderConfig):
     upstream exports cannot be re-verified in this offline image, so
     unresolved tensors fail loudly with the full candidate list.
     """
-    from safetensors import safe_open
-
-    tensors: dict[str, np.ndarray] = {}
-    with safe_open(path, framework="np") as f:
-        for k in f.keys():
-            tensors[k] = f.get_tensor(k)
+    tensors = read_safetensors_f32(path)
 
     def take(aliases: list[str], *, required: bool = True):
         for a in aliases:

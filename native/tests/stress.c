@@ -31,8 +31,37 @@ static void key_name(char *buf, int i) {
   snprintf(buf, SPT_KEY_MAX, "stress-key-%d", i);
 }
 
-static void *writer(void *arg) {
+/* --raw: measure the STORE's ceiling, not the harness's — keys are
+ * pre-rendered and the payload is constant, so the loop body is one
+ * spt_set per iteration (hash + probe + seqlock + memcpy + fanout).
+ * Readers skip payload validation in this mode (the payload carries no
+ * per-write nonce to check). */
+static int g_raw = 0;
+
+static void *writer_raw(void *arg) {
   (void)arg;
+  char *keys = malloc((size_t)g_nkeys * SPT_KEY_MAX);
+  char *payload = malloc((size_t)g_valsz + 64);
+  for (int i = 0; i < g_nkeys; i++)
+    key_name(keys + (size_t)i * SPT_KEY_MAX, i);
+  memset(payload, 'x', (size_t)g_valsz);
+  long nonce = 0;
+  while (!atomic_load_explicit(&g_stop, memory_order_relaxed)) {
+    const char *key = keys + (size_t)(nonce % g_nkeys) * SPT_KEY_MAX;
+    int rc = spt_set(g_st, key, payload, (uint32_t)g_valsz);
+    if (rc == 0)
+      atomic_fetch_add_explicit(&g_writes, 1, memory_order_relaxed);
+    else if (rc == -11) /* EAGAIN */
+      atomic_fetch_add_explicit(&g_eagain, 1, memory_order_relaxed);
+    nonce++;
+  }
+  free(keys);
+  free(payload);
+  return NULL;
+}
+
+static void *writer(void *arg) {
+  if (g_raw) return writer_raw(arg);
   char key[SPT_KEY_MAX];
   char *payload = malloc((size_t)g_valsz + 64);
   long nonce = 0;
@@ -74,18 +103,28 @@ static int parse_payload(const char *buf, uint32_t len, int expect_key) {
 static void *reader(void *arg) {
   (void)arg;
   char key[SPT_KEY_MAX];
+  char *raw_keys = NULL;
+  if (g_raw) {        /* pre-render keys: measure the store, not snprintf */
+    raw_keys = malloc((size_t)g_nkeys * SPT_KEY_MAX);
+    for (int i = 0; i < g_nkeys; i++)
+      key_name(raw_keys + (size_t)i * SPT_KEY_MAX, i);
+  }
   char *buf = malloc((size_t)g_valsz + 64);
   unsigned seed = (unsigned)(uintptr_t)&buf;
   while (!atomic_load_explicit(&g_stop, memory_order_relaxed)) {
     int i = (int)(rand_r(&seed) % g_nkeys);
-    key_name(key, i);
+    const char *k = key;
+    if (raw_keys)
+      k = raw_keys + (size_t)i * SPT_KEY_MAX;
+    else
+      key_name(key, i);
     uint32_t len = 0;
-    int rc = spt_get(g_st, key, buf, (uint32_t)g_valsz + 64, &len);
+    int rc = spt_get(g_st, k, buf, (uint32_t)g_valsz + 64, &len);
     if (rc == 0) {
       atomic_fetch_add_explicit(&g_reads, 1, memory_order_relaxed);
-      if (len > 0 && !parse_payload(buf, len, i)) {
+      if (!g_raw && len > 0 && !parse_payload(buf, len, i)) {
         atomic_fetch_add_explicit(&g_corrupt, 1, memory_order_relaxed);
-        fprintf(stderr, "CORRUPT key=%s len=%u buf=%.80s\n", key, len, buf);
+        fprintf(stderr, "CORRUPT key=%s len=%u buf=%.80s\n", k, len, buf);
       }
     } else if (rc == -11) {
       atomic_fetch_add_explicit(&g_eagain, 1, memory_order_relaxed);
@@ -94,19 +133,31 @@ static void *reader(void *arg) {
     }
   }
   free(buf);
+  free(raw_keys);
   return NULL;
+}
+
+static int int_arg(int argc, char **argv, int *i) {
+  if (*i + 1 >= argc) {
+    fprintf(stderr, "%s needs a value\n", argv[*i]);
+    exit(2);
+  }
+  return atoi(argv[++*i]);
 }
 
 int main(int argc, char **argv) {
   int readers = 7, duration_ms = 5000, slots = 50000;
   uint32_t scrub = 1;
-  for (int i = 1; i < argc - 1; i++) {
-    if (!strcmp(argv[i], "--readers")) readers = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--keys")) g_nkeys = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--duration-ms")) duration_ms = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--slots")) slots = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--val-size")) g_valsz = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--scrub")) scrub = (uint32_t)atoi(argv[++i]);
+  for (int i = 1; i < argc; i++) {
+    if (!strcmp(argv[i], "--readers")) readers = int_arg(argc, argv, &i);
+    else if (!strcmp(argv[i], "--keys")) g_nkeys = int_arg(argc, argv, &i);
+    else if (!strcmp(argv[i], "--duration-ms"))
+      duration_ms = int_arg(argc, argv, &i);
+    else if (!strcmp(argv[i], "--slots")) slots = int_arg(argc, argv, &i);
+    else if (!strcmp(argv[i], "--val-size")) g_valsz = int_arg(argc, argv, &i);
+    else if (!strcmp(argv[i], "--scrub"))
+      scrub = (uint32_t)int_arg(argc, argv, &i);
+    else if (!strcmp(argv[i], "--raw")) g_raw = 1;
   }
   char name[64];
   snprintf(name, sizeof name, "/spt-stress-%d", getpid());

@@ -33,6 +33,7 @@ from __future__ import annotations
 import dataclasses
 import errno
 import logging
+import os
 import time
 from typing import Callable, Iterator
 
@@ -323,14 +324,39 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--temp", type=float, default=0.7)
     ap.add_argument("--top-p", type=float, default=0.9)
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--weights",
+                    help="decoder checkpoint: .safetensors (HF llama "
+                         "naming) or .gguf (llama.cpp naming; geometry "
+                         "and tokenizer come from the GGUF metadata)")
+    ap.add_argument("--n-ctx", type=int, default=None,
+                    help="context window / KV-cache length override "
+                         "(default: the checkpoint's trained window, or "
+                         "2048 for seeded-random weights)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    if os.environ.get("SPTPU_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     store = Store.open(args.store, persistent=args.persistent)
     from ..models import CompletionModel, DecoderConfig
-    model = CompletionModel(DecoderConfig(), top_p=args.top_p,
-                            temp=args.temp)
-    comp = Completer(store, model=model,
+    tokenizer = None
+    if args.weights and args.weights.endswith(".gguf"):
+        from ..models.gguf import decoder_config_from_gguf, load_tokenizer
+        overrides = {"max_len": args.n_ctx} if args.n_ctx else {}
+        cfg = decoder_config_from_gguf(args.weights, **overrides)
+        tokenizer = load_tokenizer(args.weights)
+    else:
+        cfg = DecoderConfig(max_len=args.n_ctx or 2048)
+        if args.weights:
+            log.warning(
+                "--weights %s has no tokenizer metadata; falling back to "
+                "the byte-level tokenizer, which will NOT match a real "
+                "checkpoint's vocabulary — use the model's .gguf export "
+                "for faithful generation", args.weights)
+    model = CompletionModel(cfg, weights=args.weights,
+                            top_p=args.top_p, temp=args.temp)
+    comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
                      template=args.template)
     comp.attach()

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from collections import defaultdict
 from typing import Callable, Sequence
@@ -351,11 +352,38 @@ def main(argv: list[str] | None = None) -> int:
                          "non-zero embedding")
     ap.add_argument("--max-ctx", type=int, default=2048)
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--weights",
+                    help="encoder checkpoint: .safetensors (HF naming) or "
+                         ".gguf (llama.cpp naming; a GGUF's embedded "
+                         "tokenizer is used automatically)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    if os.environ.get("SPTPU_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     store = Store.open(args.store, persistent=args.persistent)
-    emb = Embedder(store, max_ctx=args.max_ctx,
+    model = tokenizer = None
+    if args.weights:
+        from ..models import EmbeddingModel, EncoderConfig
+        if args.weights.endswith(".gguf"):
+            from ..models.gguf import (encoder_config_from_gguf,
+                                       load_tokenizer)
+            cfg = encoder_config_from_gguf(args.weights,
+                                           out_dim=store.vec_dim)
+            tokenizer = load_tokenizer(args.weights)
+        else:
+            cfg = EncoderConfig(out_dim=store.vec_dim,
+                                max_len=args.max_ctx)
+            log.warning(
+                "--weights %s has no tokenizer metadata; falling back to "
+                "the hashed-vocab tokenizer, which will NOT match a real "
+                "checkpoint's vocabulary — use the model's .gguf export, "
+                "or wire a vocab.txt WordPiece tokenizer in code",
+                args.weights)
+        model = EmbeddingModel(cfg, weights=args.weights)
+    emb = Embedder(store, model=model, tokenizer=tokenizer,
+                   max_ctx=args.max_ctx,
                    vector_training=args.vector_training)
     emb.attach()
     if args.backfill_text_keys:

@@ -216,7 +216,11 @@ class CompletionModel:
             # window) must still have a program to land in
             self.buckets = self.buckets + (cfg.max_len,)
         if params is None and weights is not None:
-            params = load_safetensors_params(weights, cfg)
+            if weights.endswith(".gguf"):
+                from .gguf import load_decoder_params
+                params = load_decoder_params(weights, cfg)
+            else:
+                params = load_safetensors_params(weights, cfg)
         if params is None:
             cache = init_cache(cfg, 1)
             params = self.module.init(

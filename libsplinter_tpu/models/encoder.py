@@ -210,7 +210,11 @@ class EmbeddingModel:
         self.module = Encoder(cfg)
         self.buckets = tuple(b for b in buckets if b <= cfg.max_len)
         if params is None and weights is not None:
-            params = load_safetensors_params(weights, cfg)
+            if weights.endswith(".gguf"):
+                from .gguf import load_encoder_params
+                params = load_encoder_params(weights, cfg)
+            else:
+                params = load_safetensors_params(weights, cfg)
         if params is None:
             dummy = (jnp.zeros((1, self.buckets[0]), jnp.int32),
                      jnp.ones((1, self.buckets[0]), jnp.bool_))

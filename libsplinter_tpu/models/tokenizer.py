@@ -57,10 +57,28 @@ def basic_split(text: str, *, lower: bool = True) -> list[str]:
 class WordPieceTokenizer:
     def __init__(self, vocab_path: str | Path, *, lower: bool = True,
                  max_chars_per_word: int = 100):
-        self.vocab: dict[str, int] = {}
+        vocab: dict[str, int] = {}
         with open(vocab_path, encoding="utf-8") as f:
             for i, line in enumerate(f):
-                self.vocab[line.rstrip("\n")] = i
+                vocab[line.rstrip("\n")] = i
+        self._init_from_vocab(vocab, lower=lower,
+                              max_chars_per_word=max_chars_per_word)
+
+    @classmethod
+    def from_vocab_list(cls, tokens: list[str], *, lower: bool = True,
+                        max_chars_per_word: int = 100
+                        ) -> "WordPieceTokenizer":
+        """Construct from an in-memory vocab (e.g. GGUF
+        tokenizer.ggml.tokens) without a vocab.txt on disk."""
+        self = cls.__new__(cls)
+        self._init_from_vocab({t: i for i, t in enumerate(tokens)},
+                              lower=lower,
+                              max_chars_per_word=max_chars_per_word)
+        return self
+
+    def _init_from_vocab(self, vocab: dict[str, int], *, lower: bool,
+                         max_chars_per_word: int) -> None:
+        self.vocab = vocab
         self.lower = lower
         self.max_chars = max_chars_per_word
         self.cls_id = self.vocab[CLS]
@@ -99,6 +117,23 @@ class WordPieceTokenizer:
         if max_len is not None and len(ids) > max_len:
             ids = ids[: max_len - 1] + [self.sep_id]
         return ids
+
+    # streaming interface (so a bert-family tokenizer plugged into the
+    # completion loop degrades to readable text instead of crashing;
+    # SEP doubles as the end-of-generation id)
+    @property
+    def eos_id(self) -> int:
+        return self.sep_id
+
+    def token_to_piece(self, tok: int) -> bytes:
+        if not hasattr(self, "_inv"):
+            self._inv = {i: t for t, i in self.vocab.items()}
+        piece = self._inv.get(tok)
+        if piece is None or piece.startswith("["):
+            return b""                 # specials and unknown ids
+        if piece.startswith("##"):
+            return piece[2:].encode("utf-8")
+        return (" " + piece).encode("utf-8")
 
 
 class HashTokenizer:

@@ -70,7 +70,7 @@ def _scores_kernel(vec_ref, q_ref, qnorm_ref, mask_ref, out_ref, *,
 @functools.partial(jax.jit,
                    static_argnames=("block_n", "interpret", "mxu_bf16"))
 def _cosine_scores_pallas(vectors, queries, mask, *, block_n: int,
-                          interpret: bool, mxu_bf16: bool = True):
+                          interpret: bool, mxu_bf16: bool = False):
     n, d = vectors.shape
     q = queries.shape[0]
     qnorm = jnp.linalg.norm(queries, axis=-1, keepdims=True).T    # (1, Q)

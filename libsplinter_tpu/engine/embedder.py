@@ -350,7 +350,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--vector-training", action="store_true",
                     help="write-once vectors: never overwrite an existing "
                          "non-zero embedding")
-    ap.add_argument("--max-ctx", type=int, default=2048)
+    ap.add_argument("--max-ctx", type=int, default=None,
+                    help="context window override (default: the "
+                         "checkpoint's trained window, or 2048 for "
+                         "seeded-random weights)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
     ap.add_argument("--weights",
                     help="encoder checkpoint: .safetensors (HF naming) or "
@@ -364,26 +367,29 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", "cpu")
     store = Store.open(args.store, persistent=args.persistent)
     model = tokenizer = None
+    max_ctx = args.max_ctx or 2048
     if args.weights:
         from ..models import EmbeddingModel, EncoderConfig
         if args.weights.endswith(".gguf"):
             from ..models.gguf import (encoder_config_from_gguf,
                                        load_tokenizer)
+            overrides = {"max_len": args.max_ctx} if args.max_ctx else {}
             cfg = encoder_config_from_gguf(args.weights,
-                                           out_dim=store.vec_dim)
+                                           out_dim=store.vec_dim,
+                                           **overrides)
             tokenizer = load_tokenizer(args.weights)
         else:
-            cfg = EncoderConfig(out_dim=store.vec_dim,
-                                max_len=args.max_ctx)
+            cfg = EncoderConfig(out_dim=store.vec_dim, max_len=max_ctx)
             log.warning(
                 "--weights %s has no tokenizer metadata; falling back to "
                 "the hashed-vocab tokenizer, which will NOT match a real "
                 "checkpoint's vocabulary — use the model's .gguf export, "
                 "or wire a vocab.txt WordPiece tokenizer in code",
                 args.weights)
+        max_ctx = cfg.max_len       # guards track the model's real window
         model = EmbeddingModel(cfg, weights=args.weights)
     emb = Embedder(store, model=model, tokenizer=tokenizer,
-                   max_ctx=args.max_ctx,
+                   max_ctx=max_ctx,
                    vector_training=args.vector_training)
     emb.attach()
     if args.backfill_text_keys:

@@ -13,7 +13,7 @@ from it has GGUF files on disk.  This module reads them natively:
     decoder (llama family) and the encoder (bert / nomic-bert family);
   - tokenizer construction from the embedded tokenizer.ggml.* metadata
     (WordPiece for bert-family, unigram/SPM via Viterbi for llama
-    family; gpt2-style byte-BPE is rejected loudly for now).
+    family, GPT-2-style byte-level BPE for gpt2/qwen/falcon lineage).
 
 Validated in-tree against synthetic GGUF files written by the test
 suite's writer (tests/test_gguf.py); name parity against upstream
@@ -76,11 +76,23 @@ class GgufFile:
     def __init__(self, path: str | Path):
         self.path = str(path)
         self._f: BinaryIO = open(path, "rb")
-        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            self._mm = mmap.mmap(self._f.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        except (ValueError, OSError) as e:   # empty/odd file
+            self._f.close()
+            raise GgufError(f"{self.path}: cannot map ({e})") from None
         self._pos = 0
         self.metadata: dict[str, Any] = {}
         self.tensors: dict[str, TensorInfo] = {}
-        self._parse()
+        try:
+            self._parse()
+        except (GgufError, struct.error, IndexError) as e:
+            self.close()   # don't leak the fd/mapping on a corrupt file
+            if isinstance(e, GgufError):
+                raise
+            raise GgufError(f"{self.path}: truncated or corrupt "
+                            f"({e})") from None
 
     # -- low-level readers -------------------------------------------------
     def _read(self, fmt: str):
@@ -415,17 +427,23 @@ def load_tokenizer(path_or_gguf) -> Any:
         if model == "bert":
             from .tokenizer import WordPieceTokenizer
             return WordPieceTokenizer.from_vocab_list(tokens)
+        meta = {
+            k.rsplit(".", 1)[-1]: v for k, v in gf.metadata.items()
+            if k.startswith("tokenizer.ggml.") and k.endswith("_token_id")
+        }
         if model == "llama":
             scores = gf.metadata.get("tokenizer.ggml.scores")
-            meta = {
-                k.rsplit(".", 1)[-1]: v for k, v in gf.metadata.items()
-                if k.startswith("tokenizer.ggml.") and
-                k.endswith("_token_id")
-            }
             return UnigramTokenizer(tokens, scores, **meta)
+        if model == "gpt2":
+            merges = gf.metadata.get("tokenizer.ggml.merges")
+            if merges is None:
+                raise GgufError(
+                    f"{gf.path}: gpt2 tokenizer without "
+                    "tokenizer.ggml.merges")
+            return ByteBpeTokenizer(tokens, merges, **meta)
         raise GgufError(
-            f"tokenizer model {model!r} is not supported (bert and llama "
-            "are; gpt2 byte-BPE is not implemented)")
+            f"tokenizer model {model!r} is not supported "
+            "(bert, llama, gpt2 are)")
     finally:
         if own:
             gf.close()
@@ -531,7 +549,9 @@ class UnigramTokenizer:
 
     def decode(self, ids: list[int]) -> str:
         out = b"".join(self.token_to_piece(i) for i in ids)
-        return out.decode("utf-8", errors="replace").lstrip(" ")
+        # strip exactly ONE leading space (the SPM prefix encode added);
+        # deeper indentation in the text itself must survive
+        return out.decode("utf-8", errors="replace").removeprefix(" ")
 
 
 # ======================================================== config derivation
@@ -613,3 +633,95 @@ def encoder_config_from_gguf(path: str, **overrides):
             kw["layer_norm_eps"] = float(eps)
         kw.update(overrides)
         return EncoderConfig(**kw)
+
+
+def _gpt2_byte_map() -> dict[int, str]:
+    """GPT-2's reversible byte <-> unicode table: printable bytes map to
+    themselves, the rest to U+0100+offset, so every byte has a visible
+    single-character stand-in inside vocab/merge strings."""
+    bs = (list(range(ord("!"), ord("~") + 1)) +
+          list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+class ByteBpeTokenizer:
+    """GPT-2-style byte-level BPE (tokenizer.ggml.model == "gpt2":
+    gpt2/qwen/falcon lineage GGUFs).
+
+    Text is mapped byte-for-byte through the reversible GPT-2 byte table,
+    pre-split on the classic contraction/word/number/space pattern, then
+    merged bottom-up by merge-rank — the same procedure as the original
+    encoder.  Decode inverts the byte table exactly.
+    """
+
+    def __init__(self, tokens: list[str], merges: list[str], *,
+                 bos_token_id: int | None = None,
+                 eos_token_id: int | None = None,
+                 unknown_token_id: int = 0,
+                 padding_token_id: int = 0, **_ignored):
+        # eos defaults to None, NOT 0: id 0 is a real token ('!') in
+        # GPT-2-family vocabs, and a wrong eos truncates generation
+        self.tokens = list(tokens)
+        self.index = {t: i for i, t in enumerate(self.tokens)}
+        self.ranks = {}
+        for r, m in enumerate(merges):
+            a, _, b = m.partition(" ")
+            self.ranks[(a, b)] = r
+        self.bos_id = bos_token_id
+        self.eos_id = eos_token_id
+        self.unk_id = unknown_token_id
+        self.pad_id = padding_token_id
+        self._b2u = _gpt2_byte_map()
+        self._u2b = {u: b for b, u in self._b2u.items()}
+        import re
+        self._pre = re.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?\w+| ?[^\s\w]+|\s+(?!\S)|\s+",
+            re.UNICODE)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tokens)
+
+    def _bpe(self, chunk: str) -> list[str]:
+        parts = list(chunk)
+        while len(parts) > 1:
+            best, best_rank = None, None
+            for i in range(len(parts) - 1):
+                r = self.ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best is None:
+                break
+            parts[best:best + 2] = [parts[best] + parts[best + 1]]
+        return parts
+
+    def encode(self, text: str, max_len: int | None = None,
+               *, add_bos: bool = True) -> list[int]:
+        ids: list[int] = []
+        if add_bos and self.bos_id is not None:
+            ids.append(self.bos_id)
+        for chunk in self._pre.findall(text):
+            mapped = "".join(self._b2u[b] for b in chunk.encode("utf-8"))
+            for piece in self._bpe(mapped):
+                ids.append(self.index.get(piece, self.unk_id))
+        if max_len is not None:
+            ids = ids[:max_len]
+        return ids
+
+    def token_to_piece(self, tok: int) -> bytes:
+        if tok == self.eos_id or tok == self.bos_id or \
+                not 0 <= tok < len(self.tokens):
+            return b""
+        return bytes(self._u2b.get(ch, ord("?") & 0xFF)
+                     for ch in self.tokens[tok])
+
+    def decode(self, ids: list[int]) -> str:
+        return b"".join(self.token_to_piece(i) for i in ids).decode(
+            "utf-8", errors="replace")

@@ -129,7 +129,7 @@ class WordPieceTokenizer:
         if not hasattr(self, "_inv"):
             self._inv = {i: t for t, i in self.vocab.items()}
         piece = self._inv.get(tok)
-        if piece is None or piece.startswith("["):
+        if piece is None or piece in (CLS, SEP, PAD, UNK, MASK):
             return b""                 # specials and unknown ids
         if piece.startswith("##"):
             return piece[2:].encode("utf-8")

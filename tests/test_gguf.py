@@ -325,14 +325,6 @@ def test_unigram_byte_fallback():
     assert [tokens[i] for i in ids[1:]] == ["<0xC3>", "<0xA9>"]
 
 
-def test_gpt2_tokenizer_rejected(tmp_path):
-    p = tmp_path / "bpe.gguf"
-    write_gguf(p, {"dummy": (np.zeros((1, 1), np.float32), GGML_F32)},
-               [kv_str("tokenizer.ggml.model", "gpt2"),
-                kv_str_array("tokenizer.ggml.tokens", ["a", "b"])])
-    with pytest.raises(GgufError, match="not supported"):
-        load_tokenizer(str(p))
-
 
 def test_decoder_config_from_metadata(tmp_path):
     from libsplinter_tpu.models.gguf import decoder_config_from_gguf
@@ -404,8 +396,6 @@ def test_completer_from_gguf_end_to_end(tmp_path):
                                 jnp.zeros((1, 8), jnp.int32),
                                 init_cache(cfg0, 1), jnp.int32(0))
     p = tmp_path / "chat.gguf"
-    _decoder_gguf_from_params(p, params, cfg0)
-    # re-write with metadata appended (writer takes metadata blobs)
     pz = jax.tree.map(lambda x: np.asarray(x, np.float32),
                       params["params"])
     t = {"token_embd.weight": (pz["tok_emb"]["embedding"], GGML_F32),
@@ -461,3 +451,42 @@ def test_completer_from_gguf_end_to_end(tmp_path):
     finally:
         st.close()
         Store.unlink(name)
+
+
+def test_byte_bpe_tokenizer():
+    from libsplinter_tpu.models.gguf import ByteBpeTokenizer, _gpt2_byte_map
+    b2u = _gpt2_byte_map()
+    # tiny vocab: single mapped bytes + a few merged pieces
+    base = [b2u[b] for b in range(256)]
+    space = b2u[ord(" ")]
+    vocab = base + [space + "c", "at", space + "cat", "he", "llo",
+                    "hello", space + "hello", "<|endoftext|>"]
+    merges = [f"{space} c", "a t", f"{space}c at", "h e", "l l",
+              "ll o", "he llo", f"{space} hello"]
+    tok = ByteBpeTokenizer(vocab, merges, eos_token_id=len(vocab) - 1)
+    ids = tok.encode("hello cat", add_bos=False)
+    pieces = [vocab[i] for i in ids]
+    assert pieces == ["hello", space + "cat"]
+    assert tok.decode(ids) == "hello cat"
+    # non-ascii round-trips through the byte table
+    ids2 = tok.encode("héllo", add_bos=False)
+    assert tok.decode(ids2) == "héllo"
+    # streaming interface yields raw utf-8 bytes
+    assert tok.token_to_piece(vocab.index("hello")) == b"hello"
+    assert tok.token_to_piece(vocab.index(space + "cat")) == b" cat"
+    assert tok.token_to_piece(len(vocab) - 1) == b""   # EOS
+
+
+def test_byte_bpe_from_gguf(tmp_path):
+    from libsplinter_tpu.models.gguf import _gpt2_byte_map
+    b2u = _gpt2_byte_map()
+    vocab = [b2u[b] for b in range(256)] + ["ab"]
+    p = tmp_path / "bpe.gguf"
+    write_gguf(p, {"dummy": (np.zeros((1, 1), np.float32), GGML_F32)},
+               [kv_str("tokenizer.ggml.model", "gpt2"),
+                kv_str_array("tokenizer.ggml.tokens", vocab),
+                kv_str_array("tokenizer.ggml.merges", ["a b"])])
+    tok = load_tokenizer(str(p))
+    ids = tok.encode("ab", add_bos=False)
+    assert [vocab[i] for i in ids] == ["ab"]
+    assert tok.decode(ids) == "ab"

@@ -53,15 +53,46 @@ def render_prompt(user: str, system: str | None,
                   template: str = "chatml") -> str:
     """Chat-template render with bare fallback
     (splainference.cpp:132-169: llama_chat_apply_template else
-    'system\\n\\nuser' concatenation)."""
+    'system\\n\\nuser' concatenation).  Supported: chatml, llama2,
+    llama3, none."""
     if template == "none" or not template:
         return f"{system}\n\n{user}" if system else user
+    if template == "llama2":
+        sys_block = f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system else ""
+        return f"<s>[INST] {sys_block}{user} [/INST]"
+    if template == "llama3":
+        out = ["<|begin_of_text|>"]
+        if system:
+            out.append("<|start_header_id|>system<|end_header_id|>\n\n"
+                       f"{system}<|eot_id|>")
+        out.append("<|start_header_id|>user<|end_header_id|>\n\n"
+                   f"{user}<|eot_id|>")
+        out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return "".join(out)
+    # chatml (default)
     out = []
     if system:
         out.append(f"<|im_start|>system\n{system}<|im_end|>\n")
     out.append(f"<|im_start|>user\n{user}<|im_end|>\n")
     out.append("<|im_start|>assistant\n")
     return "".join(out)
+
+
+def detect_template(chat_template: str | None) -> str:
+    """Map a checkpoint's embedded Jinja chat template (GGUF metadata
+    tokenizer.chat_template) to the nearest built-in renderer — the
+    analog of llama.cpp's template fingerprinting.  Unknown templates
+    fall back to bare concatenation rather than guessing a wrong
+    special-token dialect."""
+    if not chat_template:
+        return "none"
+    if "<|im_start|>" in chat_template:
+        return "chatml"
+    if "<|start_header_id|>" in chat_template:
+        return "llama3"
+    if "[INST]" in chat_template:
+        return "llama2"
+    return "none"
 
 
 @dataclasses.dataclass
@@ -318,9 +349,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--persistent", action="store_true")
     ap.add_argument("--oneshot", action="store_true")
     ap.add_argument("--max-new-tokens", type=int, default=256)
-    ap.add_argument("--template", default="chatml",
-                    help="chat template ('chatml' or 'none' for bare "
-                         "system\\n\\nprompt concatenation)")
+    ap.add_argument("--template", default="auto",
+                    help="chat template: auto (fingerprint the GGUF's "
+                         "tokenizer.chat_template), chatml, llama2, "
+                         "llama3, or none (bare system\\n\\nprompt)")
     ap.add_argument("--temp", type=float, default=0.7)
     ap.add_argument("--top-p", type=float, default=0.9)
     ap.add_argument("--idle-timeout-ms", type=int, default=100)

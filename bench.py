@@ -12,15 +12,21 @@ Prints ONE JSON line:
 Baseline: BASELINE.md targets >= 100k embeddings/s on a v5e-8 for
 Nomic-Embed-Text-v1.5, i.e. 12,500 embeddings/s/chip; vs_baseline is
 value / 12500 (>1.0 beats the target's per-chip share).
+
+Fail-soft by construction: the measurement runs in a child process
+under a wall-clock watchdog.  The TPU on this host class is behind a
+single-client tunnel — if another process holds the claim, backend
+init blocks indefinitely inside PJRT client creation; the watchdog
+turns that into a JSON error line instead of a hang (the round-1
+failure mode: BENCH_r01.json rc=1, parsed=null).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -29,13 +35,29 @@ BASELINE_PER_CHIP = 12_500.0
 N_TEXTS = int(os.environ.get("BENCH_TEXTS", "4096"))
 BATCH = int(os.environ.get("BENCH_BATCH", "512"))
 BUCKET = int(os.environ.get("BENCH_BUCKET", "64"))
+TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "1200"))
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def emit(value: float, vs: float, detail: dict, error: str | None = None):
+    rec = {
+        "metric": "embeddings_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "embeddings/s",
+        "vs_baseline": round(vs, 4),
+        "detail": detail,
+    }
+    if error:
+        rec["error"] = error
+    print(json.dumps(rec), flush=True)
+
+
 def make_texts(n: int) -> list[str]:
+    import numpy as np
+
     rng = np.random.default_rng(0)
     words = ["tpu", "vector", "store", "seqlock", "arena", "signal",
              "epoch", "shard", "bloom", "label", "kernel", "mesh",
@@ -44,7 +66,10 @@ def make_texts(n: int) -> list[str]:
             for _ in range(n)]
 
 
-def main() -> int:
+def child() -> int:
+    """The actual measurement (runs under the parent's watchdog)."""
+    import numpy as np
+
     import jax
 
     from libsplinter_tpu import Store, T_VARTEXT
@@ -54,7 +79,8 @@ def main() -> int:
                                         default_tokenizer)
 
     n_chips = len(jax.devices())
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    backend = jax.default_backend()
+    log(f"backend={backend} devices={jax.devices()}")
 
     cfg = EncoderConfig(out_dim=768, max_len=2048)
     model = EmbeddingModel(cfg, buckets=(BUCKET,))
@@ -103,21 +129,47 @@ def main() -> int:
     p50 = float(np.percentile(lat, 50))
 
     log(f"embedded={done}/{N_TEXTS} in {dt:.2f}s -> {eps:,.0f} emb/s/chip")
-    log(f"p50 set->vector latency: {p50:.2f} ms "
-        f"(stats: {emb.stats})")
+    log(f"p50 set->vector latency: {p50:.2f} ms (stats: {emb.stats})")
 
     st.close()
     Store.unlink(name)
 
-    print(json.dumps({
-        "metric": "embeddings_per_sec_per_chip",
-        "value": round(eps, 1),
-        "unit": "embeddings/s",
-        "vs_baseline": round(eps / BASELINE_PER_CHIP, 4),
-        "detail": {"n_chips_visible": n_chips, "bucket": BUCKET,
-                   "batch": BATCH, "n_texts": N_TEXTS,
-                   "p50_set_to_vector_ms": round(p50, 2)},
-    }))
+    emit(eps, eps / BASELINE_PER_CHIP, {
+        "backend": backend, "n_chips_visible": n_chips,
+        "bucket": BUCKET, "batch": BATCH, "n_texts": N_TEXTS,
+        "p50_set_to_vector_ms": round(p50, 2)})
+    return 0
+
+
+def main() -> int:
+    if os.environ.get("SPTPU_BENCH_CHILD") == "1":
+        return child()
+
+    # Child stderr inherits the terminal so progress streams live; only
+    # stdout (the JSON line) is captured.
+    env = dict(os.environ, SPTPU_BENCH_CHILD="1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=TIMEOUT_S, stdout=subprocess.PIPE, text=True)
+    except subprocess.TimeoutExpired:
+        emit(0.0, 0.0, {"timeout_s": TIMEOUT_S},
+             error=f"watchdog timeout after {TIMEOUT_S:.0f}s — TPU tunnel "
+                   "likely claimed by another live client (single-client "
+                   "host); progress (if any) is on stderr above")
+        return 0
+
+    line = ""
+    for ln in (proc.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if proc.returncode == 0 and line:
+        print(line, flush=True)
+        return 0
+    emit(0.0, 0.0, {"child_rc": proc.returncode},
+         error=f"bench child failed rc={proc.returncode} "
+               "(traceback on stderr above)")
     return 0
 
 

@@ -310,9 +310,23 @@ def cmd_config(ses, args):
         raise CliError("usage: config [mop N | user N | purge]")
 
 
+def cli_jax():
+    """Import jax for CLI use, pinned to CPU unless SPTPU_CLI_TPU=1.
+
+    On tunneled-PJRT hosts the plugin ignores the JAX_PLATFORMS env var
+    and will claim (or block on) the single-client TPU from any process
+    that touches a device — force the config-level switch it respects
+    before first device access."""
+    if os.environ.get("SPTPU_CLI_TPU") != "1":
+        from ..utils import force_cpu
+        force_cpu()
+    import jax
+    return jax
+
+
 @command("caps", "caps", "print build capabilities")
 def cmd_caps(ses, args):
-    import jax
+    jax = cli_jax()
     print(f"store format   v{N.get_lib() and 1}")
     print(f"key max        {N.KEY_MAX}")
     print(f"signal groups  {N.SIGNAL_GROUPS}")
@@ -490,8 +504,10 @@ def dispatch(ses: Session, argv: list[str]) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     # Default the CLI's jax to CPU: quick commands must not grab (or block
-    # on) the TPU, which a daemon usually holds.  SPTPU_CLI_TPU=1 opts the
-    # search scorer back onto the accelerator.
+    # on) the TPU, which a daemon usually holds.  The real forcing happens
+    # in cli_jax() at first jax use (the env var alone is not enough on
+    # tunneled-PJRT hosts); the env var here covers subprocesses.
+    # SPTPU_CLI_TPU=1 opts the search scorer back onto the accelerator.
     if os.environ.get("SPTPU_CLI_TPU") != "1":
         os.environ["JAX_PLATFORMS"] = "cpu"
     argv = list(sys.argv[1:] if argv is None else argv)

@@ -110,7 +110,8 @@ def cmd_search(ses, args):
     rows = []
     if qvec is not None and keys:
         from ..ops.similarity import (cosine_scores, euclidean_distances)
-        import jax
+        from .main import cli_jax
+        jax = cli_jax()
         use_pallas = (not opts["cpu"]) and jax.default_backend() == "tpu"
         lane = st.vectors
         scores = np.asarray(cosine_scores(lane, qvec, mask,

@@ -1,0 +1,4 @@
+"""Host-side utilities (platform selection, timing helpers)."""
+from .jaxplatform import force_cpu, tpu_available
+
+__all__ = ["force_cpu", "tpu_available"]

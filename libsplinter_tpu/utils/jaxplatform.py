@@ -1,0 +1,61 @@
+"""JAX platform selection helpers.
+
+The TPU on this class of host is reached through a tunneled PJRT plugin
+that (a) admits ONE client process at a time and (b) monkey-patches
+backend lookup so the JAX_PLATFORMS *environment variable* alone does
+not stop it from initializing — a process that merely calls
+jax.devices() can grab (or block on) the chip even with
+JAX_PLATFORMS=cpu in its environment.  The one switch the plugin
+respects is the jax.config value.  Every CPU-by-contract entry point
+(CLI, tests, dry runs) must therefore call force_cpu() BEFORE any
+device access.
+
+Reference analog: the splinter CLI never touches the accelerator at
+all (scoring is scalar C, splinter_cli_cmd_search.c:43-62); here quick
+CLI commands must actively stay off the chip a daemon usually holds.
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(num_devices: int | None = None) -> None:
+    """Pin this process's JAX onto the CPU backend.
+
+    Sets both the environment variable (for any subprocesses) and the
+    jax.config value (the only switch the tunneled PJRT plugin
+    respects).  Safe to call multiple times; a no-op if a backend is
+    already initialized (the caller decided first — use as-is).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if num_devices is not None:
+            jax.config.update("jax_num_cpu_devices", num_devices)
+    except RuntimeError:
+        pass  # backend already up — too late to switch, don't crash
+
+
+def tpu_available(timeout_s: float = 60.0) -> bool:
+    """Probe whether the TPU backend can be claimed, without risking an
+    unbounded hang in this process.
+
+    Spawns a subprocess that initializes the backend and exits; the
+    claim is released on exit.  A wedged tunnel (another live client)
+    makes the probe time out -> False.
+    """
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # parent may have pinned itself to cpu
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.default_backend() != 'cpu'"],
+            env=env, timeout=timeout_s, capture_output=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False

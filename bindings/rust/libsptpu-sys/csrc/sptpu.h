@@ -292,6 +292,19 @@ int spt_vec_commit_batch(spt_store *st, const uint32_t *rows,
                          uint32_t n, uint32_t dim, int write_once,
                          int32_t *results);
 
+/* Bulk epoch snapshot: one acquire load per slot into out (nslots u64).
+ * Returns nslots.  Consecutive snapshots diffed on the host give the
+ * changed-row set — the device-lane cache's dirty detector. */
+int spt_epochs(spt_store *st, uint64_t *out);
+/* Torn-safe gather of vector rows: per row, epoch-before (odd => skip),
+ * memcpy into out[i*dim], epoch-after recheck.  epochs_out[i] = the stable
+ * epoch (0 for a stable never-written slot, whose row is zeros), or
+ * SPT_GATHER_TORN if the row was mid-write / contended / out of range
+ * (caller retries next pass).  Returns the number of stable rows. */
+#define SPT_GATHER_TORN UINT64_MAX
+int spt_vec_gather(spt_store *st, const uint32_t *rows, uint32_t n,
+                   float *out, uint64_t *epochs_out);
+
 /* ---- diagnostics ------------------------------------------------------- */
 int spt_report_parse_failure(spt_store *st);
 

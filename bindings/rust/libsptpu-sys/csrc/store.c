@@ -1034,6 +1034,38 @@ int spt_vec_commit_batch(spt_store *st, const uint32_t *rows,
   return committed;
 }
 
+int spt_epochs(spt_store *st, uint64_t *out) {
+  if (!st || !out) return -EINVAL;
+  uint32_t n = st->h->nslots;
+  for (uint32_t i = 0; i < n; i++)
+    out[i] = atomic_load_explicit(&st->slots[i].epoch, memory_order_acquire);
+  return (int)n;
+}
+
+int spt_vec_gather(spt_store *st, const uint32_t *rows, uint32_t n,
+                   float *out, uint64_t *epochs_out) {
+  if (!st || !rows || !out || !epochs_out) return -EINVAL;
+  if (!st->vectors) return -ENOTSUP;
+  uint32_t dim = st->h->vec_dim;
+  int stable = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t idx = rows[i];
+    epochs_out[i] = SPT_GATHER_TORN;
+    if (idx >= st->h->nslots) continue;
+    spt_slot *s = &st->slots[idx];
+    uint64_t e1 = atomic_load_explicit(&s->epoch, memory_order_acquire);
+    if (e1 & 1) continue;                      /* writer active: torn */
+    memcpy(out + (size_t)i * dim, slot_vec(st, idx),
+           (size_t)dim * sizeof(float));
+    atomic_thread_fence(memory_order_acquire);
+    if (atomic_load_explicit(&s->epoch, memory_order_acquire) != e1)
+      continue;                                /* raced: retry next pass */
+    epochs_out[i] = e1;                        /* 0 = stable empty slot */
+    stable++;
+  }
+  return stable;
+}
+
 /* ------------------------------------------------------------ diagnostics */
 
 int spt_report_parse_failure(spt_store *st) {

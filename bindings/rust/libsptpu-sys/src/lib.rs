@@ -277,6 +277,11 @@ extern "C" {
                                 dim: u32, write_once: c_int,
                                 results: *mut i32) -> c_int;
 
+    pub fn spt_epochs(st: *mut spt_store, out: *mut u64) -> c_int;
+    /* epochs_out[i] == SPT_GATHER_TORN (u64::MAX) => torn row, retry */
+    pub fn spt_vec_gather(st: *mut spt_store, rows: *const u32, n: u32,
+                          out: *mut f32, epochs_out: *mut u64) -> c_int;
+
     // diagnostics
     pub fn spt_report_parse_failure(st: *mut spt_store) -> c_int;
 }

@@ -185,6 +185,9 @@ def _declare(lib: C.CDLL) -> None:
         "spt_vec_commit_batch": (i32, [P, C.POINTER(u32), C.POINTER(u64),
                                        C.c_void_p, u32, u32, i32,
                                        C.POINTER(i32)]),
+        "spt_epochs": (i32, [P, C.POINTER(u64)]),
+        "spt_vec_gather": (i32, [P, C.POINTER(u32), u32, C.c_void_p,
+                                 C.POINTER(u64)]),
         "spt_report_parse_failure": (i32, [P]),
     }
     for name, (res, args) in sigs.items():

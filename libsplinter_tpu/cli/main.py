@@ -73,6 +73,8 @@ class Session:
         self.ns_prefix = os.environ.get("SPTPU_NS_PREFIX", "")
         self.labels = load_labelrc()
         self._store: Store | None = None
+        self._lane = None               # StagedLane, lazy (search caches
+                                        # the device lane across REPL cmds)
 
     @property
     def store(self) -> Store:
@@ -94,7 +96,18 @@ class Session:
             return self.labels[spec]
         return int(spec, 0)
 
+    @property
+    def lane(self):
+        """Device-resident vector lane cache, created on first search and
+        refreshed incrementally (dirty rows only) on later ones — the REPL
+        amortizes the full upload across its lifetime."""
+        if self._lane is None:
+            from ..ops import StagedLane
+            self._lane = StagedLane(self.store)
+        return self._lane
+
     def close(self) -> None:
+        self._lane = None
         if self._store is not None:
             self._store.close()
             self._store = None

@@ -89,53 +89,58 @@ def cmd_search(ses, args):
         print("warning: no embedding daemon answered; listing unscored "
               "candidates", file=sys.stderr)
 
-    # 3. candidates: bloom prefilter + regex on keys
+    # 3. candidate mask: ONE bulk epoch snapshot (or a native bloom
+    # enumeration) — never a per-slot FFI loop.  Keys are fetched lazily
+    # for the ranked head only, so regex/scratch filtering costs
+    # O(results inspected), not O(nslots).
     n = st.nslots
-    mask = np.zeros(n, np.float32)
-    if opts["bloom"]:
-        idxs = st.enumerate_indices(opts["bloom"])
-    else:
-        idxs = [i for i in range(n) if st.epoch_at(i) != 0]
     rx = re.compile(opts["regex"]) if opts["regex"] else None
-    keys: dict[int, str] = {}
-    for i in idxs:
-        k = st.key_at(i)
+    if opts["bloom"]:
+        mask = np.zeros(n, np.float32)
+        mask[st.enumerate_indices(opts["bloom"])] = 1.0
+    else:
+        eps = st.epochs()
+        mask = ((eps != 0) & ((eps & np.uint64(1)) == 0)
+                ).astype(np.float32)
+
+    def key_ok(k: str | None) -> bool:
         if k is None or k.startswith(P.SEARCH_SCRATCH_PREFIX):
-            continue
-        if rx and not rx.search(k):
-            continue
-        keys[i] = k
-        mask[i] = 1.0
+            return False
+        return rx is None or bool(rx.search(k))
 
     rows = []
-    if qvec is not None and keys:
+    if qvec is not None and mask.any():
         from ..ops.similarity import (cosine_scores, euclidean_distances)
         from .main import cli_jax
         jax = cli_jax()
         use_pallas = (not opts["cpu"]) and jax.default_backend() == "tpu"
-        lane = st.vectors
+        # device-resident lane cache: full upload on the session's first
+        # search, O(dirty rows) re-staging afterwards (VERDICT r1 item 2)
+        lane = ses.lane.refresh()
         scores = np.asarray(cosine_scores(lane, qvec, mask,
                                           use_pallas=use_pallas))[:, 0]
         dists = np.asarray(euclidean_distances(lane, qvec, mask))[:, 0]
         order = np.argsort(-scores)
         for i in order:
             i = int(i)
-            if i not in keys:
-                continue
             sim, dist = float(scores[i]), float(dists[i])
             if sim <= -1e29:
-                continue
+                break                         # sorted: only filler left
             if opts["similarity"] is not None and sim < opts["similarity"]:
                 continue
             if opts["distance"] is not None and dist > opts["distance"]:
                 continue
-            rows.append({"key": keys[i], "similarity": round(sim, 6),
+            k = st.key_at(i)
+            if not key_ok(k):
+                continue
+            rows.append({"key": k, "similarity": round(sim, 6),
                          "distance": round(dist, 6)})
             if len(rows) >= opts["limit"]:
                 break
     else:
+        keys = sorted(k for k in st.list() if key_ok(k))
         rows = [{"key": k, "similarity": None, "distance": None}
-                for k in sorted(keys.values())[: opts["limit"]]]
+                for k in keys[: opts["limit"]]]
 
     # 4. cleanup + output
     try:

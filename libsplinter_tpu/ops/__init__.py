@@ -1,5 +1,6 @@
 from .similarity import (cosine_scores, cosine_topk, cosine_topk_batch,
                          euclidean_distances)
+from .staged_lane import StagedLane
 
 __all__ = ["cosine_scores", "cosine_topk", "cosine_topk_batch",
-           "euclidean_distances"]
+           "euclidean_distances", "StagedLane"]

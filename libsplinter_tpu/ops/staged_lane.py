@@ -1,0 +1,151 @@
+"""Device-resident cache of the store's vector lane.
+
+The reference scores candidates by walking every slot's inline embedding
+on the CPU per query (splinter_cli_cmd_search.c:374-412).  Round 1 of
+this framework replaced the math with a fused TPU kernel but still
+re-uploaded the whole (nslots, dim) lane host->HBM on every search — at
+the 1M x 768 target that is ~3 GB of transfer per query.
+
+StagedLane makes the lane resident in HBM:
+
+  - first use uploads the full lane once;
+  - every refresh() takes a bulk epoch snapshot (spt_epochs — one
+    acquire load per slot in C), diffs it against the epochs the rows
+    were staged at, gathers ONLY the changed rows torn-safely
+    (spt_vec_gather), and scatters them into the device array in place
+    (donated buffer, jit'd at a few padded update-size buckets);
+  - searches read the device array directly — zero host->device traffic
+    for an unchanged lane, O(changed rows) otherwise.
+
+Rows mid-write at gather time (odd epoch / seqlock race) simply stay
+dirty and are picked up on the next refresh — same retry discipline as
+every reader of the store (sptpu.h EAGAIN contract).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..store import Store
+
+# Update sizes are padded up to one of these bucket sizes so the scatter
+# jit-compiles a handful of times, not once per distinct dirty count.
+_UPDATE_BUCKETS = (64, 512, 4096, 32768)
+
+
+def _get_jax():
+    import jax
+
+    return jax
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_fn():
+    jax = _get_jax()
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def scatter(arr, rows, vals):
+        return arr.at[rows].set(vals)
+
+    return scatter
+
+
+def _bucket(n: int) -> int:
+    for b in _UPDATE_BUCKETS:
+        if n <= b:
+            return b
+    return -(-n // _UPDATE_BUCKETS[-1]) * _UPDATE_BUCKETS[-1]
+
+
+class StagedLane:
+    """Owns the HBM copy of a store's vector lane.
+
+    Thread-compatible (single consumer); create one per long-lived
+    process (REPL session, search/embedding daemon) and call refresh()
+    before each read of .array — or just use topk(), which does both.
+    """
+
+    def __init__(self, store: Store, *, device=None):
+        if store.vec_dim == 0:
+            raise ValueError("store has no vector lane (vec_dim=0)")
+        self._st = store
+        self._device = device
+        self._arr = None                 # jax.Array (nslots, dim) f32
+        self._staged = None              # np.uint64 epoch per staged row
+        # transfer accounting (tests + perf docs read these)
+        self.full_uploads = 0
+        self.rows_staged = 0             # incremental rows transferred
+        self.refreshes = 0
+
+    # -- staging -----------------------------------------------------------
+
+    def _full_upload(self):
+        jax = _get_jax()
+        st = self._st
+        e1 = st.epochs()
+        lane = np.array(st.vectors, copy=True)
+        e2 = st.epochs()
+        stable = (e1 == e2) & ((e1 & 1) == 0)
+        dev = self._device or jax.devices()[0]
+        self._arr = jax.device_put(lane, dev)
+        # rows that moved mid-copy get an odd sentinel so the next
+        # refresh re-stages them (a stable epoch is always even)
+        self._staged = np.where(stable, e1, np.uint64(1))
+        self.full_uploads += 1
+
+    def refresh(self):
+        """Bring the device lane up to date; returns the jax array."""
+        self.refreshes += 1
+        if self._arr is None:
+            self._full_upload()
+            return self._arr
+        st = self._st
+        e = st.epochs()
+        changed = np.nonzero(e != self._staged)[0]
+        if changed.size:
+            vecs, eps = st.vec_gather(changed)
+            ok = eps != Store.GATHER_TORN
+            rows = changed[ok]
+            if rows.size:
+                n = int(rows.size)
+                b = _bucket(n)
+                # pad with a duplicate of row 0 — scatter-set with an
+                # identical (row, value) pair is idempotent
+                rows_p = np.empty(b, np.int32)
+                rows_p[:n] = rows
+                rows_p[n:] = rows[0]
+                vals_p = np.empty((b, vecs.shape[1]), np.float32)
+                vals_p[:n] = vecs[ok]
+                vals_p[n:] = vecs[ok][0]
+                self._arr = _scatter_fn()(self._arr, rows_p, vals_p)
+                self._staged[rows] = eps[ok]
+                self.rows_staged += n
+            # torn rows: staged epoch untouched -> still dirty next pass
+        return self._arr
+
+    @property
+    def array(self):
+        """The device lane WITHOUT refreshing (last staged state)."""
+        if self._arr is None:
+            self._full_upload()
+        return self._arr
+
+    def invalidate(self) -> None:
+        """Drop the device copy (next use re-uploads in full)."""
+        self._arr = None
+        self._staged = None
+
+    # -- queries -----------------------------------------------------------
+
+    def topk(self, query, k: int, mask=None, **kw):
+        """Refresh + fused cosine top-k over the device lane.
+        Same contract as ops.similarity.cosine_topk."""
+        from .similarity import cosine_topk
+
+        return cosine_topk(self.refresh(), query, k, mask, **kw)
+
+    def scores(self, queries, mask=None, **kw):
+        from .similarity import cosine_scores
+
+        return cosine_scores(self.refresh(), queries, mask, **kw)

@@ -645,6 +645,34 @@ class Store:
                out.ctypes.data_as(C.c_void_p), dim)
         return out
 
+    def epochs(self) -> np.ndarray:
+        """Bulk snapshot of every slot's epoch as a (nslots,) uint64 array.
+        Diff consecutive snapshots to find changed rows (the device-lane
+        cache's dirty detector)."""
+        out = np.empty(self.nslots, dtype=np.uint64)
+        _ck(self._lib.spt_epochs(
+            self._h, out.ctypes.data_as(C.POINTER(C.c_uint64))))
+        return out
+
+    GATHER_TORN = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+    def vec_gather(self, rows: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        """Torn-safe gather of vector rows.  Returns (vecs, epochs):
+        vecs is (len(rows), vec_dim) float32; epochs[i] is the stable
+        epoch of row i (0 = stable never-written slot, zeros row), or
+        GATHER_TORN if that row was mid-write / out of range (its vecs
+        row is undefined — retry it next pass)."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint32)
+        n = rows.size
+        vecs = np.zeros((n, self.vec_dim), dtype=np.float32)
+        eps = np.zeros(n, dtype=np.uint64)
+        _ck(self._lib.spt_vec_gather(
+            self._h, rows.ctypes.data_as(C.POINTER(C.c_uint32)), n,
+            vecs.ctypes.data_as(C.c_void_p),
+            eps.ctypes.data_as(C.POINTER(C.c_uint64))))
+        return vecs, eps
+
     def vec_commit_batch(self, rows: np.ndarray, epochs: np.ndarray,
                          vecs: np.ndarray, *,
                          write_once: bool = False) -> np.ndarray:

@@ -1,0 +1,134 @@
+"""StagedLane: device-resident vector-lane cache with O(dirty) re-staging.
+
+Covers VERDICT r1 item 2: a second search after k dirty writes must
+transfer O(k) rows, not the whole lane (the round-1 CLI re-uploaded the
+full (nslots, dim) matrix per query)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu.ops import StagedLane
+
+
+def _fill(store, n, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    for i in range(n):
+        store.set(f"doc/{i}", f"text {i}")
+        store.vec_set(f"doc/{i}", vecs[i])
+    return vecs
+
+
+class TestNativePrimitives:
+    def test_epochs_snapshot(self, store):
+        e0 = store.epochs()
+        assert e0.shape == (store.nslots,)
+        assert e0.dtype == np.uint64
+        store.set("k", b"v")
+        e1 = store.epochs()
+        idx = store.find_index("k")
+        assert e1[idx] > e0[idx]
+        assert (np.delete(e1, idx) == np.delete(e0, idx)).all()
+
+    def test_vec_gather(self, store):
+        dim = store.vec_dim
+        v = np.arange(dim, dtype=np.float32)
+        store.set("k", b"v")
+        store.vec_set("k", v)
+        idx = store.find_index("k")
+        empty = next(i for i in range(store.nslots)
+                     if store.epoch_at(i) == 0)
+        vecs, eps = store.vec_gather(np.array([idx, empty]))
+        assert eps[0] == store.epoch_at(idx) and eps[0] % 2 == 0
+        np.testing.assert_array_equal(vecs[0], v)
+        # a stable never-written slot reports epoch 0 (NOT the torn
+        # sentinel) and a zeros row
+        assert eps[1] == 0 and eps[1] != store.GATHER_TORN
+        assert (vecs[1] == 0).all()
+
+    def test_vec_gather_out_of_range(self, store):
+        vecs, eps = store.vec_gather(np.array([store.nslots + 5]))
+        assert eps[0] == store.GATHER_TORN
+        assert (vecs[0] == 0).all()
+
+
+class TestStagedLane:
+    def test_full_upload_then_incremental(self, store):
+        dim = store.vec_dim
+        vecs = _fill(store, 20, dim)
+        lane = StagedLane(store)
+        arr = np.asarray(lane.refresh())
+        assert lane.full_uploads == 1 and lane.rows_staged == 0
+        for i in range(20):
+            np.testing.assert_array_equal(
+                arr[store.find_index(f"doc/{i}")], vecs[i])
+
+        # no writes -> zero transfer
+        lane.refresh()
+        assert lane.full_uploads == 1 and lane.rows_staged == 0
+
+        # k dirty writes -> exactly k rows re-staged
+        k = 3
+        new = np.ones((k, dim), np.float32) * 7.5
+        for i in range(k):
+            store.vec_set(f"doc/{i}", new[i])
+        arr = np.asarray(lane.refresh())
+        assert lane.full_uploads == 1
+        assert lane.rows_staged == k
+        for i in range(k):
+            np.testing.assert_array_equal(
+                arr[store.find_index(f"doc/{i}")], new[i])
+        # untouched rows still correct
+        np.testing.assert_array_equal(
+            arr[store.find_index("doc/10")], vecs[10])
+
+    def test_text_write_restages_row(self, store):
+        _fill(store, 4, store.vec_dim)
+        lane = StagedLane(store)
+        lane.refresh()
+        store.set("doc/2", "new text bumps the epoch")
+        np.asarray(lane.refresh())
+        assert lane.rows_staged == 1
+
+    def test_unset_zeroes_staged_row(self, store):
+        _fill(store, 4, store.vec_dim)
+        lane = StagedLane(store)
+        idx = store.find_index("doc/1")
+        lane.refresh()
+        store.unset("doc/1")
+        arr = np.asarray(lane.refresh())
+        assert (arr[idx] == 0).all()
+
+    def test_large_update_bucket_padding(self, store):
+        n = 150  # > first bucket (64), exercises padding with dup rows
+        vecs = _fill(store, n, store.vec_dim)
+        lane = StagedLane(store)
+        lane.refresh()
+        for i in range(n):
+            store.vec_set(f"doc/{i}", vecs[i] + 1.0)
+        arr = np.asarray(lane.refresh())
+        assert lane.rows_staged == n
+        for i in (0, 77, n - 1):
+            np.testing.assert_array_equal(
+                arr[store.find_index(f"doc/{i}")], vecs[i] + 1.0)
+
+    def test_topk_reads_cache(self, store):
+        dim = store.vec_dim
+        _fill(store, 16, dim, seed=3)
+        target = np.zeros(dim, np.float32)
+        target[0] = 1.0
+        store.set("hit", "the needle")
+        store.vec_set("hit", target)
+        lane = StagedLane(store)
+        scores, idxs = lane.topk(target, k=1)
+        assert idxs[0] == store.find_index("hit")
+        assert scores[0] == pytest.approx(1.0, abs=1e-5)
+
+    def test_invalidate_forces_full_upload(self, store):
+        _fill(store, 4, store.vec_dim)
+        lane = StagedLane(store)
+        lane.refresh()
+        lane.invalidate()
+        lane.refresh()
+        assert lane.full_uploads == 2

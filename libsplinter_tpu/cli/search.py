@@ -127,7 +127,7 @@ def cmd_search(ses, args):
             if sim <= -1e29:
                 break                         # sorted: only filler left
             if opts["similarity"] is not None and sim < opts["similarity"]:
-                continue
+                break                         # sorted desc: all below now
             if opts["distance"] is not None and dist > opts["distance"]:
                 continue
             k = st.key_at(i)
@@ -138,7 +138,10 @@ def cmd_search(ses, args):
             if len(rows) >= opts["limit"]:
                 break
     else:
-        keys = sorted(k for k in st.list() if key_ok(k))
+        # degraded path (no embedding answered): list the CANDIDATES —
+        # the mask already encodes the bloom prefilter
+        cand = (st.key_at(int(i)) for i in np.nonzero(mask)[0])
+        keys = sorted(k for k in cand if key_ok(k))
         rows = [{"key": k, "similarity": None, "distance": None}
                 for k in keys[: opts["limit"]]]
 

@@ -49,12 +49,17 @@ GenerateFn = Callable[[str], Iterator[bytes]]
 OOM_MARKER = b"\n[truncated: value buffer full]"
 
 
+TEMPLATES = ("none", "chatml", "llama2", "llama3")
+
+
 def render_prompt(user: str, system: str | None,
                   template: str = "chatml") -> str:
     """Chat-template render with bare fallback
     (splainference.cpp:132-169: llama_chat_apply_template else
     'system\\n\\nuser' concatenation).  Supported: chatml, llama2,
-    llama3, none."""
+    llama3, none.  Unknown names raise — 'auto' must be resolved via
+    detect_template() BEFORE construction, never silently rendered as
+    some default dialect."""
     if template == "none" or not template:
         return f"{system}\n\n{user}" if system else user
     if template == "llama2":
@@ -69,13 +74,16 @@ def render_prompt(user: str, system: str | None,
                    f"{user}<|eot_id|>")
         out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
         return "".join(out)
-    # chatml (default)
-    out = []
-    if system:
-        out.append(f"<|im_start|>system\n{system}<|im_end|>\n")
-    out.append(f"<|im_start|>user\n{user}<|im_end|>\n")
-    out.append("<|im_start|>assistant\n")
-    return "".join(out)
+    if template == "chatml":
+        out = []
+        if system:
+            out.append(f"<|im_start|>system\n{system}<|im_end|>\n")
+        out.append(f"<|im_start|>user\n{user}<|im_end|>\n")
+        out.append("<|im_start|>assistant\n")
+        return "".join(out)
+    raise ValueError(
+        f"unknown chat template {template!r} (supported: "
+        f"{', '.join(TEMPLATES)}; 'auto' resolves via detect_template)")
 
 
 def detect_template(chat_template: str | None) -> str:
@@ -121,6 +129,11 @@ class Completer:
         self.max_new = max_new_tokens
         self.flush_tokens = flush_tokens
         self.rebid_tokens = rebid_tokens
+        if template not in TEMPLATES:
+            raise ValueError(
+                f"unknown chat template {template!r} (supported: "
+                f"{', '.join(TEMPLATES)}; resolve 'auto' with "
+                "detect_template first)")
         self.template = template
         self.group = group
         self.stats = CompleterStats()
@@ -373,11 +386,20 @@ def main(argv: list[str] | None = None) -> int:
     store = Store.open(args.store, persistent=args.persistent)
     from ..models import CompletionModel, DecoderConfig
     tokenizer = None
+    template = args.template
     if args.weights and args.weights.endswith(".gguf"):
-        from ..models.gguf import decoder_config_from_gguf, load_tokenizer
+        from ..models.gguf import (GgufFile, decoder_config_from_gguf,
+                                   load_tokenizer)
         overrides = {"max_len": args.n_ctx} if args.n_ctx else {}
-        cfg = decoder_config_from_gguf(args.weights, **overrides)
-        tokenizer = load_tokenizer(args.weights)
+        with GgufFile(args.weights) as gf:   # parse the container once
+            cfg = decoder_config_from_gguf(gf, **overrides)
+            tokenizer = load_tokenizer(gf)
+            if template == "auto":
+                # fingerprint the checkpoint's embedded Jinja template
+                # (llama.cpp reads the same metadata for its pick)
+                template = detect_template(
+                    gf.metadata.get("tokenizer.chat_template"))
+                log.info("--template auto resolved to %r", template)
     else:
         cfg = DecoderConfig(max_len=args.n_ctx or 2048)
         if args.weights:
@@ -386,11 +408,17 @@ def main(argv: list[str] | None = None) -> int:
                 "the byte-level tokenizer, which will NOT match a real "
                 "checkpoint's vocabulary — use the model's .gguf export "
                 "for faithful generation", args.weights)
+    if template == "auto":
+        # no GGUF metadata to fingerprint: the reference's own fallback
+        # when llama_chat_apply_template has no template is bare
+        # system\n\nprompt concatenation
+        template = "none"
+        log.info("--template auto with no GGUF metadata: using 'none'")
     model = CompletionModel(cfg, weights=args.weights,
                             top_p=args.top_p, temp=args.temp)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
-                     template=args.template)
+                     template=template)
     comp.attach()
     if args.oneshot:
         n = comp.run_once()

@@ -401,13 +401,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.weights:
         from ..models import EmbeddingModel, EncoderConfig
         if args.weights.endswith(".gguf"):
-            from ..models.gguf import (encoder_config_from_gguf,
+            from ..models.gguf import (GgufFile, encoder_config_from_gguf,
                                        load_tokenizer)
             overrides = {"max_len": args.max_ctx} if args.max_ctx else {}
-            cfg = encoder_config_from_gguf(args.weights,
-                                           out_dim=store.vec_dim,
-                                           **overrides)
-            tokenizer = load_tokenizer(args.weights)
+            with GgufFile(args.weights) as gf:  # parse the container once
+                cfg = encoder_config_from_gguf(gf, out_dim=store.vec_dim,
+                                               **overrides)
+                tokenizer = load_tokenizer(gf)
         else:
             cfg = EncoderConfig(out_dim=store.vec_dim, max_len=max_ctx)
             log.warning(

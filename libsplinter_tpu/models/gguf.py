@@ -101,8 +101,19 @@ class GgufFile:
         self._pos += size
         return v[0] if len(v) == 1 else v
 
+    def _bound(self, count: int, what: str, elem_bytes: int = 1) -> int:
+        """Reject attacker-controlled u64 counts that exceed what the
+        remaining mapped bytes could possibly hold — a corrupt file must
+        fail with GgufError before ballooning memory."""
+        remaining = len(self._mm) - self._pos
+        if count < 0 or count * elem_bytes > remaining:
+            raise GgufError(
+                f"{self.path}: {what} count {count} exceeds remaining "
+                f"file size ({remaining} bytes)")
+        return count
+
     def _read_string(self) -> str:
-        n = self._read("<Q")
+        n = self._bound(self._read("<Q"), "string length")
         s = bytes(self._mm[self._pos:self._pos + n])
         self._pos += n
         return s.decode("utf-8", "replace")
@@ -118,10 +129,13 @@ class GgufFile:
             etype = self._read("<I")
             count = self._read("<Q")
             if etype in _SCALAR_FMT:
-                fmt = "<" + str(count) + _SCALAR_FMT[etype][1]
+                fmt1 = _SCALAR_FMT[etype]
+                self._bound(count, "array", struct.calcsize(fmt1))
+                fmt = "<" + str(count) + fmt1[1]
                 vals = struct.unpack_from(fmt, self._mm, self._pos)
                 self._pos += struct.calcsize(fmt)
                 return list(vals)
+            self._bound(count, "array")
             return [self._read_value(etype) for _ in range(count)]
         raise GgufError(f"unknown metadata value type {vtype}")
 
@@ -133,8 +147,8 @@ class GgufFile:
         version = self._read("<I")
         if version not in (2, 3):
             raise GgufError(f"unsupported GGUF version {version}")
-        n_tensors = self._read("<Q")
-        n_kv = self._read("<Q")
+        n_tensors = self._bound(self._read("<Q"), "tensor table", 24)
+        n_kv = self._bound(self._read("<Q"), "metadata KV table", 12)
         for _ in range(n_kv):
             key = self._read_string()
             vtype = self._read("<I")
@@ -333,9 +347,15 @@ def load_encoder_params(path: str, cfg) -> dict:
             raise ValueError(
                 f"GGUF vocab {tok.shape[0]} < cfg.vocab_size "
                 f"{cfg.vocab_size}")
+        tok = tok[:cfg.vocab_size].astype(np.float32)
+        # bert GGUFs ship a token_types table added to every embedding
+        # before token_embd_norm; this pipeline uses type 0 for all
+        # tokens, so fold row 0 straight into the embedding table
+        tt = _take(gf, ["token_types.weight"], required=False)
+        if tt is not None:
+            tok = tok + tt[0].astype(np.float32)[None, :]
         p: dict[str, Any] = {
-            "tok_emb": {"embedding":
-                        tok[:cfg.vocab_size].astype(np.float32)},
+            "tok_emb": {"embedding": tok},
             "ln_emb": {
                 "scale": _take(gf, ["token_embd_norm.weight"])
                 .astype(np.float32),
@@ -406,13 +426,64 @@ def load_encoder_params(path: str, cfg) -> dict:
 
 # ============================================================== tokenizers
 
+# tokenizer.ggml.token_type values (ggml vocabulary classes)
+TOKTYPE_NORMAL, TOKTYPE_UNKNOWN, TOKTYPE_CONTROL = 1, 2, 3
+TOKTYPE_USER_DEFINED, TOKTYPE_UNUSED, TOKTYPE_BYTE = 4, 5, 6
+
+
+class _SpecialTokens:
+    """Atomic matching of control / user-defined tokens inside raw text.
+
+    Chat-template markup rendered as text (<|im_start|>, <|eot_id|>,
+    <s>, ...) must tokenize to its single control-token id, not be
+    byte-BPE'd / SPM-segmented into ordinary pieces — llama.cpp's
+    parse_special behavior.  Built from tokenizer.ggml.token_type;
+    pieces are matched greedily longest-first before the normal
+    pipeline sees the text."""
+
+    def __init__(self, tokens: list[str],
+                 token_types: list[int] | None):
+        import re
+        self.ids: dict[str, int] = {}
+        if token_types:
+            for i, (piece, tt) in enumerate(zip(tokens, token_types)):
+                if tt in (TOKTYPE_CONTROL, TOKTYPE_USER_DEFINED) and piece:
+                    self.ids[piece] = i
+        self.id_set = frozenset(self.ids.values())
+        if self.ids:
+            alts = sorted(self.ids, key=len, reverse=True)
+            self._re = re.compile("|".join(re.escape(a) for a in alts))
+        else:
+            self._re = None
+
+    def split(self, text: str) -> list[tuple[str, int | None]]:
+        """[(fragment, special_id | None), ...] in order."""
+        if self._re is None:
+            return [(text, None)] if text else []
+        out: list[tuple[str, int | None]] = []
+        pos = 0
+        for m in self._re.finditer(text):
+            if m.start() > pos:
+                out.append((text[pos:m.start()], None))
+            out.append((m.group(0), self.ids[m.group(0)]))
+            pos = m.end()
+        if pos < len(text):
+            out.append((text[pos:], None))
+        return out
+
+
 def load_tokenizer(path_or_gguf) -> Any:
     """Build a tokenizer from tokenizer.ggml.* metadata.
 
     - model "bert"  -> WordPieceTokenizer over the embedded vocab;
     - model "llama" -> SentencePiece-style unigram (Viterbi over the
       embedded scores, byte fallback);
-    - model "gpt2"  -> rejected loudly (byte-level BPE not implemented).
+    - model "gpt2"  -> GPT-2-style byte-level BPE over the embedded
+      vocab + merges (qwen/falcon/gpt2 lineage).
+
+    Control / user-defined tokens (tokenizer.ggml.token_type) are parsed
+    atomically by the unigram and BPE tokenizers (llama.cpp's
+    parse_special), so chat-template markup survives round trips.
     """
     gf = (path_or_gguf if isinstance(path_or_gguf, GgufFile)
           else GgufFile(path_or_gguf))
@@ -431,6 +502,7 @@ def load_tokenizer(path_or_gguf) -> Any:
             k.rsplit(".", 1)[-1]: v for k, v in gf.metadata.items()
             if k.startswith("tokenizer.ggml.") and k.endswith("_token_id")
         }
+        meta["token_types"] = gf.metadata.get("tokenizer.ggml.token_type")
         if model == "llama":
             scores = gf.metadata.get("tokenizer.ggml.scores")
             return UnigramTokenizer(tokens, scores, **meta)
@@ -464,7 +536,7 @@ class UnigramTokenizer:
     def __init__(self, tokens: list[str], scores: list[float] | None,
                  *, bos_token_id: int = 1, eos_token_id: int = 2,
                  unknown_token_id: int = 0, padding_token_id: int = 0,
-                 **_ignored):
+                 token_types: list[int] | None = None, **_ignored):
         self.tokens = list(tokens)
         self.scores = (list(scores) if scores is not None
                        else [0.0] * len(tokens))
@@ -478,6 +550,7 @@ class UnigramTokenizer:
             bytes([b]): self.index[f"<0x{b:02X}>"]
             for b in range(256) if f"<0x{b:02X}>" in self.index
         }
+        self.special = _SpecialTokens(self.tokens, token_types)
 
     @property
     def vocab_size(self) -> int:
@@ -523,10 +596,18 @@ class UnigramTokenizer:
 
     def encode(self, text: str, max_len: int | None = None,
                *, add_bos: bool = True) -> list[int]:
-        norm = self.SPACE + text.replace(" ", self.SPACE)
-        ids = self._viterbi(norm)
-        if add_bos:
-            ids = [self.bos_id] + ids
+        ids: list[int] = [self.bos_id] if add_bos else []
+        first = True
+        for frag, special in self.special.split(text):
+            if special is not None:
+                ids.append(special)
+            else:
+                norm = frag.replace(" ", self.SPACE)
+                if first:
+                    # SPM space prefix applies once, at the text start
+                    norm = self.SPACE + norm
+                ids.extend(self._viterbi(norm))
+            first = False
         if max_len is not None:
             ids = ids[:max_len]
         return ids
@@ -536,6 +617,7 @@ class UnigramTokenizer:
         byte-fallback pieces yield their byte, specials yield b'',
         ordinary pieces yield utf-8 text with U+2581 as space."""
         if tok in (self.bos_id, self.eos_id, self.pad_id) or \
+                tok in self.special.id_set or \
                 not 0 <= tok < len(self.tokens):
             return b""
         piece = self.tokens[tok]
@@ -556,13 +638,35 @@ class UnigramTokenizer:
 
 # ======================================================== config derivation
 
-def decoder_config_from_gguf(path: str, **overrides):
+def _as_gguf(path_or_gguf):
+    """(GgufFile, owns_it) — lets daemon startup parse the file once and
+    share it across config/tokenizer/metadata reads."""
+    if isinstance(path_or_gguf, GgufFile):
+        return path_or_gguf, False
+    return GgufFile(path_or_gguf), True
+
+
+class _MaybeClose:
+    def __init__(self, gf, own):
+        self.gf, self.own = gf, own
+
+    def __enter__(self):
+        return self.gf
+
+    def __exit__(self, *exc):
+        if self.own:
+            self.gf.close()
+
+
+def decoder_config_from_gguf(path_or_gguf, **overrides):
     """Derive a DecoderConfig from GGUF metadata (llama.* keys).  The
     architecture prefix is read from general.architecture so mistral/qwen
-    exports (same llama graph, different prefix) work too."""
+    exports (same llama graph, different prefix) work too.  Accepts a
+    path or an already-open GgufFile."""
     from .decoder import DecoderConfig
 
-    with GgufFile(path) as gf:
+    with _MaybeClose(*_as_gguf(path_or_gguf)) as gf:
+        path = gf.path
         md = gf.metadata
         arch = md.get("general.architecture", "llama")
 
@@ -597,12 +701,13 @@ def decoder_config_from_gguf(path: str, **overrides):
         return DecoderConfig(**kw)
 
 
-def encoder_config_from_gguf(path: str, **overrides):
+def encoder_config_from_gguf(path_or_gguf, **overrides):
     """Derive an EncoderConfig from GGUF metadata (bert/nomic-bert
-    arch keys)."""
+    arch keys).  Accepts a path or an already-open GgufFile."""
     from .encoder import EncoderConfig
 
-    with GgufFile(path) as gf:
+    with _MaybeClose(*_as_gguf(path_or_gguf)) as gf:
+        path = gf.path
         md = gf.metadata
         arch = md.get("general.architecture", "nomic-bert")
 
@@ -665,7 +770,8 @@ class ByteBpeTokenizer:
                  bos_token_id: int | None = None,
                  eos_token_id: int | None = None,
                  unknown_token_id: int = 0,
-                 padding_token_id: int = 0, **_ignored):
+                 padding_token_id: int = 0,
+                 token_types: list[int] | None = None, **_ignored):
         # eos defaults to None, NOT 0: id 0 is a real token ('!') in
         # GPT-2-family vocabs, and a wrong eos truncates generation
         self.tokens = list(tokens)
@@ -684,6 +790,7 @@ class ByteBpeTokenizer:
         self._pre = re.compile(
             r"'s|'t|'re|'ve|'m|'ll|'d| ?\w+| ?[^\s\w]+|\s+(?!\S)|\s+",
             re.UNICODE)
+        self.special = _SpecialTokens(self.tokens, token_types)
 
     @property
     def vocab_size(self) -> int:
@@ -707,16 +814,22 @@ class ByteBpeTokenizer:
         ids: list[int] = []
         if add_bos and self.bos_id is not None:
             ids.append(self.bos_id)
-        for chunk in self._pre.findall(text):
-            mapped = "".join(self._b2u[b] for b in chunk.encode("utf-8"))
-            for piece in self._bpe(mapped):
-                ids.append(self.index.get(piece, self.unk_id))
+        for frag, special in self.special.split(text):
+            if special is not None:
+                ids.append(special)
+                continue
+            for chunk in self._pre.findall(frag):
+                mapped = "".join(self._b2u[b]
+                                 for b in chunk.encode("utf-8"))
+                for piece in self._bpe(mapped):
+                    ids.append(self.index.get(piece, self.unk_id))
         if max_len is not None:
             ids = ids[:max_len]
         return ids
 
     def token_to_piece(self, tok: int) -> bytes:
         if tok == self.eos_id or tok == self.bos_id or \
+                tok in self.special.id_set or \
                 not 0 <= tok < len(self.tokens):
             return b""
         return bytes(self._u2b.get(ch, ord("?") & 0xFF)

@@ -155,3 +155,96 @@ def test_real_decoder_end_to_end(store):
     out = store.get("q")
     assert out.startswith(b"ab")
     assert c.stats.tokens > 0 or out == b"ab"   # eos-first is legal
+
+
+# --------------------------------------- ADVICE r1: template resolution
+
+def test_render_prompt_unknown_template_raises():
+    with pytest.raises(ValueError, match="unknown chat template"):
+        render_prompt("u", None, "auto")
+    with pytest.raises(ValueError, match="unknown chat template"):
+        render_prompt("u", None, "alpaca")
+
+
+def test_completer_rejects_unresolved_auto(store):
+    with pytest.raises(ValueError, match="unknown chat template"):
+        Completer(store, generate_fn=fake_generate, template="auto")
+
+
+def test_detect_template_fingerprints():
+    from libsplinter_tpu.engine.completer import detect_template
+    assert detect_template("{%...<|im_start|>...%}") == "chatml"
+    assert detect_template("...<|start_header_id|>...") == "llama3"
+    assert detect_template("...[INST]...") == "llama2"
+    assert detect_template("{{ weird custom }}") == "none"
+    assert detect_template(None) == "none"
+
+
+def test_main_auto_resolves_from_gguf_metadata(tmp_path, store):
+    """--template auto must fingerprint tokenizer.chat_template from the
+    GGUF (the round-1 bug: auto fell through to chatml for every model)."""
+    import jax as _jax
+    import numpy as _np
+
+    from libsplinter_tpu.models.decoder import (Decoder, DecoderConfig,
+                                                init_cache)
+    from tests.test_gguf import (_decoder_gguf_from_params, kv_f32_array,
+                                 kv_str, kv_str_array, kv_u32, write_gguf)
+
+    cfg = DecoderConfig.tiny(vocab_size=300)
+    params = Decoder(cfg).init(_jax.random.PRNGKey(0),
+                               _np.zeros((1, 4), _np.int32),
+                               init_cache(cfg, 1), _np.int32(0))
+    path = tmp_path / "auto.gguf"
+    _decoder_gguf_from_params(path, params, cfg)
+
+    # re-write with chat-template metadata attached
+    import tests.test_gguf as tg
+    p = _jax.tree.map(lambda x: _np.asarray(x, _np.float32),
+                      params["params"])
+    t = {"token_embd.weight": (p["tok_emb"]["embedding"], tg.GGML_F32),
+         "output_norm.weight": (p["ln_out"]["scale"], tg.GGML_F32),
+         "output.weight": (p["lm_head"]["kernel"].T.copy(), tg.GGML_F32)}
+    for i in range(cfg.layers):
+        lp = p[f"layer_{i}"]
+        b = f"blk.{i}"
+        t[f"{b}.attn_norm.weight"] = (lp["ln_attn"]["scale"], tg.GGML_F32)
+        t[f"{b}.ffn_norm.weight"] = (lp["ln_mlp"]["scale"], tg.GGML_F32)
+        for src, dst in (("q", "attn_q"), ("k", "attn_k"),
+                         ("v", "attn_v"), ("out", "attn_output")):
+            t[f"{b}.{dst}.weight"] = (
+                lp["attn"][src]["kernel"].T.copy(), tg.GGML_F32)
+        for name in ("gate", "up", "down"):
+            t[f"{b}.ffn_{name}.weight"] = (lp[name]["kernel"].T.copy(),
+                                           tg.GGML_F32)
+    tokens = [f"tok{i}" for i in range(300)]
+    meta = [kv_str("general.architecture", "llama"),
+            kv_u32("llama.block_count", cfg.layers),
+            kv_u32("llama.embedding_length", cfg.hidden),
+            kv_u32("llama.attention.head_count", cfg.heads),
+            kv_u32("llama.attention.head_count_kv", cfg.kv_heads),
+            kv_u32("llama.feed_forward_length", cfg.mlp_dim),
+            kv_u32("llama.context_length", cfg.max_len),
+            kv_str("tokenizer.ggml.model", "llama"),
+            kv_str_array("tokenizer.ggml.tokens", tokens),
+            kv_f32_array("tokenizer.ggml.scores", [0.0] * 300),
+            kv_str("tokenizer.chat_template",
+                   "{% ... <|start_header_id|> ... %}")]
+    write_gguf(path, t, meta)
+
+    import libsplinter_tpu.engine.completer as completer_mod
+    captured = {}
+    real_completer = completer_mod.Completer
+
+    class Capture(real_completer):
+        def __init__(self, *a, **kw):
+            captured["template"] = kw.get("template")
+            super().__init__(*a, **kw)
+
+    completer_mod.Completer = Capture
+    try:
+        completer_mod.main(["--store", store.name, "--oneshot",
+                            "--weights", str(path)])
+    finally:
+        completer_mod.Completer = real_completer
+    assert captured["template"] == "llama3"

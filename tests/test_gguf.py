@@ -490,3 +490,130 @@ def test_byte_bpe_from_gguf(tmp_path):
     ids = tok.encode("ab", add_bos=False)
     assert [vocab[i] for i in ids] == ["ab"]
     assert tok.decode(ids) == "ab"
+
+
+# ------------------------------------------- ADVICE r1: special tokens etc.
+
+def kv_i32_array(key, items):
+    body = struct.pack("<IQ", _T_I32, len(items))
+    body += struct.pack(f"<{len(items)}i", *items)
+    return _kv(key, _T_ARRAY, body)
+
+
+def test_unigram_special_tokens_parse_atomically():
+    from libsplinter_tpu.models.gguf import (TOKTYPE_CONTROL,
+                                             TOKTYPE_NORMAL)
+    tokens = ["<unk>", "<s>", "</s>", "<|im_start|>", "<|im_end|>",
+              "user", "▁hello", "▁user"]
+    types = [TOKTYPE_NORMAL, TOKTYPE_CONTROL, TOKTYPE_CONTROL,
+             TOKTYPE_CONTROL, TOKTYPE_CONTROL, TOKTYPE_NORMAL,
+             TOKTYPE_NORMAL, TOKTYPE_NORMAL]
+    tok = UnigramTokenizer(tokens, None, bos_token_id=1, eos_token_id=2,
+                           unknown_token_id=0, token_types=types)
+    ids = tok.encode("<|im_start|>user", add_bos=False)
+    assert [tokens[i] for i in ids] == ["<|im_start|>", "user"]
+    # without types the marker would shatter into unk/byte pieces
+    tok_naive = UnigramTokenizer(tokens, None, bos_token_id=1,
+                                 eos_token_id=2, unknown_token_id=0)
+    assert tok_naive.encode("<|im_start|>user", add_bos=False) != ids
+    # control tokens never leak into streamed text
+    assert tok.token_to_piece(3) == b""
+    # SPM space prefix still applies to leading ordinary text
+    assert tok.encode("hello", add_bos=False) == [tokens.index("▁hello")]
+
+
+def test_byte_bpe_special_tokens_parse_atomically(tmp_path):
+    from libsplinter_tpu.models.gguf import (TOKTYPE_CONTROL,
+                                             TOKTYPE_NORMAL, _gpt2_byte_map)
+    b2u = _gpt2_byte_map()
+    vocab = [b2u[b] for b in range(256)] + ["ab", "<|im_start|>"]
+    types = [TOKTYPE_NORMAL] * 257 + [TOKTYPE_CONTROL]
+    p = tmp_path / "bpe_special.gguf"
+    write_gguf(p, {"dummy": (np.zeros((1, 1), np.float32), GGML_F32)},
+               [kv_str("tokenizer.ggml.model", "gpt2"),
+                kv_str_array("tokenizer.ggml.tokens", vocab),
+                kv_str_array("tokenizer.ggml.merges", ["a b"]),
+                kv_i32_array("tokenizer.ggml.token_type", types)])
+    tok = load_tokenizer(str(p))
+    ids = tok.encode("<|im_start|>ab", add_bos=False)
+    assert [vocab[i] for i in ids] == ["<|im_start|>", "ab"]
+    assert tok.decode(ids) == "ab"            # control piece not streamed
+    # the marker must NOT be byte-BPE'd into <, |, im, ... fragments
+    assert len(ids) == 2
+
+
+def test_encoder_token_types_folded_into_embeddings(tmp_path):
+    """bert GGUFs add token_types row 0 to every embedding before
+    token_embd_norm (ADVICE r1); the loader folds it into tok_emb."""
+    from libsplinter_tpu.models.encoder import Encoder, EncoderConfig
+    from libsplinter_tpu.models.gguf import load_encoder_params
+    cfg = EncoderConfig.tiny(variant="bert", dtype=jnp.float32)
+    params = Encoder(cfg).init(jax.random.PRNGKey(4),
+                               np.ones((1, 8), np.int32),
+                               np.ones((1, 8), bool))
+    p = jax.tree.map(lambda x: np.asarray(x, np.float32),
+                     params["params"])
+    ttypes = np.stack([np.full(cfg.hidden, 0.25, np.float32),
+                       np.zeros(cfg.hidden, np.float32)])
+    t = {"token_embd.weight": (p["tok_emb"]["embedding"], GGML_F32),
+         "token_types.weight": (ttypes, GGML_F32),
+         "position_embd.weight": (p["pos_emb"]["embedding"], GGML_F32),
+         "token_embd_norm.weight": (p["ln_emb"]["scale"], GGML_F32),
+         "token_embd_norm.bias": (p["ln_emb"]["bias"], GGML_F32)}
+    for i in range(cfg.layers):
+        lp = p[f"layer_{i}"]
+        b = f"blk.{i}"
+        t[f"{b}.attn_qkv.weight"] = (lp["attn"]["qkv"]["kernel"].T.copy(),
+                                     GGML_F32)
+        t[f"{b}.attn_qkv.bias"] = (lp["attn"]["qkv"]["bias"], GGML_F32)
+        t[f"{b}.attn_output.weight"] = (
+            lp["attn"]["out"]["kernel"].T.copy(), GGML_F32)
+        t[f"{b}.attn_output.bias"] = (lp["attn"]["out"]["bias"], GGML_F32)
+        t[f"{b}.attn_output_norm.weight"] = (lp["ln_attn"]["scale"],
+                                             GGML_F32)
+        t[f"{b}.attn_output_norm.bias"] = (lp["ln_attn"]["bias"],
+                                           GGML_F32)
+        t[f"{b}.layer_output_norm.weight"] = (lp["ln_mlp"]["scale"],
+                                              GGML_F32)
+        t[f"{b}.layer_output_norm.bias"] = (lp["ln_mlp"]["bias"],
+                                            GGML_F32)
+        for name in ("up", "down"):
+            t[f"{b}.ffn_{name}.weight"] = (
+                lp["mlp"][name]["kernel"].T.copy(), GGML_F32)
+            t[f"{b}.ffn_{name}.bias"] = (lp["mlp"][name]["bias"],
+                                         GGML_F32)
+    path = tmp_path / "enc_tt.gguf"
+    write_gguf(path, t)
+    loaded = load_encoder_params(str(path), cfg)
+    got = np.asarray(loaded["params"]["tok_emb"]["embedding"])
+    want = p["tok_emb"]["embedding"] + 0.25
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_metadata_huge_array_count_fails_fast(tmp_path):
+    """A corrupt u64 array count must raise GgufError before any
+    allocation proportional to the claimed count (ADVICE r1)."""
+    p = tmp_path / "evil.gguf"
+    body = struct.pack("<IIQQ", 0x46554747, 3, 0, 1)     # 0 tensors, 1 kv
+    body += _s("evil.key") + struct.pack("<I", _T_ARRAY)
+    body += struct.pack("<IQ", _T_STRING, 1 << 60)       # absurd count
+    p.write_bytes(body)
+    with pytest.raises(GgufError, match="exceeds remaining"):
+        GgufFile(p)
+
+
+def test_metadata_huge_string_length_fails_fast(tmp_path):
+    p = tmp_path / "evil2.gguf"
+    body = struct.pack("<IIQQ", 0x46554747, 3, 0, 1)
+    body += struct.pack("<Q", 1 << 62)                   # huge key length
+    p.write_bytes(body)
+    with pytest.raises(GgufError, match="exceeds remaining"):
+        GgufFile(p)
+
+
+def test_metadata_huge_kv_count_fails_fast(tmp_path):
+    p = tmp_path / "evil3.gguf"
+    body = struct.pack("<IIQQ", 0x46554747, 3, 0, 1 << 58)
+    p.write_bytes(body)
+    with pytest.raises(GgufError, match="exceeds remaining"):
+        GgufFile(p)

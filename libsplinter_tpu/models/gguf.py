@@ -5,9 +5,13 @@ The reference's entire model-loading story is GGUF via llama.cpp
 from it has GGUF files on disk.  This module reads them natively:
 
   - full GGUF v2/v3 container parsing (metadata KV store + tensor index),
-    memory-mapped so tensor bytes are touched lazily;
-  - dequantization of the common ggml dtypes to float32: F32, F16, BF16,
-    Q8_0 (f16 scale + 32xi8 blocks), Q4_0, Q4_1;
+    memory-mapped so tensor bytes are touched lazily, with every u64
+    count bounded against the mapped size (corrupt files fail fast);
+  - dequantization of the ggml dtypes to float32: F32, F16, BF16,
+    Q4_0/Q4_1/Q5_0/Q5_1/Q8_0 and the K-quant super-blocks
+    Q2_K/Q3_K/Q4_K/Q5_K/Q6_K/Q8_K (the dominant published
+    quantizations), each validated against an independent scalar
+    reference in tests/test_kquants.py;
   - tensor-name mapping from llama.cpp conventions (token_embd, blk.N.*,
     output_norm, ...) onto this framework's flax trees for both the
     decoder (llama family) and the encoder (bert / nomic-bert family);
@@ -46,13 +50,21 @@ _SCALAR_FMT = {
 # ggml tensor dtypes (ids from ggml)
 GGML_F32, GGML_F16 = 0, 1
 GGML_Q4_0, GGML_Q4_1 = 2, 3
+GGML_Q5_0, GGML_Q5_1 = 6, 7
 GGML_Q8_0 = 8
+GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K, GGML_Q8_K = (
+    10, 11, 12, 13, 14, 15)
 GGML_I8, GGML_I16, GGML_I32 = 24, 25, 26
 GGML_BF16 = 30
+
+QK_K = 256  # K-quant super-block length
 
 _TYPE_NAMES = {
     GGML_F32: "F32", GGML_F16: "F16", GGML_BF16: "BF16",
     GGML_Q8_0: "Q8_0", GGML_Q4_0: "Q4_0", GGML_Q4_1: "Q4_1",
+    GGML_Q5_0: "Q5_0", GGML_Q5_1: "Q5_1",
+    GGML_Q2_K: "Q2_K", GGML_Q3_K: "Q3_K", GGML_Q4_K: "Q4_K",
+    GGML_Q5_K: "Q5_K", GGML_Q6_K: "Q6_K", GGML_Q8_K: "Q8_K",
     GGML_I8: "I8", GGML_I16: "I16", GGML_I32: "I32",
 }
 
@@ -203,6 +215,22 @@ class GgufFile:
             flat = _dequant_q4_0(raw, start, n_elems)
         elif t == GGML_Q4_1:
             flat = _dequant_q4_1(raw, start, n_elems)
+        elif t == GGML_Q5_0:
+            flat = _dequant_q5_0(raw, start, n_elems)
+        elif t == GGML_Q5_1:
+            flat = _dequant_q5_1(raw, start, n_elems)
+        elif t == GGML_Q2_K:
+            flat = _dequant_q2_k(raw, start, n_elems)
+        elif t == GGML_Q3_K:
+            flat = _dequant_q3_k(raw, start, n_elems)
+        elif t == GGML_Q4_K:
+            flat = _dequant_q4_k(raw, start, n_elems)
+        elif t == GGML_Q5_K:
+            flat = _dequant_q5_k(raw, start, n_elems)
+        elif t == GGML_Q6_K:
+            flat = _dequant_q6_k(raw, start, n_elems)
+        elif t == GGML_Q8_K:
+            flat = _dequant_q8_k(raw, start, n_elems)
         elif t == GGML_I8:
             flat = np.frombuffer(raw, np.int8, n_elems, start).copy()
         elif t == GGML_I16:
@@ -255,6 +283,200 @@ def _dequant_q4_1(buf, start: int, n: int) -> np.ndarray:
     q = np.concatenate([lo, hi], axis=1)
     return (blocks["d"].astype(np.float32)[:, None] * q +
             blocks["m"].astype(np.float32)[:, None]).reshape(-1)
+
+
+def _dequant_q5_0(buf, start: int, n: int) -> np.ndarray:
+    """Q5_0: blocks of 32 = [f16 scale][4B high-bit mask][16B nibbles],
+    value = ((nibble | hi<<4) - 16) * scale; high bit j of the u32 mask
+    belongs to element j (low nibbles 0..15, high nibbles 16..31)."""
+    nblocks = n // 32
+    if n % 32:
+        raise GgufError("Q5_0 tensor size not a multiple of 32")
+    rec = np.dtype([("d", "<f2"), ("qh", "<u4"), ("qs", "u1", (16,))])
+    B = np.frombuffer(buf, rec, nblocks, start)
+    qh = B["qh"][:, None].astype(np.uint32)
+    j = np.arange(16, dtype=np.uint32)
+    lo = (B["qs"] & 0x0F) | (((qh >> j) & 1) << 4).astype(np.uint8)
+    hi = (B["qs"] >> 4) | (((qh >> (j + 16)) & 1) << 4).astype(np.uint8)
+    q = np.concatenate([lo, hi], axis=1).astype(np.float32) - 16.0
+    return (B["d"].astype(np.float32)[:, None] * q).reshape(-1)
+
+
+def _dequant_q5_1(buf, start: int, n: int) -> np.ndarray:
+    """Q5_1: blocks of 32 = [f16 scale][f16 min][4B mask][16B nibbles],
+    value = 5-bit * scale + min."""
+    nblocks = n // 32
+    if n % 32:
+        raise GgufError("Q5_1 tensor size not a multiple of 32")
+    rec = np.dtype([("d", "<f2"), ("m", "<f2"), ("qh", "<u4"),
+                    ("qs", "u1", (16,))])
+    B = np.frombuffer(buf, rec, nblocks, start)
+    qh = B["qh"][:, None].astype(np.uint32)
+    j = np.arange(16, dtype=np.uint32)
+    lo = (B["qs"] & 0x0F) | (((qh >> j) & 1) << 4).astype(np.uint8)
+    hi = (B["qs"] >> 4) | (((qh >> (j + 16)) & 1) << 4).astype(np.uint8)
+    q = np.concatenate([lo, hi], axis=1).astype(np.float32)
+    return (B["d"].astype(np.float32)[:, None] * q +
+            B["m"].astype(np.float32)[:, None]).reshape(-1)
+
+
+def _kq_blocks(buf, start: int, n: int, rec: np.dtype, name: str):
+    if n % QK_K:
+        raise GgufError(f"{name} tensor size not a multiple of {QK_K}")
+    return np.frombuffer(buf, rec, n // QK_K, start)
+
+
+def _scale_min_k4(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack the Q4_K/Q5_K 12-byte scale table into 8 six-bit
+    (scale, min) pairs per super-block (ggml get_scale_min_k4)."""
+    q = scales.astype(np.uint8)
+    nb = q.shape[0]
+    sc = np.empty((nb, 8), np.float32)
+    mn = np.empty((nb, 8), np.float32)
+    for j in range(4):
+        sc[:, j] = q[:, j] & 63
+        mn[:, j] = q[:, j + 4] & 63
+    for j in range(4, 8):
+        sc[:, j] = (q[:, j + 4] & 0x0F) | ((q[:, j - 4] >> 6) << 4)
+        mn[:, j] = (q[:, j + 4] >> 4) | ((q[:, j] >> 6) << 4)
+    return sc, mn
+
+
+def _dequant_q4_k(buf, start: int, n: int) -> np.ndarray:
+    """Q4_K: 256-elem super-blocks = [f16 d][f16 dmin][12B packed 6-bit
+    scales/mins x8][128B nibbles]; value = d*sc*nibble - dmin*mn per
+    32-elem sub-block."""
+    rec = np.dtype([("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+                    ("qs", "u1", (128,))])
+    B = _kq_blocks(buf, start, n, rec, "Q4_K")
+    nb = len(B)
+    d = B["d"].astype(np.float32)[:, None, None]
+    dmin = B["dmin"].astype(np.float32)[:, None, None]
+    sc, mn = _scale_min_k4(B["scales"])
+    qs = B["qs"].reshape(nb, 4, 32)
+    y = np.empty((nb, 4, 64), np.float32)
+    y[:, :, :32] = (d * sc.reshape(nb, 4, 2)[:, :, 0:1] *
+                    (qs & 0x0F).astype(np.float32) -
+                    dmin * mn.reshape(nb, 4, 2)[:, :, 0:1])
+    y[:, :, 32:] = (d * sc.reshape(nb, 4, 2)[:, :, 1:2] *
+                    (qs >> 4).astype(np.float32) -
+                    dmin * mn.reshape(nb, 4, 2)[:, :, 1:2])
+    return y.reshape(-1)
+
+
+def _dequant_q5_k(buf, start: int, n: int) -> np.ndarray:
+    """Q5_K: Q4_K layout + a 32B high-bit plane; value =
+    d*sc*(nibble + 16*hi) - dmin*mn."""
+    rec = np.dtype([("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+                    ("qh", "u1", (32,)), ("qs", "u1", (128,))])
+    B = _kq_blocks(buf, start, n, rec, "Q5_K")
+    nb = len(B)
+    d = B["d"].astype(np.float32)[:, None, None]
+    dmin = B["dmin"].astype(np.float32)[:, None, None]
+    sc, mn = _scale_min_k4(B["scales"])
+    sc = sc.reshape(nb, 4, 2)
+    mn = mn.reshape(nb, 4, 2)
+    qs = B["qs"].reshape(nb, 4, 32)
+    qh = B["qh"][:, None, :]                       # (nb,1,32)
+    g = np.arange(4)[None, :, None]                # group index
+    hi_lo = ((qh >> (2 * g)) & 1).astype(np.float32)       # u1 = 1<<2g
+    hi_hi = ((qh >> (2 * g + 1)) & 1).astype(np.float32)   # u2 = 2<<2g
+    y = np.empty((nb, 4, 64), np.float32)
+    y[:, :, :32] = (d * sc[:, :, 0:1] *
+                    ((qs & 0x0F).astype(np.float32) + 16.0 * hi_lo) -
+                    dmin * mn[:, :, 0:1])
+    y[:, :, 32:] = (d * sc[:, :, 1:2] *
+                    ((qs >> 4).astype(np.float32) + 16.0 * hi_hi) -
+                    dmin * mn[:, :, 1:2])
+    return y.reshape(-1)
+
+
+def _dequant_q6_k(buf, start: int, n: int) -> np.ndarray:
+    """Q6_K: 256-elem super-blocks = [128B low nibbles][64B 2-bit high
+    planes][16 i8 scales][f16 d]; value = d * sc[l/16] * (6-bit - 32)."""
+    rec = np.dtype([("ql", "u1", (128,)), ("qh", "u1", (64,)),
+                    ("sc", "i1", (16,)), ("d", "<f2")])
+    B = _kq_blocks(buf, start, n, rec, "Q6_K")
+    nb = len(B)
+    d = B["d"].astype(np.float32).reshape(nb, 1, 1)
+    ql = B["ql"].reshape(nb, 2, 64).astype(np.int16)
+    qh = B["qh"].reshape(nb, 2, 32).astype(np.int16)
+    sc = B["sc"].reshape(nb, 2, 8).astype(np.float32)
+    q1 = ((ql[:, :, :32] & 0x0F) | (((qh >> 0) & 3) << 4)) - 32
+    q2 = ((ql[:, :, 32:] & 0x0F) | (((qh >> 2) & 3) << 4)) - 32
+    q3 = ((ql[:, :, :32] >> 4) | (((qh >> 4) & 3) << 4)) - 32
+    q4 = ((ql[:, :, 32:] >> 4) | (((qh >> 6) & 3) << 4)) - 32
+    sidx = np.arange(32) // 16                     # 16-elem scale groups
+    y = np.empty((nb, 2, 128), np.float32)
+    y[:, :, 0:32] = sc[:, :, sidx + 0] * q1
+    y[:, :, 32:64] = sc[:, :, sidx + 2] * q2
+    y[:, :, 64:96] = sc[:, :, sidx + 4] * q3
+    y[:, :, 96:128] = sc[:, :, sidx + 6] * q4
+    return (d * y).reshape(-1)
+
+
+def _dequant_q2_k(buf, start: int, n: int) -> np.ndarray:
+    """Q2_K: 256-elem super-blocks = [16B scales (lo=scale, hi=min)]
+    [64B 2-bit quants][f16 d][f16 dmin]; value = d*(sc&0xF)*q2 -
+    dmin*(sc>>4) per 16-elem sub-block."""
+    rec = np.dtype([("scales", "u1", (16,)), ("qs", "u1", (64,)),
+                    ("d", "<f2"), ("dmin", "<f2")])
+    B = _kq_blocks(buf, start, n, rec, "Q2_K")
+    nb = len(B)
+    d = B["d"].astype(np.float32).reshape(nb, 1, 1, 1)
+    dmin = B["dmin"].astype(np.float32).reshape(nb, 1, 1, 1)
+    scales = B["scales"].reshape(nb, 2, 4, 2)      # [half][j][sub]
+    qs = B["qs"].reshape(nb, 2, 32)                # per half
+    shift = np.arange(4).reshape(1, 1, 4, 1)
+    q2 = ((qs[:, :, None, :] >> (2 * shift)) & 3).astype(np.float32)
+    q2 = q2.reshape(nb, 2, 4, 2, 16)               # split 32 -> 2x16
+    sc = (scales & 0x0F).astype(np.float32)[..., None]
+    mn = (scales >> 4).astype(np.float32)[..., None]
+    y = d[..., None] * sc * q2 - dmin[..., None] * mn
+    return y.reshape(-1)
+
+
+def _dequant_q3_k(buf, start: int, n: int) -> np.ndarray:
+    """Q3_K: 256-elem super-blocks = [32B high-bit mask][64B 2-bit
+    quants][12B packed 6-bit scales x16][f16 d]; value =
+    d*(sc-32)*(q2 + hi*4 - 4) ... precisely d*sc*(q - (hm?0:4))."""
+    rec = np.dtype([("hmask", "u1", (32,)), ("qs", "u1", (64,)),
+                    ("scales", "u1", (12,)), ("d", "<f2")])
+    B = _kq_blocks(buf, start, n, rec, "Q3_K")
+    nb = len(B)
+    d = B["d"].astype(np.float32).reshape(nb, 1, 1, 1, 1)
+    # unpack 12 bytes -> 16 signed 6-bit scales (ggml kmask shuffle)
+    a = B["scales"].view("<u4").reshape(nb, 3)
+    k1, k2 = np.uint32(0x03030303), np.uint32(0x0F0F0F0F)
+    words = np.stack([
+        (a[:, 0] & k2) | (((a[:, 2] >> 0) & k1) << 4),
+        (a[:, 1] & k2) | (((a[:, 2] >> 2) & k1) << 4),
+        ((a[:, 0] >> 4) & k2) | (((a[:, 2] >> 4) & k1) << 4),
+        ((a[:, 1] >> 4) & k2) | (((a[:, 2] >> 6) & k1) << 4),
+    ], axis=1).astype("<u4")
+    sc = (words.view(np.uint8).reshape(nb, 16).astype(np.int8)
+          .astype(np.float32) - 32.0)
+    sc = sc.reshape(nb, 2, 4, 2)[..., None]        # [half][j][sub][1]
+    qs = B["qs"].reshape(nb, 2, 32)
+    hm = B["hmask"][:, None, None, :]              # (nb,1,1,32)
+    shift = np.arange(4).reshape(1, 1, 4, 1)
+    q2 = ((qs[:, :, None, :] >> (2 * shift)) & 3).astype(np.float32)
+    half = np.arange(2).reshape(1, 2, 1, 1)
+    bit = 4 * half + shift                         # m = 1 << (4n + j)
+    hi = ((hm >> bit) & 1).astype(np.float32)      # (nb,2,4,32)
+    q2 = q2.reshape(nb, 2, 4, 2, 16)
+    hi = hi.reshape(nb, 2, 4, 2, 16)
+    y = d * sc * (q2 - np.where(hi > 0, 0.0, 4.0))
+    return y.reshape(-1)
+
+
+def _dequant_q8_k(buf, start: int, n: int) -> np.ndarray:
+    """Q8_K: 256-elem super-blocks = [f32 d][256 i8][16 i16 bsums];
+    value = d * q."""
+    rec = np.dtype([("d", "<f4"), ("qs", "i1", (256,)),
+                    ("bsums", "<i2", (16,))])
+    B = _kq_blocks(buf, start, n, rec, "Q8_K")
+    return (B["d"][:, None] * B["qs"].astype(np.float32)).reshape(-1)
 
 
 # ======================================================= weight tree mapping
@@ -445,11 +667,17 @@ class _SpecialTokens:
                  token_types: list[int] | None):
         import re
         self.ids: dict[str, int] = {}
+        control: set[int] = set()
         if token_types:
             for i, (piece, tt) in enumerate(zip(tokens, token_types)):
                 if tt in (TOKTYPE_CONTROL, TOKTYPE_USER_DEFINED) and piece:
                     self.ids[piece] = i
-        self.id_set = frozenset(self.ids.values())
+                    if tt == TOKTYPE_CONTROL:
+                        control.add(i)
+        # only CONTROL tokens are suppressed from streamed output;
+        # USER_DEFINED tokens carry real surface text and llama.cpp's
+        # token_to_piece emits them verbatim
+        self.control_ids = frozenset(control)
         if self.ids:
             alts = sorted(self.ids, key=len, reverse=True)
             self._re = re.compile("|".join(re.escape(a) for a in alts))
@@ -485,10 +713,7 @@ def load_tokenizer(path_or_gguf) -> Any:
     atomically by the unigram and BPE tokenizers (llama.cpp's
     parse_special), so chat-template markup survives round trips.
     """
-    gf = (path_or_gguf if isinstance(path_or_gguf, GgufFile)
-          else GgufFile(path_or_gguf))
-    own = not isinstance(path_or_gguf, GgufFile)
-    try:
+    with _MaybeClose(*_as_gguf(path_or_gguf)) as gf:
         model = gf.metadata.get("tokenizer.ggml.model")
         tokens = gf.metadata.get("tokenizer.ggml.tokens")
         if model is None or tokens is None:
@@ -516,9 +741,6 @@ def load_tokenizer(path_or_gguf) -> Any:
         raise GgufError(
             f"tokenizer model {model!r} is not supported "
             "(bert, llama, gpt2 are)")
-    finally:
-        if own:
-            gf.close()
 
 
 class UnigramTokenizer:
@@ -597,17 +819,17 @@ class UnigramTokenizer:
     def encode(self, text: str, max_len: int | None = None,
                *, add_bos: bool = True) -> list[int]:
         ids: list[int] = [self.bos_id] if add_bos else []
-        first = True
-        for frag, special in self.special.split(text):
+        prefix = True      # SPM dummy-space: at text start AND after
+        for frag, special in self.special.split(text):   # every special
             if special is not None:
                 ids.append(special)
+                prefix = True
             else:
                 norm = frag.replace(" ", self.SPACE)
-                if first:
-                    # SPM space prefix applies once, at the text start
+                if prefix:
                     norm = self.SPACE + norm
                 ids.extend(self._viterbi(norm))
-            first = False
+                prefix = False
         if max_len is not None:
             ids = ids[:max_len]
         return ids
@@ -617,7 +839,7 @@ class UnigramTokenizer:
         byte-fallback pieces yield their byte, specials yield b'',
         ordinary pieces yield utf-8 text with U+2581 as space."""
         if tok in (self.bos_id, self.eos_id, self.pad_id) or \
-                tok in self.special.id_set or \
+                tok in self.special.control_ids or \
                 not 0 <= tok < len(self.tokens):
             return b""
         piece = self.tokens[tok]
@@ -829,7 +1051,7 @@ class ByteBpeTokenizer:
 
     def token_to_piece(self, tok: int) -> bytes:
         if tok == self.eos_id or tok == self.bos_id or \
-                tok in self.special.id_set or \
+                tok in self.special.control_ids or \
                 not 0 <= tok < len(self.tokens):
             return b""
         return bytes(self._u2b.get(ch, ord("?") & 0xFF)

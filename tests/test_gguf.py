@@ -511,7 +511,9 @@ def test_unigram_special_tokens_parse_atomically():
     tok = UnigramTokenizer(tokens, None, bos_token_id=1, eos_token_id=2,
                            unknown_token_id=0, token_types=types)
     ids = tok.encode("<|im_start|>user", add_bos=False)
-    assert [tokens[i] for i in ids] == ["<|im_start|>", "user"]
+    # SPM dummy-space prefix re-applies after a special token
+    # (llama.cpp is_prev_special behavior)
+    assert [tokens[i] for i in ids] == ["<|im_start|>", "▁user"]
     # without types the marker would shatter into unk/byte pieces
     tok_naive = UnigramTokenizer(tokens, None, bos_token_id=1,
                                  eos_token_id=2, unknown_token_id=0)
@@ -617,3 +619,22 @@ def test_metadata_huge_kv_count_fails_fast(tmp_path):
     p.write_bytes(body)
     with pytest.raises(GgufError, match="exceeds remaining"):
         GgufFile(p)
+
+
+def test_user_defined_tokens_parse_atomically_but_stream_text():
+    """USER_DEFINED tokens match atomically in encode (like llama.cpp
+    parse_special) but their surface text streams verbatim — only
+    CONTROL tokens are suppressed from output."""
+    from libsplinter_tpu.models.gguf import (TOKTYPE_CONTROL,
+                                             TOKTYPE_NORMAL,
+                                             TOKTYPE_USER_DEFINED)
+    tokens = ["<unk>", "<s>", "</s>", "<CUSTOM>", "▁hi"]
+    types = [TOKTYPE_NORMAL, TOKTYPE_CONTROL, TOKTYPE_CONTROL,
+             TOKTYPE_USER_DEFINED, TOKTYPE_NORMAL]
+    tok = UnigramTokenizer(tokens, None, bos_token_id=1, eos_token_id=2,
+                           unknown_token_id=0, token_types=types)
+    ids = tok.encode("<CUSTOM>hi", add_bos=False)
+    assert [tokens[i] for i in ids] == ["<CUSTOM>", "▁hi"]
+    assert tok.token_to_piece(3) == b"<CUSTOM>"     # streams verbatim
+    assert tok.token_to_piece(1) == b""             # control suppressed
+    assert tok.decode(ids) == "<CUSTOM> hi"

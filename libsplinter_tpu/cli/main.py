@@ -75,6 +75,7 @@ class Session:
         self._store: Store | None = None
         self._lane = None               # StagedLane, lazy (search caches
                                         # the device lane across REPL cmds)
+        self.pod_search = None          # PodSearch, lazy (search --sharded)
 
     @property
     def store(self) -> Store:
@@ -108,6 +109,7 @@ class Session:
 
     def close(self) -> None:
         self._lane = None
+        self.pod_search = None
         if self._store is not None:
             self._store.close()
             self._store = None

@@ -23,11 +23,12 @@ from .main import CliError, command
 
 @command("search", "search [--json] [--limit N] [--similarity S] "
          "[--distance D] [--bloom MASK] [--regex RX] [--timeout MS] "
-         "[--cpu] QUERY...", "semantic vector search (TPU top-k)")
+         "[--cpu] [--sharded] QUERY...",
+         "semantic vector search (TPU top-k)")
 def cmd_search(ses, args):
     opts = {"json": False, "limit": 10, "similarity": None,
             "distance": None, "bloom": 0, "regex": None, "timeout": 2000,
-            "cpu": False}
+            "cpu": False, "sharded": False}
     query_words = []
     it = iter(args)
 
@@ -43,6 +44,8 @@ def cmd_search(ses, args):
                 opts["json"] = True
             elif a == "--cpu":
                 opts["cpu"] = True
+            elif a == "--sharded":
+                opts["sharded"] = True
             elif a == "--limit":
                 opts["limit"] = int(arg_of(a))
             elif a == "--similarity":
@@ -109,7 +112,40 @@ def cmd_search(ses, args):
         return rx is None or bool(rx.search(k))
 
     rows = []
-    if qvec is not None and mask.any():
+    if qvec is not None and opts["sharded"]:
+        # pod path: this host's lane rows join the global mesh matrix
+        # (multihost.local_rows convention); top-k merges over ICI.
+        # Must run collectively on every worker of the pod job.  The
+        # local bloom/epoch mask prefilters this host's rows; our own
+        # scratch row is masked out, other hosts mask their own.
+        from .main import cli_jax
+        jax = cli_jax()
+        from ..parallel import PodSearch
+        if ses.pod_search is None:
+            ses.pod_search = PodSearch(st)
+        try:
+            mask[st.find_index(scratch)] = 0.0
+        except KeyError:
+            pass
+        use_pallas = ((not opts["cpu"]) and
+                      jax.default_backend() == "tpu")
+        # over-fetch to absorb regex filtering + stale scratch rows
+        fetch_k = opts["limit"] + 8 if opts["regex"] else opts["limit"]
+        hits = ses.pod_search.search(qvec, fetch_k, mask=mask,
+                                     use_pallas=use_pallas)
+        for h in hits:
+            if not key_ok(h["key"]):
+                continue
+            sim = round(h["similarity"], 6)
+            if opts["similarity"] is not None and \
+                    sim < opts["similarity"]:
+                break                         # sorted desc
+            rows.append({"key": h["key"], "host": h["host"],
+                         "slot": h["slot"], "similarity": sim,
+                         "distance": None})
+            if len(rows) >= opts["limit"]:
+                break
+    elif qvec is not None and mask.any():
         from ..ops.similarity import (cosine_scores, euclidean_distances)
         from .main import cli_jax
         jax = cli_jax()
@@ -158,6 +194,9 @@ def cmd_search(ses, args):
         for r in rows:
             if r["similarity"] is None:
                 print(r["key"])
+            elif r["distance"] is None:         # sharded hit: host-tagged
+                print(f"{r['similarity']:+.4f}  h{r['host']}/"
+                      f"{r['slot']:<6d}  {r['key']}")
             else:
                 print(f"{r['similarity']:+.4f}  {r['distance']:8.4f}  "
                       f"{r['key']}")

@@ -17,8 +17,38 @@ from ..ops.similarity import cosine_scores
 from .mesh import shard_map
 
 
+@functools.lru_cache(maxsize=64)
+def _topk_program(mesh: Mesh, axis: str, local_n: int, d: int, nq: int,
+                  k_local: int, k_final: int, use_pallas: bool):
+    """Compiled sharded top-k, cached per (mesh, shapes, k) so repeated
+    queries from a live session don't re-trace/re-compile."""
+
+    def local_then_merge(v_local, q, m_local):
+        # local fused scores + top-k on this shard
+        scores = cosine_scores(v_local, q, m_local,
+                               use_pallas=use_pallas)
+        s, i = jax.lax.top_k(scores[:, 0], k_local)
+        # globalize indices by shard offset
+        shard = jax.lax.axis_index(axis)
+        gi = i + shard * local_n
+        # all-gather candidates over ICI, merge, re-top-k
+        all_s = jax.lax.all_gather(s, axis)      # (m, k_local)
+        all_i = jax.lax.all_gather(gi, axis)     # (m, k_local)
+        ms, mi = jax.lax.top_k(all_s.reshape(-1), k_final)
+        return ms, all_i.reshape(-1)[mi]
+
+    fn = shard_map(
+        local_then_merge, mesh=mesh,
+        in_specs=(P(axis, None), P(), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
 def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
-                 axis: str = "dp") -> tuple[np.ndarray, np.ndarray]:
+                 axis: str = "dp", use_pallas: bool | None = None
+                 ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k over row-sharded vectors.
 
     vectors: (N, D) logically; physically sharded (N/m, D) per device on
@@ -32,39 +62,18 @@ def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
     # result still returns up to min(k, n) rows
     k_local = min(k, local_n)
     k_final = min(k, n)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
 
-    vspec = P(axis, None)
-    qspec = P()
-    mspec = P(axis)
-    out_spec = P()
-
-    def local_then_merge(v_local, q, m_local):
-        # local fused scores + top-k on this shard
-        scores = cosine_scores(v_local, q, m_local,
-                               use_pallas=jax.default_backend() == "tpu")
-        s, i = jax.lax.top_k(scores[:, 0], k_local)
-        # globalize indices by shard offset
-        shard = jax.lax.axis_index(axis)
-        gi = i + shard * local_n
-        # all-gather candidates over ICI, merge, re-top-k
-        all_s = jax.lax.all_gather(s, axis)      # (m, k_local)
-        all_i = jax.lax.all_gather(gi, axis)     # (m, k_local)
-        ms, mi = jax.lax.top_k(all_s.reshape(-1), k_final)
-        return ms, all_i.reshape(-1)[mi]
-
-    fn = shard_map(
-        local_then_merge, mesh=mesh,
-        in_specs=(vspec, qspec, mspec),
-        out_specs=(out_spec, out_spec),
-        check_vma=False,
-    )
     if mask is None:
         mask = jnp.ones((n,), jnp.float32)
     query = jnp.asarray(query, jnp.float32)
     if query.ndim == 1:
         query = query[None, :]
-    s, i = jax.jit(fn)(jnp.asarray(vectors, jnp.float32), query,
-                       jnp.asarray(mask, jnp.float32))
+    fn = _topk_program(mesh, axis, local_n, d, query.shape[0],
+                       k_local, k_final, bool(use_pallas))
+    s, i = fn(jnp.asarray(vectors, jnp.float32), query,
+              jnp.asarray(mask, jnp.float32))
     return np.asarray(s), np.asarray(i)
 
 
@@ -72,3 +81,194 @@ def shard_vectors(mesh: Mesh, vectors, axis: str = "dp"):
     """Place a host (N, D) matrix row-sharded over the mesh axis."""
     return jax.device_put(
         vectors, NamedSharding(mesh, P(axis, None)))
+
+
+class PodSearch:
+    """End-to-end pod-sharded search over per-host store lanes.
+
+    Every TPU-VM worker runs this SPMD-style with its OWN host-local
+    store (SURVEY.md §2.7): each host's (nslots, dim) vector lane —
+    zero-padded to the mesh tile — becomes this host's block of one
+    global row-sharded device matrix (multihost.local_rows convention:
+    global row g lives on host g // local_pad at local slot
+    g % local_pad).  search() runs the fused local top-k + ICI
+    all-gather merge on the mesh, then resolves winning global rows
+    back to (host, key) with one DCN process_allgather of the owning
+    hosts' key bytes — device data rides ICI, only control/keys ride
+    DCN.
+
+    Staging is epoch-diffed: a refresh with no store writes touches
+    nothing; single-process updates scatter only the changed rows into
+    the donated device matrix (same economy as ops.StagedLane); in the
+    multi-process case any host's change triggers a collective restage
+    (every host must participate in array construction).
+
+    Single-process (process_count == 1) degrades to sharding the one
+    local lane across the local mesh axis — same code path the
+    dryrun exercises on the virtual CPU mesh.
+    """
+
+    def __init__(self, store, mesh: Mesh | None = None, *,
+                 axis: str = "dp"):
+        from .mesh import make_mesh
+        from .multihost import init_distributed, process_span
+
+        init_distributed()
+        self.store = store
+        self.axis = axis
+        self.mesh = mesh or make_mesh()
+        self.pid, self.pcount = process_span()
+        self.local_n = store.nslots
+        m = self.mesh.shape[axis]
+        if m % self.pcount:
+            raise ValueError(
+                f"mesh axis {axis}={m} not divisible by "
+                f"{self.pcount} processes")
+        per_host_shards = m // self.pcount
+        # pad each host's block with zero rows to the shard tile; zero
+        # vectors are never candidates (cosine_scores nonzero mask)
+        self.local_pad = -(-self.local_n // per_host_shards) * \
+            per_host_shards
+        self.global_n = self.local_pad * self.pcount
+        self._arr = None
+        self._staged: np.ndarray | None = None   # epochs rows staged at
+        # transfer accounting (tests + perf docs)
+        self.full_stages = 0
+        self.rows_staged = 0
+
+    # -- staging -----------------------------------------------------------
+
+    def _gather_local(self) -> np.ndarray:
+        """Full torn-safe local lane, zero-padded to local_pad rows.
+        Rows mid-write stage as zeros this pass (never candidates) and
+        re-stage next refresh via their unchanged staged epoch."""
+        rows = np.arange(self.local_n, dtype=np.uint32)
+        vecs, eps = self.store.vec_gather(rows)
+        torn = eps == self.store.GATHER_TORN
+        vecs[torn] = 0.0
+        staged = np.where(torn, np.uint64(1), eps)   # odd = restage
+        if self.local_pad != self.local_n:
+            vecs = np.pad(vecs,
+                          ((0, self.local_pad - self.local_n), (0, 0)))
+        return vecs, staged
+
+    def _place(self, local: np.ndarray):
+        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if self.pcount == 1:
+            return shard_vectors(self.mesh, local, self.axis)
+        return jax.make_array_from_process_local_data(
+            sharding, local, (self.global_n, local.shape[1]))
+
+    def refresh(self):
+        """Bring the sharded matrix up to date (epoch-diffed)."""
+        if self._arr is None:
+            local, self._staged = self._gather_local()
+            self._arr = self._place(local)
+            self.full_stages += 1
+            return self._arr
+        e = self.store.epochs()
+        changed = np.nonzero(e != self._staged)[0]
+        any_changed = changed.size > 0
+        if self.pcount > 1:
+            # collective decision: every host must agree to restage
+            from jax.experimental import multihost_utils
+            flags = np.asarray(multihost_utils.process_allgather(
+                np.array([any_changed], np.int32)))
+            if flags.max() > 0:
+                local, self._staged = self._gather_local()
+                self._arr = self._place(local)
+                self.full_stages += 1
+            return self._arr
+        if any_changed:
+            vecs, eps = self.store.vec_gather(
+                changed.astype(np.uint32))
+            ok = eps != self.store.GATHER_TORN
+            rows = changed[ok]
+            if rows.size:
+                self._arr = _scatter_sharded(
+                    self._arr, jnp.asarray(rows.astype(np.int32)),
+                    jnp.asarray(vecs[ok]))
+                self._staged[rows] = eps[ok]
+                self.rows_staged += int(rows.size)
+        return self._arr
+
+    # -- query -------------------------------------------------------------
+
+    def search(self, query, k: int, *, mask=None, refresh: bool = True,
+               use_pallas: bool | None = None) -> list[dict]:
+        """Global top-k.  Returns [{host, slot, key, similarity}, ...]
+        sorted by similarity desc.  mask: optional per-host (nslots,)
+        {0,1} candidate prefilter (bloom/regex/scratch exclusion),
+        applied on this host's rows.  Must be called collectively (same
+        query, same k on every worker) — standard SPMD discipline."""
+        if refresh or self._arr is None:
+            self.refresh()
+        gmask = self._global_mask(mask)
+        s, gi = sharded_topk(self.mesh, self._arr, query, k,
+                             mask=gmask, axis=self.axis,
+                             use_pallas=use_pallas)
+        keep = s > -1e29
+        s, gi = s[keep], gi[keep]
+        keys = self._resolve_keys(gi)
+        out = []
+        for score, g, key in zip(s, gi, keys):
+            out.append({"host": int(g) // self.local_pad,
+                        "slot": int(g) % self.local_pad,
+                        "key": key,
+                        "similarity": float(score)})
+        return out
+
+    def _global_mask(self, local_mask):
+        if local_mask is None:
+            return None
+        lm = np.zeros(self.local_pad, np.float32)
+        lm[: self.local_n] = np.asarray(local_mask, np.float32)
+        if self.pcount == 1:
+            return jax.device_put(
+                lm, NamedSharding(self.mesh, P(self.axis)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(self.axis)), lm,
+            (self.global_n,))
+
+    def _resolve_keys(self, global_rows: np.ndarray) -> list[str]:
+        """Owner hosts contribute key bytes; one DCN allgather merges."""
+        from .. import _native as N
+        kmax = N.KEY_MAX
+        mine = np.zeros((len(global_rows), kmax), np.uint8)
+        for j, g in enumerate(np.asarray(global_rows)):
+            host = int(g) // self.local_pad
+            slot = int(g) % self.local_pad
+            if host == self.pid and slot < self.local_n:
+                key = self.store.key_at(slot) or ""
+                raw = key.encode()[:kmax]
+                mine[j, :len(raw)] = np.frombuffer(raw, np.uint8)
+        if self.pcount > 1:
+            from jax.experimental import multihost_utils
+            allk = np.asarray(
+                multihost_utils.process_allgather(mine))
+            mine = allk.max(axis=0)    # owner's row is the only nonzero
+        return [bytes(row[row != 0]).decode(errors="replace")
+                for row in mine]
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_fn():
+    @functools.partial(jax.jit, donate_argnums=0)
+    def scatter(arr, rows, vals):
+        return arr.at[rows].set(vals)
+    return scatter
+
+
+def _scatter_sharded(arr, rows, vals):
+    # pad the update to a few bucket sizes so the scatter compiles a
+    # handful of times, not per distinct dirty count (cf. StagedLane)
+    n = rows.shape[0]
+    b = 64
+    while b < n:
+        b *= 8
+    if b != n:
+        rows = jnp.concatenate(
+            [rows, jnp.broadcast_to(rows[0], (b - n,))])
+        vals = jnp.concatenate(
+            [vals, jnp.broadcast_to(vals[0], (b - n, vals.shape[1]))])
+    return _scatter_fn()(arr, rows, vals)

@@ -195,17 +195,16 @@ class Completer:
         if len(ids) > budget:
             ids = ids[-budget:]
         import numpy as np
-        logits = m.prefill(np.asarray(ids, np.int32))
         try:
-            for _ in range(self.max_new):
-                t = m.sample(logits)
+            # chunk-at-a-time on-device decode: the host syncs once per
+            # flush_tokens tokens, not once per token (VERDICT r1
+            # item 5; cadence from splainference.cpp:333-354)
+            for t in m.generate_tokens(np.asarray(ids, np.int32),
+                                       self.max_new,
+                                       chunk=max(1, self.flush_tokens)):
                 if t == tok.eos_id:
                     break
                 yield tok.token_to_piece(t)
-                if m.pos >= m.cfg.max_len:
-                    break             # window full: the sampled token was
-                                      # still valid, only the NEXT step isn't
-                logits = m.decode_one(t)
         finally:
             m.reset()                 # llama_memory_clear analog
 

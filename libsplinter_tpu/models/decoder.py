@@ -173,11 +173,9 @@ class Decoder(nn.Module):
 
 # ---------------------------------------------------------------- sampling
 
-@functools.partial(jax.jit, static_argnames=("top_p", "temp"))
-def sample_top_p(rng, logits, *, top_p: float = 0.9, temp: float = 0.7):
-    """The reference's sampler chain (splainference.cpp:272-279):
-    top-p nucleus filter → temperature → categorical draw.
-    logits: (V,) float32.  temp <= 0 means greedy."""
+def _sample_graph(rng, logits, top_p: float, temp: float):
+    """In-graph sampler body (traceable under scan): top-p nucleus
+    filter → temperature → categorical draw.  temp <= 0 means greedy."""
     if temp <= 0:
         return jnp.argmax(logits).astype(jnp.int32)
     order = jnp.argsort(-logits)
@@ -188,6 +186,13 @@ def sample_top_p(rng, logits, *, top_p: float = 0.9, temp: float = 0.7):
     masked = jnp.where(keep, sorted_logits, -jnp.inf)
     choice = jax.random.categorical(rng, masked)
     return order[choice].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("top_p", "temp"))
+def sample_top_p(rng, logits, *, top_p: float = 0.9, temp: float = 0.7):
+    """The reference's sampler chain (splainference.cpp:272-279),
+    jit-compiled for one-off host-side sampling."""
+    return _sample_graph(rng, logits, top_p, temp)
 
 
 # ------------------------------------------------------------- front end
@@ -232,6 +237,7 @@ class CompletionModel:
         self._rng = jax.random.PRNGKey(seed + 1)
         self._cache = None
         self._pos = 0
+        self._chunk_progs: dict[int, Any] = {}
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -282,15 +288,91 @@ class CompletionModel:
         return int(sample_top_p(sub, jnp.asarray(logits),
                                 top_p=self.top_p, temp=self.temp))
 
+    # -- chunked decode (the tokens/sec path) -----------------------------
+
+    def _chunk_program(self, n: int):
+        """One lax.scan program decoding n tokens fully on device: per
+        step, forward one token, sample the next in-graph.  The KV cache
+        never round-trips to the host (donated buffer); the host sees
+        only the n sampled token ids per chunk — the reference's
+        8-token flush cadence (splainference.cpp:333-354) becomes the
+        device↔host sync boundary instead of a per-token one."""
+        fn = self._chunk_progs.get(n)
+        if fn is None:
+            module, top_p, temp = self.module, self.top_p, self.temp
+
+            def run(params, cache, pos, rng, tok):
+                def step(carry, _):
+                    cache, pos, rng, tok = carry
+                    logits, cache = module.apply(
+                        params, tok.reshape(1, 1), cache, pos)
+                    rng, sub = jax.random.split(rng)
+                    nxt = _sample_graph(sub, logits[0, 0], top_p, temp)
+                    return (cache, pos + 1, rng, nxt), nxt
+
+                (cache, _, _, _), toks = jax.lax.scan(
+                    step, (cache, pos, rng, tok), None, length=n)
+                return cache, toks
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            self._chunk_progs[n] = fn
+        return fn
+
+    def decode_chunk(self, token: int, n: int) -> np.ndarray:
+        """Append `token`, then decode and sample n tokens on device in
+        one program.  Returns the n sampled token ids.  The caller
+        checks EOG host-side per token; a mid-chunk EOG wastes at most
+        n-1 speculative steps (their cache rows are beyond the final
+        position and are reset with the request)."""
+        if self._cache is None:
+            raise RuntimeError("prefill first")
+        if self._pos + n > self.cfg.max_len:
+            raise RuntimeError("context window full")
+        self._rng, sub = jax.random.split(self._rng)
+        self._cache, toks = self._chunk_program(n)(
+            self.params, self._cache, jnp.int32(self._pos), sub,
+            jnp.int32(int(token)))
+        self._pos += n
+        return np.asarray(toks)
+
+    def generate_tokens(self, prompt_ids: np.ndarray, max_new: int,
+                        *, chunk: int = 8):
+        """Generator of sampled token ids: bucketed prefill, then
+        chunk-at-a-time on-device decode (single-token fallback near the
+        window/budget tail so no per-length programs compile)."""
+        logits = self.prefill(np.asarray(prompt_ids, np.int32))
+        tok = self.sample(logits)
+        yield int(tok)
+        produced = 1
+        while produced < max_new:
+            room = min(self.cfg.max_len - self._pos,
+                       max_new - produced)
+            if room <= 0:
+                break
+            if room < chunk:
+                logits = self.decode_one(tok)
+                tok = self.sample(logits)
+                yield int(tok)
+                produced += 1
+                continue
+            toks = self.decode_chunk(tok, chunk)
+            for t in toks:
+                yield int(t)
+            tok = int(toks[-1])
+            produced += chunk
+
     @property
     def pos(self) -> int:
         return self._pos
 
-    def warmup(self) -> None:
-        """Pre-compile prefill buckets + the decode-one program."""
+    def warmup(self, chunk: int = 8) -> None:
+        """Pre-compile prefill buckets, decode-one, and the chunked
+        decode program."""
         for b in self.buckets:
             self.prefill(np.ones((max(1, b - 1),), np.int32))
             self.decode_one(1)
+        if self._pos + chunk <= self.cfg.max_len:
+            self.decode_chunk(1, chunk)
         self.reset()
 
 

@@ -1,9 +1,10 @@
 """Headline benchmark: end-to-end embedding throughput per chip.
 
 Drives the real pipeline on the real TPU: texts live in the native
-seqlock store, the embedding daemon drains them label-swept from the
-store, tokenizes on host, encodes with the flagship (Nomic-geometry)
-encoder in per-bucket jit programs, and commits vectors back epoch-gated.
+seqlock store, the embedding daemon drains them from the store via the
+event-driven dirty-mask path, tokenizes on host, encodes with the
+flagship (Nomic-geometry) encoder in per-bucket jit programs, and
+commits vectors back epoch-gated.
 
 Prints ONE JSON line:
   {"metric": "embeddings_per_sec_per_chip", "value": N, "unit":
@@ -13,12 +14,43 @@ Baseline: BASELINE.md targets >= 100k embeddings/s on a v5e-8 for
 Nomic-Embed-Text-v1.5, i.e. 12,500 embeddings/s/chip; vs_baseline is
 value / 12500 (>1.0 beats the target's per-chip share).
 
-Fail-soft by construction: the measurement runs in a child process
-under a wall-clock watchdog.  The TPU on this host class is behind a
-single-client tunnel — if another process holds the claim, backend
-init blocks indefinitely inside PJRT client creation; the watchdog
-turns that into a JSON error line instead of a hang (the round-1
-failure mode: BENCH_r01.json rc=1, parsed=null).
+Resilience by construction (VERDICT r2 #1): the TPU on this host class
+is behind a single-client tunnel; if another process holds the claim,
+backend init blocks indefinitely inside PJRT client creation.  The
+round-1/-2 failure mode was one hung attempt eating the whole window.
+This version treats the measurement as an engineering problem:
+
+  - pre-flight `tpu_available()` probe before each attempt (cheap
+    subprocess, bounded), so a wedged tunnel costs ~75 s, not a whole
+    child startup;
+  - retry with backoff INSIDE the watchdog window — as many attempts
+    as fit, not one shot;
+  - stage markers (client-init / compile / store / throughput / p50)
+    written to a file the parent reads on timeout, so any hang is
+    attributable to a stage;
+  - the bench store's shm name is parent-chosen and parent-unlinked on
+    every failure path (a SIGKILLed child can't leak it);
+  - on final failure, a ps scan reports candidate tunnel holders.
+
+The p50 latency is measured on the EVENT-DRIVEN wake path (daemon
+thread blocking in signal_wait, hot drain sweep=False) — the dirty-mask
+path the daemon actually serves traffic with — not run_once()'s
+O(nslots) reconciliation sweep (VERDICT r2 weak #5).
+
+Every successful measurement is appended to bench_results.jsonl (value +
+timestamp + config); if the live window fails, the error JSON carries the
+most recent in-round measurement as detail.last_measured so one unlucky
+end-of-round claim never erases the round's evidence again.
+
+Env knobs: BENCH_TEXTS, BENCH_BATCH, BENCH_BUCKET, BENCH_TIMEOUT,
+BENCH_ATTEMPT_TIMEOUT, BENCH_CPU=1 (skip probe, run on host CPU —
+for in-round tracking where the chip is unavailable), BENCH_SKIP_PROBE=1.
+
+Tunnel semantics (learned rounds 1-3, see .claude/skills/verify/SKILL.md):
+the claim server admits ONE client; concurrent clients wedge the claim and
+recovery is a server-side timeout (30+ min).  So the probe and the child
+run strictly sequentially, backoff between attempts is generous, and
+nothing here ever runs two device-touching processes at once.
 """
 from __future__ import annotations
 
@@ -36,6 +68,12 @@ N_TEXTS = int(os.environ.get("BENCH_TEXTS", "4096"))
 BATCH = int(os.environ.get("BENCH_BATCH", "512"))
 BUCKET = int(os.environ.get("BENCH_BUCKET", "64"))
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "1200"))
+ATTEMPT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "420"))
+PROBE_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
+BACKOFF_S = float(os.environ.get("BENCH_BACKOFF", "45"))
+CPU_MODE = os.environ.get("BENCH_CPU") == "1"
+RESULTS_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_results.jsonl")
 
 
 def log(*a):
@@ -66,11 +104,34 @@ def make_texts(n: int) -> list[str]:
             for _ in range(n)]
 
 
+# ---------------------------------------------------------------------------
+# child: the actual measurement (runs under the parent's per-attempt timeout)
+# ---------------------------------------------------------------------------
+
+def _stage(name: str) -> None:
+    """Stage marker: stderr for the live log, stage file for the parent's
+    post-mortem (a hung child can't report its own stage)."""
+    log(f"STAGE {name} t={time.strftime('%H:%M:%S')}")
+    path = os.environ.get("SPTPU_BENCH_STAGEFILE")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(f"{time.time():.1f} {name}\n")
+        except OSError:
+            pass
+
+
 def child() -> int:
-    """The actual measurement (runs under the parent's watchdog)."""
+    import threading
+
     import numpy as np
 
+    _stage("child-start")
     import jax
+
+    if CPU_MODE:
+        from libsplinter_tpu.utils.jaxplatform import force_cpu
+        force_cpu()
 
     from libsplinter_tpu import Store, T_VARTEXT
     from libsplinter_tpu.engine import protocol as P
@@ -78,23 +139,28 @@ def child() -> int:
     from libsplinter_tpu.models import (EmbeddingModel, EncoderConfig,
                                         default_tokenizer)
 
+    _stage("client-init")           # first device access claims the tunnel
     n_chips = len(jax.devices())
     backend = jax.default_backend()
+    _stage("client-init-done")
     log(f"backend={backend} devices={jax.devices()}")
 
     cfg = EncoderConfig(out_dim=768, max_len=2048)
     model = EmbeddingModel(cfg, buckets=(BUCKET,))
     tok = default_tokenizer(cfg.vocab_size)
 
-    log("warmup compile ...")
+    _stage("compile")
     t0 = time.perf_counter()
     ids = np.zeros((BATCH, BUCKET), np.int32)
     lens = np.full((BATCH,), BUCKET, np.int32)
     model.encode_ids(ids, lens)
-    log(f"compile: {time.perf_counter()-t0:.1f}s")
+    compile_s = time.perf_counter() - t0
+    _stage("compile-done")
+    log(f"compile: {compile_s:.1f}s")
 
     # -- stage the store ---------------------------------------------------
-    name = f"/spt-bench-{os.getpid()}"
+    _stage("stage-store")
+    name = os.environ["SPTPU_BENCH_STORE"]
     Store.unlink(name)
     st = Store.create(name, nslots=max(8192, N_TEXTS * 2), max_val=2048,
                       vec_dim=768)
@@ -109,68 +175,247 @@ def child() -> int:
                    batch_cap=BATCH)
     emb.attach()
 
-    # -- timed drain -------------------------------------------------------
+    # -- timed drain (throughput) -----------------------------------------
+    _stage("throughput")
     t0 = time.perf_counter()
     done = emb.run_once()
     dt = time.perf_counter() - t0
     eps = done / dt if dt > 0 else 0.0
+    log(f"embedded={done}/{N_TEXTS} in {dt:.2f}s -> {eps:,.0f} emb/s/chip")
 
-    # -- p50 set->vector latency ------------------------------------------
-    lat = []
-    for i in range(20):
+    # -- p50 set->vector latency on the EVENT-DRIVEN wake path -------------
+    # The daemon thread blocks in signal_wait and serves hot drains with
+    # sweep=False (dirty mask + pending set only) — the path BASELINE.md's
+    # "<2 ms set->vector" target is about.  run_once()'s O(nslots) label
+    # sweep is reconciliation, not the hot path, and is not measured here.
+    _stage("p50-wake")
+    runner = threading.Thread(
+        target=emb.run,
+        kwargs=dict(idle_timeout_ms=20, sweep_interval_s=3600.0),
+        daemon=True)
+    runner.start()
+    time.sleep(0.05)                # let the thread enter signal_wait
+
+    lat, lat_timeouts = [], 0
+    for i in range(30):
         key = f"lat/{i}"
         t1 = time.perf_counter()
         st.set(key, "latency probe text sample")
         st.set_type(key, T_VARTEXT)
         st.label_or(key, P.LBL_EMBED_REQ)
-        st.bump(key)
-        emb.run_once()
-        lat.append((time.perf_counter() - t1) * 1000)
-    p50 = float(np.percentile(lat, 50))
+        st.bump(key)                # pulses the watch group -> wake
+        idx = st.find_index(key)
+        deadline = t1 + 10.0
+        timed_out = False
+        while st.labels_at(idx) & P.LBL_EMBED_REQ:
+            if time.perf_counter() > deadline:
+                timed_out = True
+                break
+            time.sleep(0.0001)
+        if timed_out:
+            lat_timeouts += 1       # a missed wake is not a latency sample
+        else:
+            lat.append((time.perf_counter() - t1) * 1000)
+    emb.stop()
+    runner.join(timeout=2.0)
+    p50 = float(np.percentile(lat, 50)) if lat else -1.0
+    p95 = float(np.percentile(lat, 95)) if lat else -1.0
+    log(f"p50 set->vector (event-driven): {p50:.2f} ms  p95: {p95:.2f} ms "
+        f"timeouts={lat_timeouts} (stats: {emb.stats})")
 
-    log(f"embedded={done}/{N_TEXTS} in {dt:.2f}s -> {eps:,.0f} emb/s/chip")
-    log(f"p50 set->vector latency: {p50:.2f} ms (stats: {emb.stats})")
-
+    _stage("teardown")
     st.close()
     Store.unlink(name)
 
+    _stage("done")
     emit(eps, eps / BASELINE_PER_CHIP, {
         "backend": backend, "n_chips_visible": n_chips,
         "bucket": BUCKET, "batch": BATCH, "n_texts": N_TEXTS,
-        "p50_set_to_vector_ms": round(p50, 2)})
+        "compile_s": round(compile_s, 1),
+        "p50_set_to_vector_ms": round(p50, 2),
+        "p95_set_to_vector_ms": round(p95, 2),
+        "p50_samples": len(lat), "p50_timeouts": lat_timeouts})
     return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: probe + retry-with-backoff under the global watchdog
+# ---------------------------------------------------------------------------
+
+def _probe_tpu(timeout_s: float) -> bool:
+    """Bounded check that the tunnel is claimable RIGHT NOW.  Delegates
+    to jaxplatform.tpu_available, which scrubs an inherited
+    JAX_PLATFORMS=cpu pin (a force_cpu parent must not doom every
+    probe)."""
+    from libsplinter_tpu.utils.jaxplatform import tpu_available
+    return tpu_available(timeout_s=timeout_s)
+
+
+def _tunnel_suspects() -> list[str]:
+    """Best-effort ps scan: other live python/jax processes that could be
+    holding the single-client tunnel."""
+    try:
+        out = subprocess.run(["ps", "-eo", "pid,etime,comm,args"],
+                             capture_output=True, text=True, timeout=10).stdout
+    except Exception:
+        return []
+    me = os.getpid()
+    hits = []
+    for ln in out.splitlines()[1:]:
+        low = ln.lower()
+        if ("python" in low or "jax" in low or "pjrt" in low) \
+                and str(me) not in ln.split()[:1]:
+            hits.append(ln.strip()[:160])
+    return hits[:8]
+
+
+def _cleanup_store(name: str) -> None:
+    try:
+        from libsplinter_tpu import Store
+        Store.unlink(name)
+    except Exception:
+        pass
+
+
+def _last_stage(stagefile: str) -> str:
+    try:
+        with open(stagefile) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        return lines[-1].split(" ", 1)[1] if lines else "(no stage reached)"
+    except OSError:
+        return "(no stage file)"
 
 
 def main() -> int:
     if os.environ.get("SPTPU_BENCH_CHILD") == "1":
         return child()
 
-    # Child stderr inherits the terminal so progress streams live; only
-    # stdout (the JSON line) is captured.
-    env = dict(os.environ, SPTPU_BENCH_CHILD="1")
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, timeout=TIMEOUT_S, stdout=subprocess.PIPE, text=True)
-    except subprocess.TimeoutExpired:
-        emit(0.0, 0.0, {"timeout_s": TIMEOUT_S},
-             error=f"watchdog timeout after {TIMEOUT_S:.0f}s — TPU tunnel "
-                   "likely claimed by another live client (single-client "
-                   "host); progress (if any) is on stderr above")
-        return 0
+    t_start = time.monotonic()
+    deadline = t_start + TIMEOUT_S
+    store_name = f"/spt-bench-{os.getpid()}"
+    stagefile = f"/tmp/spt-bench-stage-{os.getpid()}"
+    env = dict(os.environ, SPTPU_BENCH_CHILD="1",
+               SPTPU_BENCH_STORE=store_name,
+               SPTPU_BENCH_STAGEFILE=stagefile)
 
-    line = ""
-    for ln in (proc.stdout or "").splitlines():
-        ln = ln.strip()
-        if ln.startswith("{"):
-            line = ln
-    if proc.returncode == 0 and line:
-        print(line, flush=True)
-        return 0
-    emit(0.0, 0.0, {"child_rc": proc.returncode},
-         error=f"bench child failed rc={proc.returncode} "
-               "(traceback on stderr above)")
+    attempts = 0
+    probes_failed = 0
+    last_err = ""
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining < 30:
+            break
+
+        # pre-flight probe: don't burn a child startup on a wedged tunnel
+        if not CPU_MODE and os.environ.get("BENCH_SKIP_PROBE") != "1":
+            log(f"[bench] probe tpu (timeout {PROBE_S:.0f}s, "
+                f"{remaining:.0f}s left in window) ...")
+            if not _probe_tpu(min(PROBE_S, remaining - 10)):
+                probes_failed += 1
+                last_err = "tpu probe timed out (tunnel unclaimable)"
+                log(f"[bench] probe #{probes_failed} failed; backing off "
+                    f"{BACKOFF_S:.0f}s")
+                time.sleep(min(BACKOFF_S, max(0.0,
+                                              deadline - time.monotonic())))
+                continue
+            log("[bench] probe ok — tunnel claimable, starting child")
+
+        attempt_budget = min(ATTEMPT_S, deadline - time.monotonic() - 5)
+        if attempt_budget < 30:
+            break
+        attempts += 1
+        try:
+            os.unlink(stagefile)
+        except OSError:
+            pass
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=attempt_budget,
+                stdout=subprocess.PIPE, text=True)
+        except subprocess.TimeoutExpired:
+            stage = _last_stage(stagefile)
+            last_err = (f"attempt {attempts} hit {attempt_budget:.0f}s "
+                        f"attempt-timeout at stage '{stage}'")
+            log(f"[bench] {last_err}")
+            _cleanup_store(store_name)
+            # the killed child may still hold the claim server-side; a
+            # client spawned immediately would be a CONCURRENT client —
+            # the documented wedge mode.  Back off first.
+            time.sleep(min(BACKOFF_S,
+                           max(0.0, deadline - time.monotonic())))
+            continue
+
+        line = ""
+        for ln in (proc.stdout or "").splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                line = ln
+        if proc.returncode == 0 and line:
+            print(line, flush=True)
+            _record_success(line)
+            _cleanup_store(store_name)
+            return 0
+        stage = _last_stage(stagefile)
+        last_err = (f"attempt {attempts} child rc={proc.returncode} "
+                    f"at stage '{stage}' (traceback on stderr above)")
+        log(f"[bench] {last_err}")
+        _cleanup_store(store_name)
+        time.sleep(min(BACKOFF_S, max(0.0, deadline - time.monotonic())))
+
+    _cleanup_store(store_name)
+    suspects = _tunnel_suspects()
+    detail = {
+        "timeout_s": TIMEOUT_S, "attempts": attempts,
+        "probes_failed": probes_failed,
+        "tunnel_suspects": suspects,
+    }
+    last = _latest_recorded()
+    if last is not None:
+        detail["last_measured"] = last
+    emit(0.0, 0.0, detail,
+         error=f"no successful measurement in {TIMEOUT_S:.0f}s window "
+               f"({attempts} child attempts, {probes_failed} failed probes); "
+               f"last: {last_err}"
+               + ("" if last is None else
+                  " — see detail.last_measured for the most recent "
+                  "in-round real measurement"))
     return 0
+
+
+def _record_success(json_line: str) -> None:
+    """Append a successful measurement to bench_results.jsonl so the
+    round's evidence survives a later flaky window (VERDICT r2 #1b)."""
+    try:
+        rec = json.loads(json_line)
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        with open(RESULTS_LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception as e:
+        log(f"[bench] could not record result: {e}")
+
+
+def _latest_recorded() -> dict | None:
+    """Most recent non-CPU measurement from bench_results.jsonl, if any.
+    Per-line tolerant: a truncated trailing line (parent killed
+    mid-append) must not discard the valid records before it."""
+    try:
+        with open(RESULTS_LOG) as f:
+            raw = f.read().splitlines()
+    except OSError:
+        return None
+    recs = []
+    for ln in raw:
+        if not ln.strip():
+            continue
+        try:
+            recs.append(json.loads(ln))
+        except ValueError:
+            continue
+    real = [r for r in recs
+            if r.get("value", 0) > 0
+            and r.get("detail", {}).get("backend") not in (None, "cpu")]
+    return real[-1] if real else None
 
 
 if __name__ == "__main__":

@@ -297,6 +297,11 @@ def main() -> int:
     env = dict(os.environ, SPTPU_BENCH_CHILD="1",
                SPTPU_BENCH_STORE=store_name,
                SPTPU_BENCH_STAGEFILE=stagefile)
+    if not CPU_MODE:
+        # mirror the probe's scrub: a force_cpu parent exports
+        # JAX_PLATFORMS=cpu, and a child inheriting it would run the
+        # whole bench on host CPU and report it as a success
+        env.pop("JAX_PLATFORMS", None)
 
     attempts = 0
     probes_failed = 0
@@ -313,10 +318,15 @@ def main() -> int:
             if not _probe_tpu(min(PROBE_S, remaining - 10)):
                 probes_failed += 1
                 last_err = "tpu probe timed out (tunnel unclaimable)"
+                # a probe is itself a tunnel client: hammering a held
+                # claim re-triggers the wedge (recovery is a 30+ min
+                # server-side timeout), so back off with escalation
+                backoff = min(BACKOFF_S * (2 ** min(probes_failed - 1, 4)),
+                              600.0)
                 log(f"[bench] probe #{probes_failed} failed; backing off "
-                    f"{BACKOFF_S:.0f}s")
-                time.sleep(min(BACKOFF_S, max(0.0,
-                                              deadline - time.monotonic())))
+                    f"{backoff:.0f}s")
+                time.sleep(min(backoff, max(0.0,
+                                            deadline - time.monotonic())))
                 continue
             log("[bench] probe ok — tunnel claimable, starting child")
 

@@ -114,7 +114,8 @@ def cmd_search(ses, args):
     rows = []
     if qvec is not None and opts["sharded"]:
         # pod path: this host's lane rows join the global mesh matrix
-        # (multihost.local_rows convention); top-k merges over ICI.
+        # (global row g = host * local_pad + slot; every host padded to
+        # the same local_pad); top-k merges over ICI.
         # Must run collectively on every worker of the pod job.  The
         # local bloom/epoch mask prefilters this host's rows; our own
         # scratch row is masked out, other hosts mask their own.
@@ -129,22 +130,41 @@ def cmd_search(ses, args):
             pass
         use_pallas = ((not opts["cpu"]) and
                       jax.default_backend() == "tpu")
-        # over-fetch to absorb regex filtering + stale scratch rows
-        fetch_k = opts["limit"] + 8 if opts["regex"] else opts["limit"]
-        hits = ses.pod_search.search(qvec, fetch_k, mask=mask,
-                                     use_pallas=use_pallas)
-        for h in hits:
-            if not key_ok(h["key"]):
-                continue
-            sim = round(h["similarity"], 6)
-            if opts["similarity"] is not None and \
-                    sim < opts["similarity"]:
-                break                         # sorted desc
-            rows.append({"key": h["key"], "host": h["host"],
-                         "slot": h["slot"], "similarity": sim,
-                         "distance": None})
-            if len(rows) >= opts["limit"]:
-                break
+        # over-fetch and GROW until --limit is satisfied: key_ok drops
+        # regex misses and stale __sqtmp_ scratch rows (left by crashed
+        # searches on any host; each host masks only its own current
+        # scratch), and scratch rows hold query embeddings so they rank
+        # at the very top for repeated queries — a fixed cushion can
+        # still come back short while candidates exist.  The growth is
+        # collectively consistent (same keys, same opts on every
+        # worker), preserving SPMD discipline.
+        # fetch on the shared bucket schedule (8, 64, 512, ...) so varied
+        # --limit values reuse a handful of compiled top-k programs
+        # instead of one per distinct k
+        from ..parallel.sharded_search import _bucket
+        fetch_k = _bucket(opts["limit"] + (8 if opts["regex"] else 4))
+        while True:
+            hits = ses.pod_search.search(qvec, fetch_k, mask=mask,
+                                         use_pallas=use_pallas)
+            rows.clear()
+            satisfied = False
+            for h in hits:
+                if not key_ok(h["key"]):
+                    continue
+                sim = round(h["similarity"], 6)
+                if opts["similarity"] is not None and \
+                        sim < opts["similarity"]:
+                    satisfied = True          # sorted desc: all below now
+                    break
+                rows.append({"key": h["key"], "host": h["host"],
+                             "slot": h["slot"], "similarity": sim,
+                             "distance": None})
+                if len(rows) >= opts["limit"]:
+                    satisfied = True
+                    break
+            if satisfied or len(hits) < fetch_k:
+                break                         # done, or candidates exhausted
+            fetch_k *= 8                      # stays on the bucket schedule
     elif qvec is not None and mask.any():
         from ..ops.similarity import (cosine_scores, euclidean_distances)
         from .main import cli_jax

@@ -64,14 +64,3 @@ def host_store_name(base: str) -> str:
 def process_span() -> tuple[int, int]:
     """(process_id, process_count) of this worker in the pod."""
     return jax.process_index(), jax.process_count()
-
-
-def local_rows(n_rows: int) -> slice:
-    """The contiguous slice of a length-n_rows global arena that this host
-    owns (block partition; the last host absorbs the remainder).  Used to
-    place each host's vector lane rows into the global sharded matrix."""
-    pid, pcount = process_span()
-    per = n_rows // pcount
-    start = pid * per
-    stop = n_rows if pid == pcount - 1 else start + per
-    return slice(start, stop)

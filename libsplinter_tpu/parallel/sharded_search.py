@@ -89,19 +89,23 @@ class PodSearch:
     Every TPU-VM worker runs this SPMD-style with its OWN host-local
     store (SURVEY.md §2.7): each host's (nslots, dim) vector lane —
     zero-padded to the mesh tile — becomes this host's block of one
-    global row-sharded device matrix (multihost.local_rows convention:
-    global row g lives on host g // local_pad at local slot
-    g % local_pad).  search() runs the fused local top-k + ICI
-    all-gather merge on the mesh, then resolves winning global rows
-    back to (host, key) with one DCN process_allgather of the owning
-    hosts' key bytes — device data rides ICI, only control/keys ride
-    DCN.
+    global row-sharded device matrix.  Row addressing: global row g
+    lives on host g // local_pad at local slot g % local_pad (every
+    host's lane is padded to the SAME local_pad, validated at init).
+    search() runs the fused local top-k + ICI all-gather merge on the
+    mesh, then resolves winning global rows back to (host, key) with
+    one DCN process_allgather of the owning hosts' key bytes — device
+    data rides ICI, only control/keys ride DCN.
 
-    Staging is epoch-diffed: a refresh with no store writes touches
-    nothing; single-process updates scatter only the changed rows into
-    the donated device matrix (same economy as ops.StagedLane); in the
-    multi-process case any host's change triggers a collective restage
-    (every host must participate in array construction).
+    Staging is epoch-diffed: a refresh with no store writes costs one
+    scalar DCN allgather and touches no device data; updates scatter
+    only the changed rows into the donated device matrix (same economy
+    as ops.StagedLane).  The multi-process path is collectively
+    incremental (VERDICT r2 #2): hosts allgather their dirty COUNTS,
+    agree on a shared padded bucket, and every host runs ONE scatter
+    program carrying its own changed rows (out-of-bounds sentinel rows
+    from less-dirty hosts are dropped by the scatter) — O(max dirty)
+    per refresh, never a full restage of every host's lane.
 
     Single-process (process_count == 1) degrades to sharding the one
     local lane across the local mesh axis — same code path the
@@ -129,7 +133,24 @@ class PodSearch:
         # vectors are never candidates (cosine_scores nonzero mask)
         self.local_pad = -(-self.local_n // per_host_shards) * \
             per_host_shards
+        self.per_host_shards = per_host_shards
+        self.tile = self.local_pad // per_host_shards
         self.global_n = self.local_pad * self.pcount
+        if self.pcount > 1:
+            # global-row arithmetic (host = g // local_pad, key resolve,
+            # make_array_from_process_local_data's global shape) is only
+            # sound if every worker has the same geometry — a mismatched
+            # store would yield silently misattributed results.
+            from jax.experimental import multihost_utils
+            geo = np.asarray(multihost_utils.process_allgather(
+                np.array([self.local_n, self.local_pad,
+                          store.vec_dim], np.int64)))
+            geo = geo.reshape(self.pcount, 3)
+            if not (geo == geo[0]).all():
+                raise ValueError(
+                    "PodSearch requires identical store geometry on "
+                    "every worker; got per-host (nslots, local_pad, "
+                    f"vec_dim) = {geo.tolist()}")
         self._arr = None
         self._staged: np.ndarray | None = None   # epochs rows staged at
         # transfer accounting (tests + perf docs)
@@ -168,18 +189,31 @@ class PodSearch:
             return self._arr
         e = self.store.epochs()
         changed = np.nonzero(e != self._staged)[0]
-        any_changed = changed.size > 0
         if self.pcount > 1:
-            # collective decision: every host must agree to restage
+            # collective O(dirty) update: agree on the max dirty count,
+            # pad to a shared bucket, and run one scatter program on
+            # every host with its own rows (sentinel rows are dropped).
             from jax.experimental import multihost_utils
-            flags = np.asarray(multihost_utils.process_allgather(
-                np.array([any_changed], np.int32)))
-            if flags.max() > 0:
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.array([changed.size], np.int32))).ravel()
+            maxc = int(counts.max())
+            if maxc == 0:
+                return self._arr
+            bucket = _bucket(maxc)
+            # the scatter ships per_host_shards*bucket rows per host
+            # (each dirty row occupies its own column across the host's
+            # shard rows); past that point a full restage (local_pad
+            # rows) is strictly cheaper — e.g. a bulk load.  Every host
+            # sees the same maxc, so the branch is collectively
+            # consistent.
+            if bucket * self.per_host_shards >= self.local_pad:
                 local, self._staged = self._gather_local()
                 self._arr = self._place(local)
                 self.full_stages += 1
+            else:
+                self._collective_scatter(changed, bucket)
             return self._arr
-        if any_changed:
+        if changed.size:
             vecs, eps = self.store.vec_gather(
                 changed.astype(np.uint32))
             ok = eps != self.store.GATHER_TORN
@@ -190,6 +224,55 @@ class PodSearch:
                     jnp.asarray(vecs[ok]))
                 self._staged[rows] = eps[ok]
                 self.rows_staged += int(rows.size)
+        return self._arr
+
+    def _collective_scatter(self, changed: np.ndarray, bucket: int):
+        """Multi-process incremental restage: scatter this host's changed
+        rows (padded to the pod-agreed `bucket`) into the sharded matrix.
+
+        Every worker executes the SAME program (SPMD discipline); a host
+        with fewer dirty rows than the bucket pads with an out-of-bounds
+        sentinel slot that the scatter drops.  Rows torn mid-gather stage
+        as zeros with an odd staged epoch (never candidates, retried next
+        refresh) — identical semantics to the full stage."""
+        d = self.store.vec_dim
+        rows = changed.astype(np.uint32)
+        staged_eps = None
+        if rows.size:
+            vecs, eps = self.store.vec_gather(rows)
+            torn = eps == self.store.GATHER_TORN
+            vecs[torn] = 0.0
+            staged_eps = np.where(torn, np.uint64(1), eps)
+        else:
+            vecs = np.zeros((0, d), np.float32)
+
+        # per-device rows in shard-local coordinates; sentinel = tile
+        # (one past the end -> dropped by mode='drop')
+        lrows = np.full((self.per_host_shards, bucket), self.tile,
+                        np.int32)
+        lvals = np.zeros((self.per_host_shards, bucket, d), np.float32)
+        if rows.size:
+            dev = rows // self.tile
+            off = rows % self.tile
+            j = np.arange(rows.size)
+            lrows[dev, j] = off
+            lvals[dev, j] = vecs
+        m = self.mesh.shape[self.axis]
+        sh_r = NamedSharding(self.mesh, P(self.axis, None))
+        sh_v = NamedSharding(self.mesh, P(self.axis, None, None))
+        grows = jax.make_array_from_process_local_data(
+            sh_r, lrows, (m, bucket))
+        gvals = jax.make_array_from_process_local_data(
+            sh_v, lvals, (m, bucket, d))
+        self._arr = _pod_scatter_program(
+            self.mesh, self.axis, bucket, self.tile, d)(
+                self._arr, grows, gvals)
+        # mark rows staged only AFTER the scatter executed: an exception
+        # above must leave them dirty so the next refresh retries them
+        # (the single-process path has the same ordering)
+        if staged_eps is not None:
+            self._staged[rows] = staged_eps
+        self.rows_staged += int(rows.size)
         return self._arr
 
     # -- query -------------------------------------------------------------
@@ -251,6 +334,34 @@ class PodSearch:
                 for row in mine]
 
 
+def _bucket(n: int) -> int:
+    """Shared pad bucket: few distinct sizes -> few compiled programs."""
+    b = 8
+    while b < n:
+        b *= 8
+    return b
+
+
+@functools.lru_cache(maxsize=64)
+def _pod_scatter_program(mesh: Mesh, axis: str, bucket: int, tile: int,
+                         d: int):
+    """Compiled per-shard scatter for the multi-process incremental
+    restage.  Each device owns a (tile, d) block and receives its own
+    (bucket,) shard-local row ids + (bucket, d) values; sentinel rows
+    (== tile, out of bounds) are dropped."""
+
+    def upd(block, rows, vals):
+        return block.at[rows[0]].set(vals[0], mode="drop")
+
+    fn = shard_map(
+        upd, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
 @functools.lru_cache(maxsize=None)
 def _scatter_fn():
     @functools.partial(jax.jit, donate_argnums=0)
@@ -263,9 +374,7 @@ def _scatter_sharded(arr, rows, vals):
     # pad the update to a few bucket sizes so the scatter compiles a
     # handful of times, not per distinct dirty count (cf. StagedLane)
     n = rows.shape[0]
-    b = 64
-    while b < n:
-        b *= 8
+    b = _bucket(n)
     if b != n:
         rows = jnp.concatenate(
             [rows, jnp.broadcast_to(rows[0], (b - n,))])

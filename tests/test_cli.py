@@ -230,6 +230,52 @@ def test_search_end_to_end(cli, capsys):
     st.close()
 
 
+def test_sharded_search_grows_past_stale_scratch(cli, capsys):
+    """Stale __sqtmp_ scratch rows (crashed searches, possibly other
+    hosts') hold QUERY embeddings, so they rank at the very top of a
+    repeated query; the sharded path must grow its fetch until --limit
+    real results come back (ADVICE r2 / review finding)."""
+    run, name = cli
+    st = Store.open(name)
+    emb = Embedder(st, encoder_fn=fake_encoder, max_ctx=512)
+    emb.attach()
+    query = "document number 3"
+    for i in range(8):
+        st.set(f"doc{i}", f"document number {i}")
+        st.label_or(f"doc{i}", P.LBL_EMBED_REQ)
+    # five stale scratch rows carrying the exact query text (=> exact
+    # query embedding under the deterministic fake encoder)
+    for i in range(5):
+        k = f"{P.SEARCH_SCRATCH_PREFIX}{40000 + i}"
+        st.set(k, query)
+        st.label_or(k, P.LBL_EMBED_REQ)
+    emb.run_once()
+
+    stop = threading.Event()
+
+    def daemon():
+        while not stop.is_set():
+            emb.run_once()
+            stop.wait(0.01)
+
+    t = threading.Thread(target=daemon)
+    t.start()
+    try:
+        # limit 8 = all real docs; first fetch (8+4) is swamped by the
+        # 5 stale scratch rows and must grow
+        rc = run("search", "--sharded", "--json", "--limit", "8", query)
+        assert rc == 0
+        rows = json.loads(out_of(capsys))
+        assert len(rows) == 8
+        keys = {r["key"] for r in rows}
+        assert keys == {f"doc{i}" for i in range(8)}
+        assert rows[0]["key"] == "doc3"
+    finally:
+        stop.set()
+        t.join()
+    st.close()
+
+
 def test_search_degrades_without_daemon(cli, capsys):
     run, name = cli
     st = Store.open(name)

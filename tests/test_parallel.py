@@ -88,22 +88,3 @@ def test_multihost_single_process_noop(monkeypatch):
     assert multihost.init_distributed() is False
     pid, pcount = multihost.process_span()
     assert (pid, pcount) == (0, 1)
-    assert multihost.local_rows(100) == slice(0, 100)
-
-
-def test_local_rows_partition():
-    from libsplinter_tpu.parallel import multihost
-    spans = []
-    for pid in range(4):
-        orig = multihost.process_span
-        multihost.process_span = lambda p=pid: (p, 4)
-        try:
-            spans.append(multihost.local_rows(1030))
-        finally:
-            multihost.process_span = orig
-    assert spans[0] == slice(0, 257)
-    assert spans[3].stop == 1030                 # remainder absorbed
-    covered = set()
-    for s in spans:
-        covered.update(range(s.start, s.stop))
-    assert covered == set(range(1030))

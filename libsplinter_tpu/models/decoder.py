@@ -237,7 +237,7 @@ class CompletionModel:
         self._rng = jax.random.PRNGKey(seed + 1)
         self._cache = None
         self._pos = 0
-        self._chunk_progs: dict[int, Any] = {}
+        self._chunk_progs: dict[tuple, Any] = {}
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -297,7 +297,11 @@ class CompletionModel:
         only the n sampled token ids per chunk — the reference's
         8-token flush cadence (splainference.cpp:333-354) becomes the
         device↔host sync boundary instead of a per-token one."""
-        fn = self._chunk_progs.get(n)
+        # keyed on the sampler settings too: the program closes over
+        # top_p/temp, so a consumer mutating them after first use must
+        # get a fresh program, not silently reuse the stale one
+        key = (n, self.top_p, self.temp)
+        fn = self._chunk_progs.get(key)
         if fn is None:
             module, top_p, temp = self.module, self.top_p, self.temp
 
@@ -315,7 +319,16 @@ class CompletionModel:
                 return cache, toks
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._chunk_progs[n] = fn
+            self._chunk_progs[key] = fn
+            # bound the cache: per-request sampler settings must not
+            # retain every stale compiled program for process lifetime —
+            # past a handful, drop entries for settings other than the
+            # current ones (their programs re-compile if revisited)
+            if len(self._chunk_progs) > 8:
+                cur = (self.top_p, self.temp)
+                self._chunk_progs = {
+                    k: v for k, v in self._chunk_progs.items()
+                    if (k[1], k[2]) == cur}
         return fn
 
     def decode_chunk(self, token: int, n: int) -> np.ndarray:
@@ -336,13 +349,21 @@ class CompletionModel:
         return np.asarray(toks)
 
     def generate_tokens(self, prompt_ids: np.ndarray, max_new: int,
-                        *, chunk: int = 8):
+                        *, chunk: int = 8, eos_id: int | None = None):
         """Generator of sampled token ids: bucketed prefill, then
         chunk-at-a-time on-device decode (single-token fallback near the
-        window/budget tail so no per-length programs compile)."""
+        window/budget tail so no per-length programs compile).
+
+        Contract: with eos_id=None the generator keeps yielding the
+        chunk's SPECULATIVE tokens after an end-of-generation token —
+        the consumer must detect its own stop condition and break (the
+        completion daemon does).  Pass eos_id to have the generator
+        stop itself right after yielding that token."""
         logits = self.prefill(np.asarray(prompt_ids, np.int32))
         tok = self.sample(logits)
         yield int(tok)
+        if eos_id is not None and tok == eos_id:
+            return
         produced = 1
         while produced < max_new:
             room = min(self.cfg.max_len - self._pos,
@@ -353,11 +374,15 @@ class CompletionModel:
                 logits = self.decode_one(tok)
                 tok = self.sample(logits)
                 yield int(tok)
+                if eos_id is not None and tok == eos_id:
+                    return
                 produced += 1
                 continue
             toks = self.decode_chunk(tok, chunk)
             for t in toks:
                 yield int(t)
+                if eos_id is not None and int(t) == eos_id:
+                    return
             tok = int(toks[-1])
             produced += chunk
 

@@ -109,7 +109,13 @@ class GgufFile:
     # -- low-level readers -------------------------------------------------
     def _read(self, fmt: str):
         size = struct.calcsize(fmt)
-        v = struct.unpack_from(fmt, self._mm, self._pos)
+        try:
+            v = struct.unpack_from(fmt, self._mm, self._pos)
+        except struct.error as e:
+            # corrupt counts within file size can still run the cursor
+            # off the map; fail with the documented error type
+            raise GgufError(f"{self.path}: truncated read at offset "
+                            f"{self._pos}: {e}") from e
         self._pos += size
         return v[0] if len(v) == 1 else v
 
@@ -147,7 +153,14 @@ class GgufFile:
                 vals = struct.unpack_from(fmt, self._mm, self._pos)
                 self._pos += struct.calcsize(fmt)
                 return list(vals)
-            self._bound(count, "array")
+            # string / nested-array elements each need at least an 8-byte
+            # length or count prefix — bounding those with elem_bytes=1
+            # would let a corrupt count escape as a raw struct.error deep
+            # in the element loop instead of failing fast here.  BOOL
+            # elements are 1 byte; the 8-byte bound would falsely reject
+            # valid arrays near end of file.
+            self._bound(count, "array",
+                        8 if etype in (_T_STRING, _T_ARRAY) else 1)
             return [self._read_value(etype) for _ in range(count)]
         raise GgufError(f"unknown metadata value type {vtype}")
 

@@ -1,0 +1,124 @@
+"""Online checkpoint validation: real Nomic GGUF vs HF reference.
+
+This build sandbox has ZERO egress, so the end-to-end checkpoint story
+is regression-locked offline by the pinned golden fixture
+(tests/test_golden_e2e.py).  In a network-enabled environment, run this
+script to validate the same chain against the REAL published
+checkpoint — it cross-checks this framework's GGUF loader + tokenizer +
+encoder against the HuggingFace implementation token-for-token and
+vector-for-vector (reference analog: splinference.cpp:423-447 executing
+nomic-embed-text through llama.cpp).
+
+One command:
+
+    python scripts/validate_online.py \
+        [--gguf nomic-ai/nomic-embed-text-v1.5-GGUF] \
+        [--hf nomic-ai/nomic-embed-text-v1.5]
+
+What it does:
+  1. downloads the f32 GGUF via huggingface_hub (or uses --gguf-path);
+  2. cold-loads it: encoder_config_from_gguf + load_tokenizer +
+     EmbeddingModel(weights=...);
+  3. tokenizes the probe texts with BOTH our WordPiece and HF's
+     AutoTokenizer; asserts identical ids;
+  4. encodes with both (ours on jax, HF's on torch cpu), mean-pools,
+     L2-normalizes, truncates to --dim (matryoshka);
+  5. asserts cosine(ours, hf) > 0.999 per text and prints a table.
+
+Exit 0 = full parity; non-zero = the first mismatching stage, printed.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+PROBES = [
+    "search_query: what is a seqlock?",
+    "search_document: The quick brown fox jumps over the lazy dog.",
+    "Multi-reader single-writer stores favor wait-free reads.",
+    "TPUs execute matmuls on a 128x128 systolic array.",
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gguf", default="nomic-ai/nomic-embed-text-v1.5-GGUF")
+    ap.add_argument("--gguf-file", default="nomic-embed-text-v1.5.f32.gguf")
+    ap.add_argument("--gguf-path", help="already-downloaded .gguf")
+    ap.add_argument("--hf", default="nomic-ai/nomic-embed-text-v1.5")
+    ap.add_argument("--dim", type=int, default=768)
+    args = ap.parse_args()
+
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from libsplinter_tpu.utils.jaxplatform import force_cpu
+    force_cpu()
+
+    path = args.gguf_path
+    if path is None:
+        try:
+            from huggingface_hub import hf_hub_download
+        except ImportError:
+            print("huggingface_hub not installed and no --gguf-path; "
+                  "this environment has no download path", file=sys.stderr)
+            return 2
+        try:
+            path = hf_hub_download(args.gguf, args.gguf_file)
+        except Exception as e:
+            print(f"download failed ({e}); zero-egress environment? "
+                  "use --gguf-path", file=sys.stderr)
+            return 2
+
+    import numpy as np
+
+    from libsplinter_tpu.models.encoder import EmbeddingModel
+    from libsplinter_tpu.models.gguf import (GgufFile,
+                                             encoder_config_from_gguf,
+                                             load_tokenizer)
+
+    with GgufFile(path) as gf:
+        cfg = encoder_config_from_gguf(gf, out_dim=args.dim)
+        tok = load_tokenizer(gf)
+    model = EmbeddingModel(cfg, weights=path)
+    print(f"loaded {path}: {cfg.layers}x{cfg.hidden} vocab={cfg.vocab_size}")
+
+    from transformers import AutoModel, AutoTokenizer
+    hf_tok = AutoTokenizer.from_pretrained(args.hf)
+    hf_model = AutoModel.from_pretrained(args.hf, trust_remote_code=True)
+    hf_model.eval()
+
+    import torch
+
+    worst = 1.0
+    for text in PROBES:
+        ours = tok.encode(text)
+        theirs = hf_tok(text)["input_ids"]
+        if ours != theirs:
+            print(f"TOKENIZER MISMATCH on {text!r}:\n  ours   {ours}\n"
+                  f"  theirs {theirs}")
+            return 1
+        n = len(ours)
+        bucket = model.bucket_for(n)
+        ids = np.full((1, bucket), tok.pad_id, np.int32)
+        ids[0, :n] = ours
+        v_ours = np.asarray(model.encode_ids(
+            ids, np.array([n], np.int32))[0])
+        with torch.no_grad():
+            out = hf_model(**{k: torch.tensor(v).unsqueeze(0)
+                              for k, v in hf_tok(text).items()})
+        emb = out.last_hidden_state[0, :n].mean(0)
+        emb = emb[: args.dim]
+        v_hf = (emb / emb.norm()).numpy()
+        cos = float(v_ours @ v_hf)
+        worst = min(worst, cos)
+        print(f"  cos={cos:.6f}  {text[:50]!r}")
+    if worst < 0.999:
+        print(f"FAIL: worst cosine {worst:.6f} < 0.999")
+        return 1
+    print(f"PARITY OK (worst cosine {worst:.6f})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -183,3 +183,40 @@ def test_tied_lm_head_fallback(tmp_path):
     np.testing.assert_allclose(
         np.asarray(loaded["params"]["lm_head"]["kernel"]),
         np.asarray(loaded["params"]["tok_emb"]["embedding"]).T)
+
+
+def test_generate_tokens_eos_stop(model):
+    """With eos_id set the generator stops right after yielding it; the
+    chunk's speculative tail is not surfaced (ADVICE r2)."""
+    # greedy tiny model: find whatever token it repeats, use it as "eos"
+    toks = []
+    for t in model.generate_tokens(np.ones(4, np.int32), 12, chunk=4):
+        toks.append(t)
+    model.reset()
+    eos = toks[2]                      # appears mid-stream
+    got = list(model.generate_tokens(np.ones(4, np.int32), 12, chunk=4,
+                                     eos_id=eos))
+    model.reset()
+    assert got[-1] == eos
+    assert eos not in got[:-1]
+    assert got == toks[: toks.index(eos) + 1]
+
+
+def test_chunk_program_tracks_sampler_settings(model):
+    """Mutating top_p/temp after first use must not silently reuse the
+    stale compiled program (ADVICE r2): the cache is keyed on them."""
+    model.prefill(np.ones(4, np.int32))
+    model.decode_chunk(1, 4)
+    n_before = len(model._chunk_progs)
+    old = (model.top_p, model.temp)
+    try:
+        model.top_p, model.temp = 0.5, 1.3
+        model.reset()
+        model.prefill(np.ones(4, np.int32))
+        model.decode_chunk(1, 4)
+        assert len(model._chunk_progs) == n_before + 1
+        keys = set(model._chunk_progs)
+        assert (4, 0.5, 1.3) in keys
+    finally:
+        model.top_p, model.temp = old
+        model.reset()

@@ -20,7 +20,14 @@ from libsplinter_tpu.models.gguf import (
     load_encoder_params, load_tokenizer,
 )
 
-# ------------------------------------------------------------ gguf writer
+# ---------------------------------------------------------- gguf writer
+# The writer lives in the package now (models/gguf_writer.py — it also
+# produces the committed golden fixture); these tests import it so the
+# reader is exercised against the same byte layout users export.
+
+from libsplinter_tpu.models.gguf_writer import (  # noqa: E402
+    kv_f32_array, kv_i32_array, kv_str, kv_str_array, kv_u32, write_gguf,
+)
 
 _T_U32, _T_F32, _T_STRING, _T_ARRAY, _T_U64 = 4, 6, 8, 9, 10
 _T_I32 = 5
@@ -33,94 +40,6 @@ def _s(txt: str) -> bytes:
 
 def _kv(key: str, vtype: int, payload: bytes) -> bytes:
     return _s(key) + struct.pack("<I", vtype) + payload
-
-
-def kv_u32(key, v):
-    return _kv(key, _T_U32, struct.pack("<I", v))
-
-
-def kv_str(key, v):
-    return _kv(key, _T_STRING, _s(v))
-
-
-def kv_str_array(key, items):
-    body = struct.pack("<IQ", _T_STRING, len(items))
-    body += b"".join(_s(t) for t in items)
-    return _kv(key, _T_ARRAY, body)
-
-
-def kv_f32_array(key, items):
-    body = struct.pack("<IQ", _T_F32, len(items))
-    body += struct.pack(f"<{len(items)}f", *items)
-    return _kv(key, _T_ARRAY, body)
-
-
-def quantize_q8_0(flat: np.ndarray) -> bytes:
-    out = b""
-    for blk in flat.reshape(-1, 32):
-        d = float(np.abs(blk).max()) / 127.0 or 1e-8
-        qs = np.clip(np.round(blk / d), -127, 127).astype(np.int8)
-        out += struct.pack("<e", d) + qs.tobytes()
-    return out
-
-
-def quantize_q4_0(flat: np.ndarray) -> bytes:
-    out = b""
-    for blk in flat.reshape(-1, 32):
-        d = float(np.abs(blk).max()) / 7.0 or 1e-8
-        q = np.clip(np.round(blk / d) + 8, 0, 15).astype(np.uint8)
-        packed = (q[:16] | (q[16:] << 4)).astype(np.uint8)
-        out += struct.pack("<e", d) + packed.tobytes()
-    return out
-
-
-def quantize_q4_1(flat: np.ndarray) -> bytes:
-    out = b""
-    for blk in flat.reshape(-1, 32):
-        mn = float(blk.min())
-        d = (float(blk.max()) - mn) / 15.0 or 1e-8
-        q = np.clip(np.round((blk - mn) / d), 0, 15).astype(np.uint8)
-        packed = (q[:16] | (q[16:] << 4)).astype(np.uint8)
-        out += struct.pack("<ee", d, mn) + packed.tobytes()
-    return out
-
-
-def write_gguf(path, tensors: dict[str, tuple[np.ndarray, int]],
-               metadata: list[bytes] = (), align: int = 32) -> None:
-    """tensors: name -> (array [numpy layout, slowest-first], ggml_type).
-    ne[] is written reversed (fastest-first) like real GGUF."""
-    header = struct.pack("<IIQQ", 0x46554747, 3, len(tensors),
-                         len(metadata))
-    meta = b"".join(metadata)
-    infos, data = b"", b""
-    for name, (arr, gtype) in tensors.items():
-        flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
-        if gtype == GGML_F32:
-            payload = flat.tobytes()
-        elif gtype == GGML_F16:
-            payload = flat.astype(np.float16).tobytes()
-        elif gtype == GGML_BF16:
-            payload = ((flat.view(np.uint32) >> 16)
-                       .astype(np.uint16).tobytes())
-        elif gtype == GGML_Q8_0:
-            payload = quantize_q8_0(flat)
-        elif gtype == GGML_Q4_0:
-            payload = quantize_q4_0(flat)
-        elif gtype == GGML_Q4_1:
-            payload = quantize_q4_1(flat)
-        else:
-            raise ValueError(gtype)
-        pad = (-len(data)) % align
-        data += b"\0" * pad
-        ne = tuple(reversed(arr.shape))
-        infos += (_s(name) + struct.pack("<I", len(ne)) +
-                  struct.pack(f"<{len(ne)}Q", *ne) +
-                  struct.pack("<IQ", gtype, len(data)))
-        data += payload
-    head = header + meta + infos
-    pad = (-len(head)) % align
-    with open(path, "wb") as f:
-        f.write(head + b"\0" * pad + data)
 
 
 # ------------------------------------------------------------- container
@@ -493,11 +412,6 @@ def test_byte_bpe_from_gguf(tmp_path):
 
 
 # ------------------------------------------- ADVICE r1: special tokens etc.
-
-def kv_i32_array(key, items):
-    body = struct.pack("<IQ", _T_I32, len(items))
-    body += struct.pack(f"<{len(items)}i", *items)
-    return _kv(key, _T_ARRAY, body)
 
 
 def test_unigram_special_tokens_parse_atomically():

@@ -275,21 +275,134 @@ def cmd_orders(ses, args):
         print(f"  [{i}] {k} ({ses.store.value_len(k)}B)")
 
 
-@command("watch", "watch KEY|@GROUP [TIMEOUT_MS]",
-         "block until a key changes (or a signal group pulses)")
+@command("watch", "watch KEY|@GROUP [TIMEOUT_MS] [--oneshot]",
+         "continuous change watch (Ctrl-] or stdin EOF aborts); with "
+         "TIMEOUT_MS or --oneshot: stop after the first event")
 def cmd_watch(ses, args):
+    """Continuous key/group watch (reference behavior:
+    splinter_cli_cmd_watch.c:73-183 — raw-terminal loop, Ctrl-] abort,
+    `size:value` per key change, pulse lines per group signal).
+
+    TPU-idiom differences: waits block in C on the event bus / poll
+    with a short timeout instead of a 50 ms usleep spin, and stdin EOF
+    aborts too, so scripts can drive the loop through a pipe (the
+    cli_regression.sh interactive check does exactly that).
+
+    Back-compat: `watch KEY TIMEOUT_MS` = one bounded wait, then exit
+    (prints `timeout` if nothing changed) — the r1/r2 behavior.
+    """
+    args = list(args)
+    oneshot = "--oneshot" in args
+    if oneshot:
+        args.remove("--oneshot")
     if not args:
-        raise CliError("usage: watch KEY|@GROUP [TIMEOUT_MS]")
-    timeout = int(args[1]) if len(args) > 1 else -1
-    if args[0].startswith("@"):
-        g = int(args[0][1:])
-        last = ses.store.signal_count(g)
-        got = ses.store.signal_wait(g, last, timeout)
-        print(f"group {g}: {last} -> {got}" if got is not None
-              else "timeout")
-    else:
-        ok = ses.store.poll(ses.key(args[0]), timeout)
-        print("changed" if ok else "timeout")
+        raise CliError("usage: watch KEY|@GROUP [TIMEOUT_MS] [--oneshot]")
+    timeout = int(args[1]) if len(args) > 1 else None
+    if timeout is not None:
+        oneshot = True
+    bounded = timeout if timeout is not None else 100
+
+    import contextlib
+    import select
+
+    @contextlib.contextmanager
+    def raw_stdin():
+        """Raw terminal so Ctrl-] arrives unbuffered; restored on exit.
+        Non-tty stdin (pipe) needs no mode change — select + read works
+        as-is and EOF doubles as the abort signal."""
+        fd = None
+        try:
+            if sys.stdin.isatty():
+                import termios
+                import tty
+                fd = sys.stdin.fileno()
+                saved = termios.tcgetattr(fd)
+                tty.setcbreak(fd)
+            yield
+        finally:
+            if fd is not None:
+                termios.tcsetattr(fd, termios.TCSADRAIN, saved)
+
+    def abort_requested() -> bool:
+        try:
+            r, _, _ = select.select([sys.stdin], [], [], 0)
+        except (OSError, ValueError):
+            return False
+        if not r:
+            return False
+        data = os.read(sys.stdin.fileno(), 1)
+        return data in (b"\x1d", b"")        # Ctrl-] or EOF
+
+    if not oneshot:
+        print("watching — press Ctrl-] to stop", file=sys.stderr)
+
+    got_event = False
+    with raw_stdin():
+        if args[0].startswith("@"):
+            g = int(args[0][1:])
+            last = ses.store.signal_count(g)
+            while True:
+                # stdin abort applies to the continuous loop only: a
+                # backgrounded oneshot (stdin /dev/null or exhausted)
+                # must honor its bounded wait, not exit instantly on EOF
+                if not oneshot and abort_requested():
+                    break
+                got = ses.store.signal_wait(g, last, bounded)
+                if got is not None:
+                    print(f"group {g} pulsed (total {got})", flush=True)
+                    last = got
+                    got_event = True
+                    if oneshot:
+                        break
+                elif oneshot:
+                    break
+        else:
+            # track the last-reported epoch across iterations: a write
+            # landing between two poll() calls (each snapshots its own
+            # baseline) must still be reported, not missed
+            key = ses.key(args[0])
+            e_last = ses.store.epoch_at(ses.store.find_index(key))
+
+            def report() -> bool:
+                """Print the value if the epoch moved; True on print."""
+                nonlocal e_last, got_event
+                try:
+                    # re-resolve the slot every time: unset + re-create
+                    # can move the key, and a pinned index would read a
+                    # stale (or recycled) slot's epoch forever
+                    idx = ses.store.find_index(key)
+                    e = ses.store.epoch_at(idx)
+                    if e == e_last or (e & 1):
+                        return False
+                    val = ses.store.get(key).rstrip(b"\0")
+                except KeyError:
+                    return False              # vanished: caller decides
+                e_last = e
+                sys.stdout.buffer.write(
+                    f"{len(val)}:".encode() + val + b"\n")
+                sys.stdout.flush()
+                got_event = True
+                return True
+
+            while True:
+                if not oneshot and abort_requested():
+                    break
+                if report():
+                    if oneshot:
+                        break
+                    continue
+                try:
+                    changed = ses.store.poll(key, bounded)
+                except KeyError:
+                    break                     # key unset mid-watch
+                if not changed and oneshot:
+                    # a write in the window between report()'s epoch
+                    # read and poll()'s baseline snapshot would be
+                    # invisible to both — one final re-check
+                    report()
+                    break
+    if oneshot and not got_event:
+        print("timeout")
 
 
 @command("retrain", "retrain KEY",

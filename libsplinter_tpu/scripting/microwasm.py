@@ -15,10 +15,22 @@ interpreter covering the MVP core:
               widths), memory.size, memory.grow
   numeric     full i32/i64 ALU (clz..rotr), f32/f64 arithmetic & compares,
               the conversion/reinterpret matrix, sign-extension ops
+  simd        the fixed-width SIMD proposal's v128 core (the reference
+              enables the proposal in WasmEdge,
+              splinter_cli_cmd_wasm.c:85-143): loads/stores incl. lane +
+              splat + extend variants, const/shuffle/swizzle, splats,
+              lane extract/replace, ALL lane comparisons, bitwise +
+              bitselect + any/all_true + bitmask, integer lane
+              add/sub/mul/abs/neg/min/max/shifts/saturating/avgr/dot/
+              narrow/extend, float lane
+              arith/sqrt/rounding/min/max/pmin/pmax, and the
+              int<->float conversion matrix
 
-Out of scope (raise WasmError): SIMD, threads, reference types, multi-value
-block signatures, bulk memory.  Scripts that heavy-compute belong in the
-JAX tier; wasm here is a portable *protocol* client, like the reference's.
+Out of scope (raise WasmError): threads, reference types, multi-value
+block signatures, bulk memory, and the SIMD tail that exists for codec
+inner loops (q15mulr, extadd_pairwise, extmul, relaxed-simd).  Scripts
+that heavy-compute belong in the JAX tier; wasm here is a portable
+*protocol* client, like the reference's.
 
 Host functions are supplied as a dict {("module","name"): python_callable};
 callables receive (Instance, *args) so they can touch linear memory.
@@ -29,6 +41,8 @@ import math
 import struct
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+import numpy as np
 
 
 class WasmError(Exception):
@@ -42,8 +56,9 @@ class Trap(WasmError):
 MAGIC = b"\x00asm\x01\x00\x00\x00"
 PAGE = 65536
 
-I32, I64, F32, F64 = 0x7F, 0x7E, 0x7D, 0x7C
-_VALNAMES = {I32: "i32", I64: "i64", F32: "f32", F64: "f64"}
+I32, I64, F32, F64, V128 = 0x7F, 0x7E, 0x7D, 0x7C, 0x7B
+_VALNAMES = {I32: "i32", I64: "i64", F32: "f32", F64: "f64",
+             V128: "v128"}
 
 
 # -------------------------------------------------------------- byte reader
@@ -151,6 +166,96 @@ def _decode_blocktype(r: _Reader) -> tuple:
     raise WasmError("multi-value block signatures are not supported")
 
 
+# ------------------------------------------------------------- SIMD tables
+# v128 values travel the stack as 16-byte `bytes`; lane math runs on numpy
+# views.  Tables key the fixed-width SIMD proposal's sub-opcodes (0xFD
+# prefix) to (dtype, operation) pairs so the executor stays a dispatch.
+
+_SD = {0: "<i1", 1: "<i2", 2: "<i4", 3: "<i8"}   # signed by log2 width
+_UD = {0: "<u1", 1: "<u2", 2: "<u4", 3: "<u8"}
+
+_SIMD_CMP: dict[int, tuple[str, str]] = {}
+for _b, _w in ((35, 0), (45, 1), (55, 2)):
+    for _j, _dt in enumerate((_SD[_w], _SD[_w], _SD[_w], _UD[_w],
+                              _SD[_w], _UD[_w], _SD[_w], _UD[_w],
+                              _SD[_w], _UD[_w])):
+        _SIMD_CMP[_b + _j] = (_dt, ("eq", "ne", "lt", "lt", "gt", "gt",
+                                    "le", "le", "ge", "ge")[_j])
+for _b, _dt in ((65, "<f4"), (71, "<f8")):
+    for _j, _nm in enumerate(("eq", "ne", "lt", "gt", "le", "ge")):
+        _SIMD_CMP[_b + _j] = (_dt, _nm)
+for _j, _nm in enumerate(("eq", "ne", "lt", "gt", "le", "ge")):
+    _SIMD_CMP[214 + _j] = ("<i8", _nm)
+
+_SIMD_IBIN = {  # wrap-around + saturating + min/max/avgr integer binops
+    110: ("<u1", "add"), 113: ("<u1", "sub"),
+    142: ("<u2", "add"), 145: ("<u2", "sub"), 149: ("<u2", "mul"),
+    174: ("<u4", "add"), 177: ("<u4", "sub"), 181: ("<u4", "mul"),
+    206: ("<u8", "add"), 209: ("<u8", "sub"), 213: ("<u8", "mul"),
+    111: ("<i1", "add_sat"), 112: ("<u1", "add_sat"),
+    114: ("<i1", "sub_sat"), 115: ("<u1", "sub_sat"),
+    143: ("<i2", "add_sat"), 144: ("<u2", "add_sat"),
+    146: ("<i2", "sub_sat"), 147: ("<u2", "sub_sat"),
+    118: ("<i1", "min"), 119: ("<u1", "min"),
+    120: ("<i1", "max"), 121: ("<u1", "max"),
+    150: ("<i2", "min"), 151: ("<u2", "min"),
+    152: ("<i2", "max"), 153: ("<u2", "max"),
+    182: ("<i4", "min"), 183: ("<u4", "min"),
+    184: ("<i4", "max"), 185: ("<u4", "max"),
+    123: ("<u1", "avgr"), 155: ("<u2", "avgr"),
+}
+_SIMD_IUN = {
+    96: ("<i1", "abs"), 97: ("<u1", "neg"), 98: ("<u1", "popcnt"),
+    128: ("<i2", "abs"), 129: ("<u2", "neg"),
+    160: ("<i4", "abs"), 161: ("<u4", "neg"),
+    192: ("<i8", "abs"), 193: ("<u8", "neg"),
+}
+_SIMD_ALLTRUE = {99: "<u1", 131: "<u2", 163: "<u4", 195: "<u8"}
+_SIMD_BITMASK = {100: "<i1", 132: "<i2", 164: "<i4", 196: "<i8"}
+_SIMD_SHIFT = {
+    107: ("<u1", "shl"), 108: ("<i1", "shr"), 109: ("<u1", "shr"),
+    139: ("<u2", "shl"), 140: ("<i2", "shr"), 141: ("<u2", "shr"),
+    171: ("<u4", "shl"), 172: ("<i4", "shr"), 173: ("<u4", "shr"),
+    203: ("<u8", "shl"), 204: ("<i8", "shr"), 205: ("<u8", "shr"),
+}
+_SIMD_FUN = {
+    103: ("<f4", "ceil"), 104: ("<f4", "floor"), 105: ("<f4", "trunc"),
+    106: ("<f4", "nearest"), 116: ("<f8", "ceil"), 117: ("<f8", "floor"),
+    122: ("<f8", "trunc"), 148: ("<f8", "nearest"),
+    224: ("<f4", "abs"), 225: ("<f4", "neg"), 227: ("<f4", "sqrt"),
+    236: ("<f8", "abs"), 237: ("<f8", "neg"), 239: ("<f8", "sqrt"),
+}
+_SIMD_FBIN = {}
+for _b, _dt in ((228, "<f4"), (240, "<f8")):
+    for _j, _nm in enumerate(("add", "sub", "mul", "div",
+                              "min", "max", "pmin", "pmax")):
+        _SIMD_FBIN[_b + _j] = (_dt, _nm)
+
+_SIMD_NARROW = {101: ("<i2", "<i1"), 102: ("<i2", "<u1"),
+                133: ("<i4", "<i2"), 134: ("<i4", "<u2")}
+_SIMD_EXTEND = {}
+for _b, _src, _dst in ((135, "<i1", "<i2"), (167, "<i2", "<i4"),
+                       (199, "<i4", "<i8")):
+    _usrc = "<u" + _src[2]
+    _udst = "<u" + _dst[2]
+    _SIMD_EXTEND[_b] = (_src, _dst, "low")
+    _SIMD_EXTEND[_b + 1] = (_src, _dst, "high")
+    _SIMD_EXTEND[_b + 2] = (_usrc, _udst, "low")
+    _SIMD_EXTEND[_b + 3] = (_usrc, _udst, "high")
+
+# lane counts for extract/replace immediates (decode-time validation)
+_SIMD_LANE_N = {21: 16, 22: 16, 23: 16, 24: 8, 25: 8, 26: 8,
+                27: 4, 28: 4, 29: 2, 30: 2, 31: 4, 32: 4, 33: 2, 34: 2}
+
+_SIMD_SUPPORTED = (
+    set(range(14, 21)) | set(_SIMD_CMP) | set(range(77, 84))
+    | set(_SIMD_IBIN) | set(_SIMD_IUN) | set(_SIMD_ALLTRUE)
+    | set(_SIMD_BITMASK) | set(_SIMD_SHIFT) | set(_SIMD_FUN)
+    | set(_SIMD_FBIN) | set(_SIMD_NARROW) | set(_SIMD_EXTEND)
+    | {94, 95, 186} | set(range(248, 256))
+)
+
+
 # opcode name tables keep the decoder readable; executor dispatches on int.
 
 def _decode_expr(r: _Reader) -> list:
@@ -204,6 +309,40 @@ def _decode_expr(r: _Reader) -> list:
             out.append((op, r.f64()))
         elif 0x45 <= op <= 0xC4:                # numeric ops, no immediates
             out.append((op,))
+        elif op == 0xFD:                        # SIMD prefix
+            sub = r.uleb()
+            # ops are re-keyed as 0xFD00|sub so the executor still
+            # dispatches on one int
+            if sub <= 11 or sub in (92, 93):    # loads/store: memarg
+                align, offset = r.uleb(), r.uleb()
+                out.append((0xFD00 | sub, align, offset))
+            elif 84 <= sub <= 91:               # lane load/store: +lane
+                align, offset = r.uleb(), r.uleb()
+                lane = r.u8()
+                if lane >= 16 >> ((sub - 84) & 3):
+                    raise WasmError(f"lane {lane} out of range for "
+                                    f"SIMD op 0xfd {sub}")
+                out.append((0xFD00 | sub, align, offset, lane))
+            elif sub in (12, 13):               # const / shuffle: 16 bytes
+                imm = bytes(r.b[r.p:r.p + 16])
+                if len(imm) != 16:
+                    raise WasmError("truncated v128 immediate")
+                r.p += 16
+                if sub == 13 and any(i >= 32 for i in imm):
+                    raise WasmError("shuffle lane index >= 32")
+                out.append((0xFD00 | sub, imm))
+            elif 21 <= sub <= 34:               # lane ops: lane index
+                lane = r.u8()
+                if lane >= _SIMD_LANE_N[sub]:
+                    raise WasmError(f"lane {lane} out of range for "
+                                    f"SIMD op 0xfd {sub}")
+                out.append((0xFD00 | sub, lane))
+            elif sub in _SIMD_SUPPORTED:
+                out.append((0xFD00 | sub,))
+            else:
+                raise WasmError(f"unsupported SIMD opcode 0xfd {sub} "
+                                "(q15mulr/extadd/extmul/relaxed tail is "
+                                "out of scope; see module docstring)")
         else:
             raise WasmError(f"unsupported opcode 0x{op:02x}")
 
@@ -378,7 +517,7 @@ class Instance:
             self.globals.append(_const_expr_value(init)
                                 if init[0][0] in (0x41, 0x42)
                                 else (init[0][1] if init[0][0] in
-                                      (0x43, 0x44) else 0))
+                                      (0x43, 0x44, 0xFD0C) else 0))
         self.host: list[Optional[Callable]] = []
         self.host_types: list[FuncType] = []
         for mod, name, kind, extra in module.imports:
@@ -441,7 +580,8 @@ class Instance:
             _wrap32(a) if t == I32 else (_wrap64(a) if t == I64 else a)
             for a, t in zip(args, fn.type.params)]
         for vt in fn.locals:
-            locals_.append(0.0 if vt in (F32, F64) else 0)
+            locals_.append(b"\x00" * 16 if vt == V128
+                           else 0.0 if vt in (F32, F64) else 0)
         return self._exec(fn, locals_)
 
     # -- the interpreter loop --------------------------------------------
@@ -619,6 +759,8 @@ class Instance:
                         stack.append(old)
             elif op in (0x41, 0x42, 0x43, 0x44):  # consts
                 stack.append(ins[1])
+            elif op >= 0xFD00:                   # SIMD (pops/pushes itself)
+                self._simd(ins, stack)
             else:
                 stack.append(self._numeric(op, stack))
                 # _numeric pops its own operands and returns the result
@@ -920,6 +1062,259 @@ class Instance:
             return _wrap64(_sign32(stack.pop()))
 
         raise WasmError(f"unsupported numeric opcode 0x{op:02x}")
+
+    # -- SIMD (v128) -------------------------------------------------------
+
+    def _simd(self, ins: tuple, stack: list) -> None:
+        """Execute one 0xFD-prefixed op.  v128 values are 16-byte bytes
+        on the stack; lane math runs on numpy views of them."""
+        sub = ins[0] - 0xFD00
+        mem = self.mem
+
+        def ld(addr: int, n: int) -> bytes:
+            if addr < 0 or addr + n > len(mem):
+                raise Trap("out-of-bounds memory access")
+            return bytes(mem[addr:addr + n])
+
+        def stv(addr: int, data: bytes) -> None:
+            if addr < 0 or addr + len(data) > len(mem):
+                raise Trap("out-of-bounds memory access")
+            mem[addr:addr + len(data)] = data
+
+        # ---- memory ------------------------------------------------------
+        if sub == 0:                              # v128.load
+            stack.append(ld(_wrap32(stack.pop()) + ins[2], 16))
+        elif 1 <= sub <= 6:                       # load-extend 8 bytes
+            src, dst = (("<i1", "<i2"), ("<u1", "<u2"),
+                        ("<i2", "<i4"), ("<u2", "<u4"),
+                        ("<i4", "<i8"), ("<u4", "<u8"))[sub - 1]
+            raw = ld(_wrap32(stack.pop()) + ins[2], 8)
+            stack.append(np.frombuffer(raw, src).astype(dst).tobytes())
+        elif 7 <= sub <= 10:                      # loadN_splat
+            n = 1 << (sub - 7)
+            stack.append(ld(_wrap32(stack.pop()) + ins[2], n) * (16 // n))
+        elif sub == 11:                           # v128.store
+            v = stack.pop()
+            stv(_wrap32(stack.pop()) + ins[2], v)
+        elif sub in (92, 93):                     # load32_zero/load64_zero
+            n = 4 if sub == 92 else 8
+            stack.append(ld(_wrap32(stack.pop()) + ins[2], n)
+                         + b"\x00" * (16 - n))
+        elif 84 <= sub <= 87:                     # loadN_lane
+            n = 1 << (sub - 84)
+            lane = ins[3]
+            v = bytearray(stack.pop())
+            v[lane * n:(lane + 1) * n] = ld(
+                _wrap32(stack.pop()) + ins[2], n)
+            stack.append(bytes(v))
+        elif 88 <= sub <= 91:                     # storeN_lane
+            n = 1 << (sub - 88)
+            lane = ins[3]
+            v = stack.pop()
+            stv(_wrap32(stack.pop()) + ins[2],
+                v[lane * n:(lane + 1) * n])
+        # ---- const / lane shuffles --------------------------------------
+        elif sub == 12:                           # v128.const
+            stack.append(ins[1])
+        elif sub == 13:                           # i8x16.shuffle
+            b2 = stack.pop()
+            a = stack.pop()
+            both = a + b2
+            stack.append(bytes(both[i] for i in ins[1]))
+        elif sub == 14:                           # i8x16.swizzle
+            s = stack.pop()
+            a = stack.pop()
+            stack.append(bytes(a[i] if i < 16 else 0 for i in s))
+        elif 15 <= sub <= 18:                     # int splats
+            dt, mask, n = (("<u1", 0xFF, 16), ("<u2", 0xFFFF, 8),
+                           ("<u4", 0xFFFFFFFF, 4),
+                           ("<u8", (1 << 64) - 1, 2))[sub - 15]
+            stack.append(np.full(n, int(stack.pop()) & mask,
+                                 dt).tobytes())
+        elif sub in (19, 20):                     # float splats
+            dt, n = ("<f4", 4) if sub == 19 else ("<f8", 2)
+            stack.append(np.full(n, float(stack.pop()), dt).tobytes())
+        elif 21 <= sub <= 34:                     # extract/replace lane
+            self._simd_lane(sub, ins[1], stack)
+        # ---- comparisons / bitwise --------------------------------------
+        elif sub in _SIMD_CMP:
+            dt, nm = _SIMD_CMP[sub]
+            b_ = np.frombuffer(stack.pop(), dt)
+            a_ = np.frombuffer(stack.pop(), dt)
+            cond = {"eq": a_ == b_, "ne": a_ != b_, "lt": a_ < b_,
+                    "gt": a_ > b_, "le": a_ <= b_, "ge": a_ >= b_}[nm]
+            lanes = "<i" + (dt[2] if dt[1] != "f"
+                            else ("4" if dt == "<f4" else "8"))
+            stack.append(np.where(cond, -1, 0).astype(lanes).tobytes())
+        elif sub == 77:                           # v128.not
+            x = int.from_bytes(stack.pop(), "little")
+            stack.append((~x & ((1 << 128) - 1)).to_bytes(16, "little"))
+        elif 78 <= sub <= 81:                     # and/andnot/or/xor
+            b_ = int.from_bytes(stack.pop(), "little")
+            a_ = int.from_bytes(stack.pop(), "little")
+            full = (1 << 128) - 1
+            r = {78: a_ & b_, 79: a_ & (~b_ & full), 80: a_ | b_,
+                 81: a_ ^ b_}[sub]
+            stack.append(r.to_bytes(16, "little"))
+        elif sub == 82:                           # bitselect
+            c = int.from_bytes(stack.pop(), "little")
+            b_ = int.from_bytes(stack.pop(), "little")
+            a_ = int.from_bytes(stack.pop(), "little")
+            stack.append(((a_ & c) | (b_ & ~c & ((1 << 128) - 1)))
+                         .to_bytes(16, "little"))
+        elif sub == 83:                           # v128.any_true
+            stack.append(int(stack.pop() != b"\x00" * 16))
+        # ---- integer lane math ------------------------------------------
+        elif sub in _SIMD_IBIN:
+            dt, nm = _SIMD_IBIN[sub]
+            b_ = np.frombuffer(stack.pop(), dt)
+            a_ = np.frombuffer(stack.pop(), dt)
+            if nm in ("add", "sub", "mul"):
+                with np.errstate(over="ignore"):
+                    r = {"add": a_ + b_, "sub": a_ - b_,
+                         "mul": a_ * b_}[nm]
+            elif nm in ("add_sat", "sub_sat"):
+                wide = np.int32 if dt[2] in "12" else np.int64
+                info = np.iinfo(dt[1:])
+                w = (a_.astype(wide) + b_.astype(wide)) if nm[0] == "a" \
+                    else (a_.astype(wide) - b_.astype(wide))
+                r = np.clip(w, info.min, info.max).astype(dt)
+            elif nm == "avgr":
+                r = ((a_.astype(np.uint32) + b_.astype(np.uint32) + 1)
+                     // 2).astype(dt)
+            else:                                 # min / max
+                r = (np.minimum if nm == "min" else np.maximum)(a_, b_)
+            stack.append(r.astype(dt).tobytes())
+        elif sub in _SIMD_IUN:
+            dt, nm = _SIMD_IUN[sub]
+            a_ = np.frombuffer(stack.pop(), dt)
+            if nm == "abs":
+                with np.errstate(over="ignore"):
+                    r = np.abs(a_)                # INT_MIN wraps (spec)
+            elif nm == "neg":
+                with np.errstate(over="ignore"):
+                    r = (0 - a_).astype(dt)
+            else:                                 # popcnt (u8 lanes)
+                r = np.unpackbits(a_).reshape(16, 8).sum(1).astype(dt)
+            stack.append(r.astype(dt).tobytes())
+        elif sub in _SIMD_ALLTRUE:
+            a_ = np.frombuffer(stack.pop(), _SIMD_ALLTRUE[sub])
+            stack.append(int(bool((a_ != 0).all())))
+        elif sub in _SIMD_BITMASK:
+            a_ = np.frombuffer(stack.pop(), _SIMD_BITMASK[sub])
+            stack.append(int(sum(1 << i for i, t
+                                 in enumerate(a_ < 0) if t)))
+        elif sub in _SIMD_SHIFT:
+            dt, nm = _SIMD_SHIFT[sub]
+            bits = int(dt[2]) * 8
+            k = _wrap32(stack.pop()) % bits
+            a_ = np.frombuffer(stack.pop(), dt)
+            with np.errstate(over="ignore"):
+                r = (a_ << k) if nm == "shl" else (a_ >> k)
+            stack.append(r.astype(dt).tobytes())
+        elif sub == 186:                          # i32x4.dot_i16x8_s
+            b_ = np.frombuffer(stack.pop(), "<i2").astype(np.int32)
+            a_ = np.frombuffer(stack.pop(), "<i2").astype(np.int32)
+            stack.append((a_ * b_).reshape(4, 2).sum(1)
+                         .astype("<i4").tobytes())
+        elif sub in _SIMD_NARROW:
+            src, dst = _SIMD_NARROW[sub]
+            info = np.iinfo(dst[1:])
+            b_ = np.frombuffer(stack.pop(), src)
+            a_ = np.frombuffer(stack.pop(), src)
+            r = np.clip(np.concatenate([a_, b_]), info.min, info.max)
+            stack.append(r.astype(dst).tobytes())
+        elif sub in _SIMD_EXTEND:
+            src, dst, half = _SIMD_EXTEND[sub]
+            a_ = np.frombuffer(stack.pop(), src)
+            n = len(a_) // 2
+            part = a_[:n] if half == "low" else a_[n:]
+            stack.append(part.astype(dst).tobytes())
+        # ---- float lane math --------------------------------------------
+        elif sub in _SIMD_FUN:
+            dt, nm = _SIMD_FUN[sub]
+            a_ = np.frombuffer(stack.pop(), dt)
+            with np.errstate(invalid="ignore"):
+                r = {"ceil": np.ceil, "floor": np.floor,
+                     "trunc": np.trunc, "nearest": np.rint,
+                     "abs": np.abs, "neg": np.negative,
+                     "sqrt": np.sqrt}[nm](a_)
+            stack.append(r.astype(dt).tobytes())
+        elif sub in _SIMD_FBIN:
+            dt, nm = _SIMD_FBIN[sub]
+            b_ = np.frombuffer(stack.pop(), dt)
+            a_ = np.frombuffer(stack.pop(), dt)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                if nm == "pmin":
+                    r = np.where(b_ < a_, b_, a_)
+                elif nm == "pmax":
+                    r = np.where(a_ < b_, b_, a_)
+                else:
+                    r = {"add": a_ + b_, "sub": a_ - b_, "mul": a_ * b_,
+                         "div": a_ / b_, "min": np.minimum(a_, b_),
+                         "max": np.maximum(a_, b_)}[nm]
+            stack.append(r.astype(dt).tobytes())
+        # ---- conversions ------------------------------------------------
+        elif sub == 94:                           # f32x4.demote_f64x2_zero
+            a_ = np.frombuffer(stack.pop(), "<f8").astype("<f4")
+            stack.append(a_.tobytes() + b"\x00" * 8)
+        elif sub == 95:                           # f64x2.promote_low_f32x4
+            a_ = np.frombuffer(stack.pop(), "<f4")[:2].astype("<f8")
+            stack.append(a_.tobytes())
+        elif sub in (248, 249, 252, 253):         # trunc_sat variants
+            src = "<f4" if sub in (248, 249) else "<f8"
+            signed = sub in (248, 252)
+            a_ = np.frombuffer(stack.pop(), src).astype(np.float64)
+            a_ = np.where(np.isnan(a_), 0.0, a_)
+            lo, hi = ((-2**31, 2**31 - 1) if signed else (0, 2**32 - 1))
+            r = np.clip(np.trunc(a_), lo, hi)
+            r = r.astype("<i4" if signed else "<u4")
+            if sub in (252, 253):                 # _zero: 2 lanes + zeros
+                stack.append(r.tobytes() + b"\x00" * 8)
+            else:
+                stack.append(r.tobytes())
+        elif sub in (250, 251):                   # f32x4.convert_i32x4
+            dt = "<i4" if sub == 250 else "<u4"
+            a_ = np.frombuffer(stack.pop(), dt).astype("<f4")
+            stack.append(a_.tobytes())
+        elif sub in (254, 255):                   # f64x2.convert_low_i32x4
+            dt = "<i4" if sub == 254 else "<u4"
+            a_ = np.frombuffer(stack.pop(), dt)[:2].astype("<f8")
+            stack.append(a_.tobytes())
+        else:                                     # pragma: no cover
+            raise WasmError(f"unsupported SIMD opcode 0xfd {sub}")
+
+    def _simd_lane(self, sub: int, lane: int, stack: list) -> None:
+        """extract_lane / replace_lane family (subs 21-34)."""
+        spec = {
+            21: ("<i1", "xs"), 22: ("<u1", "xu"), 23: ("<u1", "r"),
+            24: ("<i2", "xs"), 25: ("<u2", "xu"), 26: ("<u2", "r"),
+            27: ("<i4", "xs"), 28: ("<u4", "r"),
+            29: ("<i8", "xs64"), 30: ("<u8", "r"),
+            31: ("<f4", "xf"), 32: ("<f4", "rf"),
+            33: ("<f8", "xf"), 34: ("<f8", "rf"),
+        }[sub]
+        dt, kind = spec
+        if kind.startswith("x"):                  # extract
+            v = np.frombuffer(stack.pop(), dt)
+            x = v[lane]
+            if kind == "xs":
+                stack.append(_wrap32(int(x)))
+            elif kind == "xu":
+                stack.append(int(x))
+            elif kind == "xs64":
+                stack.append(_wrap64(int(x)))
+            else:
+                stack.append(float(x))
+        else:                                     # replace
+            x = stack.pop()
+            v = np.frombuffer(stack.pop(), dt).copy()
+            if kind == "rf":
+                v[lane] = float(x)
+            else:
+                mask = (1 << (int(dt[2]) * 8)) - 1
+                v[lane] = int(x) & mask
+            stack.append(v.tobytes())
 
 
 def instantiate(data: bytes,

@@ -85,6 +85,30 @@ check "orders count" "doc: 3 orders" "$($CLI orders doc 2>/dev/null | head -1)"
 $CLI unset greet >/dev/null
 if $CLI get greet >/dev/null 2>&1; then fail "unset removed key"; else pass "unset removed key"; fi
 
+# --- watch: continuous loop + Ctrl-] abort (interactive, r2 #6) ---------
+$CLI set wkey v0 >/dev/null
+WATCH_OUT=$(mktemp)
+# drive the interactive loop through a pipe: two writes must stream as
+# size:value lines, then the Ctrl-] byte (0x1d) must end the loop
+{
+    sleep 0.4; $CLI set wkey alpha >/dev/null
+    sleep 0.4; $CLI set wkey bravoo >/dev/null
+    sleep 0.4; printf '\035'
+} | $CLI watch wkey >"$WATCH_OUT" 2>/dev/null &
+WATCH_PID=$!
+if wait $WATCH_PID; then
+    grep -q "^5:alpha$" "$WATCH_OUT" && pass "watch streams first change" \
+        || fail "watch missed first change: $(cat "$WATCH_OUT")"
+    grep -q "^6:bravoo$" "$WATCH_OUT" && pass "watch streams second change" \
+        || fail "watch missed second change: $(cat "$WATCH_OUT")"
+else
+    fail "watch did not exit 0 on Ctrl-]"
+fi
+rm -f "$WATCH_OUT"
+
+# --- watch: oneshot timeout --------------------------------------------
+check "watch oneshot timeout" "timeout" "$($CLI watch wkey 60)"
+
 # --- one-shot error discipline -----------------------------------------
 if $CLI get nonexistent >/dev/null 2>&1; then
     fail "missing key must exit nonzero"

@@ -300,3 +300,167 @@ def test_cli_regression_script():
     r = subprocess.run(["sh", str(script)], capture_output=True, text=True,
                        timeout=600, env=env)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+# --------------------------------------------- watch (continuous, r2 #6)
+
+def test_watch_oneshot_timeout(cli, capsys):
+    run, _ = cli
+    run("set", "w", "v0")
+    assert run("watch", "w", "60") == 0
+    assert out_of(capsys).endswith("timeout\n")
+
+
+def test_watch_oneshot_catches_change(cli, capsys):
+    run, name = cli
+    run("set", "w", "v0")
+    st = Store.open(name)
+
+    def writer():
+        import time as _t
+        _t.sleep(0.1)
+        st.set("w", "fresh value")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        assert run("watch", "w", "3000") == 0
+    finally:
+        t.join()
+    st.close()
+    assert "11:fresh value" in out_of(capsys)
+
+
+def test_watch_continuous_streams_until_ctrl_bracket(cli, capsys,
+                                                    monkeypatch):
+    """Continuous loop: multiple changes stream as size:value lines;
+    Ctrl-] (0x1d) on stdin ends the loop — driven through a real pipe
+    exactly like the cli_regression.sh interactive check."""
+    run, name = cli
+    run("set", "w", "v0")
+    st = Store.open(name)
+    r, w = os.pipe()
+    monkeypatch.setattr("sys.stdin", os.fdopen(r, "rb", buffering=0))
+
+    rc_box = {}
+
+    def watcher():
+        rc_box["rc"] = run("watch", "w")
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    try:
+        import time as _t
+        deadline = _t.monotonic() + 5.0
+        st.set("w", "one")
+        st.set("w", "two")                  # may coalesce with "one"
+        while "2:" not in _read_captured(capsys) and \
+                _t.monotonic() < deadline:
+            st.set("w", "two")
+            _t.sleep(0.05)
+        os.write(w, b"\x1d")                # Ctrl-]
+        t.join(timeout=5)
+        assert not t.is_alive(), "watch did not abort on Ctrl-]"
+    finally:
+        os.close(w)
+        if t.is_alive():
+            t.join(timeout=1)
+    st.close()
+    assert rc_box["rc"] == 0
+    assert "3:two" in _CAPTURED["buf"]
+
+
+_CAPTURED = {"buf": ""}
+
+
+def _read_captured(capsys) -> str:
+    out = capsys.readouterr().out
+    _CAPTURED["buf"] += out
+    return _CAPTURED["buf"]
+
+
+def test_watch_group_oneshot(cli, capsys):
+    run, name = cli
+    st = Store.open(name)
+    st.set("g", "x")
+    st.watch_register("g", 5)
+
+    def pulser():
+        import time as _t
+        _t.sleep(0.1)
+        st.bump("g")
+
+    t = threading.Thread(target=pulser)
+    t.start()
+    try:
+        assert run("watch", "@5", "3000") == 0
+    finally:
+        t.join()
+    st.close()
+    assert "group 5 pulsed" in out_of(capsys)
+
+
+def test_watch_oneshot_ignores_stdin_eof(cli, capsys, monkeypatch):
+    """A backgrounded oneshot watch (stdin at EOF, e.g. /dev/null or an
+    exhausted pipe) must honor its bounded wait — EOF-as-abort applies
+    to the continuous loop only (review r3 finding)."""
+    run, name = cli
+    run("set", "w", "v0")
+    r, w = os.pipe()
+    os.close(w)                                # stdin is instantly EOF
+    monkeypatch.setattr("sys.stdin", os.fdopen(r, "rb", buffering=0))
+    st = Store.open(name)
+
+    def writer():
+        import time as _t
+        _t.sleep(0.3)
+        st.set("w", "late")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        assert run("watch", "w", "3000") == 0
+    finally:
+        t.join()
+    st.close()
+    assert "4:late" in out_of(capsys)
+
+
+def test_watch_survives_unset_recreate(cli, capsys, monkeypatch):
+    """unset + re-create may move the key to another slot; the watch
+    loop must re-resolve, not pin a stale slot index."""
+    run, name = cli
+    run("set", "w", "v0")
+    st = Store.open(name)
+    r, w = os.pipe()
+    monkeypatch.setattr("sys.stdin", os.fdopen(r, "rb", buffering=0))
+
+    out_box = {}
+
+    def watcher():
+        out_box["rc"] = run("watch", "w")
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    try:
+        import time as _t
+        _t.sleep(0.1)
+        st.unset("w")
+        # occupy the freed slot region with fresh keys, then re-create
+        for i in range(8):
+            st.set(f"filler/{i}", "x")
+        st.set("w", "reborn")
+        deadline = _t.monotonic() + 5.0
+        while "6:reborn" not in _read_captured(capsys) and \
+                _t.monotonic() < deadline:
+            st.bump("w")
+            _t.sleep(0.05)
+        os.write(w, b"\x1d")
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        os.close(w)
+        if t.is_alive():
+            t.join(timeout=1)
+    st.close()
+    assert "6:reborn" in _CAPTURED["buf"]

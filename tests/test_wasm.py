@@ -313,3 +313,159 @@ class TestStoreHost:
         out = capsys.readouterr().out
         assert "hello wasm" in out and "10" in out
         assert store.get("wkey") == b"hello wasm"
+
+
+# ------------------------------------------------------------ SIMD (v128)
+
+V128 = 0x7B
+
+
+def fd(sub: int, *extra: bytes) -> bytes:
+    return b"\xfd" + uleb(sub) + b"".join(extra)
+
+
+def v128c(raw16: bytes) -> bytes:
+    assert len(raw16) == 16
+    return fd(12, raw16)
+
+
+def memory_module(params, results, body):
+    return module([
+        section(1, vec([functype(params, results)])),
+        section(3, vec([uleb(0)])),
+        section(5, vec([b"\x00" + uleb(1)])),               # 1 page
+        section(7, vec([name("run") + b"\x00" + uleb(0)])),
+        section(10, vec([code_entry([], body)])),
+    ])
+
+
+class TestSimd:
+    def test_i32x4_add_and_extract(self):
+        a = struct.pack("<4i", 1, 2, 3, 4)
+        b = struct.pack("<4i", 10, 20, 30, -40)
+        body = v128c(a) + v128c(b) + fd(174) + fd(27, b"\x03") + END
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [(4 + -40) & 0xFFFFFFFF]
+
+    def test_splat_mul_f32x4(self):
+        body = (b"\x43" + struct.pack("<f", 1.5) + fd(19) +   # splat 1.5
+                b"\x43" + struct.pack("<f", 2.0) + fd(19) +   # splat 2.0
+                fd(230) +                                     # f32x4.mul
+                fd(31, b"\x02") + END)                        # extract lane
+        inst = instantiate(simple_module([], [F32], body))
+        assert inst.invoke("run", []) == [3.0]
+
+    def test_load_store_roundtrip(self):
+        payload = bytes(range(16))
+        body = (i32c(0) + v128c(payload) + fd(11, b"\x00", b"\x00") +
+                i32c(0) + fd(0, b"\x00", b"\x00") +
+                fd(21, b"\x05") + END)          # i8x16.extract_lane_s 5
+        inst = instantiate(memory_module([], [I32], body))
+        assert inst.invoke("run", []) == [5]
+
+    def test_shuffle_reverses(self):
+        a = bytes(range(16))
+        ctl = bytes(range(15, -1, -1))
+        body = (v128c(a) + v128c(b"\xff" * 16) + fd(13, ctl) +
+                fd(22, b"\x00") + END)          # extract_lane_u 0
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [15]
+
+    def test_swizzle_out_of_range_zeroes(self):
+        a = bytes(range(16, 32))
+        idx = bytes([0, 31, 2, 200] + [0] * 12)
+        body = (v128c(a) + v128c(idx) + fd(14) +
+                fd(22, b"\x03") + END)
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [0]    # index 200 -> 0
+
+    def test_saturating_i8_add(self):
+        a = struct.pack("<16b", *([127] * 16))
+        b = struct.pack("<16b", *([1] * 16))
+        body = (v128c(a) + v128c(b) + fd(111) +  # i8x16.add_sat_s
+                fd(21, b"\x00") + END)
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [127]  # clamped, not wrapped
+
+    def test_compare_bitmask_alltrue(self):
+        a = struct.pack("<4i", 5, -1, 7, 0)
+        b = struct.pack("<4i", 4, 0, 9, 1)
+        # gt_s -> lanes (T, F, F, F); bitmask -> 0b0001
+        body = v128c(a) + v128c(b) + fd(59) + fd(164) + END
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [0b0001]
+        # all_true over a vector with one zero lane
+        body2 = v128c(a) + fd(163) + END
+        assert instantiate(
+            simple_module([], [I32], body2)).invoke("run", []) == [0]
+        body3 = v128c(a) + fd(83) + END         # any_true
+        assert instantiate(
+            simple_module([], [I32], body3)).invoke("run", []) == [1]
+
+    def test_shifts(self):
+        a = struct.pack("<4i", -8, 8, 16, 1)
+        body = (v128c(a) + i32c(2) + fd(172) +  # i32x4.shr_s by 2
+                fd(27, b"\x00") + END)
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [(-2) & 0xFFFFFFFF]
+
+    def test_narrow_and_extend(self):
+        a = struct.pack("<8h", 300, -300, 5, 6, 7, 8, 9, 10)
+        body = (v128c(a) + v128c(a) + fd(101) +  # narrow_i16x8_s
+                fd(21, b"\x00") + END)           # 300 clamps to 127
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [127]
+        body2 = (v128c(a) + fd(135) +            # extend_low_i8x16_s
+                 fd(24, b"\x00") + END)          # lane0 of i16x8
+        got = instantiate(
+            simple_module([], [I32], body2)).invoke("run", [])
+        assert got == [struct.unpack("<16b", a)[0] & 0xFFFFFFFF]
+
+    def test_trunc_sat_nan_is_zero(self):
+        a = struct.pack("<4f", float("nan"), 1.9, -2.9, 3e9)
+        body = (v128c(a) + fd(248) +             # i32x4.trunc_sat_f32x4_s
+                fd(27, b"\x00") + END)
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [0]
+        body2 = v128c(a) + fd(248) + fd(27, b"\x03") + END
+        assert instantiate(simple_module([], [I32], body2)).invoke(
+            "run", []) == [2**31 - 1]            # 3e9 saturates
+
+    def test_v128_local_defaults_zero(self):
+        body = (LOCAL_GET(0) + fd(83) + END)     # any_true(zero) == 0
+        inst = instantiate(simple_module([], [I32], body,
+                                         locals_=[(1, V128)]))
+        assert inst.invoke("run", []) == [0]
+
+    def test_dot_product(self):
+        a = struct.pack("<8h", 1, 2, 3, 4, 5, 6, 7, 8)
+        b = struct.pack("<8h", 1, 1, 1, 1, 1, 1, 1, 1)
+        body = v128c(a) + v128c(b) + fd(186) + fd(27, b"\x00") + END
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [3]     # 1*1 + 2*1
+
+    def test_bitselect(self):
+        a = b"\xaa" * 16
+        b = b"\x55" * 16
+        c = b"\xf0" * 16
+        body = (v128c(a) + v128c(b) + v128c(c) + fd(82) +
+                fd(22, b"\x00") + END)
+        inst = instantiate(simple_module([], [I32], body))
+        assert inst.invoke("run", []) == [(0xAA & 0xF0) | (0x55 & 0x0F)]
+
+    def test_unsupported_simd_tail_raises(self):
+        body = v128c(b"\x00" * 16) + v128c(b"\x00" * 16) + fd(156) + END
+        with pytest.raises(WasmError, match="SIMD"):
+            instantiate(simple_module([], [I32], body))
+
+    def test_lane_immediate_out_of_range_rejected(self):
+        body = v128c(b"\x00" * 16) + fd(27, b"\x09") + END
+        with pytest.raises(WasmError, match="lane 9 out of range"):
+            instantiate(simple_module([], [I32], body))
+
+    def test_shuffle_control_out_of_range_rejected(self):
+        ctl = bytes([40] + [0] * 15)
+        body = (v128c(b"\x00" * 16) + v128c(b"\x00" * 16) +
+                fd(13, ctl) + fd(22, b"\x00") + END)
+        with pytest.raises(WasmError, match="shuffle lane"):
+            instantiate(simple_module([], [I32], body))

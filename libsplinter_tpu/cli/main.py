@@ -300,7 +300,9 @@ def cmd_watch(ses, args):
     timeout = int(args[1]) if len(args) > 1 else None
     if timeout is not None:
         oneshot = True
-    bounded = timeout if timeout is not None else 100
+    # continuous loop: short waits so the Ctrl-]/EOF abort check runs;
+    # oneshot with no TIMEOUT_MS: block indefinitely for the first event
+    bounded = timeout if timeout is not None else (-1 if oneshot else 100)
 
     import contextlib
     import select
@@ -374,7 +376,10 @@ def cmd_watch(ses, args):
                     e = ses.store.epoch_at(idx)
                     if e == e_last or (e & 1):
                         return False
-                    val = ses.store.get(key).rstrip(b"\0")
+                    # exact bytes, no trimming: the size:value framing
+                    # must match value_len for piped consumers, and
+                    # binary values may legitimately end in NULs
+                    val = ses.store.get(key)
                 except KeyError:
                     return False              # vanished: caller decides
                 e_last = e

@@ -23,12 +23,13 @@ from .main import CliError, command
 
 @command("search", "search [--json] [--limit N] [--similarity S] "
          "[--distance D] [--bloom MASK] [--regex RX] [--timeout MS] "
-         "[--cpu] [--sharded] QUERY...",
-         "semantic vector search (TPU top-k)")
+         "[--cpu] [--sharded] [--fast] QUERY...",
+         "semantic vector search (TPU top-k; --fast = bf16 MXU scoring, "
+         "2x kernel throughput, ~2e-2 score precision)")
 def cmd_search(ses, args):
     opts = {"json": False, "limit": 10, "similarity": None,
             "distance": None, "bloom": 0, "regex": None, "timeout": 2000,
-            "cpu": False, "sharded": False}
+            "cpu": False, "sharded": False, "fast": False}
     query_words = []
     it = iter(args)
 
@@ -46,6 +47,11 @@ def cmd_search(ses, args):
                 opts["cpu"] = True
             elif a == "--sharded":
                 opts["sharded"] = True
+            elif a == "--fast":
+                # bf16 MXU scoring (pallas path only): 2x matmul
+                # throughput, scores good to ~2e-2 absolute — fine for
+                # ranking; --similarity thresholds should allow slack
+                opts["fast"] = True
             elif a == "--limit":
                 opts["limit"] = int(arg_of(a))
             elif a == "--similarity":
@@ -145,7 +151,8 @@ def cmd_search(ses, args):
         fetch_k = _bucket(opts["limit"] + (8 if opts["regex"] else 4))
         while True:
             hits = ses.pod_search.search(qvec, fetch_k, mask=mask,
-                                         use_pallas=use_pallas)
+                                         use_pallas=use_pallas,
+                                         mxu_bf16=opts["fast"])
             rows.clear()
             satisfied = False
             for h in hits:
@@ -173,8 +180,9 @@ def cmd_search(ses, args):
         # device-resident lane cache: full upload on the session's first
         # search, O(dirty rows) re-staging afterwards (VERDICT r1 item 2)
         lane = ses.lane.refresh()
-        scores = np.asarray(cosine_scores(lane, qvec, mask,
-                                          use_pallas=use_pallas))[:, 0]
+        scores = np.asarray(cosine_scores(
+            lane, qvec, mask, use_pallas=use_pallas,
+            mxu_bf16=opts["fast"], vnorm=ses.lane.norms))[:, 0]
         dists = np.asarray(euclidean_distances(lane, qvec, mask))[:, 0]
         order = np.argsort(-scores)
         for i in order:

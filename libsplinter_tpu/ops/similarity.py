@@ -63,7 +63,10 @@ def _scores_kernel(vec_ref, q_ref, qnorm_ref, mask_ref, out_ref, *,
     vnorm = jnp.sqrt(jnp.sum(v * v, axis=1, keepdims=True))       # (TN,1)
     denom = jnp.maximum(vnorm * qnorm_ref[:], 1e-12)              # (TN,Q)
     cos = dots / denom
-    keep = mask_ref[:] > 0.0                                      # (TN,1)
+    # zero rows (un-embedded slots) are excluded HERE, from the norm the
+    # kernel already computed in VMEM — a host-side nonzero pre-pass
+    # would re-read the whole lane from HBM per query
+    keep = (mask_ref[:] > 0.0) & (vnorm > 0.0)                    # (TN,1)
     out_ref[:] = jnp.where(keep, cos, NEG_INF)
 
 
@@ -95,22 +98,31 @@ def _cosine_scores_pallas(vectors, queries, mask, *, block_n: int,
     )(vectors, queries, qnorm, mask)
 
 
-def _cosine_scores_jnp(vectors, queries, mask):
+def _cosine_scores_jnp(vectors, queries, mask, vnorm=None):
     dots = vectors @ queries.T
-    vnorm = jnp.linalg.norm(vectors, axis=-1, keepdims=True)
+    if vnorm is None:
+        vnorm = jnp.linalg.norm(vectors, axis=-1, keepdims=True)
+    else:
+        vnorm = jnp.asarray(vnorm, jnp.float32).reshape(-1, 1)
     qnorm = jnp.linalg.norm(queries, axis=-1, keepdims=True).T
     cos = dots / jnp.maximum(vnorm * qnorm, 1e-12)
-    return jnp.where(mask > 0.0, cos, NEG_INF)
+    keep = (mask > 0.0) & (vnorm > 0.0)   # zero rows: never candidates
+    return jnp.where(keep, cos, NEG_INF)
 
 
 def cosine_scores(vectors, queries, mask=None, *, block_n: int = 1024,
                   use_pallas: bool | None = None,
-                  mxu_bf16: bool = False) -> jnp.ndarray:
+                  mxu_bf16: bool = False, vnorm=None) -> jnp.ndarray:
     """(N, D) vectors x (Q, D) queries -> (N, Q) cosine scores.
 
     mask: optional (N,) {0,1} prefilter (bloom/regex filtered candidates);
     filtered rows score NEG_INF.  Rows of all zeros (empty slots) also
-    score NEG_INF via the norm guard + explicit zero-row mask.
+    score NEG_INF — the exclusion comes from the row norm, computed
+    in-kernel (pallas) or from `vnorm` when the caller staged it.
+    vnorm: optional precomputed (N,) row L2 norms (lane-static data — a
+    StagedLane maintains them O(dirty) so repeated queries skip the
+    full-lane norm pass; ignored by the pallas path, whose kernel gets
+    the norms for free from the VMEM tile).
     mxu_bf16 (pallas path only, opt-in): bf16 matmul inputs, f32
     accumulation — 2x MXU throughput at ~2e-2 absolute score error.
     Ranking-equivalent in practice, but absolute scores feed user-facing
@@ -125,14 +137,14 @@ def cosine_scores(vectors, queries, mask=None, *, block_n: int = 1024,
         mask_col = jnp.ones((n, 1), jnp.float32)
     else:
         mask_col = jnp.asarray(mask, jnp.float32).reshape(n, 1)
-    # zero vectors (un-embedded slots) are never candidates
-    nonzero = (jnp.abs(vectors).max(axis=1, keepdims=True) > 0)
-    mask_col = mask_col * nonzero.astype(jnp.float32)
+    # zero-vector (un-embedded slot) exclusion happens inside the score
+    # computation from the row norms it already needs — no extra
+    # full-lane pass here
 
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if not use_pallas:
-        return _cosine_scores_jnp(vectors, queries, mask_col)
+        return _cosine_scores_jnp(vectors, queries, mask_col, vnorm)
 
     # pad N to the block, Q to the lane width, D to 128 for clean tiling
     q = queries.shape[0]
@@ -165,25 +177,45 @@ def euclidean_distances(vectors, queries, mask=None) -> jnp.ndarray:
     return dist
 
 
+@functools.lru_cache(maxsize=32)
+def _topk_fn(k: int, batch: bool, use_pallas: bool, mxu_bf16: bool):
+    """One jitted program for score + top-k: the eager per-op dispatch
+    over an (N, D) lane costs more than the math on CPU (and leaves
+    fusion on the table on TPU), so the whole path compiles once per
+    (k, flags) and is cached."""
+
+    def run(vectors, queries, mask, vnorm):
+        scores = cosine_scores(vectors, queries, mask,
+                               use_pallas=use_pallas, mxu_bf16=mxu_bf16,
+                               vnorm=vnorm)
+        if batch:
+            return jax.lax.top_k(scores.T, k)
+        return jax.lax.top_k(scores[:, 0], k)
+
+    return jax.jit(run)
+
+
 def cosine_topk(vectors, query, k: int, mask=None, *,
-                use_pallas: bool | None = None, mxu_bf16: bool = False
-                ) -> tuple[np.ndarray, np.ndarray]:
+                use_pallas: bool | None = None, mxu_bf16: bool = False,
+                vnorm=None) -> tuple[np.ndarray, np.ndarray]:
     """Top-k most-similar rows for one query.  Returns (scores, indices),
     scores NEG_INF-padded when fewer than k candidates exist."""
-    scores = cosine_scores(vectors, query, mask, use_pallas=use_pallas,
-                           mxu_bf16=mxu_bf16)
-    s = scores[:, 0]
-    k = min(k, s.shape[0])
-    top_s, top_i = jax.lax.top_k(s, k)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    k = min(k, int(np.asarray(vectors.shape[0])))
+    fn = _topk_fn(k, False, bool(use_pallas), bool(mxu_bf16))
+    top_s, top_i = fn(vectors, query, mask, vnorm)
     return np.asarray(top_s), np.asarray(top_i)
 
 
 def cosine_topk_batch(vectors, queries, k: int, mask=None, *,
-                      use_pallas: bool | None = None, mxu_bf16: bool = False
+                      use_pallas: bool | None = None,
+                      mxu_bf16: bool = False, vnorm=None
                       ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k per query.  Returns (Q, k) scores and indices."""
-    scores = cosine_scores(vectors, queries, mask, use_pallas=use_pallas,
-                           mxu_bf16=mxu_bf16)
-    k = min(k, scores.shape[0])
-    top_s, top_i = jax.lax.top_k(scores.T, k)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    k = min(k, int(np.asarray(vectors.shape[0])))
+    fn = _topk_fn(k, True, bool(use_pallas), bool(mxu_bf16))
+    top_s, top_i = fn(vectors, queries, mask, vnorm)
     return np.asarray(top_s), np.asarray(top_i)

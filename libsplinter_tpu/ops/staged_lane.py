@@ -72,6 +72,7 @@ class StagedLane:
         self._st = store
         self._device = device
         self._arr = None                 # jax.Array (nslots, dim) f32
+        self._norms = None               # jax.Array (nslots,) f32
         self._staged = None              # np.uint64 epoch per staged row
         # transfer accounting (tests + perf docs read these)
         self.full_uploads = 0
@@ -89,6 +90,11 @@ class StagedLane:
         stable = (e1 == e2) & ((e1 & 1) == 0)
         dev = self._device or jax.devices()[0]
         self._arr = jax.device_put(lane, dev)
+        # row norms are lane-static: maintained here (full pass on
+        # upload, O(dirty) on refresh) so queries never pay a full-lane
+        # norm pass (ops.similarity's vnorm fast path)
+        self._norms = jax.device_put(
+            np.linalg.norm(lane, axis=1).astype(np.float32), dev)
         # rows that moved mid-copy get an odd sentinel so the next
         # refresh re-stages them (a stable epoch is always even)
         self._staged = np.where(stable, e1, np.uint64(1))
@@ -119,6 +125,10 @@ class StagedLane:
                 vals_p[:n] = vecs[ok]
                 vals_p[n:] = vecs[ok][0]
                 self._arr = _scatter_fn()(self._arr, rows_p, vals_p)
+                norms_p = np.linalg.norm(vals_p, axis=1) \
+                    .astype(np.float32)
+                self._norms = _scatter_fn()(self._norms, rows_p,
+                                            norms_p)
                 self._staged[rows] = eps[ok]
                 self.rows_staged += n
             # torn rows: staged epoch untouched -> still dirty next pass
@@ -131,9 +141,17 @@ class StagedLane:
             self._full_upload()
         return self._arr
 
+    @property
+    def norms(self):
+        """Device (nslots,) row L2 norms of the last staged state."""
+        if self._arr is None:
+            self._full_upload()
+        return self._norms
+
     def invalidate(self) -> None:
         """Drop the device copy (next use re-uploads in full)."""
         self._arr = None
+        self._norms = None
         self._staged = None
 
     # -- queries -----------------------------------------------------------
@@ -143,9 +161,13 @@ class StagedLane:
         Same contract as ops.similarity.cosine_topk."""
         from .similarity import cosine_topk
 
-        return cosine_topk(self.refresh(), query, k, mask, **kw)
+        arr = self.refresh()
+        kw.setdefault("vnorm", self._norms)
+        return cosine_topk(arr, query, k, mask, **kw)
 
     def scores(self, queries, mask=None, **kw):
         from .similarity import cosine_scores
 
-        return cosine_scores(self.refresh(), queries, mask, **kw)
+        arr = self.refresh()
+        kw.setdefault("vnorm", self._norms)
+        return cosine_scores(arr, queries, mask, **kw)

@@ -19,14 +19,16 @@ from .mesh import shard_map
 
 @functools.lru_cache(maxsize=64)
 def _topk_program(mesh: Mesh, axis: str, local_n: int, d: int, nq: int,
-                  k_local: int, k_final: int, use_pallas: bool):
+                  k_local: int, k_final: int, use_pallas: bool,
+                  mxu_bf16: bool = False):
     """Compiled sharded top-k, cached per (mesh, shapes, k) so repeated
     queries from a live session don't re-trace/re-compile."""
 
     def local_then_merge(v_local, q, m_local):
         # local fused scores + top-k on this shard
         scores = cosine_scores(v_local, q, m_local,
-                               use_pallas=use_pallas)
+                               use_pallas=use_pallas,
+                               mxu_bf16=mxu_bf16)
         s, i = jax.lax.top_k(scores[:, 0], k_local)
         # globalize indices by shard offset
         shard = jax.lax.axis_index(axis)
@@ -47,7 +49,8 @@ def _topk_program(mesh: Mesh, axis: str, local_n: int, d: int, nq: int,
 
 
 def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
-                 axis: str = "dp", use_pallas: bool | None = None
+                 axis: str = "dp", use_pallas: bool | None = None,
+                 mxu_bf16: bool = False
                  ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k over row-sharded vectors.
 
@@ -71,7 +74,8 @@ def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
     if query.ndim == 1:
         query = query[None, :]
     fn = _topk_program(mesh, axis, local_n, d, query.shape[0],
-                       k_local, k_final, bool(use_pallas))
+                       k_local, k_final, bool(use_pallas),
+                       bool(mxu_bf16))
     s, i = fn(jnp.asarray(vectors, jnp.float32), query,
               jnp.asarray(mask, jnp.float32))
     return np.asarray(s), np.asarray(i)
@@ -278,7 +282,8 @@ class PodSearch:
     # -- query -------------------------------------------------------------
 
     def search(self, query, k: int, *, mask=None, refresh: bool = True,
-               use_pallas: bool | None = None) -> list[dict]:
+               use_pallas: bool | None = None,
+               mxu_bf16: bool = False) -> list[dict]:
         """Global top-k.  Returns [{host, slot, key, similarity}, ...]
         sorted by similarity desc.  mask: optional per-host (nslots,)
         {0,1} candidate prefilter (bloom/regex/scratch exclusion),
@@ -289,7 +294,7 @@ class PodSearch:
         gmask = self._global_mask(mask)
         s, gi = sharded_topk(self.mesh, self._arr, query, k,
                              mask=gmask, axis=self.axis,
-                             use_pallas=use_pallas)
+                             use_pallas=use_pallas, mxu_bf16=mxu_bf16)
         keep = s > -1e29
         s, gi = s[keep], gi[keep]
         keys = self._resolve_keys(gi)

@@ -132,3 +132,33 @@ class TestStagedLane:
         lane.invalidate()
         lane.refresh()
         assert lane.full_uploads == 2
+
+
+class TestNorms:
+    def test_norms_track_incremental_updates(self, store):
+        """Row norms are lane-static data maintained at stage time
+        (full pass on upload, O(dirty) on refresh) — they must match a
+        fresh host computation after incremental writes."""
+        dim = store.vec_dim
+        _fill(store, 12, dim)
+        lane = StagedLane(store)
+        lane.refresh()
+        want = np.linalg.norm(np.array(store.vectors), axis=1)
+        np.testing.assert_allclose(np.asarray(lane.norms), want,
+                                   rtol=1e-6)
+        store.vec_set("doc/4", np.full(dim, 3.0, np.float32))
+        lane.refresh()
+        assert lane.full_uploads == 1          # incremental, not re-upload
+        want = np.linalg.norm(np.array(store.vectors), axis=1)
+        np.testing.assert_allclose(np.asarray(lane.norms), want,
+                                   rtol=1e-6)
+
+    def test_topk_uses_staged_norms(self, store):
+        dim = store.vec_dim
+        _fill(store, 8, dim)
+        lane = StagedLane(store)
+        slot = store.find_index("doc/3")
+        q = np.array(store.vectors)[slot]
+        s, i = lane.topk(q, k=1)
+        assert int(i[0]) == slot
+        assert s[0] == pytest.approx(1.0, abs=1e-5)

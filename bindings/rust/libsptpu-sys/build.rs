@@ -1,12 +1,14 @@
 fn main() {
     println!("cargo:rerun-if-changed=csrc/store.c");
     println!("cargo:rerun-if-changed=csrc/coord.c");
+    println!("cargo:rerun-if-changed=csrc/wptok.c");
     println!("cargo:rerun-if-changed=csrc/internal.h");
     println!("cargo:rerun-if-changed=csrc/sptpu.h");
 
     cc::Build::new()
         .file("csrc/store.c")
         .file("csrc/coord.c")
+        .file("csrc/wptok.c")
         .include("csrc")
         .flag_if_supported("-std=c11")
         .flag_if_supported("-pthread")

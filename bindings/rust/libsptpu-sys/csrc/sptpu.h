@@ -308,6 +308,36 @@ int spt_vec_gather(spt_store *st, const uint32_t *rows, uint32_t n,
 /* ---- diagnostics ------------------------------------------------------- */
 int spt_report_parse_failure(spt_store *st);
 
+/* ---- host tokenizer (wptok.c) ------------------------------------------
+ * Native tokenization for the embedding daemon's hot path (the
+ * reference tokenizes natively via llama.cpp, splinference.cpp:209-217).
+ * ASCII fast path: inputs with bytes >= 0x80 return -EDOM and the
+ * Python caller falls back to its full-Unicode implementation. */
+typedef struct spt_wptok spt_wptok;
+
+/* WordPiece over a BERT-family vocab (greedy longest-match, "##"
+ * continuations, optional ASCII lowercasing).  Requires [CLS]/[SEP]/
+ * [UNK] in the vocab ([PAD] defaults to id 0); returns NULL otherwise. */
+spt_wptok *spt_wptok_create(const char *const *tokens, uint32_t n_tokens,
+                            int lower);
+/* Hashed-vocabulary fallback: word -> 4 + fnv1a64(word) % (vocab-4);
+ * ids 0..3 = PAD/CLS/SEP/UNK.  Mirrors models/tokenizer.HashTokenizer. */
+spt_wptok *spt_wptok_create_hashed(uint32_t vocab_size, int lower);
+void spt_wptok_destroy(spt_wptok *t);
+
+/* Encode one text: out = [CLS] ids... [SEP].  Returns the id count,
+ * -EDOM for non-ASCII input (use the host-language fallback), -ERANGE
+ * when cap is too small (cap >= strlen(text)+3 always suffices). */
+int spt_wptok_encode(const spt_wptok *t, const char *text, uint32_t *out,
+                     uint32_t cap);
+/* Encode+pad a batch into ids (count x max_len, padded with [PAD]) and
+ * lens (count).  Rows the fast path cannot handle (non-ASCII) get
+ * lens[i] = UINT32_MAX and an all-PAD row — re-encode those in the
+ * caller.  Truncation keeps the trailing [SEP] (tokenizer.py parity). */
+int spt_wptok_encode_batch(const spt_wptok *t, const char *const *texts,
+                           uint32_t count, uint32_t max_len,
+                           uint32_t *ids, uint32_t *lens);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,323 @@
+/* wptok.c — native host tokenizer for the embedding engine.
+ *
+ * The reference tokenizes in native code via llama.cpp's C tokenizer
+ * (splinference.cpp:209-217).  The TPU framework's embedding daemon
+ * must feed a chip that sustains >10k embeddings/sec; a pure-Python
+ * WordPiece loop tops out around 3-24k texts/sec and becomes the
+ * pipeline bottleneck, so the hot path lives here:
+ *
+ *   - WordPiece mode: greedy longest-match-first with "##"
+ *     continuations over a caller-supplied vocab (BERT family), exact
+ *     parity with models/tokenizer.py's pure-Python implementation;
+ *   - hashed mode: FNV-1a 64 word hashing into [4, vocab) — parity
+ *     with HashTokenizer, the no-vocab fallback;
+ *   - batch API: one call tokenizes + pads a whole micro-batch
+ *     (ctypes releases the GIL for the duration).
+ *
+ * ASCII fast path by contract: inputs containing bytes >= 0x80 return
+ * -EDOM and the Python caller falls back to its full-Unicode
+ * implementation (NFD strip, Unicode categories).  The split rules
+ * below mirror Python str semantics exactly for ASCII:
+ *   space = 0x09..0x0D, 0x1C..0x1F, 0x20   (str.isspace)
+ *   punct = 33..47, 58..64, 91..96, 123..126
+ *   other control bytes join words (same as Python, where category Cc
+ *   is neither space nor punctuation)
+ */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "sptpu.h"
+
+#define WPT_MAX_WORD 100u        /* chars per word before UNK (Python parity) */
+
+typedef struct {
+  uint32_t off;                  /* into blob */
+  uint32_t id;
+  uint16_t len;
+  uint16_t used;
+} wpt_entry;
+
+struct spt_wptok {
+  /* wordpiece mode */
+  char *blob;                    /* all vocab bytes, concatenated */
+  wpt_entry *table;              /* open-addressing, power-of-2 */
+  uint32_t cap;                  /* table capacity */
+  /* both modes */
+  uint32_t vocab_size;
+  uint32_t cls_id, sep_id, pad_id, unk_id;
+  int lower;
+  int hashed;                    /* 1 = FNV word-hash mode, no vocab */
+};
+
+static inline int wpt_isspace(unsigned char c) {
+  return (c >= 0x09 && c <= 0x0D) || (c >= 0x1C && c <= 0x20);
+}
+
+static inline int wpt_ispunct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+static inline uint64_t fnv1a64(const char *s, size_t n, uint64_t h) {
+  for (size_t i = 0; i < n; i++)
+    h = (h ^ (unsigned char)s[i]) * 0x100000001b3ULL;
+  return h;
+}
+#define FNV_BASIS 0xcbf29ce484222325ULL
+
+/* -------------------------------------------------------------- lookup */
+
+static int wpt_find(const spt_wptok *t, const char *piece, size_t len,
+                    int continuation, uint32_t *id_out) {
+  uint64_t h = FNV_BASIS;
+  if (continuation) h = fnv1a64("##", 2, h);
+  h = fnv1a64(piece, len, h);
+  size_t total = len + (continuation ? 2 : 0);
+  uint32_t mask = t->cap - 1;
+  for (uint32_t i = (uint32_t)h & mask;; i = (i + 1) & mask) {
+    const wpt_entry *e = &t->table[i];
+    if (!e->used) return 0;
+    if (e->len == total) {
+      const char *tok = t->blob + e->off;
+      if (continuation) {
+        if (tok[0] == '#' && tok[1] == '#' &&
+            memcmp(tok + 2, piece, len) == 0) {
+          *id_out = e->id;
+          return 1;
+        }
+      } else if (memcmp(tok, piece, len) == 0) {
+        *id_out = e->id;
+        return 1;
+      }
+    }
+  }
+}
+
+static int wpt_insert(spt_wptok *t, const char *tok, size_t len,
+                      uint32_t id, uint32_t off) {
+  uint64_t h = fnv1a64(tok, len, FNV_BASIS);
+  uint32_t mask = t->cap - 1;
+  for (uint32_t i = (uint32_t)h & mask;; i = (i + 1) & mask) {
+    wpt_entry *e = &t->table[i];
+    if (!e->used) {
+      e->off = off;
+      e->len = (uint16_t)len;
+      e->id = id;
+      e->used = 1;
+      return 0;
+    }
+    /* duplicate tokens: first id wins (dict semantics differ — Python
+     * keeps the LAST duplicate's index; real vocabs have no dups, and
+     * the tokenizer_golden tests pin the behavior on trained vocabs */
+    if (e->len == len && memcmp(t->blob + e->off, tok, len) == 0)
+      return 0;
+  }
+}
+
+/* ------------------------------------------------------------ creation */
+
+void spt_wptok_destroy(spt_wptok *t) {
+  if (!t) return;
+  free(t->blob);
+  free(t->table);
+  free(t);
+}
+
+spt_wptok *spt_wptok_create(const char *const *tokens, uint32_t n,
+                            int lower) {
+  if (!tokens || n == 0) return NULL;
+  spt_wptok *t = calloc(1, sizeof(*t));
+  if (!t) return NULL;
+  t->lower = lower;
+  t->vocab_size = n;
+  t->hashed = 0;
+
+  size_t blob_sz = 0;
+  for (uint32_t i = 0; i < n; i++) blob_sz += strlen(tokens[i]);
+  t->blob = malloc(blob_sz ? blob_sz : 1);
+  uint32_t cap = 16;
+  while (cap < 2 * n) cap <<= 1;
+  t->cap = cap;
+  t->table = calloc(cap, sizeof(wpt_entry));
+  if (!t->blob || !t->table) {
+    spt_wptok_destroy(t);
+    return NULL;
+  }
+
+  t->cls_id = t->sep_id = t->unk_id = UINT32_MAX;
+  t->pad_id = 0;
+  uint32_t off = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    size_t len = strlen(tokens[i]);
+    if (len > UINT16_MAX) {
+      spt_wptok_destroy(t);
+      return NULL;
+    }
+    memcpy(t->blob + off, tokens[i], len);
+    wpt_insert(t, t->blob + off, len, i, off);
+    if (len == 5 && memcmp(tokens[i], "[CLS]", 5) == 0) t->cls_id = i;
+    if (len == 5 && memcmp(tokens[i], "[SEP]", 5) == 0) t->sep_id = i;
+    if (len == 5 && memcmp(tokens[i], "[UNK]", 5) == 0) t->unk_id = i;
+    if (len == 5 && memcmp(tokens[i], "[PAD]", 5) == 0) t->pad_id = i;
+    off += (uint32_t)len;
+  }
+  if (t->cls_id == UINT32_MAX || t->sep_id == UINT32_MAX ||
+      t->unk_id == UINT32_MAX) {
+    spt_wptok_destroy(t);          /* not a BERT-family vocab */
+    return NULL;
+  }
+  return t;
+}
+
+spt_wptok *spt_wptok_create_hashed(uint32_t vocab_size, int lower) {
+  if (vocab_size < 8) return NULL;
+  spt_wptok *t = calloc(1, sizeof(*t));
+  if (!t) return NULL;
+  t->hashed = 1;
+  t->lower = lower;
+  t->vocab_size = vocab_size;
+  t->pad_id = 0;
+  t->cls_id = 1;
+  t->sep_id = 2;
+  t->unk_id = 3;
+  return t;
+}
+
+/* ------------------------------------------------------------ encoding */
+
+static uint32_t hash_word_id(const spt_wptok *t, const char *w,
+                             size_t len) {
+  uint64_t h = fnv1a64(w, len, FNV_BASIS);
+  return 4u + (uint32_t)(h % (uint64_t)(t->vocab_size - 4));
+}
+
+/* emit ids for one word; returns count written (<= word len), cap
+ * pre-checked by caller */
+static uint32_t encode_word(const spt_wptok *t, const char *w,
+                            size_t len, uint32_t *out) {
+  if (t->hashed) {
+    out[0] = hash_word_id(t, w, len);
+    return 1;
+  }
+  if (len > WPT_MAX_WORD) {
+    out[0] = t->unk_id;
+    return 1;
+  }
+  uint32_t n = 0;
+  size_t start = 0;
+  while (start < len) {
+    size_t end = len;
+    uint32_t id = 0;
+    int found = 0;
+    while (end > start) {
+      if (wpt_find(t, w + start, end - start, start > 0, &id)) {
+        found = 1;
+        break;
+      }
+      end--;
+    }
+    if (!found) {                 /* whole word becomes UNK */
+      out[0] = t->unk_id;
+      return 1;
+    }
+    out[n++] = id;
+    start = end;
+  }
+  return n;
+}
+
+int spt_wptok_encode(const spt_wptok *t, const char *text, uint32_t *out,
+                     uint32_t cap) {
+  if (!t || !text || !out) return -EINVAL;
+  size_t tlen = strlen(text);
+  for (size_t i = 0; i < tlen; i++)
+    if ((unsigned char)text[i] >= 0x80) return -EDOM;
+  if (cap < 2) return -ERANGE;
+
+  uint32_t n = 0;
+  out[n++] = t->cls_id;
+  char word[WPT_MAX_WORD + 2];
+  size_t wlen = 0;
+  int overlong = 0;
+
+  for (size_t i = 0; i <= tlen; i++) {
+    unsigned char c = i < tlen ? (unsigned char)text[i] : ' ';
+    if (t->lower && c >= 'A' && c <= 'Z') c += 32;
+    if (wpt_isspace(c) || wpt_ispunct(c)) {
+      if (wlen || overlong) {
+        if (n + (overlong ? 1 : wlen) + 1 > cap) return -ERANGE;
+        if (overlong)
+          out[n++] = t->unk_id;   /* only wordpiece mode reaches this:
+                                   * hashed overlong returned -EDOM */
+        else
+          n += encode_word(t, word, wlen, out + n);
+        wlen = 0;
+        overlong = 0;
+      }
+      if (wpt_ispunct(c)) {
+        if (n + 2 > cap) return -ERANGE;
+        char pc = (char)c;
+        n += encode_word(t, &pc, 1, out + n);
+      }
+    } else {
+      if (wlen >= WPT_MAX_WORD) {
+        /* words beyond the bound: wordpiece mode maps them to UNK;
+         * hashed mode must hash the FULL word, so overflow falls back
+         * (caller re-encodes in Python — rare pathological input) */
+        if (t->hashed) return -EDOM;
+        overlong = 1;
+        wlen = 0;                 /* keep scanning to the boundary */
+      }
+      if (!overlong) word[wlen++] = (char)c;
+    }
+  }
+  if (n + 1 > cap) return -ERANGE;
+  out[n++] = t->sep_id;
+  return (int)n;
+}
+
+int spt_wptok_encode_batch(const spt_wptok *t, const char *const *texts,
+                           uint32_t count, uint32_t max_len,
+                           uint32_t *ids, uint32_t *lens) {
+  if (!t || !texts || !ids || !lens || max_len < 2) return -EINVAL;
+  /* scratch big enough for any outcome before truncation */
+  uint32_t scratch_cap = 4096;
+  uint32_t *scratch = malloc(scratch_cap * sizeof(uint32_t));
+  if (!scratch) return -ENOMEM;
+
+  for (uint32_t i = 0; i < count; i++) {
+    size_t need = strlen(texts[i]) + 3;
+    if (need > scratch_cap) {
+      uint32_t nc = scratch_cap;
+      while (nc < need) nc *= 2;
+      uint32_t *ns = realloc(scratch, nc * sizeof(uint32_t));
+      if (!ns) {
+        free(scratch);
+        return -ENOMEM;
+      }
+      scratch = ns;
+      scratch_cap = nc;
+    }
+    int rc = spt_wptok_encode(t, texts[i], scratch, scratch_cap);
+    uint32_t *row = ids + (size_t)i * max_len;
+    if (rc < 0) {
+      /* -EDOM (non-ASCII): mark for the caller's Python fallback */
+      lens[i] = UINT32_MAX;
+      for (uint32_t j = 0; j < max_len; j++) row[j] = t->pad_id;
+      continue;
+    }
+    uint32_t n = (uint32_t)rc;
+    if (n > max_len) {            /* truncate, keep trailing SEP */
+      n = max_len;
+      scratch[max_len - 1] = t->sep_id;
+    }
+    memcpy(row, scratch, n * sizeof(uint32_t));
+    for (uint32_t j = n; j < max_len; j++) row[j] = t->pad_id;
+    lens[i] = n;
+  }
+  free(scratch);
+  return 0;
+}

@@ -133,6 +133,13 @@ const SYMBOLS: Record<string, { args: string[]; ret: string }> = {
   spt_bus_wait: { args: ["p", "i32"], ret: "i32" },
   spt_bus_close: { args: ["p"], ret: "i32" },
   spt_bus_drain: { args: ["p", "b"], ret: "i32" },
+  // host tokenizer (wptok.c): WordPiece / hashed fast path
+  spt_wptok_create: { args: ["p", "u32", "i32"], ret: "p" },
+  spt_wptok_create_hashed: { args: ["u32", "i32"], ret: "p" },
+  spt_wptok_destroy: { args: ["p"], ret: "void" },
+  spt_wptok_encode: { args: ["p", "b", "b", "u32"], ret: "i32" },
+  spt_wptok_encode_batch: { args: ["p", "p", "u32", "u32", "b", "b"],
+    ret: "i32" },
 };
 
 const enc = new TextEncoder();
@@ -179,6 +186,7 @@ async function loadBun(libPath: string): Promise<Runtime> {
     u32: FFIType.u32,
     u64: FFIType.u64,
     i32: FFIType.i32,
+    void: FFIType.void,
   };
   const defs: Record<string, unknown> = {};
   for (const [name, sig] of Object.entries(SYMBOLS)) {
@@ -205,6 +213,7 @@ function loadDeno(libPath: string): Runtime {
     u32: "u32",
     u64: "u64",
     i32: "i32",
+    void: "void",
   };
   const defs: Record<string, unknown> = {};
   for (const [name, sig] of Object.entries(SYMBOLS)) {

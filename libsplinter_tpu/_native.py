@@ -83,8 +83,8 @@ def _needs_build() -> bool:
     if not _LIB_PATH.exists():
         return True
     lib_mtime = _LIB_PATH.stat().st_mtime
-    for src in ("src/store.c", "src/coord.c", "src/internal.h",
-                "include/sptpu.h"):
+    for src in ("src/store.c", "src/coord.c", "src/wptok.c",
+                "src/internal.h", "include/sptpu.h"):
         p = _NATIVE_DIR / src
         if p.exists() and p.stat().st_mtime > lib_mtime:
             return True
@@ -189,6 +189,17 @@ def _declare(lib: C.CDLL) -> None:
         "spt_vec_gather": (i32, [P, C.POINTER(u32), u32, C.c_void_p,
                                  C.POINTER(u64)]),
         "spt_report_parse_failure": (i32, [P]),
+        # host tokenizer (wptok.c)
+        "spt_wptok_create": (C.c_void_p,
+                             [C.POINTER(C.c_char_p), u32, i32]),
+        "spt_wptok_create_hashed": (C.c_void_p, [u32, i32]),
+        "spt_wptok_destroy": (None, [C.c_void_p]),
+        "spt_wptok_encode": (i32, [C.c_void_p, C.c_char_p,
+                                   C.POINTER(u32), u32]),
+        "spt_wptok_encode_batch": (i32, [C.c_void_p,
+                                         C.POINTER(C.c_char_p), u32,
+                                         u32, C.POINTER(u32),
+                                         C.POINTER(u32)]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

@@ -133,6 +133,15 @@ class Embedder:
         # tokenize first; the padding bucket comes from REAL token counts
         # (a whitespace heuristic undercounts punctuation-dense text and
         # would silently truncate it)
+        if hasattr(self._tok, "encode_batch"):
+            # one native GIL-releasing call for the whole micro-batch
+            # (wptok.c); Unicode rows fall back internally
+            ids_full, lens = self._tok.encode_batch(
+                list(texts), self._model.cfg.max_len)
+            bucket = self._model.bucket_for(int(lens.max()))
+            ids = np.ascontiguousarray(ids_full[:, :bucket])
+            lens = np.minimum(lens, bucket).astype(np.int32)
+            return self._model.encode_ids(ids, lens)
         encs = [self._tok.encode(t, max_len=self._model.cfg.max_len)
                 for t in texts]
         bucket = self._model.bucket_for(max(len(e) for e in encs))

@@ -1,7 +1,7 @@
 """Host-side tokenizers for the embedding engine.
 
 The reference links llama.cpp and uses its GGUF tokenizer
-(splinference.cpp:209-217).  We tokenize on the TPU-VM host in Python:
+(splinference.cpp:209-217).  Tokenization happens on the TPU-VM host:
 
   - WordPieceTokenizer: a full WordPiece implementation (BERT family —
     greedy longest-match-first with "##" continuations, basic whitespace +
@@ -10,16 +10,119 @@ The reference links llama.cpp and uses its GGUF tokenizer
     vocab file ships with the environment; keeps the whole pipeline
     runnable and benchmarkable (embedding quality is weight-bound anyway
     in this offline setting).
+
+Both carry a NATIVE fast path (native/src/wptok.c, bound via ctypes):
+ASCII inputs run through the C tokenizer — including a GIL-releasing
+batch call the embedding daemon uses — and anything non-ASCII falls
+back to the full-Unicode Python implementation below.  The C side
+replicates Python str semantics exactly for ASCII and is
+cross-validated against the pure path by tests/test_tokenizer_native.py.
+A chip sustaining >10k embeddings/sec cannot be fed by a Python
+per-text loop; this is the same division of labor as the reference's
+llama.cpp C tokenizer.
 """
 from __future__ import annotations
 
-import hashlib
+import ctypes as C
 import unicodedata
 from pathlib import Path
 
 import numpy as np
 
 CLS, SEP, PAD, UNK, MASK = "[CLS]", "[SEP]", "[PAD]", "[UNK]", "[MASK]"
+
+_FNV_BASIS = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_U64 = (1 << 64) - 1
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = _FNV_BASIS
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _U64
+    return h
+
+
+class _NativeTok:
+    """ctypes wrapper over spt_wptok — the ASCII fast path."""
+
+    def __init__(self, handle: int):
+        from .. import _native as N
+        self._lib = N.load()
+        self._h = handle
+
+    def __del__(self):
+        try:
+            self._lib.spt_wptok_destroy(self._h)
+        except Exception:
+            pass
+
+    @classmethod
+    def wordpiece(cls, tokens: list[str], lower: bool):
+        """Build from an id-ordered vocab; None if the native library or
+        the vocab shape can't support the fast path."""
+        try:
+            from .. import _native as N
+            lib = N.load()
+        except Exception:
+            return None
+        try:
+            arr = (C.c_char_p * len(tokens))(
+                *[t.encode("utf-8") for t in tokens])
+        except Exception:
+            return None              # un-encodable token: python path
+        h = lib.spt_wptok_create(arr, len(tokens), int(lower))
+        return cls(h) if h else None
+
+    @classmethod
+    def hashed(cls, vocab_size: int, lower: bool):
+        try:
+            from .. import _native as N
+            lib = N.load()
+        except Exception:
+            return None
+        h = lib.spt_wptok_create_hashed(vocab_size, int(lower))
+        return cls(h) if h else None
+
+    def encode(self, text: str) -> list[int] | None:
+        """Full id list, or None when the caller must use the Python
+        path (non-ASCII, embedded NUL, or capacity surprise)."""
+        if not text.isascii() or "\x00" in text:
+            return None
+        raw = text.encode()
+        cap = len(raw) + 3
+        out = (C.c_uint32 * cap)()
+        rc = self._lib.spt_wptok_encode(self._h, raw, out, cap)
+        if rc < 0:
+            return None
+        return list(out[:rc])
+
+    def encode_batch(self, texts: list[str], max_len: int):
+        """(ids (n, max_len) int32, lens (n,) int32) with lens == -1
+        marking rows the caller must re-encode in Python.  One C call,
+        GIL released for the duration."""
+        n = len(texts)
+        ids = np.zeros((n, max_len), np.uint32)
+        lens = np.zeros(n, np.uint32)
+        raws = []
+        ok = np.ones(n, bool)
+        for i, t in enumerate(texts):
+            if t.isascii() and "\x00" not in t:
+                raws.append(t.encode())
+            else:
+                ok[i] = False
+                raws.append(b"")
+        arr = (C.c_char_p * n)(*raws)
+        rc = self._lib.spt_wptok_encode_batch(
+            self._h, arr, n, max_len,
+            ids.ctypes.data_as(C.POINTER(C.c_uint32)),
+            lens.ctypes.data_as(C.POINTER(C.c_uint32)))
+        if rc < 0:
+            return None
+        lens = lens.astype(np.int64)
+        lens[~ok] = -1
+        lens[lens == 0xFFFFFFFF] = -1
+        return ids.astype(np.int32), lens.astype(np.int32)
 
 
 def _is_punct(ch: str) -> bool:
@@ -86,6 +189,16 @@ class WordPieceTokenizer:
         self.pad_id = self.vocab.get(PAD, 0)
         self.unk_id = self.vocab[UNK]
         self.vocab_size = len(self.vocab)
+        # native ASCII fast path: needs a contiguous id->token list and
+        # the default word-length bound (the C side hard-codes 100)
+        self._native = None
+        if max_chars_per_word == 100:
+            tokens: list[str | None] = [None] * len(vocab)
+            for t, i in vocab.items():
+                if 0 <= i < len(tokens):
+                    tokens[i] = t
+            if all(t is not None for t in tokens):
+                self._native = _NativeTok.wordpiece(tokens, lower)
 
     def _wordpiece(self, word: str) -> list[int]:
         if len(word) > self.max_chars:
@@ -110,13 +223,24 @@ class WordPieceTokenizer:
         return ids
 
     def encode(self, text: str, *, max_len: int | None = None) -> list[int]:
-        ids = [self.cls_id]
-        for w in basic_split(text, lower=self.lower):
-            ids.extend(self._wordpiece(w))
-        ids.append(self.sep_id)
+        ids = None
+        if self._native is not None:
+            ids = self._native.encode(text)   # None => non-ASCII etc.
+        if ids is None:
+            ids = [self.cls_id]
+            for w in basic_split(text, lower=self.lower):
+                ids.extend(self._wordpiece(w))
+            ids.append(self.sep_id)
         if max_len is not None and len(ids) > max_len:
             ids = ids[: max_len - 1] + [self.sep_id]
         return ids
+
+    def encode_batch(self, texts: list[str], max_len: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """Batch encode + pad to max_len: (ids (n, max_len) int32,
+        lens (n,) int32).  One GIL-releasing native call for the ASCII
+        rows; Unicode rows re-encode through the Python path."""
+        return _batch_with_fallback(self, texts, max_len)
 
     # streaming interface (so a bert-family tokenizer plugged into the
     # completion loop degrades to readable text instead of crashing;
@@ -137,26 +261,44 @@ class WordPieceTokenizer:
 
 
 class HashTokenizer:
-    """Deterministic fallback: word -> stable hash bucket.  Special ids:
-    0 PAD, 1 CLS, 2 SEP, 3 UNK; words occupy [4, vocab_size)."""
+    """Deterministic fallback: word -> stable hash bucket (FNV-1a 64,
+    matching the native fast path bit for bit).  Special ids:
+    0 PAD, 1 CLS, 2 SEP, 3 UNK; words occupy [4, vocab_size).
+
+    MIGRATION (round 3): the word hash changed from blake2s to FNV-1a 64
+    so the native C path can reproduce it.  Vectors embedded by an older
+    build through this fallback were computed from different token ids —
+    re-embed persisted stores once after upgrading
+    (`engine.embedder --backfill-text-keys` after `retrain`/zeroing, or
+    simply re-ingest).  Real checkpoints are unaffected (they tokenize
+    with their own trained vocab, not this fallback)."""
 
     def __init__(self, vocab_size: int = 30528, *, lower: bool = True):
         self.vocab_size = vocab_size
         self.lower = lower
         self.pad_id, self.cls_id, self.sep_id, self.unk_id = 0, 1, 2, 3
+        self._native = _NativeTok.hashed(vocab_size, lower) \
+            if vocab_size >= 8 else None
 
     def _word_id(self, word: str) -> int:
-        h = hashlib.blake2s(word.encode(), digest_size=8).digest()
-        return 4 + int.from_bytes(h, "little") % (self.vocab_size - 4)
+        return 4 + _fnv1a64(word.encode()) % (self.vocab_size - 4)
 
     def encode(self, text: str, *, max_len: int | None = None) -> list[int]:
-        ids = [self.cls_id]
-        ids.extend(self._word_id(w)
-                   for w in basic_split(text, lower=self.lower))
-        ids.append(self.sep_id)
+        ids = None
+        if self._native is not None:
+            ids = self._native.encode(text)
+        if ids is None:
+            ids = [self.cls_id]
+            ids.extend(self._word_id(w)
+                       for w in basic_split(text, lower=self.lower))
+            ids.append(self.sep_id)
         if max_len is not None and len(ids) > max_len:
             ids = ids[: max_len - 1] + [self.sep_id]
         return ids
+
+    def encode_batch(self, texts: list[str], max_len: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        return _batch_with_fallback(self, texts, max_len)
 
 
 class ByteTokenizer:
@@ -189,6 +331,27 @@ class ByteTokenizer:
         Ids outside [3, 259) — specials, or lm-head slack rows when the
         model's vocab is wider than the byte table — map to b''."""
         return bytes([tok - 3]) if 3 <= tok < 259 else b""
+
+
+def _batch_with_fallback(tok, texts: list[str], max_len: int
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    """Shared batch path: one native call for the ASCII rows, Python
+    re-encode for the rest.  Returns (ids (n, max_len) int32 padded
+    with tok.pad_id, lens (n,) int32)."""
+    n = len(texts)
+    native = getattr(tok, "_native", None)
+    if native is not None and n:
+        got = native.encode_batch(texts, max_len)
+        if got is not None:
+            ids, lens = got
+            redo = np.nonzero(lens < 0)[0]
+            for i in redo:
+                row = tok.encode(texts[int(i)], max_len=max_len)
+                ids[i, :] = tok.pad_id
+                ids[i, : len(row)] = row
+                lens[i] = len(row)
+            return ids, lens
+    return batch_encode(tok, texts, max_len)
 
 
 def default_tokenizer(vocab_size: int = 30528):

@@ -7,6 +7,7 @@ ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 DST="$ROOT/bindings/rust/libsptpu-sys/csrc"
 mkdir -p "$DST"
 cp "$ROOT/native/src/store.c" \
+   "$ROOT/native/src/wptok.c" \
    "$ROOT/native/src/coord.c" \
    "$ROOT/native/src/internal.h" \
    "$DST/"

@@ -28,6 +28,7 @@ def test_rust_vendor_in_sync():
     pairs = [
         (ROOT / "native" / "src" / "store.c", CSRC / "store.c"),
         (ROOT / "native" / "src" / "coord.c", CSRC / "coord.c"),
+        (ROOT / "native" / "src" / "wptok.c", CSRC / "wptok.c"),
         (ROOT / "native" / "src" / "internal.h", CSRC / "internal.h"),
         (HDR, CSRC / "sptpu.h"),
     ]

@@ -1,0 +1,153 @@
+"""Native (C) tokenizer fast path vs the pure-Python reference.
+
+native/src/wptok.c must reproduce models/tokenizer.py bit for bit on
+ASCII input — same split rules (str.isspace / punctuation ranges), same
+greedy WordPiece, same FNV word hashing — and must cleanly hand
+anything non-ASCII back to the Python path.  Every test here encodes
+through BOTH paths and compares.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu.models.tokenizer import (HashTokenizer,
+                                              WordPieceTokenizer)
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures")
+
+EDGE_CASES = [
+    "",
+    " ",
+    "hello world",
+    "Hello, World!",
+    "a  b\tc\nd\x1ce",                      # python isspace extras
+    "a\x01b",                               # control chars join words
+    "punct,,,runs!!!===",
+    "x" * 100,                              # exactly the word bound
+    "y" * 101,                              # beyond: UNK
+    "mixed " + "z" * 150 + " tail",
+    "trailing space ",
+    " leading",
+    "the seqlock store commits vectors epoch gated",
+    "UPPER lower MiXeD",
+    "[CLS] literal specials [SEP]",
+    "1234 5678 90",
+    "a-b_c.d/e\\f",
+]
+
+UNICODE_CASES = ["café au lait", "naïve", "日本語テスト", "emoji 🚀 path",
+                 "Ωmega über"]
+
+
+def _rand_texts(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    words = ["tpu", "vector", "store", "seqlock", "arena", "label,",
+             "epoch!", "shard", "bloom.", "kernel", "mesh", "a", "I",
+             "un", "##aff", "x" * 40, "12.5", "don't"]
+    return [" ".join(rng.choice(words, size=int(rng.integers(0, 30))))
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def wp():
+    """Native-enabled tokenizer over the committed trained vocab, plus
+    a forced-Python twin."""
+    with open(os.path.join(FIXDIR, "golden_vocab.txt"),
+              encoding="utf-8") as f:
+        vocab = [ln.rstrip("\n") for ln in f]
+    fast = WordPieceTokenizer.from_vocab_list(vocab)
+    slow = WordPieceTokenizer.from_vocab_list(vocab)
+    slow._native = None
+    assert fast._native is not None, \
+        "native tokenizer failed to initialize (build native/ first)"
+    return fast, slow
+
+
+@pytest.fixture(scope="module")
+def ht():
+    fast = HashTokenizer(4096)
+    slow = HashTokenizer(4096)
+    slow._native = None
+    assert fast._native is not None
+    return fast, slow
+
+
+class TestWordPieceParity:
+    def test_edge_cases(self, wp):
+        fast, slow = wp
+        for text in EDGE_CASES:
+            assert fast.encode(text) == slow.encode(text), repr(text)
+
+    def test_unicode_falls_back_identically(self, wp):
+        fast, slow = wp
+        for text in UNICODE_CASES:
+            assert fast.encode(text) == slow.encode(text), repr(text)
+
+    def test_random_corpus(self, wp):
+        fast, slow = wp
+        for text in _rand_texts():
+            assert fast.encode(text) == slow.encode(text), repr(text)
+
+    def test_max_len_truncation(self, wp):
+        fast, slow = wp
+        long = "word " * 200
+        for m in (2, 5, 16, 64):
+            a = fast.encode(long, max_len=m)
+            assert a == slow.encode(long, max_len=m)
+            assert len(a) == m and a[-1] == fast.sep_id
+
+
+class TestHashParity:
+    def test_edge_cases(self, ht):
+        fast, slow = ht
+        for text in EDGE_CASES:
+            assert fast.encode(text) == slow.encode(text), repr(text)
+
+    def test_unicode_falls_back_identically(self, ht):
+        fast, slow = ht
+        for text in UNICODE_CASES:
+            assert fast.encode(text) == slow.encode(text), repr(text)
+
+    def test_random_corpus(self, ht):
+        fast, slow = ht
+        for text in _rand_texts(seed=7):
+            assert fast.encode(text) == slow.encode(text), repr(text)
+
+    def test_id_range(self, ht):
+        fast, _ = ht
+        ids = fast.encode("some ordinary words")
+        assert ids[0] == fast.cls_id and ids[-1] == fast.sep_id
+        assert all(4 <= i < 4096 for i in ids[1:-1])
+
+
+class TestBatch:
+    def test_batch_matches_per_text(self, wp):
+        fast, slow = wp
+        texts = EDGE_CASES + UNICODE_CASES + _rand_texts(50)
+        ids, lens = fast.encode_batch(texts, max_len=32)
+        assert ids.shape == (len(texts), 32)
+        for i, t in enumerate(texts):
+            want = slow.encode(t, max_len=32)
+            assert lens[i] == len(want), repr(t)
+            assert list(ids[i, : lens[i]]) == want, repr(t)
+            assert (ids[i, lens[i]:] == fast.pad_id).all()
+
+    def test_batch_hash(self, ht):
+        fast, slow = ht
+        texts = ["alpha beta", "café", "gamma delta epsilon"]
+        ids, lens = fast.encode_batch(texts, max_len=8)
+        for i, t in enumerate(texts):
+            want = slow.encode(t, max_len=8)
+            assert list(ids[i, : lens[i]]) == want
+
+    def test_pure_python_batch_when_no_native(self, wp):
+        _, slow = wp
+        texts = ["one two", "three"]
+        ids, lens = slow.encode_batch(texts, max_len=16)
+        for i, t in enumerate(texts):
+            want = slow.encode(t, max_len=16)
+            assert list(ids[i, : lens[i]]) == want

@@ -138,10 +138,7 @@ class Embedder:
             # (wptok.c); Unicode rows fall back internally
             ids_full, lens = self._tok.encode_batch(
                 list(texts), self._model.cfg.max_len)
-            bucket = self._model.bucket_for(int(lens.max()))
-            ids = np.ascontiguousarray(ids_full[:, :bucket])
-            lens = np.minimum(lens, bucket).astype(np.int32)
-            return self._model.encode_ids(ids, lens)
+            return self._encode_bucketed(ids_full, lens)
         encs = [self._tok.encode(t, max_len=self._model.cfg.max_len)
                 for t in texts]
         bucket = self._model.bucket_for(max(len(e) for e in encs))
@@ -152,6 +149,14 @@ class Embedder:
             ids[i, : len(e)] = e
             lens[i] = len(e)
         return self._model.encode_ids(ids, lens)
+
+    def _encode_bucketed(self, ids: np.ndarray, lens: np.ndarray):
+        """Shared encode tail: pick the padding bucket from the real
+        token counts, truncate, clamp lens, run the jit program."""
+        bucket = self._model.bucket_for(int(lens.max()))
+        return self._model.encode_ids(
+            np.ascontiguousarray(ids[:, :bucket]),
+            np.minimum(lens, bucket).astype(np.int32))
 
     def _too_long(self, text: str) -> bool:
         if self._tok is None:
@@ -215,6 +220,28 @@ class Embedder:
         st.bump(key)
         self.stats.ctx_exceeded += 1
 
+    def _ctx_flags_and_ids(self, texts):
+        """Context-guard decisions for a gather, with the token ids as a
+        byproduct when the real model drives encoding.
+
+        Fused path: ONE native batch tokenization (wptok.c) yields both
+        the too-long flags and the ids the encoder will consume — the
+        old flow tokenized every text twice (_too_long + _model_encode).
+        Rows truncated at the model window necessarily exceed the guard
+        threshold, so capped lens stay decision-exact."""
+        fused = (getattr(self, "_model", None) is not None
+                 and self.encoder_fn == self._model_encode
+                 and self._tok is not None
+                 and hasattr(self._tok, "encode_batch"))
+        if fused:
+            thr = int(self.max_ctx * P.CTX_GUARD_FRACTION)
+            if thr <= self._model.cfg.max_len:
+                ids, lens = self._tok.encode_batch(
+                    list(texts), self._model.cfg.max_len)
+                return lens >= thr, ids, lens
+        return (np.array([self._too_long(t) for t in texts], bool),
+                None, None)
+
     def process_rows(self, rows: list[int]) -> int:
         """Embed a set of candidate slot indices; returns committed count."""
         st = self.store
@@ -224,29 +251,42 @@ class Embedder:
         self._pending.update(rows)            # until each row resolves
         keep, texts, epochs = self._gather(rows)
 
-        # context-window guard (reference: splinference.cpp:226-233)
-        ok_rows, ok_texts, ok_epochs = [], [], []
-        for idx, text, e in zip(keep, texts, epochs):
-            if self._too_long(text):
-                self._mark_ctx_exceeded(idx)
-            else:
-                ok_rows.append(idx)
-                ok_texts.append(text)
-                ok_epochs.append(e)
-        if not ok_rows:
-            return 0
-
         committed_total = 0
         t_start = Store.now()
-        for lo in range(0, len(ok_rows), self.batch_cap):
-            sl = slice(lo, lo + self.batch_cap)
-            vecs = np.asarray(self.encoder_fn(ok_texts[sl]), np.float32)
+        # the guard + tokenize + encode pipeline runs per batch_cap
+        # chunk: the fused tokenization materializes (chunk, max_len)
+        # ids, which must stay bounded on huge drains (backfill sweeps)
+        for lo in range(0, len(keep), self.batch_cap):
+            ch = slice(lo, lo + self.batch_cap)
+            ch_rows, ch_texts, ch_eps = keep[ch], texts[ch], epochs[ch]
+
+            # context-window guard (reference: splinference.cpp:226-233)
+            too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
+            ok_rows, ok_texts, ok_epochs, ok_i = [], [], [], []
+            for j, (idx, text, e) in enumerate(
+                    zip(ch_rows, ch_texts, ch_eps)):
+                if too_long[j]:
+                    self._mark_ctx_exceeded(idx)
+                else:
+                    ok_rows.append(idx)
+                    ok_texts.append(text)
+                    ok_epochs.append(e)
+                    ok_i.append(j)
+            if not ok_rows:
+                continue
+
+            if ids is not None:
+                # ids already tokenized by the guard pass
+                vecs = np.asarray(self._encode_bucketed(
+                    ids[ok_i], lens[ok_i]), np.float32)
+            else:
+                vecs = np.asarray(self.encoder_fn(ok_texts), np.float32)
             results = st.vec_commit_batch(
-                np.asarray(ok_rows[sl], np.uint32),
-                np.asarray(ok_epochs[sl], np.uint64),
+                np.asarray(ok_rows, np.uint32),
+                np.asarray(ok_epochs, np.uint64),
                 vecs, write_once=self.vector_training)
             self.stats.batches += 1
-            for idx, e, r in zip(ok_rows[sl], ok_epochs[sl], results):
+            for idx, e, r in zip(ok_rows, ok_epochs, results):
                 if r == 0:
                     committed_total += 1
                     expected = e + 2          # our commit's epoch bump

@@ -208,7 +208,11 @@ class EmbeddingModel:
                  params: Any = None, weights: str | None = None):
         self.cfg = cfg
         self.module = Encoder(cfg)
-        self.buckets = tuple(b for b in buckets if b <= cfg.max_len)
+        # always include max_len itself: a long-context checkpoint whose
+        # window exceeds the default bucket list must not have texts
+        # between buckets[-1] and the window silently truncated
+        self.buckets = tuple(b for b in buckets if b < cfg.max_len) \
+            + (cfg.max_len,)
         if params is None and weights is not None:
             if weights.endswith(".gguf"):
                 from .gguf import load_encoder_params

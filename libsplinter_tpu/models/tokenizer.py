@@ -102,7 +102,9 @@ class _NativeTok:
         marking rows the caller must re-encode in Python.  One C call,
         GIL released for the duration."""
         n = len(texts)
-        ids = np.zeros((n, max_len), np.uint32)
+        # int32 up front: ids are < 2^31 so the uint32 the C side writes
+        # is bit-identical, and this avoids a full-matrix astype copy
+        ids = np.zeros((n, max_len), np.int32)
         lens = np.zeros(n, np.uint32)
         raws = []
         ok = np.ones(n, bool)
@@ -122,7 +124,7 @@ class _NativeTok:
         lens = lens.astype(np.int64)
         lens[~ok] = -1
         lens[lens == 0xFFFFFFFF] = -1
-        return ids.astype(np.int32), lens.astype(np.int32)
+        return ids, lens.astype(np.int32)
 
 
 def _is_punct(ch: str) -> bool:

@@ -178,3 +178,33 @@ def test_event_driven_loop_end_to_end(store):
     finally:
         emb.stop()
         t.join()
+
+
+def test_fused_model_path_end_to_end(store):
+    """Real-model drain: the fused guard+tokenize path (one native batch
+    call feeding both the ctx decision and the encoder ids) must embed
+    short texts and ctx-exceed long ones exactly like the two-pass flow."""
+    from libsplinter_tpu.models import EmbeddingModel, EncoderConfig
+    import jax.numpy as jnp
+
+    cfg = EncoderConfig.tiny(out_dim=store.vec_dim, max_len=64,
+                             dtype=jnp.float32)
+    model = EmbeddingModel(cfg, buckets=(16, 64))
+    emb = Embedder(store, model=model, max_ctx=64)
+    emb.attach()
+    # guard threshold = 0.9 * 64 = 57 tokens
+    store.set("short", "a few ordinary words")
+    store.set_type("short", sp.T_VARTEXT)
+    store.label_or("short", P.LBL_EMBED_REQ)
+    store.set("long", "word " * 80)
+    store.set_type("long", sp.T_VARTEXT)
+    store.label_or("long", P.LBL_EMBED_REQ)
+    n = emb.run_once()
+    assert n == 1
+    assert emb.stats.ctx_exceeded == 1
+    assert np.abs(store.vec_get("short")).max() > 0
+    assert np.abs(store.vec_get("long")).max() == 0
+    assert store.labels("long") & P.LBL_CTX_EXCEEDED
+    # parity: the decision matches the pure two-pass predicate
+    assert not emb._too_long("a few ordinary words")
+    assert emb._too_long("word " * 80)

@@ -48,7 +48,11 @@ def test_bucket_for(model):
     assert model.bucket_for(3) == 16
     assert model.bucket_for(16) == 16
     assert model.bucket_for(17) == 32
-    assert model.bucket_for(999) == 64  # clamps to largest
+    # the context window itself is always the last bucket: texts between
+    # the configured buckets and the window must not silently truncate
+    assert model.buckets[-1] == model.cfg.max_len == 128
+    assert model.bucket_for(65) == 128
+    assert model.bucket_for(999) == 128  # beyond window: clamps to it
 
 
 def test_bert_variant_runs():

@@ -194,22 +194,29 @@ class PodSearch:
         e = self.store.epochs()
         changed = np.nonzero(e != self._staged)[0]
         if self.pcount > 1:
-            # collective O(dirty) update: agree on the max dirty count,
-            # pad to a shared bucket, and run one scatter program on
-            # every host with its own rows (sentinel rows are dropped).
+            # collective O(dirty) update: rows are PACKED per device
+            # shard, so the pod only needs to agree on the max dirty
+            # count any single device sees — the scatter then ships
+            # per_host_shards * bucket(max_per_device) rows per host,
+            # ~per_host_shards x less than bucketing on per-host totals
+            # when writes spread across shards.
             from jax.experimental import multihost_utils
+            if changed.size:
+                dev_counts = np.bincount(changed // self.tile,
+                                         minlength=self.per_host_shards)
+                local_max = int(dev_counts.max())
+            else:
+                local_max = 0
             counts = np.asarray(multihost_utils.process_allgather(
-                np.array([changed.size], np.int32))).ravel()
+                np.array([local_max], np.int32))).ravel()
             maxc = int(counts.max())
             if maxc == 0:
                 return self._arr
             bucket = _bucket(maxc)
-            # the scatter ships per_host_shards*bucket rows per host
-            # (each dirty row occupies its own column across the host's
-            # shard rows); past that point a full restage (local_pad
-            # rows) is strictly cheaper — e.g. a bulk load.  Every host
-            # sees the same maxc, so the branch is collectively
-            # consistent.
+            # past the point where the scatter ships as many rows as the
+            # lane holds, a full restage is strictly cheaper (bulk
+            # load).  Every host sees the same maxc, so the branch is
+            # collectively consistent.
             if bucket * self.per_host_shards >= self.local_pad:
                 local, self._staged = self._gather_local()
                 self._arr = self._place(local)
@@ -232,10 +239,11 @@ class PodSearch:
 
     def _collective_scatter(self, changed: np.ndarray, bucket: int):
         """Multi-process incremental restage: scatter this host's changed
-        rows (padded to the pod-agreed `bucket`) into the sharded matrix.
+        rows (packed per device shard, padded to the pod-agreed per-device
+        `bucket`) into the sharded matrix.
 
-        Every worker executes the SAME program (SPMD discipline); a host
-        with fewer dirty rows than the bucket pads with an out-of-bounds
+        Every worker executes the SAME program (SPMD discipline); devices
+        with fewer dirty rows than the bucket pad with an out-of-bounds
         sentinel slot that the scatter drops.  Rows torn mid-gather stage
         as zeros with an odd staged epoch (never candidates, retried next
         refresh) — identical semantics to the full stage."""
@@ -250,17 +258,21 @@ class PodSearch:
         else:
             vecs = np.zeros((0, d), np.float32)
 
-        # per-device rows in shard-local coordinates; sentinel = tile
-        # (one past the end -> dropped by mode='drop')
+        # per-device rows in shard-local coordinates, packed into the
+        # leading columns; sentinel = tile (one past the end -> dropped
+        # by mode='drop')
         lrows = np.full((self.per_host_shards, bucket), self.tile,
                         np.int32)
         lvals = np.zeros((self.per_host_shards, bucket, d), np.float32)
         if rows.size:
             dev = rows // self.tile
             off = rows % self.tile
-            j = np.arange(rows.size)
-            lrows[dev, j] = off
-            lvals[dev, j] = vecs
+            for dshard in range(self.per_host_shards):
+                sel = dev == dshard
+                k = int(sel.sum())
+                if k:
+                    lrows[dshard, :k] = off[sel]
+                    lvals[dshard, :k] = vecs[sel]
         m = self.mesh.shape[self.axis]
         sh_r = NamedSharding(self.mesh, P(self.axis, None))
         sh_v = NamedSharding(self.mesh, P(self.axis, None, None))

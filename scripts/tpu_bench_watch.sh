@@ -1,0 +1,51 @@
+#!/bin/sh
+# Opportunistic TPU measurement loop (VERDICT r2 #1b).
+#
+# The chip sits behind a single-client claim tunnel that can be
+# unavailable for hours (a killed client wedges the claim server-side;
+# recovery is a ~30 min server timeout).  This loop keeps exactly ONE
+# patient client knocking: each cycle runs bench.py with a bounded
+# window (its child blocks in PJRT client-init until the server answers
+# UNAVAILABLE or grants the chip).  On the first real measurement it
+# also runs the decode and search benches on the chip, then exits —
+# every success lands in bench_results.jsonl (timestamped) so the
+# round's evidence survives a flaky end-of-round window.
+#
+# Usage: nohup sh scripts/tpu_bench_watch.sh [deadline_epoch] &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+DEADLINE="${1:-$(($(date +%s) + 30600))}"   # default: +8.5h
+
+# single instance only: a second concurrent tunnel client is the
+# documented claim-wedge mode (see header) — refuse to double-run
+LOCK=/tmp/tpu_bench_watch.lock
+exec 9>"$LOCK"
+if ! flock -n 9; then
+    echo "[watch] another watcher holds $LOCK; refusing to double-run" >&2
+    exit 1
+fi
+OUT="/tmp/bench_cycle.$$.json"
+LOG="/tmp/bench_cycle.$$.log"
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    echo "[watch] $(date -u +%H:%M:%S) bench cycle starting" >&2
+    BENCH_SKIP_PROBE=1 BENCH_ATTEMPT_TIMEOUT=2700 BENCH_TIMEOUT=3000 \
+        BENCH_BACKOFF=60 python bench.py > "$OUT" 2>>"$LOG"
+    # success = a JSON line with a value and NO error field (a hard
+    # crash leaves empty output, which must not count as success)
+    if ! grep -q '"value"' "$OUT" || grep -q '"error"' "$OUT"; then
+        echo "[watch] cycle failed; next cycle" >&2
+        continue
+    fi
+    echo "[watch] EMBED BENCH LANDED: $(cat "$OUT")" >&2
+    # chip is claimable: capture the other benches back to back
+    DECODE_TOKENS=256 timeout 1800 python bench_decode.py \
+        >> "$LOG" 2>&1
+    SEARCH_N=1000000 timeout 1800 python bench_search.py \
+        >> "$LOG" 2>&1
+    echo "[watch] all benches done; results in bench_results.jsonl" >&2
+    exit 0
+done
+echo "[watch] deadline reached without a successful claim" >&2
+exit 1

@@ -250,6 +250,11 @@ class CompletionModel:
         self._cache = None
         self._pos = 0
 
+    def _fresh_cache(self):
+        """Zeroed KV cache for a new request.  Subclasses place it with
+        an explicit device sharding (parallel.serve)."""
+        return init_cache(self.cfg, 1)
+
     def prefill(self, prompt_ids: np.ndarray) -> np.ndarray:
         """prompt_ids: (P,) int32, P < max_len.  Pads to a bucket, runs
         one prefill program, returns the last real token's logits (V,)."""
@@ -261,7 +266,7 @@ class CompletionModel:
         b = self.bucket_for(P)
         ids = np.zeros((1, b), np.int32)
         ids[0, :P] = prompt_ids[:P]
-        cache = init_cache(self.cfg, 1)
+        cache = self._fresh_cache()
         logits, cache = self._fn(self.params, jnp.asarray(ids), cache,
                                  jnp.int32(0))
         # cache rows P..b-1 hold pad-token k/v, but they can never leak:

@@ -2,12 +2,14 @@ from .mesh import (batch_sharding, make_mesh, param_shardings, replicated,
                    shard_params)
 from .ring_attention import (dense_reference, ring_attention,
                              ring_attention_sharded)
+from .serve import ShardedCompletionModel, shard_decoder_params
 from .sharded_search import PodSearch, shard_vectors, sharded_topk
 from .train import (TrainState, info_nce_loss, make_ring_train_step,
                     make_sharded_train_step, make_train_step)
 
 __all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params",
-           "param_shardings", "sharded_topk", "shard_vectors", "PodSearch",
+           "param_shardings", "ShardedCompletionModel",
+           "shard_decoder_params", "sharded_topk", "shard_vectors", "PodSearch",
            "TrainState", "info_nce_loss", "make_train_step",
            "make_sharded_train_step", "make_ring_train_step",
            "ring_attention", "ring_attention_sharded", "dense_reference"]

@@ -69,11 +69,14 @@ def param_pspec(path: tuple, leaf) -> P:
     return P()
 
 
-def shard_params(params, mesh: Mesh):
-    """Apply param_pspec over the tree, returning sharded params."""
+def shard_params(params, mesh: Mesh, *, pspec_fn=None):
+    """Place a param tree onto the mesh.  pspec_fn(path, leaf) -> P
+    defaults to the encoder's param_pspec (serve.py passes the decoder
+    rules)."""
+    pspec_fn = pspec_fn or param_pspec
     def place(path, leaf):
-        spec = param_pspec(path, leaf)
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return jax.device_put(
+            leaf, NamedSharding(mesh, pspec_fn(path, leaf)))
     return jax.tree_util.tree_map_with_path(place, params)
 
 

@@ -1,0 +1,79 @@
+"""Tensor-parallel completion serving: the decoder sharded over a mesh.
+
+The reference's completion sidecar is single-context llama.cpp on one
+CPU (splainference.cpp:414-448 — one model, one ctx, one seq); model
+parallelism simply does not exist there (SURVEY.md §2.7).  On TPU a
+completion model larger than one chip's HBM — or one that wants more
+MXU per token — shards Megatron-style over the mesh's `tp` axis:
+
+  - q/k/v and gate/up Dense kernels split their OUTPUT dim (heads /
+    mlp lanes) across tp — column parallel;
+  - out and down kernels split their INPUT dim — row parallel, so each
+    transformer block needs exactly one psum pair, which XLA inserts
+    from the shardings (GSPMD propagation; no hand-written
+    collectives);
+  - the KV cache shards on its kv_heads axis, so attention stays fully
+    local per device (GQA's head-repeat also stays local because query
+    heads shard consistently with kv heads);
+  - embeddings and the LM head stay replicated: logits come out
+    replicated, so the in-graph sampler (and therefore the whole
+    decode_chunk lax.scan) runs identically on every device with the
+    same rng — no gather before sampling.
+
+ShardedCompletionModel IS a CompletionModel: same prefill / decode_one /
+decode_chunk / generate_tokens surface, same compiled-program caching,
+so the completion daemon (engine.completer) drives it unchanged —
+scale-out is a constructor swap.
+
+Requires cfg.heads % tp == 0 and cfg.kv_heads % tp == 0.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.decoder import CompletionModel, init_cache
+from .mesh import make_mesh
+
+
+def decoder_param_pspec(path: tuple, leaf) -> P:
+    """Megatron-style partition specs for Decoder parameters."""
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    joined = "/".join(str(n) for n in names)
+    if leaf.ndim == 2:
+        if joined.endswith("kernel"):
+            last = joined.rsplit("/", 2)[-2] if "/" in joined else ""
+            if last in ("q", "k", "v", "gate", "up"):
+                return P(None, "tp")          # column parallel
+            if last in ("out", "down"):
+                return P("tp", None)          # row parallel
+    return P()                                # norms, embeddings, lm head
+
+
+def shard_decoder_params(params, mesh: Mesh):
+    """Place a Decoder param tree onto the mesh per decoder_param_pspec."""
+    from .mesh import shard_params
+    return shard_params(params, mesh, pspec_fn=decoder_param_pspec)
+
+
+class ShardedCompletionModel(CompletionModel):
+    """CompletionModel whose params + KV cache live sharded on a mesh.
+
+    Everything above the placement is inherited: the same jitted
+    programs run over sharded arrays and GSPMD inserts the block psums.
+    """
+
+    def __init__(self, cfg, mesh: Mesh | None = None, **kw):
+        self.mesh = mesh or make_mesh()
+        tp = self.mesh.shape["tp"]
+        if cfg.heads % tp or cfg.kv_heads % tp:
+            raise ValueError(
+                f"heads={cfg.heads}/kv_heads={cfg.kv_heads} must divide "
+                f"the tp={tp} mesh axis")
+        super().__init__(cfg, **kw)
+        self.params = shard_decoder_params(self.params, self.mesh)
+
+    def _fresh_cache(self):
+        sh = NamedSharding(self.mesh, P(None, None, "tp", None))
+        return [(jax.device_put(k, sh), jax.device_put(v, sh))
+                for k, v in init_cache(self.cfg, 1)]

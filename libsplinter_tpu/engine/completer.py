@@ -376,6 +376,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="context window / KV-cache length override "
                          "(default: the checkpoint's trained window, or "
                          "2048 for seeded-random weights)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard the decoder "
+                         "(params + KV cache) over a tp-axis mesh of "
+                         "this many devices (parallel.serve; must "
+                         "divide the model's heads and kv_heads)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -413,8 +418,16 @@ def main(argv: list[str] | None = None) -> int:
         # system\n\nprompt concatenation
         template = "none"
         log.info("--template auto with no GGUF metadata: using 'none'")
-    model = CompletionModel(cfg, weights=args.weights,
-                            top_p=args.top_p, temp=args.temp)
+    if args.tp > 1:
+        from ..parallel import ShardedCompletionModel
+        from ..parallel.mesh import make_mesh
+        mesh = make_mesh(tp=args.tp)      # dp inferred from #devices
+        model = ShardedCompletionModel(cfg, mesh, weights=args.weights,
+                                       top_p=args.top_p, temp=args.temp)
+        log.info("tensor-parallel decode over %d devices", args.tp)
+    else:
+        model = CompletionModel(cfg, weights=args.weights,
+                                top_p=args.top_p, temp=args.temp)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
                      template=template)

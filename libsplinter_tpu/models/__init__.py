@@ -1,10 +1,12 @@
 from .decoder import (CompletionModel, Decoder, DecoderConfig, init_cache,
                       sample_top_p)
 from .encoder import Encoder, EncoderConfig, EmbeddingModel
+from .moe import MoeDecoder, MoeDecoderConfig, moe_completion_model
 from .tokenizer import (ByteTokenizer, HashTokenizer, WordPieceTokenizer,
                         batch_encode, default_tokenizer)
 
 __all__ = ["Encoder", "EncoderConfig", "EmbeddingModel", "HashTokenizer",
            "WordPieceTokenizer", "ByteTokenizer", "batch_encode",
            "default_tokenizer", "CompletionModel", "Decoder",
-           "DecoderConfig", "init_cache", "sample_top_p"]
+           "DecoderConfig", "init_cache", "sample_top_p",
+           "MoeDecoder", "MoeDecoderConfig", "moe_completion_model"]

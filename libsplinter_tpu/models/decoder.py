@@ -129,7 +129,12 @@ class CausalAttention(nn.Module):
 
 
 class DecoderLayer(nn.Module):
+    """Pre-norm attention + MLP block.  mlp_cls=None is the dense
+    SwiGLU (param names gate/up/down directly under the layer — the
+    GGUF/safetensors loaders map onto this tree); a custom mlp_cls
+    (e.g. moe.MoeMlp) mounts at name 'moe' instead."""
     cfg: DecoderConfig
+    mlp_cls: Any = None
 
     @nn.compact
     def __call__(self, x, cache_kv, pos):
@@ -139,6 +144,8 @@ class DecoderLayer(nn.Module):
             cache_kv, pos)
         x = x + a
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_mlp")(x)
+        if self.mlp_cls is not None:
+            return x + self.mlp_cls(cfg, name="moe")(h), cache_kv
         gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
                         name="gate")(h)
         up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
@@ -150,8 +157,11 @@ class DecoderLayer(nn.Module):
 
 class Decoder(nn.Module):
     """Causal LM over a static KV cache.  One program serves prefill
-    (S = bucket) and decode (S = 1)."""
+    (S = bucket) and decode (S = 1).  The whole trunk (embed, cache
+    threading, final norm, LM head) is shared by every decoder family;
+    mlp_cls swaps the per-layer MLP (moe.MoeDecoder passes MoeMlp)."""
     cfg: DecoderConfig
+    mlp_cls: Any = None
 
     @nn.compact
     def __call__(self, token_ids, cache, pos):
@@ -163,7 +173,8 @@ class Decoder(nn.Module):
                      name="tok_emb")(token_ids)
         new_cache = []
         for i in range(cfg.layers):
-            x, kv = DecoderLayer(cfg, name=f"layer_{i}")(x, cache[i], pos)
+            x, kv = DecoderLayer(cfg, self.mlp_cls,
+                                 name=f"layer_{i}")(x, cache[i], pos)
             new_cache.append(kv)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_out")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
@@ -211,9 +222,13 @@ class CompletionModel:
     def __init__(self, cfg: DecoderConfig, *, seed: int = 0,
                  buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
                  params: Any = None, weights: str | None = None,
-                 top_p: float = 0.9, temp: float = 0.7):
+                 top_p: float = 0.9, temp: float = 0.7,
+                 module: Any = None):
         self.cfg = cfg
-        self.module = Decoder(cfg)
+        # module override: any flax module with the Decoder call
+        # signature (ids, cache, pos) -> (logits, cache) — e.g. the
+        # MoE family (models/moe.MoeDecoder)
+        self.module = module if module is not None else Decoder(cfg)
         self.buckets = tuple(b for b in buckets if b <= cfg.max_len)
         self.top_p, self.temp = top_p, temp
         if not self.buckets or self.buckets[-1] < cfg.max_len:

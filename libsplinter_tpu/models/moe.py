@@ -1,0 +1,112 @@
+"""Mixture-of-Experts decoder family (Mixtral-style) with expert
+parallelism.
+
+The reference serves only dense llama-family GGUF checkpoints through
+llama.cpp (splainference.cpp:414-448); MoE is a net-new model family on
+the TPU side, designed for how XLA actually schedules it:
+
+  - the expert FFNs are STACKED weight tensors (E, hidden, mlp) and the
+    whole layer is three einsums over the expert axis — dense compute,
+    every expert runs for every token, the router's top-k gates weight
+    the combine.  For the expert counts this framework targets (4-16)
+    that is the MXU-friendly formulation: one big batched matmul per
+    projection instead of gather/scatter dispatch (sparse dispatch
+    kernels pay off only at much larger E; documented non-goal here);
+  - expert parallelism = shard the stacked tensors' E axis over the
+    mesh's `ep` axis (parallel/serve.moe_param_pspec).  Each device
+    computes its local experts' outputs; the gated combine's einsum
+    reduces over E, so GSPMD closes each layer with one psum over ep —
+    the canonical dense-MoE sharding;
+  - the router is tiny and replicated; gates renormalize over the
+    selected top-k (Mixtral convention).
+
+MoeDecoder is call-compatible with Decoder (ids, cache, pos) ->
+(logits, cache): the SAME CompletionModel / ShardedCompletionModel /
+completion-daemon stack serves it via the `module=` override, and
+attention still shards on tp independently of ep.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .decoder import DecoderConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeDecoderConfig(DecoderConfig):
+    n_experts: int = 8
+    top_k: int = 2
+
+    @classmethod
+    def tiny(cls, **kw) -> "MoeDecoderConfig":
+        kw = {"vocab_size": 1024, "hidden": 64, "layers": 2, "heads": 4,
+              "kv_heads": 2, "mlp_dim": 128, "max_len": 128,
+              "n_experts": 4, "top_k": 2, **kw}
+        return cls(**kw)
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed SwiGLU experts, computed densely over stacked
+    (E, ...) weights and combined with renormalized gates."""
+    cfg: MoeDecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E, H, M = cfg.n_experts, cfg.hidden, cfg.mlp_dim
+
+        # routing in f32 for stable softmax/top-k
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # (B, S, E)
+        topv, topi = jax.lax.top_k(probs, cfg.top_k)
+        gates = (jax.nn.one_hot(topi, E, dtype=probs.dtype)
+                 * topv[..., None]).sum(axis=-2)           # (B, S, E)
+        gates = gates / jnp.maximum(
+            gates.sum(-1, keepdims=True), 1e-9)            # renormalize
+        gates = gates.astype(cfg.dtype)
+
+        init = nn.initializers.lecun_normal()
+        wg = self.param("gate_experts", init, (E, H, M))
+        wu = self.param("up_experts", init, (E, H, M))
+        wd = self.param("down_experts", init, (E, M, H))
+
+        xd = x.astype(cfg.dtype)
+        g = jnp.einsum("bsh,ehm->bsem", xd, wg.astype(cfg.dtype))
+        u = jnp.einsum("bsh,ehm->bsem", xd, wu.astype(cfg.dtype))
+        y = nn.silu(g) * u                                 # (B, S, E, M)
+        out = jnp.einsum("bsem,emh->bseh", y, wd.astype(cfg.dtype))
+        # gated combine reduces over E -> one psum over ep when sharded
+        return jnp.einsum("bseh,bse->bsh", out, gates)
+
+
+def MoeDecoder(cfg: MoeDecoderConfig):
+    """Causal MoE LM: the shared Decoder trunk (embed, cache threading,
+    final norm, LM head — decoder.Decoder) with MoeMlp as each layer's
+    MLP.  Same call signature; param tree differs only inside each
+    layer (layer_i/moe/...)."""
+    from .decoder import Decoder
+
+    return Decoder(cfg, mlp_cls=MoeMlp)
+
+
+def moe_completion_model(cfg: MoeDecoderConfig, mesh=None, **kw) -> Any:
+    """CompletionModel over the MoE family; pass a mesh for sharded
+    (tp attention + ep experts) serving."""
+    from .decoder import CompletionModel
+
+    module = MoeDecoder(cfg)
+    if mesh is None:
+        return CompletionModel(cfg, module=module, **kw)
+    ep = mesh.shape.get("ep", 1)
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} must divide the ep={ep} mesh "
+            "axis (expert tensors shard their E dimension)")
+    from ..parallel.serve import ShardedCompletionModel
+    return ShardedCompletionModel(cfg, mesh, module=module, **kw)

@@ -10,6 +10,7 @@ Axes:
   dp — data parallel (batch)
   tp — tensor parallel (hidden/heads)
   sp — sequence parallel (long-context; ring attention rides this axis)
+  ep — expert parallel (MoE expert dimension; models/moe.py)
 """
 from __future__ import annotations
 
@@ -24,18 +25,21 @@ except ImportError:                           # pragma: no cover
 
 
 def make_mesh(dp: int | None = None, tp: int = 1, sp: int = 1,
-              devices=None) -> Mesh:
-    """Build a (dp, tp, sp) mesh.  dp=None uses all remaining devices."""
+              ep: int = 1, devices=None) -> Mesh:
+    """Build a (dp, tp, sp, ep) mesh.  dp=None uses all remaining
+    devices.  ep defaults to 1, so existing (dp, tp, sp) call sites and
+    partition specs are unaffected."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if dp is None:
-        if n % (tp * sp):
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp*sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp != n:
-        raise ValueError(f"dp*tp*sp={dp*tp*sp} != #devices={n}")
-    arr = np.asarray(devices).reshape(dp, tp, sp)
-    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+        if n % (tp * sp * ep):
+            raise ValueError(
+                f"{n} devices not divisible by tp*sp*ep={tp*sp*ep}")
+        dp = n // (tp * sp * ep)
+    if dp * tp * sp * ep != n:
+        raise ValueError(f"dp*tp*sp*ep={dp*tp*sp*ep} != #devices={n}")
+    arr = np.asarray(devices).reshape(dp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -37,10 +37,17 @@ from .mesh import make_mesh
 
 
 def decoder_param_pspec(path: tuple, leaf) -> P:
-    """Megatron-style partition specs for Decoder parameters."""
+    """Megatron-style partition specs for Decoder / MoeDecoder params:
+    attention + dense MLP shard on tp; stacked MoE expert tensors shard
+    their expert axis on ep (models/moe.py); routers/norms/embeddings/
+    lm head replicate."""
     names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
     joined = "/".join(str(n) for n in names)
+    if leaf.ndim == 3 and joined.endswith("_experts"):
+        return P("ep", None, None)            # expert parallel
     if leaf.ndim == 2:
+        if "router" in joined:
+            return P()                        # tiny: replicate
         if joined.endswith("kernel"):
             last = joined.rsplit("/", 2)[-2] if "/" in joined else ""
             if last in ("q", "k", "v", "gate", "up"):

@@ -248,3 +248,24 @@ def test_main_auto_resolves_from_gguf_metadata(tmp_path, store):
     finally:
         completer_mod.Completer = real_completer
     assert captured["template"] == "llama3"
+
+
+def test_tp_sharded_model_serves_daemon(store):
+    """The completion daemon drives a tensor-parallel decoder unchanged
+    (parallel.serve: constructor swap) — a labeled request is serviced
+    end to end with the model sharded over the virtual mesh."""
+    from libsplinter_tpu.models.decoder import DecoderConfig
+    from libsplinter_tpu.parallel import ShardedCompletionModel, make_mesh
+
+    cfg = DecoderConfig.tiny(dtype=jnp.float32, vocab_size=512)
+    mesh = make_mesh(dp=4, tp=2, sp=1)
+    model = ShardedCompletionModel(cfg, mesh, buckets=(16,), temp=0.0)
+    c = Completer(store, model=model, max_new_tokens=8, template="none")
+    c.attach()
+    _request(store, "q", "hi")
+    assert c.run_once() == 1
+    out = store.get("q")
+    assert len(out.rstrip(b"\0")) > 0
+    labels = store.labels("q")
+    assert labels & P.LBL_READY
+    assert not labels & (P.LBL_INFER_REQ | P.LBL_SERVICING)

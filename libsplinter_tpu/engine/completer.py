@@ -381,6 +381,11 @@ def main(argv: list[str] | None = None) -> int:
                          "(params + KV cache) over a tp-axis mesh of "
                          "this many devices (parallel.serve; must "
                          "divide the model's heads and kv_heads)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree for MoE checkpoints: "
+                         "shard the stacked expert FFNs over an ep "
+                         "mesh axis (must divide the model's "
+                         "expert_count; composes with --tp)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -418,16 +423,24 @@ def main(argv: list[str] | None = None) -> int:
         # system\n\nprompt concatenation
         template = "none"
         log.info("--template auto with no GGUF metadata: using 'none'")
-    if args.tp > 1:
-        from ..parallel import ShardedCompletionModel
+    mesh = None
+    if args.tp > 1 or args.ep > 1:
         from ..parallel.mesh import make_mesh
-        mesh = make_mesh(tp=args.tp)      # dp inferred from #devices
-        model = ShardedCompletionModel(cfg, mesh, weights=args.weights,
-                                       top_p=args.top_p, temp=args.temp)
-        log.info("tensor-parallel decode over %d devices", args.tp)
+        mesh = make_mesh(tp=args.tp, ep=args.ep)  # dp inferred
+        log.info("sharded decode: tp=%d ep=%d", args.tp, args.ep)
+    mkw = dict(weights=args.weights, top_p=args.top_p, temp=args.temp)
+    from ..models import MoeDecoderConfig, moe_completion_model
+    if isinstance(cfg, MoeDecoderConfig):
+        # a Mixtral-family GGUF resolves to the MoE config; the same
+        # daemon stack serves it (models/moe.py)
+        log.info("MoE checkpoint: %d experts, top-%d routing",
+                 cfg.n_experts, cfg.top_k)
+        model = moe_completion_model(cfg, mesh, **mkw)
+    elif mesh is not None:
+        from ..parallel import ShardedCompletionModel
+        model = ShardedCompletionModel(cfg, mesh, **mkw)
     else:
-        model = CompletionModel(cfg, weights=args.weights,
-                                top_p=args.top_p, temp=args.temp)
+        model = CompletionModel(cfg, **mkw)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
                      template=template)

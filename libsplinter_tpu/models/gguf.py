@@ -538,7 +538,7 @@ def load_decoder_params(path: str, cfg) -> dict:
             p["lm_head"] = {"kernel": p["tok_emb"]["embedding"].T.copy()}
         for i in range(cfg.layers):
             b = f"blk.{i}"
-            p[f"layer_{i}"] = {
+            layer = {
                 "ln_attn": {"scale":
                             _take(gf, [f"{b}.attn_norm.weight"])
                             .astype(np.float32)},
@@ -551,10 +551,27 @@ def load_decoder_params(path: str, cfg) -> dict:
                 "ln_mlp": {"scale":
                            _take(gf, [f"{b}.ffn_norm.weight"])
                            .astype(np.float32)},
-                "gate": kern([f"{b}.ffn_gate.weight"]),
-                "up": kern([f"{b}.ffn_up.weight"]),
-                "down": kern([f"{b}.ffn_down.weight"]),
             }
+            if f"{b}.ffn_gate_exps.weight" in gf.tensors:
+                # Mixtral-family MoE block: stacked expert tensors
+                # (E, out, in) in the numpy view -> (E, in, out) for
+                # the flax einsums (models/moe.MoeMlp); router is a
+                # plain Dense kernel
+                def exps(name):
+                    a = _take(gf, [f"{b}.{name}.weight"])
+                    return a.transpose(0, 2, 1).astype(np.float32)
+
+                layer["moe"] = {
+                    "router": kern([f"{b}.ffn_gate_inp.weight"]),
+                    "gate_experts": exps("ffn_gate_exps"),
+                    "up_experts": exps("ffn_up_exps"),
+                    "down_experts": exps("ffn_down_exps"),
+                }
+            else:
+                layer["gate"] = kern([f"{b}.ffn_gate.weight"])
+                layer["up"] = kern([f"{b}.ffn_up.weight"])
+                layer["down"] = kern([f"{b}.ffn_down.weight"])
+            p[f"layer_{i}"] = layer
     return {"params": jax.tree.map(
         lambda x: jnp.asarray(x, jnp.float32), p)}
 
@@ -933,6 +950,15 @@ def decoder_config_from_gguf(path_or_gguf, **overrides):
         if eps is not None:
             kw["rms_eps"] = float(eps)
         kw.update(overrides)
+        n_experts = g("expert_count")
+        if n_experts:
+            # Mixtral-family checkpoint: llama.cpp publishes
+            # llama.expert_count / llama.expert_used_count and stacks
+            # the expert FFNs in blk.N.ffn_{gate,up,down}_exps
+            from .moe import MoeDecoderConfig
+            kw.setdefault("n_experts", int(n_experts))
+            kw.setdefault("top_k", int(g("expert_used_count", 2)))
+            return MoeDecoderConfig(**kw)
         return DecoderConfig(**kw)
 
 

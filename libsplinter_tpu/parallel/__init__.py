@@ -2,6 +2,8 @@ from .mesh import (batch_sharding, make_mesh, param_shardings, replicated,
                    shard_params)
 from .ring_attention import (dense_reference, ring_attention,
                              ring_attention_sharded)
+from .pipeline import (make_pipeline_encode_fn, pipeline_encode,
+                       stack_layer_params)
 from .serve import ShardedCompletionModel, shard_decoder_params
 from .sharded_search import PodSearch, shard_vectors, sharded_topk
 from .train import (TrainState, info_nce_loss, make_ring_train_step,
@@ -9,7 +11,8 @@ from .train import (TrainState, info_nce_loss, make_ring_train_step,
 
 __all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params",
            "param_shardings", "ShardedCompletionModel",
-           "shard_decoder_params", "sharded_topk", "shard_vectors", "PodSearch",
+           "shard_decoder_params", "pipeline_encode",
+           "make_pipeline_encode_fn", "stack_layer_params", "sharded_topk", "shard_vectors", "PodSearch",
            "TrainState", "info_nce_loss", "make_train_step",
            "make_sharded_train_step", "make_ring_train_step",
            "ring_attention", "ring_attention_sharded", "dense_reference"]

@@ -11,6 +11,7 @@ Axes:
   tp — tensor parallel (hidden/heads)
   sp — sequence parallel (long-context; ring attention rides this axis)
   ep — expert parallel (MoE expert dimension; models/moe.py)
+  pp — pipeline parallel (layer stages; parallel/pipeline.py)
 """
 from __future__ import annotations
 
@@ -25,21 +26,22 @@ except ImportError:                           # pragma: no cover
 
 
 def make_mesh(dp: int | None = None, tp: int = 1, sp: int = 1,
-              ep: int = 1, devices=None) -> Mesh:
-    """Build a (dp, tp, sp, ep) mesh.  dp=None uses all remaining
-    devices.  ep defaults to 1, so existing (dp, tp, sp) call sites and
-    partition specs are unaffected."""
+              ep: int = 1, pp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, tp, sp, ep, pp) mesh.  dp=None uses all remaining
+    devices.  ep/pp default to 1, so existing (dp, tp, sp) call sites
+    and partition specs are unaffected."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
+    rest = tp * sp * ep * pp
     if dp is None:
-        if n % (tp * sp * ep):
+        if n % rest:
             raise ValueError(
-                f"{n} devices not divisible by tp*sp*ep={tp*sp*ep}")
-        dp = n // (tp * sp * ep)
-    if dp * tp * sp * ep != n:
-        raise ValueError(f"dp*tp*sp*ep={dp*tp*sp*ep} != #devices={n}")
-    arr = np.asarray(devices).reshape(dp, tp, sp, ep)
-    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))
+                f"{n} devices not divisible by tp*sp*ep*pp={rest}")
+        dp = n // rest
+    if dp * rest != n:
+        raise ValueError(f"dp*tp*sp*ep*pp={dp * rest} != #devices={n}")
+    arr = np.asarray(devices).reshape(dp, tp, sp, ep, pp)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep", "pp"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

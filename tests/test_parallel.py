@@ -14,11 +14,11 @@ from libsplinter_tpu.parallel import (make_mesh, make_sharded_train_step,
 
 def test_make_mesh_shapes():
     m = make_mesh(dp=4, tp=2)
-    assert m.shape == {"dp": 4, "tp": 2, "sp": 1, "ep": 1}
+    assert m.shape == {"dp": 4, "tp": 2, "sp": 1, "ep": 1, "pp": 1}
     m2 = make_mesh(tp=2)          # dp inferred = 4
     assert m2.shape["dp"] == 4
     m3 = make_mesh(tp=2, ep=2)    # dp inferred = 2
-    assert m3.shape == {"dp": 2, "tp": 2, "sp": 1, "ep": 2}
+    assert m3.shape == {"dp": 2, "tp": 2, "sp": 1, "ep": 2, "pp": 1}
     with pytest.raises(ValueError):
         make_mesh(dp=3, tp=3)
 

@@ -179,20 +179,30 @@ class Encoder(nn.Module):
                          name="ln_emb")(x)
         for i in range(cfg.layers):
             x = EncoderLayer(cfg, name=f"layer_{i}")(x, attn_mask)
-        # masked mean pool in f32 for stable norms
-        xf = x.astype(jnp.float32)
-        m = attn_mask.astype(jnp.float32)[..., None]
-        sums = (xf * m).sum(axis=1)
-        counts = m.sum(axis=1)
-        if cfg.ring_axis:
-            # pool over the full sequence: reduce across shards so every
-            # sp member holds the replicated global embedding
-            sums = jax.lax.psum(sums, cfg.ring_axis)
-            counts = jax.lax.psum(counts, cfg.ring_axis)
-        pooled = sums / jnp.maximum(counts, 1.0)
-        pooled = pooled[:, : cfg.out_dim]          # matryoshka truncation
-        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
-        return pooled / jnp.maximum(norm, 1e-9)
+        return pool_normalize(cfg, x, attn_mask,
+                              ring_axis=cfg.ring_axis)
+
+
+def pool_normalize(cfg: EncoderConfig, x, attn_mask, *,
+                   ring_axis: str | None = None):
+    """The encoder's output head: masked mean pool in f32 (stable
+    norms), matryoshka truncation to out_dim, L2 normalize.  Shared by
+    Encoder.__call__ and the pipeline-parallel forward
+    (parallel/pipeline.py) so the tail cannot drift between them.
+    x: (..., S, hidden); attn_mask: (..., S)."""
+    xf = x.astype(jnp.float32)
+    m = attn_mask.astype(jnp.float32)[..., None]
+    sums = (xf * m).sum(axis=-2)
+    counts = m.sum(axis=-2)
+    if ring_axis:
+        # pool over the full sequence: reduce across shards so every
+        # sp member holds the replicated global embedding
+        sums = jax.lax.psum(sums, ring_axis)
+        counts = jax.lax.psum(counts, ring_axis)
+    pooled = sums / jnp.maximum(counts, 1.0)
+    pooled = pooled[..., : cfg.out_dim]            # matryoshka truncation
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
 
 
 class EmbeddingModel:

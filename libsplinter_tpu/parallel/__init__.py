@@ -1,3 +1,4 @@
+from . import checkpoint
 from .mesh import (batch_sharding, make_mesh, param_shardings, replicated,
                    shard_params)
 from .ring_attention import (dense_reference, ring_attention,
@@ -9,7 +10,7 @@ from .sharded_search import PodSearch, shard_vectors, sharded_topk
 from .train import (TrainState, info_nce_loss, make_ring_train_step,
                     make_sharded_train_step, make_train_step)
 
-__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params",
+__all__ = ["checkpoint", "make_mesh", "batch_sharding", "replicated", "shard_params",
            "param_shardings", "ShardedCompletionModel",
            "shard_decoder_params", "pipeline_encode",
            "make_pipeline_encode_fn", "stack_layer_params", "sharded_topk", "shard_vectors", "PodSearch",

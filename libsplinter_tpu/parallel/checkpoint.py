@@ -1,0 +1,103 @@
+"""Trainer checkpoint / resume (orbax-backed).
+
+The reference's checkpoint story is the persistent store mapping — the
+store IS the checkpoint (SURVEY.md §5, splinter.c:157-168); it has no
+trainer to checkpoint.  This framework trains the encoder
+(parallel/train.py), so training state needs its own durable story:
+
+  - save(state, path, step): atomic orbax write of the full TrainState
+    pytree (params + optimizer state + step counter) keyed by step;
+  - restore(path[, step]): back to a TrainState, optionally resharded
+    onto a mesh (restore on a different topology than the save — the
+    arrays are placed per the trainer's own param/opt specs);
+  - latest_step(path): resume-from-newest without bookkeeping files.
+
+Works for single-device and mesh-sharded states alike: orbax persists
+the addressable shards and the restore path re-places them.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from .train import TrainState
+
+
+def _manager(path: str):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(path),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3,
+                                             create=True))
+
+
+def save(state: TrainState, path: str, *, step: int | None = None) -> int:
+    """Persist the TrainState under `path` keyed by `step` (defaults to
+    state.step).  Returns the step saved.  Keeps the newest 3.
+    Blocking: the manager is closed before returning (close() waits for
+    the write), so the checkpoint is durable when this returns — hold a
+    long-lived CheckpointManager yourself if you want async saves."""
+    import orbax.checkpoint as ocp
+
+    step = int(state.step) if step is None else int(step)
+    mgr = _manager(path)
+    mgr.save(step, args=ocp.args.StandardSave(state._asdict()))
+    mgr.close()
+    return step
+
+
+def latest_step(path: str) -> int | None:
+    """Newest saved step under `path`, or None if nothing is there."""
+    if not os.path.isdir(path):
+        return None
+    mgr = _manager(path)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(path: str, like: TrainState, *,
+            step: int | None = None) -> TrainState:
+    """Load a TrainState.  step=None resumes the newest save.
+
+    `like` is REQUIRED: a freshly-initialized TrainState from the
+    trainer that will resume.  It supplies (a) the pytree STRUCTURE —
+    optimizer states are optax NamedTuples that a structure-free
+    restore would flatten into dicts — and (b) the target shardings,
+    so a single-device save resumes directly onto a mesh-sharded
+    trainer (or vice versa) with arrays placed where that trainer
+    expects them."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(path)
+    step = mgr.latest_step() if step is None else step
+    if step is None:
+        mgr.close()
+        raise FileNotFoundError(f"no checkpoint under {path}")
+
+    def absify(x):
+        sh = getattr(x, "sharding", None)
+        if not isinstance(sh, jax.sharding.Sharding):
+            sh = None
+        return jax.ShapeDtypeStruct(np.shape(x),
+                                    getattr(x, "dtype", np.float32),
+                                    sharding=sh)
+
+    tmpl = jax.tree.map(absify, like._asdict())
+    out = mgr.restore(step, args=ocp.args.StandardRestore(tmpl))
+    mgr.close()
+    return TrainState(**out)
+
+
+def save_params_npz(params, path: str) -> None:
+    """Flat .npz export of a param tree (interchange/debugging; the
+    orbax path above is the durable trainer format)."""
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(params):
+        key = "/".join(getattr(p, "key", str(p)) for p in kp)
+        flat[key] = np.asarray(leaf)
+    np.savez(path, **flat)

@@ -33,7 +33,7 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.encoder import EncoderConfig, EncoderLayer
+from ..models.encoder import EncoderConfig, EncoderLayer, pool_normalize
 from .mesh import shard_map
 
 
@@ -44,16 +44,60 @@ def stack_layer_params(params, cfg: EncoderConfig):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
 
 
+def stage_params(params, cfg: EncoderConfig, mesh: Mesh,
+                 axis: str = "pp"):
+    """ONE-TIME setup for the pipeline: split the param tree into
+    (outer, staged) and PLACE them —
+
+      outer  = non-layer params (tok_emb, ln_emb) replicated;
+      staged = layer params stacked to (stages, layers_per_stage, ...)
+               and sharded P(axis), so each device physically holds
+               only its own stage's layers.
+
+    This is where the HBM win happens: pass the result to
+    make_pipeline_encode_fn / pipeline_encode_staged and the full
+    layer stack never materializes on any single chip.  (The
+    convenience wrapper pipeline_encode() stages a replicated tree on
+    every call — fine for tests and parity checks, NOT the
+    big-model path.)"""
+    stages = mesh.shape[axis]
+    if cfg.layers % stages:
+        raise ValueError(f"layers={cfg.layers} must divide into "
+                         f"{stages} pipeline stages")
+    per = cfg.layers // stages
+    p = params["params"] if "params" in params else params
+    outer = {k: v for k, v in p.items() if not k.startswith("layer_")}
+    stacked = stack_layer_params(params, cfg)
+    stacked = jax.tree.map(
+        lambda a: a.reshape((stages, per) + a.shape[1:]), stacked)
+    staged = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))),
+        stacked)
+    outer = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P())), outer)
+    return outer, staged
+
+
 def pipeline_encode(cfg: EncoderConfig, mesh: Mesh, params,
                     token_ids, attn_mask, *, microbatches: int,
                     axis: str = "pp"):
-    """Encoder forward with the layer stack pipelined over `axis`.
+    """Convenience wrapper: stage a (replicated) param tree and run one
+    pipelined forward.  token_ids: (B, S) int32; attn_mask: (B, S)
+    bool.  Returns (B, out_dim) float32 — identical to Encoder.apply
+    on the same params.  For repeated use (and for models that only
+    fit BECAUSE of pipelining) call stage_params() once and use
+    make_pipeline_encode_fn / pipeline_encode_staged instead."""
+    outer, staged = stage_params(params, cfg, mesh, axis)
+    return pipeline_encode_staged(cfg, mesh, outer, staged,
+                                  token_ids, attn_mask,
+                                  microbatches=microbatches, axis=axis)
 
-    token_ids: (B, S) int32; attn_mask: (B, S) bool.  B must divide by
-    `microbatches`; cfg.layers must divide by the axis size.  Returns
-    (B, out_dim) float32 — identical to Encoder.apply on the same
-    params.
-    """
+
+def pipeline_encode_staged(cfg: EncoderConfig, mesh: Mesh, outer, staged,
+                           token_ids, attn_mask, *, microbatches: int,
+                           axis: str = "pp"):
+    """Pipelined encoder forward over pre-staged params (stage_params).
+    Differentiable w.r.t. (outer, staged)."""
     if cfg.variant != "nomic":
         raise ValueError("pipeline_encode supports the rotary 'nomic' "
                          "variant (bert adds a position table)")
@@ -64,27 +108,18 @@ def pipeline_encode(cfg: EncoderConfig, mesh: Mesh, params,
             "chunks and silently mis-position/mis-pool — compose pp "
             "with dp/tp instead")
     stages = mesh.shape[axis]
-    if cfg.layers % stages:
-        raise ValueError(f"layers={cfg.layers} must divide into "
-                         f"{stages} pipeline stages")
     B, S = token_ids.shape
     M = microbatches
     if B % M:
         raise ValueError(f"batch {B} must divide into {M} microbatches")
     mb = B // M
 
-    p = params["params"] if "params" in params else params
-    # replicated pre-stage: embedding + embedding layernorm
+    # replicated pre-stage: the SAME nn modules Encoder.__call__ runs,
+    # applied over the outer params (no math duplicated to drift)
     x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype) \
-        .apply({"params": p["tok_emb"]}, jnp.asarray(token_ids))
+        .apply({"params": outer["tok_emb"]}, jnp.asarray(token_ids))
     x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype) \
-        .apply({"params": p["ln_emb"]}, x)
-
-    # (stages, L/stages, ...) stacked layer params, stage axis sharded
-    stacked = stack_layer_params(params, cfg)
-    per = cfg.layers // stages
-    stacked = jax.tree.map(
-        lambda a: a.reshape((stages, per) + a.shape[1:]), stacked)
+        .apply({"params": outer["ln_emb"]}, x)
 
     x_mb = x.reshape(M, mb, S, cfg.hidden)
     m_mb = jnp.asarray(attn_mask, bool).reshape(M, mb, S)
@@ -126,17 +161,11 @@ def pipeline_encode(cfg: EncoderConfig, mesh: Mesh, params,
             step, (zero, out_buf), jnp.arange(n_steps))
         # pool BEFORE re-replicating: the end-of-pipe collective then
         # carries (M, mb, out_dim), not the S-times-larger activations.
-        # Tail mirrors Encoder.__call__ (parity pinned by tests); on
-        # non-last stages out_buf is all zeros, so the masked pooled
-        # value is zeros too (no NaN) and the where+psum discards it.
-        yf = out_buf.astype(jnp.float32)          # (M, mb, S, H)
-        mm = m_mb.astype(jnp.float32)[..., None]
-        sums = (yf * mm).sum(axis=2)
-        counts = mm.sum(axis=2)
-        pooled = sums / jnp.maximum(counts, 1.0)
-        pooled = pooled[..., : cfg.out_dim]
-        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
-        pooled = pooled / jnp.maximum(norm, 1e-9)
+        # The head is the shared pool_normalize (encoder.py) so the
+        # tail cannot drift from Encoder.__call__; on non-last stages
+        # out_buf is all zeros, so the pooled value is zeros too (no
+        # NaN) and the where+psum discards it.
+        pooled = pool_normalize(cfg, out_buf, m_mb)   # (M, mb, out)
         return jax.lax.psum(
             jnp.where(s == stages - 1, pooled, 0.0), axis)
 
@@ -146,14 +175,19 @@ def pipeline_encode(cfg: EncoderConfig, mesh: Mesh, params,
         out_specs=P(),
         check_vma=False,
     )
-    return fn(stacked, x_mb, m_mb).reshape(B, cfg.out_dim)
+    return fn(staged, x_mb, m_mb).reshape(B, cfg.out_dim)
 
 
-def make_pipeline_encode_fn(cfg: EncoderConfig, mesh: Mesh, *,
+def make_pipeline_encode_fn(cfg: EncoderConfig, mesh: Mesh, params, *,
                             microbatches: int, axis: str = "pp"):
-    """jit-ready closure over (params, token_ids, attn_mask)."""
+    """Stage the params ONCE (each device keeps only its stage's
+    layers; see stage_params) and return a jitted
+    fn(token_ids, attn_mask) -> (B, out_dim)."""
+    outer, staged = stage_params(params, cfg, mesh, axis)
+
     @jax.jit
-    def fn(params, token_ids, attn_mask):
-        return pipeline_encode(cfg, mesh, params, token_ids, attn_mask,
-                               microbatches=microbatches, axis=axis)
+    def fn(token_ids, attn_mask):
+        return pipeline_encode_staged(
+            cfg, mesh, outer, staged, token_ids, attn_mask,
+            microbatches=microbatches, axis=axis)
     return fn

@@ -67,6 +67,11 @@ BASELINE_PER_CHIP = 12_500.0
 N_TEXTS = int(os.environ.get("BENCH_TEXTS", "4096"))
 BATCH = int(os.environ.get("BENCH_BATCH", "512"))
 BUCKET = int(os.environ.get("BENCH_BUCKET", "64"))
+# buckets the model may route texts to (largest = BUCKET): short texts
+# run narrow programs instead of paying BUCKET-wide padding
+BUCKETS = tuple(int(x) for x in os.environ.get(
+    "BENCH_BUCKETS", f"16,32,{BUCKET}").split(",")) \
+    if os.environ.get("BENCH_BUCKETS") != "" else (BUCKET,)
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "1200"))
 ATTEMPT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "420"))
 PROBE_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
@@ -139,6 +144,9 @@ def child() -> int:
     from libsplinter_tpu.models import (EmbeddingModel, EncoderConfig,
                                         default_tokenizer)
 
+    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()          # shapes compile once per machine
+
     _stage("client-init")           # first device access claims the tunnel
     n_chips = len(jax.devices())
     backend = jax.default_backend()
@@ -146,14 +154,17 @@ def child() -> int:
     log(f"backend={backend} devices={jax.devices()}")
 
     cfg = EncoderConfig(out_dim=768, max_len=2048)
-    model = EmbeddingModel(cfg, buckets=(BUCKET,))
+    model = EmbeddingModel(cfg, buckets=BUCKETS)
     tok = default_tokenizer(cfg.vocab_size)
 
     _stage("compile")
     t0 = time.perf_counter()
-    ids = np.zeros((BATCH, BUCKET), np.int32)
-    lens = np.full((BATCH,), BUCKET, np.int32)
-    model.encode_ids(ids, lens)
+    for bsz in (1, BATCH):          # p50 probe path + throughput path
+        for b in model.buckets[:-1] if len(model.buckets) > 1 \
+                else model.buckets:
+            ids = np.zeros((bsz, b), np.int32)
+            lens = np.full((bsz,), b, np.int32)
+            model.encode_ids(ids, lens)
     compile_s = time.perf_counter() - t0
     _stage("compile-done")
     log(f"compile: {compile_s:.1f}s")
@@ -174,6 +185,23 @@ def child() -> int:
     emb = Embedder(st, model=model, tokenizer=tok, max_ctx=2048,
                    batch_cap=BATCH)
     emb.attach()
+
+    # -- untimed first drain: absorbs every data-dependent program
+    # compile (tail batches pad to powers of two the fixed warmup can't
+    # enumerate); on a warm .xla_cache this costs one plain drain
+    _stage("throughput-warm-drain")
+    t0 = time.perf_counter()
+    done = emb.run_once()
+    log(f"warm drain: {done}/{N_TEXTS} in "
+        f"{time.perf_counter() - t0:.2f}s (compiles included)")
+
+    # re-arm every key (epoch bump + label) so the timed drain redoes
+    # the full store->tokenize->encode->commit pipeline with zero
+    # compiles in the measured window
+    for i, t in enumerate(texts):
+        key = f"bench/{i}"
+        st.set(key, t)
+        st.label_or(key, P.LBL_EMBED_REQ)
 
     # -- timed drain (throughput) -----------------------------------------
     _stage("throughput")
@@ -230,7 +258,8 @@ def child() -> int:
     _stage("done")
     emit(eps, eps / BASELINE_PER_CHIP, {
         "backend": backend, "n_chips_visible": n_chips,
-        "bucket": BUCKET, "batch": BATCH, "n_texts": N_TEXTS,
+        "bucket": BUCKET, "buckets": list(model.buckets[:-1]),
+        "batch": BATCH, "n_texts": N_TEXTS,
         "compile_s": round(compile_s, 1),
         "p50_set_to_vector_ms": round(p50, 2),
         "p95_set_to_vector_ms": round(p95, 2),

@@ -51,7 +51,13 @@ def log(*a):
 
 
 def main() -> int:
+    import faulthandler
+
     import numpy as np
+
+    # a phase that hangs (tunnel stall, surprise compile) must leave a
+    # stack in the log before the watcher's timeout SIGKILLs us
+    faulthandler.dump_traceback_later(300, repeat=True, file=sys.stderr)
 
     if CPU_MODE:
         from libsplinter_tpu.utils.jaxplatform import force_cpu
@@ -106,7 +112,12 @@ def main() -> int:
     tps_chunked = tokens_per_sec(CHUNK, N_TOKENS)
     # the reference's cadence: host<->device sync every token
     tps_serial = tokens_per_sec(1, max(32, N_TOKENS // 4))
+    # wide-chunk point: how far does amortizing the host sync scale?
+    model.warmup(chunk=32)
+    tokens_per_sec(32, 64)
+    tps_c32 = tokens_per_sec(32, max(N_TOKENS, 128))
     log(f"decode: {tps_chunked:,.1f} tok/s chunked (chunk={CHUNK}), "
+        f"{tps_c32:,.1f} tok/s (chunk=32), "
         f"{tps_serial:,.1f} tok/s per-token sync")
 
     # -- completion daemon e2e --------------------------------------------
@@ -120,6 +131,7 @@ def main() -> int:
     comp = Completer(st, model=model, max_new_tokens=32,
                      flush_tokens=CHUNK, template="none")
     comp.attach()
+    log("completer e2e ...")
     e2e = []
     for i in range(3):
         key = f"q/{i}"
@@ -129,6 +141,7 @@ def main() -> int:
         st.bump(key)
         comp.run_once()
         e2e.append((time.perf_counter() - t0) * 1000)
+        log(f"completer e2e request {i}: {e2e[-1]:.0f} ms")
     e2e_ms = float(np.median(e2e))
     log(f"completer e2e (32 new tokens): {e2e_ms:.0f} ms")
     st.close()
@@ -146,6 +159,7 @@ def main() -> int:
             "chunk": CHUNK, "n_tokens": N_TOKENS,
             "prefill_ms_bucket64": round(prefill_ms, 2),
             "tokens_per_sec_serial_sync": round(tps_serial, 1),
+            "tokens_per_sec_chunk32": round(tps_c32, 1),
             "completer_e2e_ms_32tok": round(e2e_ms, 0),
         },
     }

@@ -27,7 +27,7 @@ import dataclasses
 import logging
 import os
 import time
-from collections import defaultdict
+from collections import deque
 from typing import Callable, Sequence
 
 import numpy as np
@@ -40,6 +40,8 @@ log = logging.getLogger("libsplinter_tpu.embedder")
 
 # An encoder takes a list of texts and returns (B, dim) float32 vectors.
 EncoderFn = Callable[[Sequence[str]], np.ndarray]
+
+
 
 
 @dataclasses.dataclass
@@ -150,13 +152,33 @@ class Embedder:
             lens[i] = len(e)
         return self._model.encode_ids(ids, lens)
 
+    def _dispatch_bucketed(self, ids: np.ndarray, lens: np.ndarray):
+        """Group rows by their own padding bucket and dispatch one
+        encode per (bucket, <=batch_cap) group, without forcing any
+        result.  Yields (row_selection, PendingEmbeddings) lazily so
+        the consumer's in-flight bound actually applies back-pressure
+        between dispatches (an eager list would enqueue the whole
+        window on the device before the first commit).
+
+        Grouping matters: the reference pays each text its own length
+        (serial llama.cpp decode); a naive batch pays every text the
+        LONGEST text's bucket.  Grouping keeps short texts on narrow
+        programs — most of the padding FLOPs come back."""
+        bkts = self._model.buckets_for(np.asarray(lens))
+        for b in np.unique(bkts):
+            sel = np.nonzero(bkts == b)[0]
+            for lo in range(0, len(sel), self.batch_cap):
+                ss = sel[lo: lo + self.batch_cap]
+                yield ss, self._model.encode_ids_async(
+                    np.ascontiguousarray(ids[ss, : int(b)]),
+                    np.minimum(lens[ss], b).astype(np.int32))
+
     def _encode_bucketed(self, ids: np.ndarray, lens: np.ndarray):
-        """Shared encode tail: pick the padding bucket from the real
-        token counts, truncate, clamp lens, run the jit program."""
-        bucket = self._model.bucket_for(int(lens.max()))
-        return self._model.encode_ids(
-            np.ascontiguousarray(ids[:, :bucket]),
-            np.minimum(lens, bucket).astype(np.int32))
+        """Synchronous encode tail for the public encoder_fn surface."""
+        vecs = np.zeros((len(lens), self._model.cfg.out_dim), np.float32)
+        for sel, pend in self._dispatch_bucketed(ids, lens):
+            vecs[sel] = pend.materialize()
+        return vecs
 
     def _too_long(self, text: str) -> bool:
         if self._tok is None:
@@ -242,6 +264,11 @@ class Embedder:
         return (np.array([self._too_long(t) for t in texts], bool),
                 None, None)
 
+    # how many dispatched encode batches may be outstanding before the
+    # host blocks to commit the oldest: with jax's async dispatch the
+    # TPU works on batch k+1..k+DEPTH while the host commits batch k
+    _INFLIGHT_DEPTH = 2
+
     def process_rows(self, rows: list[int]) -> int:
         """Embed a set of candidate slot indices; returns committed count."""
         st = self.store
@@ -251,13 +278,40 @@ class Embedder:
         self._pending.update(rows)            # until each row resolves
         keep, texts, epochs = self._gather(rows)
 
+        # order the drain by text byte length (a cheap token-count
+        # proxy): windows become nearly bucket-homogeneous, so the
+        # bucket grouping fills whole batch_cap batches instead of
+        # fragmenting every window into per-bucket stragglers
+        if len(keep) > 1:
+            order = sorted(range(len(keep)), key=lambda i: len(texts[i]))
+            keep = [keep[i] for i in order]
+            texts = [texts[i] for i in order]
+            epochs = [epochs[i] for i in order]
+
+        from ..models.encoder import PendingEmbeddings
+
         committed_total = 0
         t_start = Store.now()
-        # the guard + tokenize + encode pipeline runs per batch_cap
-        # chunk: the fused tokenization materializes (chunk, max_len)
-        # ids, which must stay bounded on huge drains (backfill sweeps)
-        for lo in range(0, len(keep), self.batch_cap):
-            ch = slice(lo, lo + self.batch_cap)
+        inflight: deque = deque()             # (rows, epochs, pending)
+
+        def commit_oldest():
+            nonlocal committed_total
+            r, e, pend = inflight.popleft()
+            committed_total += self._commit_batch(
+                r, e, pend.materialize(), t_start)
+
+        def enqueue(rows_b, eps_b, pend):
+            inflight.append((rows_b, eps_b, pend))
+            while len(inflight) > self._INFLIGHT_DEPTH:
+                commit_oldest()
+
+        # guard + tokenize run per window (a few batch_caps): the fused
+        # tokenization materializes (window, max_len) ids, which must
+        # stay bounded on huge drains (backfill sweeps), while giving
+        # the bucket grouping enough rows to fill homogeneous batches
+        window = max(self.batch_cap * 4, 512)
+        for lo in range(0, len(keep), window):
+            ch = slice(lo, lo + window)
             ch_rows, ch_texts, ch_eps = keep[ch], texts[ch], epochs[ch]
 
             # context-window guard (reference: splinference.cpp:226-233)
@@ -276,55 +330,73 @@ class Embedder:
                 continue
 
             if ids is not None:
-                # ids already tokenized by the guard pass
-                vecs = np.asarray(self._encode_bucketed(
-                    ids[ok_i], lens[ok_i]), np.float32)
+                # ids already tokenized by the guard pass: group by
+                # per-row bucket and dispatch without forcing
+                rows_a = np.asarray(ok_rows)
+                eps_a = np.asarray(ok_epochs)
+                for ss, pend in self._dispatch_bucketed(
+                        ids[ok_i], lens[ok_i]):
+                    enqueue([int(x) for x in rows_a[ss]],
+                            [int(x) for x in eps_a[ss]], pend)
             else:
-                vecs = np.asarray(self.encoder_fn(ok_texts), np.float32)
-            results = st.vec_commit_batch(
-                np.asarray(ok_rows, np.uint32),
-                np.asarray(ok_epochs, np.uint64),
-                vecs, write_once=self.vector_training)
-            self.stats.batches += 1
-            for idx, e, r in zip(ok_rows, ok_epochs, results):
-                if r == 0:
-                    committed_total += 1
-                    expected = e + 2          # our commit's epoch bump
-                    key = st.key_at(idx)
-                    if key is not None:
-                        st.label_clear(key,
-                                       P.LBL_EMBED_REQ | P.LBL_WAITING)
-                        try:
-                            st.stamp(key, which=0,
-                                     ticks_ago=Store.now() - t_start)
-                            expected += 2     # stamp's epoch bump
-                        except Exception:
-                            pass
-                    # a content writer racing between our commit and here
-                    # must not be masked: only record the slot as done if
-                    # the epoch is exactly what OUR mutations produced
-                    # (the reference's epoch==pre+2 check,
-                    # splinference.cpp:275-287)
-                    if st.epoch_at(idx) == expected:
-                        self._known_epochs[idx] = expected
-                        self._pending.discard(idx)
-                    else:
-                        self._known_epochs.pop(idx, None)
-                        if key is not None:
-                            try:  # restore the wake label we cleared
-                                st.label_or(key, P.LBL_EMBED_REQ)
-                            except KeyError:
-                                pass
-                elif r == -17:  # EEXIST: write-once gate
-                    self.stats.skipped_write_once += 1
-                    self._known_epochs[idx] = e
-                    self._pending.discard(idx)
-                else:           # ESTALE: raced with a writer; retry later
-                    self.stats.raced += 1
+                for slo in range(0, len(ok_rows), self.batch_cap):
+                    sl = slice(slo, slo + self.batch_cap)
+                    vecs = np.asarray(self.encoder_fn(ok_texts[sl]),
+                                      np.float32)
+                    enqueue(ok_rows[sl], ok_epochs[sl],
+                            PendingEmbeddings(vecs, len(vecs)))
+        while inflight:
+            commit_oldest()
         self.stats.embedded += committed_total
         if committed_total and P.KEY_DONE_LANE in st:
             st.bump(P.KEY_DONE_LANE)
         return committed_total
+
+    def _commit_batch(self, ok_rows, ok_epochs, vecs: np.ndarray,
+                      t_start: int) -> int:
+        """Epoch-gated bulk vector commit + per-row protocol tail
+        (labels, ctime stamp, the reference's epoch==pre+2 race check,
+        splinference.cpp:275-287).  Returns the committed count."""
+        st = self.store
+        committed = 0
+        results = st.vec_commit_batch(
+            np.asarray(ok_rows, np.uint32),
+            np.asarray(ok_epochs, np.uint64),
+            vecs, write_once=self.vector_training)
+        self.stats.batches += 1
+        for idx, e, r in zip(ok_rows, ok_epochs, results):
+            if r == 0:
+                committed += 1
+                expected = e + 2              # our commit's epoch bump
+                key = st.key_at(idx)
+                if key is not None:
+                    st.label_clear(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+                    try:
+                        st.stamp(key, which=0,
+                                 ticks_ago=Store.now() - t_start)
+                        expected += 2         # stamp's epoch bump
+                    except Exception:
+                        pass
+                # a content writer racing between our commit and here
+                # must not be masked: only record the slot as done if
+                # the epoch is exactly what OUR mutations produced
+                if st.epoch_at(idx) == expected:
+                    self._known_epochs[idx] = expected
+                    self._pending.discard(idx)
+                else:
+                    self._known_epochs.pop(idx, None)
+                    if key is not None:
+                        try:  # restore the wake label we cleared
+                            st.label_or(key, P.LBL_EMBED_REQ)
+                        except KeyError:
+                            pass
+            elif r == -17:  # EEXIST: write-once gate
+                self.stats.skipped_write_once += 1
+                self._known_epochs[idx] = e
+                self._pending.discard(idx)
+            else:           # ESTALE: raced with a writer; retry later
+                self.stats.raced += 1
+        return committed
 
     def drain(self, *, sweep: bool = False) -> int:
         """One drain cycle.  The hot path (sweep=False) is fed ONLY by
@@ -444,6 +516,8 @@ def main(argv: list[str] | None = None) -> int:
     if os.environ.get("SPTPU_FORCE_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    from ..utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()
     store = Store.open(args.store, persistent=args.persistent)
     model = tokenizer = None
     max_ctx = args.max_ctx or 2048

@@ -205,24 +205,55 @@ def pool_normalize(cfg: EncoderConfig, x, attn_mask, *,
     return pooled / jnp.maximum(norm, 1e-9)
 
 
+class PendingEmbeddings:
+    """An encode dispatched but not yet forced.  jax's async dispatch
+    means the TPU computes (and the tunnel round-trips fly) while the
+    host does other work; materialize() blocks for the result.  The
+    batch may have been padded — only the first `n` rows are real."""
+
+    __slots__ = ("_out", "n")
+
+    def __init__(self, out, n: int):
+        self._out = out
+        self.n = n
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self._out)[: self.n]
+
+
+def _batch_pad(n: int) -> int:
+    """Next power of two >= n: the batch dimension must come from a
+    small fixed set or every odd-sized drain compiles a fresh XLA
+    program (~10 s on TPU) on what should be the hot path."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
 class EmbeddingModel:
     """Bucketed, jit-compiled embedding front end.
 
-    Sequences are padded to the nearest bucket so XLA compiles a small,
-    fixed set of programs (no recompiles on the hot path — SURVEY.md §7
-    "pre-compiled buckets").
+    Sequences are padded to the nearest bucket and batches to the next
+    power of two, so XLA compiles a small, fixed set of programs (no
+    recompiles on the hot path — SURVEY.md §7 "pre-compiled buckets").
+    The attention mask is derived from the lengths INSIDE the program:
+    the host ships (B, S) ids + (B,) lengths, not a second (B, S)
+    boolean — half the transfer on a tunnel where round trips dominate
+    small-batch latency.
     """
 
     def __init__(self, cfg: EncoderConfig, *, seed: int = 0,
-                 buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048),
+                 buckets: tuple[int, ...] = (16, 32, 64, 128, 256, 512,
+                                             1024, 2048),
                  params: Any = None, weights: str | None = None):
         self.cfg = cfg
         self.module = Encoder(cfg)
         # always include max_len itself: a long-context checkpoint whose
         # window exceeds the default bucket list must not have texts
-        # between buckets[-1] and the window silently truncated
-        self.buckets = tuple(b for b in buckets if b < cfg.max_len) \
-            + (cfg.max_len,)
+        # between buckets[-1] and the window silently truncated.
+        # Sorted + deduped: buckets_for's searchsorted requires
+        # ascending order or it routes lengths to oversized buckets.
+        self.buckets = tuple(sorted(
+            {b for b in buckets if b < cfg.max_len} | {cfg.max_len}))
+        self._buckets_arr = np.asarray(self.buckets, np.int64)
         if params is None and weights is not None:
             if weights.endswith(".gguf"):
                 from .gguf import load_encoder_params
@@ -234,7 +265,13 @@ class EmbeddingModel:
                      jnp.ones((1, self.buckets[0]), jnp.bool_))
             params = self.module.init(jax.random.PRNGKey(seed), *dummy)
         self.params = params
-        self._fn = jax.jit(self.module.apply)
+
+        def fwd(params, token_ids, lengths):
+            mask = jnp.arange(token_ids.shape[1])[None, :] < \
+                lengths[:, None]
+            return self.module.apply(params, token_ids, mask)
+
+        self._fn = jax.jit(fwd)
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -242,15 +279,35 @@ class EmbeddingModel:
                 return b
         return self.buckets[-1]
 
+    def buckets_for(self, lengths: np.ndarray) -> np.ndarray:
+        """Vectorised bucket_for: (N,) lengths -> (N,) bucket widths."""
+        i = np.searchsorted(self._buckets_arr, lengths, side="left")
+        return self._buckets_arr[np.minimum(i, len(self.buckets) - 1)]
+
+    def encode_ids_async(self, token_ids: np.ndarray,
+                         lengths: np.ndarray) -> PendingEmbeddings:
+        """Dispatch an encode without forcing the result.  token_ids:
+        (B, S) int32 with S a bucket width; lengths: (B,) valid counts.
+        The batch is padded to a power of two (padded rows have
+        length 0 and mean-pool to the zero vector; rows are
+        independent, so real rows' numerics are unchanged)."""
+        n = token_ids.shape[0]
+        bpad = _batch_pad(n)
+        if bpad != n:
+            token_ids = np.concatenate(
+                [token_ids, np.zeros((bpad - n, token_ids.shape[1]),
+                                     token_ids.dtype)])
+            lengths = np.concatenate(
+                [lengths, np.zeros(bpad - n, lengths.dtype)])
+        out = self._fn(self.params, jnp.asarray(token_ids),
+                       jnp.asarray(lengths.astype(np.int32)))
+        return PendingEmbeddings(out, n)
+
     def encode_ids(self, token_ids: np.ndarray,
                    lengths: np.ndarray) -> np.ndarray:
         """token_ids: (B, S) int32 already padded to a bucket length;
         lengths: (B,) valid lengths.  Returns (B, out_dim) float32."""
-        S = token_ids.shape[1]
-        mask = np.arange(S)[None, :] < lengths[:, None]
-        out = self._fn(self.params, jnp.asarray(token_ids),
-                       jnp.asarray(mask))
-        return np.asarray(out)
+        return self.encode_ids_async(token_ids, lengths).materialize()
 
     def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
         """Pre-compile each (batch, bucket) program off the hot path."""

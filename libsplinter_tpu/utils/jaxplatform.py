@@ -38,6 +38,31 @@ def force_cpu(num_devices: int | None = None) -> None:
         pass  # backend already up — too late to switch, don't crash
 
 
+def enable_compile_cache(path: str | None = None) -> None:
+    """Point XLA's persistent compilation cache at a stable directory.
+
+    Drain batches have data-dependent (power-of-two) batch shapes; the
+    first encounter of a shape costs a ~10 s TPU compile.  With the
+    persistent cache, every shape compiles ONCE per machine — daemon
+    restarts and repeated bench runs start warm.  Call before the
+    first jit execution.  Override dir with SPTPU_XLA_CACHE.
+    """
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "SPTPU_XLA_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", ".xla_cache"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except (RuntimeError, OSError):
+        pass  # cache is an optimization; never fail the caller
+
+
 def tpu_available(timeout_s: float = 60.0) -> bool:
     """Probe whether the TPU backend can be claimed, without risking an
     unbounded hang in this process.

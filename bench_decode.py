@@ -120,6 +120,24 @@ def main() -> int:
         f"{tps_c32:,.1f} tok/s (chunk=32), "
         f"{tps_serial:,.1f} tok/s per-token sync")
 
+    # batched serving: aggregate tok/s over 8 concurrent rows — the
+    # completion daemon's batch_cap path (engine/completer.py
+    # process_batch); a decode step for 8 rows costs ~one row's step
+    def batch_tokens_per_sec(bsz: int, n: int) -> float:
+        prompts = [np.ones((24 + r,), np.int32) for r in range(bsz)]
+        model.reset()
+        t0 = time.perf_counter()
+        got = 0
+        for _col in model.generate_batch(prompts, n, chunk=CHUNK):
+            got += bsz
+        model.reset()
+        return got / (time.perf_counter() - t0)
+
+    batch_tokens_per_sec(8, CHUNK * 2)        # warm (prefill + chunk progs)
+    tps_b8 = batch_tokens_per_sec(8, N_TOKENS)
+    log(f"batched decode: {tps_b8:,.1f} aggregate tok/s (batch=8, "
+        f"chunk={CHUNK})")
+
     # -- completion daemon e2e --------------------------------------------
     from libsplinter_tpu import Store
     from libsplinter_tpu.engine import protocol as P
@@ -160,6 +178,7 @@ def main() -> int:
             "prefill_ms_bucket64": round(prefill_ms, 2),
             "tokens_per_sec_serial_sync": round(tps_serial, 1),
             "tokens_per_sec_chunk32": round(tps_c32, 1),
+            "tokens_per_sec_batch8_aggregate": round(tps_b8, 1),
             "completer_e2e_ms_32tok": round(e2e_ms, 0),
         },
     }

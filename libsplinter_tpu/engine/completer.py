@@ -124,11 +124,13 @@ class Completer:
                  flush_tokens: int = 8,
                  rebid_tokens: int = 32,
                  template: str = "chatml",
-                 group: int = P.GROUP_INFER):
+                 group: int = P.GROUP_INFER,
+                 batch_cap: int = 8):
         self.store = store
         self.max_new = max_new_tokens
         self.flush_tokens = flush_tokens
         self.rebid_tokens = rebid_tokens
+        self.batch_cap = batch_cap
         if template not in TEMPLATES:
             raise ValueError(
                 f"unknown chat template {template!r} (supported: "
@@ -185,15 +187,28 @@ class Completer:
 
     # -- model backend -----------------------------------------------------
 
+    def _clip_context(self, ids: list[int], *, bucketed: bool) -> list[int]:
+        """Keep the most recent context that still leaves max_new decode
+        slots in the window.  Serial prefill parks the decode position
+        at the REAL prompt length, so its budget is raw
+        (max_len - max_new - 1).  Batched prefill left-pads to a bucket
+        and parks at the BUCKET width (models/decoder.py prefill_batch),
+        so the batched budget must be the largest bucket that still
+        fits — a raw budget would round up into the window and strand
+        every row with ~zero decode room."""
+        m = self._model
+        if bucketed:
+            fit = [b for b in m.buckets if b + self.max_new <= m.cfg.max_len]
+            budget = fit[-1] if fit else min(m.buckets)
+        else:
+            budget = m.cfg.max_len - self.max_new - 1
+            if budget < 1:
+                budget = m.cfg.max_len // 2
+        return ids[-budget:] if len(ids) > budget else ids
+
     def _model_generate(self, prompt: str) -> Iterator[bytes]:
         m, tok = self._model, self._tok
-        ids = tok.encode(prompt)
-        # keep the most recent context if the prompt overflows the window
-        budget = m.cfg.max_len - self.max_new - 1
-        if budget < 1:
-            budget = m.cfg.max_len // 2
-        if len(ids) > budget:
-            ids = ids[-budget:]
+        ids = self._clip_context(tok.encode(prompt), bucketed=False)
         import numpy as np
         try:
             # chunk-at-a-time on-device decode: the host syncs once per
@@ -210,26 +225,29 @@ class Completer:
 
     # -- the completion ----------------------------------------------------
 
-    def process_key(self, idx: int) -> bool:
-        """Run one completion for slot idx.  Returns True if serviced."""
+    def _prepare(self, idx: int):
+        """The per-key request head (splainference.cpp:190-269): guarded
+        prompt read, fresh system-prompt fetch, template render,
+        WAITING→SERVICING flip, slot overwrite with the rendered
+        prompt.  Returns (key, rendered, t0) or None."""
         st = self.store
         e = st.epoch_at(idx)
         if e & 1:
-            return False              # writer active: next wake
+            return None               # writer active: next wake
         if not st.labels_at(idx) & P.LBL_INFER_REQ:
-            return False              # slot recycled since enumeration:
+            return None               # slot recycled since enumeration:
                                       # never service a key that didn't ask
         key = st.key_at(idx)
         if key is None:
-            return False
+            return None
         try:
             prompt = st.get_at(idx).rstrip(b"\0").decode(
                 "utf-8", errors="replace")
         except Exception:
-            return False
+            return None
         if st.epoch_at(idx) != e:
             self.stats.raced += 1
-            return False              # torn read: re-queued by next wake
+            return None               # torn read: re-queued by next wake
 
         # system prompt fetched fresh each request
         system = None
@@ -252,6 +270,40 @@ class Completer:
             st.set(key, data)
         except OSError:               # rendered prompt alone overflows —
             st.set(key, data[: st.max_val - 1])   # slice BYTES, not chars
+        return key, rendered, t0
+
+    def _finalize(self, key: str, t0: int, n_tok: int,
+                  truncated: bool) -> None:
+        """The per-key request tail: oom bookkeeping, ctime backfill
+        with tick delta (splainference.cpp:282,383-387),
+        SERVICING→READY flip."""
+        st = self.store
+        if truncated:
+            self.stats.truncated += 1
+            self._debug(f"completion for {key!r} truncated at max_val")
+        try:
+            st.stamp(key, which=0, ticks_ago=Store.now() - t0)
+        except Exception:
+            pass
+        st.label_clear(key, P.LBL_SERVICING)
+        st.label_or(key, P.LBL_READY)
+        st.bump(key)
+        self.stats.completions += 1
+        self.stats.tokens += n_tok
+
+    def _rebid(self) -> None:
+        if self._bid >= 0:
+            try:
+                self.store.shard_rebid(self._bid)
+            except OSError:
+                pass
+
+    def process_key(self, idx: int) -> bool:
+        """Run one completion for slot idx.  Returns True if serviced."""
+        prep = self._prepare(idx)
+        if prep is None:
+            return False
+        key, rendered, t0 = prep
         n_tok, pending, truncated = 0, b"", False
         try:
             for piece in self.generate_fn(rendered):
@@ -263,31 +315,89 @@ class Completer:
                         truncated = True
                         break
                     pending = b""
-                if self.rebid_tokens and n_tok % self.rebid_tokens == 0 \
-                        and self._bid >= 0:
-                    try:
-                        st.shard_rebid(self._bid)
-                    except OSError:
-                        pass
+                if self.rebid_tokens and n_tok % self.rebid_tokens == 0:
+                    self._rebid()
             if pending and not truncated:
                 truncated = not self._flush(key, pending)
         except Exception as ex:       # model failure must not wedge WAITING
             self._debug(f"generation failed for {key!r}: {ex}")
-        if truncated:
-            self.stats.truncated += 1
-            self._debug(f"completion for {key!r} truncated at max_val")
-
-        # ctime backfill with tick delta (splainference.cpp:282,383-387)
-        try:
-            st.stamp(key, which=0, ticks_ago=Store.now() - t0)
-        except Exception:
-            pass
-        st.label_clear(key, P.LBL_SERVICING)
-        st.label_or(key, P.LBL_READY)
-        st.bump(key)
-        self.stats.completions += 1
-        self.stats.tokens += n_tok
+        self._finalize(key, t0, n_tok, truncated)
         return True
+
+    def process_batch(self, idxs: list[int]) -> int:
+        """Service up to batch_cap waiting keys as ONE batched decode.
+
+        The reference is strictly serial — one llama.cpp context per
+        request (splainference.cpp:414-448, 306-365).  Here the decoder
+        left-pads every prompt into one bucket and decodes all rows per
+        device step (models/decoder.py generate_batch), so N concurrent
+        requests cost ~one request's wall clock.  Per-key protocol is
+        IDENTICAL to process_key: label trifecta, rendered-prompt
+        overwrite, word-boundary/8-token streaming appends, per-row oom
+        truncation, ctime backfill, __debug on failure."""
+        import numpy as np
+
+        m, tok = self._model, self._tok
+        prepped = []                  # (key, t0, ids)
+        done_early = 0
+        for idx in idxs:
+            prep = self._prepare(idx)
+            if prep is None:
+                continue
+            key, rendered, t0 = prep
+            ids = self._clip_context(tok.encode(rendered), bucketed=True)
+            if not len(ids):
+                # an empty prompt must fail alone, not poison the whole
+                # batch via prefill_batch's empty-prompt ValueError
+                self._finalize(key, t0, 0, False)
+                done_early += 1
+                continue
+            prepped.append((key, t0, np.asarray(ids, np.int32)))
+        if not prepped:
+            return done_early
+
+        B = len(prepped)
+        n_tok = [0] * B
+        pending = [b""] * B
+        done = [False] * B
+        truncated = [False] * B
+        total = 0
+        try:
+            gen = m.generate_batch([p[2] for p in prepped], self.max_new,
+                                   chunk=max(1, self.flush_tokens))
+            for col in gen:           # (B,) token column per step
+                for r in range(B):
+                    if done[r]:
+                        continue      # speculative token: discard
+                    t = int(col[r])
+                    if t == tok.eos_id:
+                        done[r] = True
+                        continue
+                    key = prepped[r][0]
+                    piece = tok.token_to_piece(t)
+                    pending[r] += piece
+                    n_tok[r] += 1
+                    boundary = piece.endswith((b" ", b"\n", b"\t"))
+                    if boundary or n_tok[r] % self.flush_tokens == 0:
+                        if not self._flush(key, pending[r]):
+                            truncated[r] = True
+                            done[r] = True
+                        pending[r] = b""
+                total += 1
+                if self.rebid_tokens and total % self.rebid_tokens == 0:
+                    self._rebid()
+                if all(done):
+                    break
+        except Exception as ex:       # model failure must not wedge WAITING
+            self._debug(f"batched generation failed: {ex}")
+        finally:
+            m.reset()
+        for r in range(B):
+            key, t0, _ = prepped[r]
+            if pending[r] and not truncated[r]:
+                truncated[r] = not self._flush(key, pending[r])
+            self._finalize(key, t0, n_tok[r], truncated[r])
+        return B + done_early
 
     def _flush(self, key: str, data: bytes) -> bool:
         """Append a flushed run; on overflow truncate-and-mark
@@ -310,19 +420,33 @@ class Completer:
     # -- drain loop --------------------------------------------------------
 
     def run_once(self) -> int:
-        """Enumerate waiting keys and service each (cold-start drain and
-        per-wake drain are the same sweep, splainference.cpp:541-551)."""
+        """Enumerate waiting keys and service them (cold-start drain and
+        per-wake drain are the same sweep, splainference.cpp:541-551).
+        With a model backend, waiting keys are served in batches of
+        batch_cap through one left-padded decode each; a custom
+        generate_fn serves serially (its contract is one prompt)."""
         st = self.store
+        idxs = list(st.enumerate_indices(P.LBL_INFER_REQ))
+        if not idxs:
+            return 0
+        if self._bid >= 0:
+            try:
+                st.shard_rebid(self._bid)
+                st.madvise(self._bid, N.ADV_WILLNEED, timeout_ms=0)
+            except OSError:
+                pass
         n = 0
-        for idx in st.enumerate_indices(P.LBL_INFER_REQ):
-            if self._bid >= 0:
-                try:
-                    st.shard_rebid(self._bid)
-                    st.madvise(self._bid, N.ADV_WILLNEED, timeout_ms=0)
-                except OSError:
-                    pass
-            if self.process_key(idx):
-                n += 1
+        batched = getattr(self, "_model", None) is not None \
+            and self.generate_fn == self._model_generate \
+            and self.batch_cap > 1
+        if batched:
+            for lo in range(0, len(idxs), self.batch_cap):
+                n += self.process_batch(idxs[lo: lo + self.batch_cap])
+        else:
+            for idx in idxs:
+                self._rebid()
+                if self.process_key(idx):
+                    n += 1
         return n
 
     def run(self, *, idle_timeout_ms: int = 100,

@@ -85,9 +85,14 @@ class CausalAttention(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, cache_kv, pos):
-        """x: (B, S, H) chunk at absolute positions pos..pos+S-1.
-        cache_kv: (k, v) each (B, T, KH, D).  Returns (out, new_cache)."""
+    def __call__(self, x, cache_kv, pos, start=None):
+        """x: (B, S, H) chunk at cache slots pos..pos+S-1.
+        cache_kv: (k, v) each (B, T, KH, D).  start: None, or (B,)
+        left-pad offsets for batched serving — row r's real tokens
+        occupy slots start[r].., its rotary position at slot s is
+        s - start[r], and slots below start[r] (pad K/V) are masked.
+        With start=None the graph is the classic single-request one
+        (slot == position).  Returns (out, new_cache)."""
         cfg = self.cfg
         B, S, _ = x.shape
         D = cfg.head_dim
@@ -98,10 +103,14 @@ class CausalAttention(nn.Module):
         v = nn.Dense(cfg.kv_heads * D, use_bias=False, dtype=cfg.dtype,
                      name="v")(x).reshape(B, S, cfg.kv_heads, D)
 
-        # rotary at absolute positions (dynamic under jit)
+        # rotary at per-row positions (dynamic under jit)
         cos_t, sin_t = _rotary_angles(cfg.max_len, D, cfg.rope_base)
-        idx = pos + jnp.arange(S)
-        cos, sin = cos_t[idx], sin_t[idx]          # (S, D/2)
+        idx = pos + jnp.arange(S)                  # cache slots (S,)
+        if start is None:
+            cos, sin = cos_t[idx], sin_t[idx]      # (S, D/2)
+        else:
+            rp = jnp.maximum(idx[None, :] - start[:, None], 0)  # (B, S)
+            cos, sin = cos_t[rp], sin_t[rp]        # (B, S, D/2)
         q = _apply_rotary(q, cos, sin)
         k = _apply_rotary(k, cos, sin)
 
@@ -115,11 +124,17 @@ class CausalAttention(nn.Module):
         vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
 
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(D)
-        # key j visible to query at abs position pos+i iff j <= pos+i
+        # key slot j visible to the query at slot pos+i iff j <= pos+i
+        # (and, batched, iff j is at/after the row's first real slot)
         jpos = jnp.arange(cfg.max_len)[None, :]
         visible = jpos <= idx[:, None]             # (S, T)
-        logits = jnp.where(visible[None, None], logits.astype(jnp.float32),
-                           -1e9)
+        if start is None:
+            mask = visible[None, None]             # (1, 1, S, T)
+        else:
+            mask = (visible[None, :, :] &
+                    (jnp.arange(cfg.max_len)[None, None, :]
+                     >= start[:, None, None]))[:, None]   # (B, 1, S, T)
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e9)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(
             B, S, cfg.heads * D)
@@ -137,11 +152,11 @@ class DecoderLayer(nn.Module):
     mlp_cls: Any = None
 
     @nn.compact
-    def __call__(self, x, cache_kv, pos):
+    def __call__(self, x, cache_kv, pos, start=None):
         cfg = self.cfg
         a, cache_kv = CausalAttention(cfg, name="attn")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_attn")(x),
-            cache_kv, pos)
+            cache_kv, pos, start)
         x = x + a
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_mlp")(x)
         if self.mlp_cls is not None:
@@ -164,17 +179,20 @@ class Decoder(nn.Module):
     mlp_cls: Any = None
 
     @nn.compact
-    def __call__(self, token_ids, cache, pos):
+    def __call__(self, token_ids, cache, pos, start=None):
         """token_ids: (B, S) int32; cache: list of per-layer (k, v);
-        pos: scalar int32 — absolute position of token_ids[:, 0].
-        Returns (logits (B, S, V) float32, new_cache)."""
+        pos: scalar int32 — cache slot of token_ids[:, 0]; start:
+        optional (B,) left-pad offsets (batched serving — see
+        CausalAttention).  Returns (logits (B, S, V) float32,
+        new_cache)."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
                      name="tok_emb")(token_ids)
         new_cache = []
         for i in range(cfg.layers):
             x, kv = DecoderLayer(cfg, self.mlp_cls,
-                                 name=f"layer_{i}")(x, cache[i], pos)
+                                 name=f"layer_{i}")(x, cache[i], pos,
+                                                    start)
             new_cache.append(kv)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_out")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
@@ -204,6 +222,23 @@ def sample_top_p(rng, logits, *, top_p: float = 0.9, temp: float = 0.7):
     """The reference's sampler chain (splainference.cpp:272-279),
     jit-compiled for one-off host-side sampling."""
     return _sample_graph(rng, logits, top_p, temp)
+
+
+def _sample_rows(rng, logits, top_p: float, temp: float):
+    """Per-row sampling graph shared by every batched path (prefill
+    tail and the in-chunk scan step must draw from the SAME sampler):
+    logits (B, V) -> (B,) ids."""
+    subs = jax.random.split(rng, logits.shape[0])
+    return jax.vmap(lambda r, l: _sample_graph(r, l, top_p, temp))(
+        subs, logits)
+
+
+@functools.partial(jax.jit, static_argnames=("top_p", "temp"))
+def sample_top_p_batch(rng, logits, *, top_p: float = 0.9,
+                       temp: float = 0.7):
+    """Batched sampler: logits (B, V) -> (B,) ids in ONE dispatch
+    (B separate sample_top_p calls would pay B device round trips)."""
+    return _sample_rows(rng, logits, top_p, temp)
 
 
 # ------------------------------------------------------------- front end
@@ -252,6 +287,8 @@ class CompletionModel:
         self._rng = jax.random.PRNGKey(seed + 1)
         self._cache = None
         self._pos = 0
+        self._start = None            # (B,) left-pad offsets when batched
+        self._batch = 0
         self._chunk_progs: dict[tuple, Any] = {}
 
     def bucket_for(self, length: int) -> int:
@@ -264,11 +301,14 @@ class CompletionModel:
         """llama_memory_clear analog (splainference.cpp:378)."""
         self._cache = None
         self._pos = 0
+        self._start = None
+        self._batch = 0
 
-    def _fresh_cache(self):
-        """Zeroed KV cache for a new request.  Subclasses place it with
-        an explicit device sharding (parallel.serve)."""
-        return init_cache(self.cfg, 1)
+    def _fresh_cache(self, batch: int = 1):
+        """Zeroed KV cache for a new request (or a batch of them).
+        Subclasses place it with an explicit device sharding
+        (parallel.serve)."""
+        return init_cache(self.cfg, batch)
 
     def prefill(self, prompt_ids: np.ndarray) -> np.ndarray:
         """prompt_ids: (P,) int32, P < max_len.  Pads to a bucket, runs
@@ -289,6 +329,7 @@ class CompletionModel:
         # row <= p is rewritten with real data (prompt or decoded token)
         # before the first query that could see it.
         self._cache, self._pos = cache, P
+        self._start, self._batch = None, 1
         return np.asarray(logits[0, P - 1])
 
     def decode_one(self, token: int) -> np.ndarray:
@@ -348,7 +389,7 @@ class CompletionModel:
                 cur = (self.top_p, self.temp)
                 self._chunk_progs = {
                     k: v for k, v in self._chunk_progs.items()
-                    if (k[1], k[2]) == cur}
+                    if k[-2:] == cur}
         return fn
 
     def decode_chunk(self, token: int, n: int) -> np.ndarray:
@@ -405,6 +446,120 @@ class CompletionModel:
                     return
             tok = int(toks[-1])
             produced += chunk
+
+    # -- batched generation (the aggregate-throughput path) ----------------
+    #
+    # The reference's completion sidecar is strictly serial — one
+    # llama.cpp context, one request at a time (splainference.cpp:
+    # 414-448).  On TPU that wastes the device: a decode step for one
+    # row costs the same dispatch (and, on a tunneled chip, the same
+    # RTT) as a decode step for eight.  Batched serving left-pads the
+    # prompts into one bucket so every row's NEXT slot is uniform:
+    # row r's tokens occupy slots [bucket - P_r, bucket) and decode
+    # proceeds at slot bucket, bucket+1, ... for all rows at once —
+    # only prefill needs per-row position offsets (`start`).
+
+    def prefill_batch(self, prompts: list[np.ndarray]) -> np.ndarray:
+        """Left-padded batched prefill.  prompts: list of (P_i,) int32,
+        each 0 < P_i < max_len.  Returns the last real token's logits
+        per row, (B, vocab) float32."""
+        B = len(prompts)
+        if B == 0:
+            raise ValueError("empty batch")
+        lens = [len(p) for p in prompts]
+        if min(lens) == 0:
+            raise ValueError("empty prompt")
+        if max(lens) >= self.cfg.max_len:
+            raise ValueError("prompt exceeds context window")
+        b = self.bucket_for(max(lens))
+        bp = 1 << max(B - 1, 0).bit_length()     # batch power-of-two pad
+        ids = np.zeros((bp, b), np.int32)
+        start = np.full((bp,), b, np.int32)      # pad rows: no real slots
+        for r, p in enumerate(prompts):
+            ids[r, b - lens[r]:] = p
+            start[r] = b - lens[r]
+        cache = self._fresh_cache(bp)
+        start_d = jnp.asarray(start)
+        logits, cache = self._fn(self.params, jnp.asarray(ids), cache,
+                                 jnp.int32(0), start_d)
+        self._cache, self._pos = cache, b
+        self._start, self._batch = start_d, B
+        # every row's last REAL token sits in the last slot (left pad)
+        return np.asarray(logits[:B, b - 1])
+
+    def _chunk_program_batch(self, n: int, bp: int):
+        """Batched analog of _chunk_program: one lax.scan decoding n
+        slots for bp rows, sampling every row in-graph per step."""
+        key = (n, bp, self.top_p, self.temp)
+        fn = self._chunk_progs.get(key)
+        if fn is None:
+            module, top_p, temp = self.module, self.top_p, self.temp
+
+            def run(params, cache, pos, start, rng, toks):
+                def step(carry, _):
+                    cache, pos, rng, toks = carry
+                    logits, cache = module.apply(
+                        params, toks.reshape(-1, 1), cache, pos, start)
+                    rng, sub = jax.random.split(rng)
+                    nxt = _sample_rows(sub, logits[:, 0], top_p, temp)
+                    return (cache, pos + 1, rng, nxt), nxt
+
+                (cache, _, _, _), out = jax.lax.scan(
+                    step, (cache, pos, rng, toks), None, length=n)
+                return cache, out                  # out: (n, bp)
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            self._chunk_progs[key] = fn
+            if len(self._chunk_progs) > 16:
+                cur = (self.top_p, self.temp)
+                self._chunk_progs = {
+                    k: v for k, v in self._chunk_progs.items()
+                    if k[-2:] == cur}
+        return fn
+
+    def decode_chunk_batch(self, tokens: np.ndarray, n: int) -> np.ndarray:
+        """Append tokens (B,), decode+sample n steps on device for the
+        whole batch.  Returns (B, n) sampled ids.  Rows that already
+        finished keep decoding speculatively — the caller discards."""
+        if self._cache is None or getattr(self, "_start", None) is None:
+            raise RuntimeError("prefill_batch first")
+        if self._pos + n > self.cfg.max_len:
+            raise RuntimeError("context window full")
+        bp = self._cache[0][0].shape[0]
+        toks = np.zeros((bp,), np.int32)
+        toks[: self._batch] = np.asarray(tokens, np.int32)
+        self._rng, sub = jax.random.split(self._rng)
+        self._cache, out = self._chunk_program_batch(n, bp)(
+            self.params, self._cache, jnp.int32(self._pos),
+            self._start, sub, jnp.asarray(toks))
+        self._pos += n
+        return np.asarray(out).T[: self._batch]    # (B, n)
+
+    def generate_batch(self, prompts: list[np.ndarray], max_new: int,
+                       *, chunk: int = 8):
+        """Generator over token COLUMNS for a batch of prompts: first
+        yields the (B,) post-prefill samples, then one (B,) column per
+        decoded step, chunk steps dispatched per device round trip.
+        Rows past their stop condition yield speculative tokens — the
+        consumer tracks per-row completion and discards (same contract
+        as generate_tokens with eos_id=None)."""
+        logits = self.prefill_batch(prompts)
+        self._rng, sub = jax.random.split(self._rng)
+        toks = np.asarray(sample_top_p_batch(
+            sub, jnp.asarray(logits), top_p=self.top_p,
+            temp=self.temp)).astype(np.int32)
+        yield toks.copy()
+        produced = 1
+        while produced < max_new:
+            room = min(self.cfg.max_len - self._pos, max_new - produced)
+            if room <= 0:
+                break
+            step = min(chunk, room)
+            block = self.decode_chunk_batch(toks, step)   # (B, step)
+            for c in range(step):
+                yield block[:, c].copy()
+            toks = block[:, -1].astype(np.int32)
+            produced += step
 
     @property
     def pos(self) -> int:

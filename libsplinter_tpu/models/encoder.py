@@ -74,11 +74,19 @@ def _rotary_angles_at(pos: jnp.ndarray, head_dim: int,
 
 def _apply_rotary(x: jnp.ndarray, cos: jnp.ndarray,
                   sin: jnp.ndarray) -> jnp.ndarray:
-    """x: (B, S, H, D).  Rotates pairs (x1, x2) = (x[..., :half], rest)."""
+    """x: (B, S, H, D).  Rotates pairs (x1, x2) = (x[..., :half], rest).
+    cos/sin: (S, D/2) shared across the batch, or (B, S, D/2) per-row
+    (left-padded batched decode offsets each row's positions)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    cos = cos[None, :, None, :].astype(x.dtype)
-    sin = sin[None, :, None, :].astype(x.dtype)
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    cos = cos.astype(x.dtype)
+    sin = sin.astype(x.dtype)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 

@@ -80,7 +80,7 @@ class ShardedCompletionModel(CompletionModel):
         super().__init__(cfg, **kw)
         self.params = shard_decoder_params(self.params, self.mesh)
 
-    def _fresh_cache(self):
+    def _fresh_cache(self, batch: int = 1):
         sh = NamedSharding(self.mesh, P(None, None, "tp", None))
         return [(jax.device_put(k, sh), jax.device_put(v, sh))
-                for k, v in init_cache(self.cfg, 1)]
+                for k, v in init_cache(self.cfg, batch)]

@@ -1,0 +1,195 @@
+"""Batched completion serving: left-padded batch decode equals serial
+decode row for row, and the completion daemon's batched drain preserves
+the per-key protocol.
+
+The reference is strictly serial (one llama.cpp context per request,
+/root/reference/splainference.cpp:414-448); batching is this
+framework's TPU-first aggregate-throughput design, so its correctness
+bar is exact row-vs-serial equality (greedy) plus protocol parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.models.decoder import CompletionModel, DecoderConfig
+
+PROMPTS = [np.arange(1, 6, dtype=np.int32),
+           np.arange(3, 15, dtype=np.int32),
+           np.array([7, 8, 9], np.int32)]
+
+
+@pytest.fixture(scope="module")
+def model():
+    # f32 on CPU so greedy argmax comparisons are tie-stable
+    return CompletionModel(DecoderConfig.tiny(dtype=jnp.float32),
+                           buckets=(16, 32), temp=0.0)
+
+
+def _serial(model, prompts, n, chunk):
+    out = []
+    for p in prompts:
+        toks = [int(t) for t in model.generate_tokens(p, n, chunk=chunk)]
+        model.reset()
+        out.append(toks)
+    return out
+
+
+def _batched(model, prompts, n, chunk):
+    cols = [c for c in model.generate_batch(prompts, n, chunk=chunk)]
+    model.reset()
+    return [list(map(int, row)) for row in np.stack(cols, axis=1)]
+
+
+def test_batched_greedy_equals_serial(model):
+    """Mixed-length prompts, greedy: every row of the batch must decode
+    the exact serial token sequence (left-pad masking + per-row rotary
+    offsets are position-exact)."""
+    assert _batched(model, PROMPTS, 12, 4) == _serial(model, PROMPTS, 12, 4)
+
+
+def test_batch_of_one_equals_serial(model):
+    assert _batched(model, PROMPTS[:1], 10, 4) == \
+        _serial(model, PROMPTS[:1], 10, 4)
+
+
+def test_batch_padding_isolation(model):
+    """Padding the batch to a power of two (3 real rows + 1 dummy) must
+    not perturb real rows, and neither must batch composition."""
+    two = _batched(model, PROMPTS[:2], 10, 4)
+    three = _batched(model, PROMPTS, 10, 4)
+    assert two == three[:2]
+
+
+def test_chunk_size_invariance(model):
+    """The chunk cadence is a host-sync boundary, not a semantic one."""
+    assert _batched(model, PROMPTS, 12, 3) == _batched(model, PROMPTS, 12, 6)
+
+
+def test_completer_batched_drain_protocol(tmp_path):
+    """N waiting keys drain through ONE batched decode; every key gets
+    the full label trifecta, a completion appended after its rendered
+    prompt, and a ctime stamp."""
+    name = f"/spt-batchcomp-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=128, max_val=2048, vec_dim=8)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(), buckets=(32,),
+                                temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=12,
+                         flush_tokens=4, template="none", batch_cap=4)
+        comp.attach()
+        keys = [f"q/{i}" for i in range(5)]     # 5 > batch_cap: 2 batches
+        for i, k in enumerate(keys):
+            st.set(k, f"prompt number {i}")
+            st.label_or(k, P.LBL_INFER_REQ | P.LBL_WAITING)
+            st.bump(k)
+        n = comp.run_once()
+        assert n == 5
+        assert comp.stats.completions == 5
+        for i, k in enumerate(keys):
+            labels = st.labels(k)
+            assert labels & P.LBL_READY, k
+            assert not labels & (P.LBL_INFER_REQ | P.LBL_WAITING |
+                                 P.LBL_SERVICING), k
+            val = st.get(k).rstrip(b"\0")
+            assert val.startswith(f"prompt number {i}".encode()), k
+            assert len(val) > len(f"prompt number {i}"), \
+                f"{k}: no completion appended"
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_completer_batch_long_prompt_keeps_decode_room(tmp_path):
+    """A prompt that clips near the window must still receive real
+    decode room: the batched budget is measured in PADDING BUCKETS
+    (prefill_batch parks the decode position at the bucket width), so
+    a raw-length budget would strand every row at ~1 token."""
+    name = f"/spt-longp-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=4096, vec_dim=8)
+    try:
+        # window 128, max_new 24: fitting buckets are those <= 104
+        model = CompletionModel(DecoderConfig.tiny(), buckets=(32, 64, 96),
+                                temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=24,
+                         flush_tokens=4, template="none", batch_cap=4)
+        comp.attach()
+        long_prompt = "word " * 300            # way past the window
+        st.set("long", long_prompt.encode()[: 3500])
+        st.set("short", b"hi there")
+        for k in ("long", "short"):
+            st.label_or(k, P.LBL_INFER_REQ)
+            st.bump(k)
+        assert comp.run_once() == 2
+        assert comp.stats.tokens >= 2 * 10, \
+            f"rows starved of decode room: {comp.stats}"
+        for k in ("long", "short"):
+            assert st.labels(k) & P.LBL_READY
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_completer_batch_empty_prompt_isolated(tmp_path):
+    """An empty prompt must fail alone — the other rows of its batch
+    still get full completions (no batch poisoning through
+    prefill_batch's empty-prompt ValueError)."""
+    name = f"/spt-emptyp-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=2048, vec_dim=8)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(), buckets=(32,),
+                                temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=10,
+                         flush_tokens=4, template="none", batch_cap=4)
+        comp.attach()
+        st.set("empty", b"")
+        st.set("good", b"a real question")
+        for k in ("empty", "good"):
+            st.label_or(k, P.LBL_INFER_REQ)
+            st.bump(k)
+        assert comp.run_once() == 2
+        assert st.labels("empty") & P.LBL_READY
+        assert st.labels("good") & P.LBL_READY
+        good = st.get("good").rstrip(b"\0")
+        assert len(good) > len(b"a real question"), \
+            "valid row was poisoned by the empty one"
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_completer_batched_matches_serial_content(tmp_path):
+    """Greedy completions must be byte-identical whether the daemon
+    served the keys batched or one at a time."""
+    out: dict[str, bytes] = {}
+    for cap, tag in ((1, "serial"), (4, "batched")):
+        name = f"/spt-bvs-{tag}-{tmp_path.name}"
+        Store.unlink(name)
+        st = Store.create(name, nslots=64, max_val=2048, vec_dim=8)
+        try:
+            model = CompletionModel(
+                DecoderConfig.tiny(dtype=jnp.float32), buckets=(32,),
+                temp=0.0)
+            comp = Completer(st, model=model, max_new_tokens=10,
+                             flush_tokens=4, template="none",
+                             batch_cap=cap)
+            comp.attach()
+            for i in range(3):
+                k = f"q/{i}"
+                st.set(k, f"say {i} things")
+                st.label_or(k, P.LBL_INFER_REQ)
+                st.bump(k)
+            assert comp.run_once() == 3
+            out[tag] = b"|".join(
+                st.get(f"q/{i}").rstrip(b"\0") for i in range(3))
+        finally:
+            st.close()
+            Store.unlink(name)
+    assert out["serial"] == out["batched"]

@@ -69,12 +69,13 @@ def main() -> int:
     backend = jax.default_backend()
     log(f"backend={backend}")
 
+    quant = os.environ.get("DECODE_QUANT") == "1"
     if GEOMETRY == "tiny":
-        cfg = DecoderConfig.tiny()
+        cfg = DecoderConfig.tiny(quantized=quant)
     else:
         # the completion daemon's default geometry (completer.py):
         # llama-tiny-class 12x768 with the byte tokenizer's padded vocab
-        cfg = DecoderConfig(vocab_size=512)
+        cfg = DecoderConfig(vocab_size=512, quantized=quant)
     model = CompletionModel(cfg)
 
     log("warmup compile (prefill buckets + decode + chunk programs) ...")
@@ -173,6 +174,7 @@ def main() -> int:
         if tps_serial > 0 else 0.0,
         "detail": {
             "backend": backend, "geometry": GEOMETRY,
+            "quantized": quant,
             "layers": cfg.layers, "hidden": cfg.hidden,
             "chunk": CHUNK, "n_tokens": N_TOKENS,
             "prefill_ms_bucket64": round(prefill_ms, 2),

@@ -198,13 +198,29 @@ class Completer:
         every row with ~zero decode room."""
         m = self._model
         if bucketed:
-            fit = [b for b in m.buckets if b + self.max_new <= m.cfg.max_len]
-            budget = fit[-1] if fit else min(m.buckets)
+            budget = self._batched_budget()
+            assert budget is not None, \
+                "run_once must route to serial when no bucket fits"
         else:
             budget = m.cfg.max_len - self.max_new - 1
             if budget < 1:
                 budget = m.cfg.max_len // 2
         return ids[-budget:] if len(ids) > budget else ids
+
+    def _batched_budget(self) -> int | None:
+        """Largest prompt budget the BATCHED path can serve: the widest
+        padding bucket strictly inside the window (prefill_batch
+        requires max(lens) < max_len and parks the decode position at
+        the bucket width), preferring one that also leaves max_new
+        decode slots.  None when every bucket is the window itself —
+        batched prefill would have zero decode room, so run_once falls
+        back to serial serving for that geometry."""
+        m = self._model
+        usable = [b for b in m.buckets if b < m.cfg.max_len]
+        if not usable:
+            return None
+        fit = [b for b in usable if b + self.max_new <= m.cfg.max_len]
+        return fit[-1] if fit else usable[-1]
 
     def _model_generate(self, prompt: str) -> Iterator[bytes]:
         m, tok = self._model, self._tok
@@ -276,7 +292,9 @@ class Completer:
                   truncated: bool) -> None:
         """The per-key request tail: oom bookkeeping, ctime backfill
         with tick delta (splainference.cpp:282,383-387),
-        SERVICING→READY flip."""
+        SERVICING→READY flip.  A key deleted mid-request must fail
+        alone — in a batch, a raising tail would strand the SIBLING
+        rows in SERVICING forever."""
         st = self.store
         if truncated:
             self.stats.truncated += 1
@@ -285,9 +303,12 @@ class Completer:
             st.stamp(key, which=0, ticks_ago=Store.now() - t0)
         except Exception:
             pass
-        st.label_clear(key, P.LBL_SERVICING)
-        st.label_or(key, P.LBL_READY)
-        st.bump(key)
+        try:
+            st.label_clear(key, P.LBL_SERVICING)
+            st.label_or(key, P.LBL_READY)
+            st.bump(key)
+        except (KeyError, OSError):
+            self._debug(f"key {key!r} vanished mid-request")
         self.stats.completions += 1
         self.stats.tokens += n_tok
 
@@ -401,19 +422,23 @@ class Completer:
 
     def _flush(self, key: str, data: bytes) -> bool:
         """Append a flushed run; on overflow truncate-and-mark
-        (splainference.cpp:336-344).  Returns False when full."""
+        (splainference.cpp:336-344).  Returns False when the value is
+        full — or when the key vanished mid-request (client deleted
+        it), which must stop THIS row without touching its batch."""
         st = self.store
         try:
             st.append(key, data)
             return True
+        except KeyError:
+            return False
         except OSError as ex:
             if ex.errno != errno.EMSGSIZE:
                 raise
-            room = st.max_val - 1 - st.value_len(key)
-            tail = data[: max(0, room - len(OOM_MARKER))] + OOM_MARKER
             try:
+                room = st.max_val - 1 - st.value_len(key)
+                tail = data[: max(0, room - len(OOM_MARKER))] + OOM_MARKER
                 st.append(key, tail[: max(0, room)])
-            except OSError:
+            except (KeyError, OSError):
                 pass
             return False
 
@@ -438,7 +463,8 @@ class Completer:
         n = 0
         batched = getattr(self, "_model", None) is not None \
             and self.generate_fn == self._model_generate \
-            and self.batch_cap > 1
+            and self.batch_cap > 1 \
+            and self._batched_budget() is not None
         if batched:
             for lo in range(0, len(idxs), self.batch_cap):
                 n += self.process_batch(idxs[lo: lo + self.batch_cap])

@@ -46,6 +46,11 @@ class DecoderConfig:
     rope_base: float = 10000.0
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # int8 blockwise weight residency (models/quant.py): attention +
+    # MLP kernels live in HBM as Q8_0-geometry int8 + per-block scales
+    # — half bf16's weight bandwidth on the decode path.  Embeddings,
+    # norms, and the LM head stay float.
+    quantized: bool = False
 
     @classmethod
     def tiny(cls, **kw) -> "DecoderConfig":
@@ -81,6 +86,15 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
+def _proj(cfg: DecoderConfig, features: int, name: str):
+    """The decoder's projection layer: nn.Dense, or QuantDense when
+    the config asks for int8 weight residency."""
+    if cfg.quantized:
+        from .quant import QuantDense
+        return QuantDense(features, dtype=cfg.dtype, name=name)
+    return nn.Dense(features, use_bias=False, dtype=cfg.dtype, name=name)
+
+
 class CausalAttention(nn.Module):
     cfg: DecoderConfig
 
@@ -96,12 +110,11 @@ class CausalAttention(nn.Module):
         cfg = self.cfg
         B, S, _ = x.shape
         D = cfg.head_dim
-        q = nn.Dense(cfg.heads * D, use_bias=False, dtype=cfg.dtype,
-                     name="q")(x).reshape(B, S, cfg.heads, D)
-        k = nn.Dense(cfg.kv_heads * D, use_bias=False, dtype=cfg.dtype,
-                     name="k")(x).reshape(B, S, cfg.kv_heads, D)
-        v = nn.Dense(cfg.kv_heads * D, use_bias=False, dtype=cfg.dtype,
-                     name="v")(x).reshape(B, S, cfg.kv_heads, D)
+        q = _proj(cfg, cfg.heads * D, "q")(x).reshape(B, S, cfg.heads, D)
+        k = _proj(cfg, cfg.kv_heads * D, "k")(x).reshape(
+            B, S, cfg.kv_heads, D)
+        v = _proj(cfg, cfg.kv_heads * D, "v")(x).reshape(
+            B, S, cfg.kv_heads, D)
 
         # rotary at per-row positions (dynamic under jit)
         cos_t, sin_t = _rotary_angles(cfg.max_len, D, cfg.rope_base)
@@ -138,8 +151,7 @@ class CausalAttention(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(
             B, S, cfg.heads * D)
-        out = nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
-                       name="out")(out)
+        out = _proj(cfg, cfg.hidden, "out")(out)
         return out, (ck, cv)
 
 
@@ -161,12 +173,9 @@ class DecoderLayer(nn.Module):
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_mlp")(x)
         if self.mlp_cls is not None:
             return x + self.mlp_cls(cfg, name="moe")(h), cache_kv
-        gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
-                        name="gate")(h)
-        up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
-                      name="up")(h)
-        x = x + nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
-                         name="down")(nn.silu(gate) * up)
+        gate = _proj(cfg, cfg.mlp_dim, "gate")(h)
+        up = _proj(cfg, cfg.mlp_dim, "up")(h)
+        x = x + _proj(cfg, cfg.hidden, "down")(nn.silu(gate) * up)
         return x, cache_kv
 
 
@@ -276,6 +285,11 @@ class CompletionModel:
                 params = load_decoder_params(weights, cfg)
             else:
                 params = load_safetensors_params(weights, cfg)
+        if params is not None and cfg.quantized:
+            # float checkpoints re-quantize into the int8-resident
+            # layout (idempotent: already-quantized trees pass through)
+            from .quant import quantize_decoder_params
+            params = quantize_decoder_params(params)
         if params is None:
             cache = init_cache(cfg, 1)
             params = self.module.init(
@@ -351,33 +365,35 @@ class CompletionModel:
 
     # -- chunked decode (the tokens/sec path) -----------------------------
 
-    def _chunk_program(self, n: int):
-        """One lax.scan program decoding n tokens fully on device: per
-        step, forward one token, sample the next in-graph.  The KV cache
-        never round-trips to the host (donated buffer); the host sees
-        only the n sampled token ids per chunk — the reference's
-        8-token flush cadence (splainference.cpp:333-354) becomes the
-        device↔host sync boundary instead of a per-token one."""
+    def _chunk_program(self, n: int, bp: int = 1):
+        """One lax.scan program decoding n slots for bp rows (bp=1 is
+        the serial path): per step, forward one token per row, sample
+        the next in-graph (_sample_rows — the SAME sampler graph for
+        serial, batched, and the prefill tail).  The KV cache never
+        round-trips to the host (donated buffer); the host sees only
+        the sampled ids per chunk — the reference's 8-token flush
+        cadence (splainference.cpp:333-354) becomes the device↔host
+        sync boundary instead of a per-token one."""
         # keyed on the sampler settings too: the program closes over
         # top_p/temp, so a consumer mutating them after first use must
         # get a fresh program, not silently reuse the stale one
-        key = (n, self.top_p, self.temp)
+        key = (n, bp, self.top_p, self.temp)
         fn = self._chunk_progs.get(key)
         if fn is None:
             module, top_p, temp = self.module, self.top_p, self.temp
 
-            def run(params, cache, pos, rng, tok):
+            def run(params, cache, pos, start, rng, toks):
                 def step(carry, _):
-                    cache, pos, rng, tok = carry
+                    cache, pos, rng, toks = carry
                     logits, cache = module.apply(
-                        params, tok.reshape(1, 1), cache, pos)
+                        params, toks.reshape(-1, 1), cache, pos, start)
                     rng, sub = jax.random.split(rng)
-                    nxt = _sample_graph(sub, logits[0, 0], top_p, temp)
+                    nxt = _sample_rows(sub, logits[:, 0], top_p, temp)
                     return (cache, pos + 1, rng, nxt), nxt
 
-                (cache, _, _, _), toks = jax.lax.scan(
-                    step, (cache, pos, rng, tok), None, length=n)
-                return cache, toks
+                (cache, _, _, _), out = jax.lax.scan(
+                    step, (cache, pos, rng, toks), None, length=n)
+                return cache, out                  # out: (n, bp)
 
             fn = jax.jit(run, donate_argnums=(1,))
             self._chunk_progs[key] = fn
@@ -403,11 +419,11 @@ class CompletionModel:
         if self._pos + n > self.cfg.max_len:
             raise RuntimeError("context window full")
         self._rng, sub = jax.random.split(self._rng)
-        self._cache, toks = self._chunk_program(n)(
-            self.params, self._cache, jnp.int32(self._pos), sub,
-            jnp.int32(int(token)))
+        self._cache, out = self._chunk_program(n)(
+            self.params, self._cache, jnp.int32(self._pos), None, sub,
+            jnp.asarray([int(token)], jnp.int32))
         self._pos += n
-        return np.asarray(toks)
+        return np.asarray(out)[:, 0]
 
     def generate_tokens(self, prompt_ids: np.ndarray, max_new: int,
                         *, chunk: int = 8, eos_id: int | None = None):
@@ -487,36 +503,6 @@ class CompletionModel:
         # every row's last REAL token sits in the last slot (left pad)
         return np.asarray(logits[:B, b - 1])
 
-    def _chunk_program_batch(self, n: int, bp: int):
-        """Batched analog of _chunk_program: one lax.scan decoding n
-        slots for bp rows, sampling every row in-graph per step."""
-        key = (n, bp, self.top_p, self.temp)
-        fn = self._chunk_progs.get(key)
-        if fn is None:
-            module, top_p, temp = self.module, self.top_p, self.temp
-
-            def run(params, cache, pos, start, rng, toks):
-                def step(carry, _):
-                    cache, pos, rng, toks = carry
-                    logits, cache = module.apply(
-                        params, toks.reshape(-1, 1), cache, pos, start)
-                    rng, sub = jax.random.split(rng)
-                    nxt = _sample_rows(sub, logits[:, 0], top_p, temp)
-                    return (cache, pos + 1, rng, nxt), nxt
-
-                (cache, _, _, _), out = jax.lax.scan(
-                    step, (cache, pos, rng, toks), None, length=n)
-                return cache, out                  # out: (n, bp)
-
-            fn = jax.jit(run, donate_argnums=(1,))
-            self._chunk_progs[key] = fn
-            if len(self._chunk_progs) > 16:
-                cur = (self.top_p, self.temp)
-                self._chunk_progs = {
-                    k: v for k, v in self._chunk_progs.items()
-                    if k[-2:] == cur}
-        return fn
-
     def decode_chunk_batch(self, tokens: np.ndarray, n: int) -> np.ndarray:
         """Append tokens (B,), decode+sample n steps on device for the
         whole batch.  Returns (B, n) sampled ids.  Rows that already
@@ -529,7 +515,7 @@ class CompletionModel:
         toks = np.zeros((bp,), np.int32)
         toks[: self._batch] = np.asarray(tokens, np.int32)
         self._rng, sub = jax.random.split(self._rng)
-        self._cache, out = self._chunk_program_batch(n, bp)(
+        self._cache, out = self._chunk_program(n, bp)(
             self.params, self._cache, jnp.int32(self._pos),
             self._start, sub, jnp.asarray(toks))
         self._pos += n

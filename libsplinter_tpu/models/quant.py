@@ -1,0 +1,130 @@
+"""Int8 blockwise weight quantization for the decoder (Q8_0 geometry).
+
+The reference serves quantized GGUF checkpoints through llama.cpp's
+ggml kernels (splainference.cpp:414-448; the store itself never sees
+weights).  This framework loads those checkpoints by dequantizing to
+float masters (models/gguf.py) — correct, but it forfeits the size
+win: decode is weight-bandwidth-bound (every token reads every
+parameter), so weights resident in HBM as int8 + per-block scales move
+half the bytes of bf16 and a quarter of f32.
+
+Q8_0 geometry (ggml block layout, models/gguf.py:261-269): blocks of
+32 consecutive input elements share one scale; q = round(w / d),
+d = max|w_block| / 127.  QuantDense keeps exactly that layout as its
+parameters — (in/32, 32, out) int8 plus (in/32, out) float32 scales —
+and dequantizes INSIDE the forward so XLA fuses the int8 load +
+scale-multiply into the matmul's operand read instead of materializing
+a float weight tensor in HBM.
+
+The LM head and embeddings stay full precision (sampling reads the
+logits; quantization noise there is user-visible bias, and the embed
+table is a gather, not a matmul).  MoE expert tensors keep their own
+path (models/moe.py) — quantizing them composes later.
+
+Loading note: a Q8_0 GGUF dequantized by models/gguf.py and
+re-quantized here is LOSSLESS — symmetric Q8_0 always maps each
+block's max element to ±127, so requantizing the dequantized grid
+reproduces the original d and q exactly (tests/test_quant.py
+roundtrip).  No direct block-copy path is needed for Q8_0; other
+source formats (Q4_K…) gain at most d/2 extra roundoff.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+QBLOCK = 32                           # ggml Q8_0 block width
+
+
+def _q_init(key, shape, dtype=jnp.int8):
+    """Seeded-random int8 weights for checkpoint-free runs (protocol
+    tests and benchmarks don't depend on weight values)."""
+    return jax.random.randint(key, shape, -127, 128, jnp.int32).astype(dtype)
+
+
+def _scale_init(key, shape, dtype=jnp.float32):
+    """Scales sized so dequantized weights land near lecun-normal
+    magnitude: d ~ 1/(127 * sqrt(fan_in))."""
+    fan_in = shape[0] * QBLOCK
+    return jnp.full(shape, 1.0 / (127.0 * np.sqrt(fan_in)), dtype)
+
+
+class QuantDense(nn.Module):
+    """Bias-free Dense whose weight lives as int8 blocks + f32 scales.
+
+    Drop-in for the decoder's nn.Dense(use_bias=False) sites: same
+    module NAME in the tree, different leaf structure ({q, scale}
+    instead of {kernel}).  quantize_tree converts a float tree."""
+    features: int
+    dtype: Any
+    block: int = QBLOCK
+
+    @nn.compact
+    def __call__(self, x):
+        din = x.shape[-1]
+        if din % self.block:
+            raise ValueError(
+                f"QuantDense input dim {din} not a multiple of the "
+                f"quantization block {self.block}")
+        nb = din // self.block
+        q = self.param("q", _q_init, (nb, self.block, self.features))
+        scale = self.param("scale", _scale_init, (nb, self.features))
+        w = (q.astype(self.dtype) *
+             scale[:, None, :].astype(self.dtype)).reshape(
+                 din, self.features)
+        return x.astype(self.dtype) @ w
+
+
+def quantize_kernel(kernel: np.ndarray,
+                    block: int = QBLOCK) -> dict[str, np.ndarray]:
+    """Float (in, out) kernel -> Q8_0-geometry {q, scale}.
+
+    Symmetric per-block: d = max|w| / 127 over each block of `block`
+    consecutive INPUT rows (ggml blocks run along the contraction dim),
+    q = round(w / d).  Max roundoff per element is d/2."""
+    din, dout = kernel.shape
+    if din % block:
+        raise ValueError(f"kernel input dim {din} not a multiple of "
+                         f"the quantization block {block}")
+    w = np.asarray(kernel, np.float32).reshape(din // block, block, dout)
+    d = np.abs(w).max(axis=1) / 127.0            # (nb, out)
+    d = np.where(d == 0, 1.0, d)                 # all-zero block
+    q = np.clip(np.round(w / d[:, None, :]), -127, 127).astype(np.int8)
+    return {"q": q, "scale": d.astype(np.float32)}
+
+
+def dequantize_kernel(qp: dict, block: int = QBLOCK) -> np.ndarray:
+    """Inverse of quantize_kernel (exact for its own output)."""
+    q = np.asarray(qp["q"], np.float32)
+    scale = np.asarray(qp["scale"], np.float32)
+    nb, b, dout = q.shape
+    return (q * scale[:, None, :]).reshape(nb * b, dout)
+
+
+# dense leaves the decoder quantizes: attention projections + MLP
+QUANT_LEAVES = ("q", "k", "v", "out", "gate", "up", "down")
+
+
+def quantize_decoder_params(params, block: int = QBLOCK):
+    """Convert a float Decoder tree (models/decoder.py) to the
+    QuantDense layout: every attention/MLP kernel becomes {q, scale};
+    embeddings, norms, and the LM head stay float."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if (k in QUANT_LEAVES and isinstance(v, dict)
+                    and set(v) == {"kernel"}):
+                out[k] = quantize_kernel(np.asarray(v["kernel"]), block)
+            else:
+                out[k] = walk(v)
+        return out
+
+    p = jax.tree.map(lambda x: np.asarray(x), params["params"])
+    return {"params": jax.tree.map(jnp.asarray, walk(p))}

@@ -45,6 +45,16 @@ def decoder_param_pspec(path: tuple, leaf) -> P:
     joined = "/".join(str(n) for n in names)
     if leaf.ndim == 3 and joined.endswith("_experts"):
         return P("ep", None, None)            # expert parallel
+    # int8-resident projections (models/quant.py QuantDense): q is
+    # (in_blocks, 32, out), scale is (in_blocks, out) — column-parallel
+    # layers shard out, row-parallel layers shard the input blocks
+    last2 = joined.rsplit("/", 2)[-2:]
+    if len(last2) == 2 and last2[1] in ("q", "scale") \
+            and last2[0] in ("q", "k", "v", "gate", "up", "out", "down"):
+        colp = last2[0] in ("q", "k", "v", "gate", "up")
+        if last2[1] == "q":                   # (nb, 32, out) int8
+            return P(None, None, "tp") if colp else P("tp", None, None)
+        return P(None, "tp") if colp else P("tp", None)   # (nb, out)
     if leaf.ndim == 2:
         if "router" in joined:
             return P()                        # tiny: replicate

@@ -165,6 +165,73 @@ def test_completer_batch_empty_prompt_isolated(tmp_path):
         Store.unlink(name)
 
 
+def test_completer_batch_key_deleted_mid_generation(tmp_path):
+    """A client deleting its key mid-decode must fail only its own
+    row: siblings still stream to completion and the daemon survives
+    (no KeyError escaping through the batch tail)."""
+    name = f"/spt-delmid-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=2048, vec_dim=8)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(), buckets=(32,),
+                                temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=16,
+                         flush_tokens=2, template="none", batch_cap=4)
+        comp.attach()
+        for k in ("victim", "survivor"):
+            st.set(k, f"prompt for {k}")
+            st.label_or(k, P.LBL_INFER_REQ)
+            st.bump(k)
+        orig_flush = comp._flush
+        state = {"deleted": False}
+
+        def sabotaged(key, data):
+            if key == "victim" and not state["deleted"]:
+                st.unset("victim")
+                state["deleted"] = True
+            return orig_flush(key, data)
+
+        comp._flush = sabotaged
+        n = comp.run_once()           # must not raise
+        assert n == 2
+        assert state["deleted"]
+        assert st.labels("survivor") & P.LBL_READY
+        val = st.get("survivor").rstrip(b"\0")
+        assert len(val) > len(b"prompt for survivor")
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_completer_window_only_bucket_falls_back_serial(tmp_path):
+    """buckets == (max_len,) gives the batched path zero decode room
+    (prefill parks at the bucket width); run_once must serve such
+    geometries serially, where the raw budget leaves real room."""
+    name = f"/spt-tinywin-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=2048, vec_dim=8)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=64),
+                                buckets=(64,), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=12,
+                         flush_tokens=4, template="none", batch_cap=4)
+        comp.attach()
+        assert comp._batched_budget() is None
+        long_prompt = ("tok " * 40).encode()   # clips at the raw budget
+        st.set("a", long_prompt)
+        st.set("b", b"short one")
+        for k in ("a", "b"):
+            st.label_or(k, P.LBL_INFER_REQ)
+            st.bump(k)
+        assert comp.run_once() == 2
+        assert comp.stats.tokens >= 8, comp.stats
+        for k in ("a", "b"):
+            assert st.labels(k) & P.LBL_READY
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
 def test_completer_batched_matches_serial_content(tmp_path):
     """Greedy completions must be byte-identical whether the daemon
     served the keys batched or one at a time."""

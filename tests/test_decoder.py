@@ -216,7 +216,7 @@ def test_chunk_program_tracks_sampler_settings(model):
         model.decode_chunk(1, 4)
         assert len(model._chunk_progs) == n_before + 1
         keys = set(model._chunk_progs)
-        assert (4, 0.5, 1.3) in keys
+        assert (4, 1, 0.5, 1.3) in keys    # (n, bp, top_p, temp)
     finally:
         model.top_p, model.temp = old
         model.reset()

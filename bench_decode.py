@@ -62,6 +62,8 @@ def main() -> int:
     if CPU_MODE:
         from libsplinter_tpu.utils.jaxplatform import force_cpu
         force_cpu()
+    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()
     import jax
 
     from libsplinter_tpu.models import CompletionModel, DecoderConfig

@@ -38,11 +38,19 @@ def log(*a):
 
 
 def main() -> int:
+    import faulthandler
+
     import numpy as np
+
+    # a hang (tunnel stall, surprise compile) must leave a stack in
+    # the log before the watcher's timeout SIGKILLs us
+    faulthandler.dump_traceback_later(300, repeat=True, file=sys.stderr)
 
     if CPU_MODE:
         from libsplinter_tpu.utils.jaxplatform import force_cpu
         force_cpu()
+    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()
     import jax
 
     from libsplinter_tpu.ops.similarity import cosine_topk

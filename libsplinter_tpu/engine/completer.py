@@ -110,6 +110,7 @@ class CompleterStats:
     tokens: int = 0
     truncated: int = 0
     raced: int = 0
+    vanished: int = 0                 # keys deleted mid-request
 
 
 class Completer:
@@ -289,13 +290,18 @@ class Completer:
         return key, rendered, t0
 
     def _finalize(self, key: str, t0: int, n_tok: int,
-                  truncated: bool) -> None:
+                  truncated: bool, vanished: bool = False) -> None:
         """The per-key request tail: oom bookkeeping, ctime backfill
         with tick delta (splainference.cpp:282,383-387),
         SERVICING→READY flip.  A key deleted mid-request must fail
         alone — in a batch, a raising tail would strand the SIBLING
-        rows in SERVICING forever."""
+        rows in SERVICING forever — and is counted as vanished, not as
+        a completion or a max_val truncation."""
         st = self.store
+        if vanished:
+            self.stats.vanished += 1
+            self._debug(f"key {key!r} vanished mid-request")
+            return
         if truncated:
             self.stats.truncated += 1
             self._debug(f"completion for {key!r} truncated at max_val")
@@ -308,7 +314,9 @@ class Completer:
             st.label_or(key, P.LBL_READY)
             st.bump(key)
         except (KeyError, OSError):
+            self.stats.vanished += 1
             self._debug(f"key {key!r} vanished mid-request")
+            return
         self.stats.completions += 1
         self.stats.tokens += n_tok
 
@@ -325,24 +333,29 @@ class Completer:
         if prep is None:
             return False
         key, rendered, t0 = prep
-        n_tok, pending, truncated = 0, b"", False
+        n_tok, pending = 0, b""
+        truncated = vanished = False
         try:
             for piece in self.generate_fn(rendered):
                 pending += piece
                 n_tok += 1
                 boundary = piece.endswith((b" ", b"\n", b"\t"))
                 if boundary or n_tok % self.flush_tokens == 0:
-                    if not self._flush(key, pending):
-                        truncated = True
+                    r = self._flush(key, pending)
+                    if r != "ok":
+                        truncated = r == "full"
+                        vanished = r == "gone"
                         break
                     pending = b""
                 if self.rebid_tokens and n_tok % self.rebid_tokens == 0:
                     self._rebid()
-            if pending and not truncated:
-                truncated = not self._flush(key, pending)
+            if pending and not truncated and not vanished:
+                r = self._flush(key, pending)
+                truncated = r == "full"
+                vanished = r == "gone"
         except Exception as ex:       # model failure must not wedge WAITING
             self._debug(f"generation failed for {key!r}: {ex}")
-        self._finalize(key, t0, n_tok, truncated)
+        self._finalize(key, t0, n_tok, truncated, vanished)
         return True
 
     def process_batch(self, idxs: list[int]) -> int:
@@ -382,6 +395,7 @@ class Completer:
         pending = [b""] * B
         done = [False] * B
         truncated = [False] * B
+        vanished = [False] * B
         total = 0
         try:
             gen = m.generate_batch([p[2] for p in prepped], self.max_new,
@@ -400,8 +414,10 @@ class Completer:
                     n_tok[r] += 1
                     boundary = piece.endswith((b" ", b"\n", b"\t"))
                     if boundary or n_tok[r] % self.flush_tokens == 0:
-                        if not self._flush(key, pending[r]):
-                            truncated[r] = True
+                        res = self._flush(key, pending[r])
+                        if res != "ok":
+                            truncated[r] = res == "full"
+                            vanished[r] = res == "gone"
                             done[r] = True
                         pending[r] = b""
                 total += 1
@@ -415,22 +431,25 @@ class Completer:
             m.reset()
         for r in range(B):
             key, t0, _ = prepped[r]
-            if pending[r] and not truncated[r]:
-                truncated[r] = not self._flush(key, pending[r])
-            self._finalize(key, t0, n_tok[r], truncated[r])
+            if pending[r] and not truncated[r] and not vanished[r]:
+                res = self._flush(key, pending[r])
+                truncated[r] = res == "full"
+                vanished[r] = res == "gone"
+            self._finalize(key, t0, n_tok[r], truncated[r], vanished[r])
         return B + done_early
 
-    def _flush(self, key: str, data: bytes) -> bool:
+    def _flush(self, key: str, data: bytes) -> str:
         """Append a flushed run; on overflow truncate-and-mark
-        (splainference.cpp:336-344).  Returns False when the value is
-        full — or when the key vanished mid-request (client deleted
-        it), which must stop THIS row without touching its batch."""
+        (splainference.cpp:336-344).  Returns "ok", "full" (value at
+        max_val — an OOM truncation), or "gone" (client deleted the
+        key mid-request — stops THIS row without touching its batch,
+        and must NOT be reported as a truncation)."""
         st = self.store
         try:
             st.append(key, data)
-            return True
+            return "ok"
         except KeyError:
-            return False
+            return "gone"
         except OSError as ex:
             if ex.errno != errno.EMSGSIZE:
                 raise
@@ -440,7 +459,7 @@ class Completer:
                 st.append(key, tail[: max(0, room)])
             except (KeyError, OSError):
                 pass
-            return False
+            return "full"
 
     # -- drain loop --------------------------------------------------------
 
@@ -536,12 +555,27 @@ def main(argv: list[str] | None = None) -> int:
                          "shard the stacked expert FFNs over an ep "
                          "mesh axis (must divide the model's "
                          "expert_count; composes with --tp)")
+    ap.add_argument("--batch-cap", type=int, default=8,
+                    help="serve up to this many waiting keys as one "
+                         "left-padded batched decode (1 = serial, the "
+                         "reference's cadence)")
+    ap.add_argument("--quantized", action="store_true",
+                    help="int8 weight residency: keep attention/MLP "
+                         "kernels in HBM as Q8_0-geometry int8 + "
+                         "per-block scales (models/quant.py)")
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile prefill buckets + decode "
+                         "programs before serving (first requests "
+                         "otherwise pay the compiles; .xla_cache "
+                         "persists them across restarts)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
     if os.environ.get("SPTPU_FORCE_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    from ..utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()
     store = Store.open(args.store, persistent=args.persistent)
     from ..models import CompletionModel, DecoderConfig
     tokenizer = None
@@ -573,6 +607,8 @@ def main(argv: list[str] | None = None) -> int:
         # system\n\nprompt concatenation
         template = "none"
         log.info("--template auto with no GGUF metadata: using 'none'")
+    if args.quantized:
+        cfg = dataclasses.replace(cfg, quantized=True)
     mesh = None
     if args.tp > 1 or args.ep > 1:
         from ..parallel.mesh import make_mesh
@@ -593,8 +629,14 @@ def main(argv: list[str] | None = None) -> int:
         model = CompletionModel(cfg, **mkw)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
-                     template=template)
+                     template=template, batch_cap=args.batch_cap)
     comp.attach()
+    if args.warmup:
+        t0 = time.monotonic()
+        model.warmup(chunk=comp.flush_tokens)
+        log.info("warmup compiled in %.1fs (batched shapes compile on "
+                 "first batch; .xla_cache persists them)",
+                 time.monotonic() - t0)
     if args.oneshot:
         n = comp.run_once()
         log.info("oneshot serviced %d completions", n)

@@ -506,6 +506,10 @@ def main(argv: list[str] | None = None) -> int:
                          "checkpoint's trained window, or 2048 for "
                          "seeded-random weights)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile the (1, bucket) and (batch_cap, "
+                         "bucket) encoder programs before serving "
+                         "(.xla_cache persists them across restarts)")
     ap.add_argument("--weights",
                     help="encoder checkpoint: .safetensors (HF naming) or "
                          ".gguf (llama.cpp naming; a GGUF's embedded "
@@ -545,6 +549,10 @@ def main(argv: list[str] | None = None) -> int:
                    max_ctx=max_ctx,
                    vector_training=args.vector_training)
     emb.attach()
+    if args.warmup:
+        t0 = time.monotonic()
+        emb._model.warmup(batch_sizes=(1, emb.batch_cap))
+        log.info("warmup compiled in %.1fs", time.monotonic() - t0)
     if args.backfill_text_keys:
         n = emb.backfill()
         log.info("backfill embedded %d keys", n)

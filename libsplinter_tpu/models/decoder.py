@@ -557,6 +557,11 @@ class CompletionModel:
         for b in self.buckets:
             self.prefill(np.ones((max(1, b - 1),), np.int32))
             self.decode_one(1)
+        # the loop leaves _pos parked at max_len (the last bucket IS
+        # the window), where no chunk fits — re-prefill short so the
+        # chunk program (the serving hot path) actually compiles
+        self.reset()
+        self.prefill(np.ones((max(1, self.buckets[0] - 1),), np.int32))
         if self._pos + chunk <= self.cfg.max_len:
             self.decode_chunk(1, chunk)
         self.reset()

@@ -39,11 +39,21 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         continue
     fi
     echo "[watch] EMBED BENCH LANDED: $(cat "$OUT")" >&2
-    # chip is claimable: capture the other benches back to back
+    # chip is claimable: capture the whole series back to back while
+    # we hold the window (each script is its own single client; they
+    # run strictly sequentially).  Failures are logged, not fatal —
+    # every success lands in bench_results.jsonl.
+    echo "[watch] profile" >&2
+    timeout 1200 python bench_profile.py          >> "$LOG" 2>&1
+    echo "[watch] decode" >&2
     DECODE_TOKENS=256 timeout 1800 python bench_decode.py \
-        >> "$LOG" 2>&1
+                                                  >> "$LOG" 2>&1
+    echo "[watch] decode quantized" >&2
+    DECODE_QUANT=1 DECODE_TOKENS=256 timeout 1800 python bench_decode.py \
+                                                  >> "$LOG" 2>&1
+    echo "[watch] search" >&2
     SEARCH_N=1000000 timeout 1800 python bench_search.py \
-        >> "$LOG" 2>&1
+                                                  >> "$LOG" 2>&1
     echo "[watch] all benches done; results in bench_results.jsonl" >&2
     exit 0
 done

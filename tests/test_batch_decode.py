@@ -187,8 +187,13 @@ def test_completer_batch_key_deleted_mid_generation(tmp_path):
 
         def sabotaged(key, data):
             if key == "victim" and not state["deleted"]:
+                # this store's append is an upsert, so a plain unset
+                # would be resurrected by the next flush; force the
+                # "gone" outcome _flush reports when the slot truly
+                # cannot take the append (key recycled mid-request)
                 st.unset("victim")
                 state["deleted"] = True
+                return "gone"
             return orig_flush(key, data)
 
         comp._flush = sabotaged
@@ -198,6 +203,11 @@ def test_completer_batch_key_deleted_mid_generation(tmp_path):
         assert st.labels("survivor") & P.LBL_READY
         val = st.get("survivor").rstrip(b"\0")
         assert len(val) > len(b"prompt for survivor")
+        # accounting: the vanished key is neither a completion nor a
+        # max_val truncation
+        assert comp.stats.vanished == 1, comp.stats
+        assert comp.stats.truncated == 0, comp.stats
+        assert comp.stats.completions == 1, comp.stats
     finally:
         st.close()
         Store.unlink(name)

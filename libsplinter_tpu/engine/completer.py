@@ -494,6 +494,14 @@ class Completer:
                     n += 1
         return n
 
+    def publish_stats(self) -> None:
+        """Heartbeat: JSON stats snapshot into the debug-labeled
+        __completer_stats key (the structured counterpart of the
+        reference's __debug chatter; sidecar group-63 watch surfaces
+        it)."""
+        P.publish_heartbeat(self.store, P.KEY_COMPLETE_STATS,
+                            dataclasses.asdict(self.stats))
+
     def run(self, *, idle_timeout_ms: int = 100,
             stop_after: float | None = None) -> None:
         self._running = True
@@ -505,13 +513,19 @@ class Completer:
             got = self.store.signal_wait(self.group, last,
                                          timeout_ms=idle_timeout_ms)
             now = time.monotonic()
+            # heartbeat cadence is independent of the wake path — a
+            # daemon at full load must still look alive to watchers
+            do_sweep = now >= next_sweep
+            if do_sweep:
+                next_sweep = now + 2.0
             if got is not None:
                 last = got
                 self.stats.wakes += 1
                 self.run_once()
-            elif now >= next_sweep:
-                next_sweep = now + 2.0
+            elif do_sweep:
                 self.run_once()
+            if do_sweep:
+                self.publish_stats()
             if deadline and now > deadline:
                 break
 

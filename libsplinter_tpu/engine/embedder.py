@@ -423,10 +423,20 @@ class Embedder:
         """One full drain cycle (--oneshot): dirty mask + label sweep."""
         return self.drain(sweep=True)
 
+    def publish_stats(self) -> None:
+        """Heartbeat: JSON stats snapshot into the debug-labeled
+        __embedder_stats key (observability counterpart of the
+        reference's __debug channel; the sidecar's group-63 watch
+        surfaces every update)."""
+        P.publish_heartbeat(self.store, P.KEY_EMBED_STATS,
+                            {**dataclasses.asdict(self.stats),
+                             "pending": len(self._pending)})
+
     def run(self, *, idle_timeout_ms: int = 100,
             stop_after: float | None = None,
             sweep_interval_s: float = 10.0) -> None:
-        """The daemon loop: block on the signal group, drain, repeat."""
+        """The daemon loop: block on the signal group, drain, repeat.
+        Each periodic sweep also publishes the stats heartbeat."""
         self._running = True
         last = self.store.signal_count(self.group)
         deadline = (time.monotonic() + stop_after) if stop_after else None
@@ -446,6 +456,8 @@ class Embedder:
                 # periodic reconciliation only — an idle daemon must not
                 # walk the whole label lane on every idle timeout
                 self.drain(sweep=True)
+            if do_sweep:
+                self.publish_stats()
             if deadline and now > deadline:
                 break
 

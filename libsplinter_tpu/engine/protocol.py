@@ -40,6 +40,26 @@ PRIO_COMPLETE = 200
 KEY_DONE_LANE = "__lane_dw_2"  # pulsed after each committed embedding
 KEY_DEBUG = "__debug"          # append-only shared debug log
 KEY_SYSTEM_PROMPT = "__system_prompt"
+# periodic daemon heartbeats: JSON stats snapshots, debug-labeled so
+# the sidecar's group-63 watch surfaces them (the reference's only
+# runtime telemetry is the __debug append channel; these are the
+# structured counterpart)
+KEY_EMBED_STATS = "__embedder_stats"
+KEY_COMPLETE_STATS = "__completer_stats"
+
+
+def publish_heartbeat(store, key: str, payload: dict) -> None:
+    """Write a timestamped JSON stats snapshot into a debug-labeled
+    key.  Telemetry must never wedge serving: a concurrently deleted
+    key (KeyError) or a full/failed store op (OSError) is swallowed."""
+    import json
+    import time
+
+    try:
+        store.set(key, json.dumps({"ts": time.time(), **payload}))
+        store.label_or(key, LBL_DEBUG)
+    except (KeyError, OSError):
+        pass
 SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
 
 # context guard: reject inputs >= this fraction of the model window

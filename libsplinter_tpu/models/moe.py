@@ -71,16 +71,27 @@ class MoeMlp(nn.Module):
             gates.sum(-1, keepdims=True), 1e-9)            # renormalize
         gates = gates.astype(cfg.dtype)
 
-        init = nn.initializers.lecun_normal()
-        wg = self.param("gate_experts", init, (E, H, M))
-        wu = self.param("up_experts", init, (E, H, M))
-        wd = self.param("down_experts", init, (E, M, H))
+        if cfg.quantized:
+            # int8-resident expert stacks (models/quant.py): same HBM
+            # halving as the dense projections, dequantized in-graph
+            from .quant import expert_weight
+            wg = expert_weight(self, "gate_experts", E, H, M, cfg.dtype)
+            wu = expert_weight(self, "up_experts", E, H, M, cfg.dtype)
+            wd = expert_weight(self, "down_experts", E, M, H, cfg.dtype)
+        else:
+            init = nn.initializers.lecun_normal()
+            wg = self.param("gate_experts", init, (E, H, M)).astype(
+                cfg.dtype)
+            wu = self.param("up_experts", init, (E, H, M)).astype(
+                cfg.dtype)
+            wd = self.param("down_experts", init, (E, M, H)).astype(
+                cfg.dtype)
 
         xd = x.astype(cfg.dtype)
-        g = jnp.einsum("bsh,ehm->bsem", xd, wg.astype(cfg.dtype))
-        u = jnp.einsum("bsh,ehm->bsem", xd, wu.astype(cfg.dtype))
+        g = jnp.einsum("bsh,ehm->bsem", xd, wg)
+        u = jnp.einsum("bsh,ehm->bsem", xd, wu)
         y = nn.silu(g) * u                                 # (B, S, E, M)
-        out = jnp.einsum("bsem,emh->bseh", y, wd.astype(cfg.dtype))
+        out = jnp.einsum("bsem,emh->bseh", y, wd)
         # gated combine reduces over E -> one psum over ep when sharded
         return jnp.einsum("bseh,bse->bsh", out, gates)
 

@@ -48,8 +48,10 @@ def _q_init(key, shape, dtype=jnp.int8):
 
 def _scale_init(key, shape, dtype=jnp.float32):
     """Scales sized so dequantized weights land near lecun-normal
-    magnitude: d ~ 1/(127 * sqrt(fan_in))."""
-    fan_in = shape[0] * QBLOCK
+    magnitude: d ~ 1/(127 * sqrt(fan_in)).  shape is (nb, out) for a
+    dense kernel or (E, nb, out) for stacked experts — fan_in is the
+    block axis either way."""
+    fan_in = shape[-2] * QBLOCK
     return jnp.full(shape, 1.0 / (127.0 * np.sqrt(fan_in)), dtype)
 
 
@@ -105,14 +107,33 @@ def dequantize_kernel(qp: dict, block: int = QBLOCK) -> np.ndarray:
     return (q * scale[:, None, :]).reshape(nb * b, dout)
 
 
+def expert_weight(module: nn.Module, name: str, n_experts: int,
+                  din: int, dout: int, dtype) -> jnp.ndarray:
+    """Stacked expert weight (E, din, dout) for MoeMlp, materialized
+    from int8-resident blocks when the config quantizes: params are
+    {name}_q (E, din/32, 32, dout) int8 + {name}_scale (E, din/32,
+    dout) f32, dequantized in-graph like QuantDense."""
+    if din % QBLOCK:
+        raise ValueError(
+            f"expert weight input dim {din} not a multiple of the "
+            f"quantization block {QBLOCK}")
+    nb = din // QBLOCK
+    q = module.param(f"{name}_q", _q_init, (n_experts, nb, QBLOCK, dout))
+    s = module.param(f"{name}_scale", _scale_init, (n_experts, nb, dout))
+    return (q.astype(dtype) * s[:, :, None, :].astype(dtype)).reshape(
+        n_experts, din, dout)
+
+
 # dense leaves the decoder quantizes: attention projections + MLP
 QUANT_LEAVES = ("q", "k", "v", "out", "gate", "up", "down")
 
 
 def quantize_decoder_params(params, block: int = QBLOCK):
     """Convert a float Decoder tree (models/decoder.py) to the
-    QuantDense layout: every attention/MLP kernel becomes {q, scale};
-    embeddings, norms, and the LM head stay float."""
+    QuantDense layout: every attention/MLP kernel becomes {q, scale},
+    stacked MoE expert tensors (models/moe.py `*_experts`) become
+    `*_experts_q` + `*_experts_scale`; embeddings, norms, routers, and
+    the LM head stay float."""
 
     def walk(node):
         if not isinstance(node, dict):
@@ -122,6 +143,12 @@ def quantize_decoder_params(params, block: int = QBLOCK):
             if (k in QUANT_LEAVES and isinstance(v, dict)
                     and set(v) == {"kernel"}):
                 out[k] = quantize_kernel(np.asarray(v["kernel"]), block)
+            elif k.endswith("_experts") and not isinstance(v, dict):
+                arr = np.asarray(v)               # (E, din, dout)
+                qs = [quantize_kernel(arr[e], block)
+                      for e in range(arr.shape[0])]
+                out[f"{k}_q"] = np.stack([x["q"] for x in qs])
+                out[f"{k}_scale"] = np.stack([x["scale"] for x in qs])
             else:
                 out[k] = walk(v)
         return out

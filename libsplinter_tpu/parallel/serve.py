@@ -45,6 +45,10 @@ def decoder_param_pspec(path: tuple, leaf) -> P:
     joined = "/".join(str(n) for n in names)
     if leaf.ndim == 3 and joined.endswith("_experts"):
         return P("ep", None, None)            # expert parallel
+    if leaf.ndim == 4 and joined.endswith("_experts_q"):
+        return P("ep", None, None, None)      # int8 expert blocks
+    if leaf.ndim == 3 and joined.endswith("_experts_scale"):
+        return P("ep", None, None)
     # int8-resident projections (models/quant.py QuantDense): q is
     # (in_blocks, 32, out), scale is (in_blocks, out) — column-parallel
     # layers shard out, row-parallel layers shard the input blocks

@@ -113,6 +113,54 @@ def test_quantize_tree_idempotent(float_and_quant):
     assert chex_equal
 
 
+def test_quantized_moe_tracks_float_source():
+    """MoE expert stacks quantize too: the int8-resident MoE decoder's
+    prefill logits track the float source."""
+    from libsplinter_tpu.models.moe import (MoeDecoderConfig,
+                                            moe_completion_model)
+
+    cfg = MoeDecoderConfig.tiny(dtype=jnp.float32)
+    fm = moe_completion_model(cfg, buckets=(16,), temp=0.0, seed=7)
+    qcfg = MoeDecoderConfig.tiny(dtype=jnp.float32, quantized=True)
+    qm = moe_completion_model(qcfg, buckets=(16,), temp=0.0,
+                              params=fm.params)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    lf = np.asarray(fm.prefill(prompt))
+    fm.reset()
+    lq = np.asarray(qm.prefill(prompt))
+    qm.reset()
+    cos = float(np.dot(lf, lq) /
+                (np.linalg.norm(lf) * np.linalg.norm(lq) + 1e-9))
+    assert cos > 0.99, f"cosine {cos}"
+    # the quantized tree really is int8-resident
+    leaves = jax.tree.leaves(qm.params)
+    assert any(lv.dtype == jnp.int8 for lv in leaves)
+    # and serves end to end
+    toks = [int(t) for t in qm.generate_tokens(prompt, 6, chunk=3)]
+    qm.reset()
+    assert len(toks) == 6
+
+
+def test_quantized_moe_ep_sharded():
+    """Int8 expert stacks shard on the ep axis: sharded quantized MoE
+    prefill equals unsharded quantized."""
+    from libsplinter_tpu.models.moe import (MoeDecoderConfig,
+                                            moe_completion_model)
+    from libsplinter_tpu.parallel import make_mesh
+
+    cfg = MoeDecoderConfig.tiny(dtype=jnp.float32, quantized=True)
+    base = moe_completion_model(cfg, buckets=(16,), temp=0.0, seed=9)
+    mesh = make_mesh(dp=2, tp=2, sp=1, ep=2)
+    sh = moe_completion_model(cfg, mesh, buckets=(16,), temp=0.0,
+                              params=base.params)
+    prompt = np.arange(1, 7, dtype=np.int32)
+    lu = np.asarray(base.prefill(prompt))
+    base.reset()
+    ls = np.asarray(sh.prefill(prompt))
+    sh.reset()
+    np.testing.assert_allclose(lu, ls, rtol=2e-4, atol=2e-4)
+
+
 def test_quantized_sharded_serving():
     """Int8 trees shard over the tp mesh axis (parallel/serve.py
     pspecs): sharded quantized prefill equals unsharded quantized."""

@@ -1,0 +1,61 @@
+"""Daemon stats heartbeats: structured JSON snapshots in debug-labeled
+store keys (__embedder_stats / __completer_stats) — the observability
+counterpart of the reference's append-only __debug channel
+(/root/reference/splainference.cpp:94-100), consumable by the sidecar's
+group-63 debug watch."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.engine.embedder import Embedder
+
+
+def _mkstore(tag):
+    name = f"/spt-stats-{tag}"
+    Store.unlink(name)
+    return name, Store.create(name, nslots=64, max_val=1024, vec_dim=8)
+
+
+def test_embedder_stats_heartbeat(tmp_path):
+    name, st = _mkstore(tmp_path.name)
+    try:
+        emb = Embedder(st, encoder_fn=lambda ts: np.zeros(
+            (len(ts), 8), np.float32), max_ctx=64)
+        emb.attach()
+        st.set("k", "text")
+        st.set_type("k", 0x80)        # T_VARTEXT
+        st.label_or("k", P.LBL_EMBED_REQ)
+        emb.run_once()
+        emb.publish_stats()
+        snap = json.loads(st.get(P.KEY_EMBED_STATS).rstrip(b"\0"))
+        assert snap["embedded"] == 1
+        assert snap["pending"] == 0
+        assert "ts" in snap
+        assert st.labels(P.KEY_EMBED_STATS) & P.LBL_DEBUG
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_completer_stats_heartbeat(tmp_path):
+    name, st = _mkstore(tmp_path.name)
+    try:
+        comp = Completer(st, generate_fn=lambda p: iter([b"ok "]),
+                         template="none")
+        comp.attach()
+        st.set("q", "hi")
+        st.label_or("q", P.LBL_INFER_REQ)
+        comp.run_once()
+        comp.publish_stats()
+        snap = json.loads(st.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+        assert snap["completions"] == 1
+        assert snap["vanished"] == 0
+        assert st.labels(P.KEY_COMPLETE_STATS) & P.LBL_DEBUG
+    finally:
+        st.close()
+        Store.unlink(name)

@@ -14,7 +14,6 @@ Environment:
 """
 from __future__ import annotations
 
-import json
 import os
 import re
 import shlex

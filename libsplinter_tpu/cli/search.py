@@ -17,7 +17,6 @@ import sys
 import numpy as np
 
 from ..engine import protocol as P
-from ..store import Store
 from .main import CliError, command
 
 

@@ -46,6 +46,10 @@ KEY_SYSTEM_PROMPT = "__system_prompt"
 # structured counterpart)
 KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
+SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
+
+# context guard: reject inputs >= this fraction of the model window
+CTX_GUARD_FRACTION = 0.9
 
 
 def publish_heartbeat(store, key: str, payload: dict) -> None:
@@ -60,8 +64,4 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
         store.label_or(key, LBL_DEBUG)
     except (KeyError, OSError):
         pass
-SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
-
-# context guard: reject inputs >= this fraction of the model window
-CTX_GUARD_FRACTION = 0.9
 CTX_EXCEEDED_DIAGNOSTIC = b"[context exceeded: input too long for model]"

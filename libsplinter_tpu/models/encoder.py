@@ -21,7 +21,6 @@ not depend on the weight values).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any
 
 import jax

@@ -18,8 +18,9 @@ a float weight tensor in HBM.
 
 The LM head and embeddings stay full precision (sampling reads the
 logits; quantization noise there is user-visible bias, and the embed
-table is a gather, not a matmul).  MoE expert tensors keep their own
-path (models/moe.py) — quantizing them composes later.
+table is a gather, not a matmul).  Stacked MoE expert tensors
+(models/moe.py) quantize through the same geometry — expert_weight
+materializes them from (E, in/32, 32, out) int8 blocks in-graph.
 
 Loading note: a Q8_0 GGUF dequantized by models/gguf.py and
 re-quantized here is LOSSLESS — symmetric Q8_0 always maps each

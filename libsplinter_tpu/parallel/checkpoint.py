@@ -18,7 +18,6 @@ the addressable shards and the restore path re-places them.
 from __future__ import annotations
 
 import os
-from typing import Any
 
 import jax
 import numpy as np

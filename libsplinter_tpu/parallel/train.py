@@ -10,7 +10,6 @@ all-gather collectives over ICI.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, NamedTuple
 
 import jax

@@ -41,6 +41,10 @@ class EncoderConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16     # activation dtype
     out_dim: int = 768            # matryoshka truncation target
+    # buckets at/above this width attend through the blockwise Pallas
+    # kernel (ops/flash_attention.py): no HBM-quadratic logits, so long
+    # buckets keep real batch sizes.  0 disables (always naive).
+    flash_min_seq: int = 512
     # Sequence parallelism: when set, inputs are the LOCAL chunk of a
     # sequence sharded over this mesh axis and attention runs as ring
     # attention (must be applied inside shard_map with the axis bound).
@@ -116,6 +120,9 @@ class SelfAttention(nn.Module):
         if cfg.ring_axis:
             from ..parallel.ring_attention import ring_attention
             out = ring_attention(q, k, v, mask, axis_name=cfg.ring_axis)
+        elif cfg.flash_min_seq and S >= cfg.flash_min_seq:
+            from ..ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, mask)
         else:
             scale = 1.0 / np.sqrt(head_dim)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

@@ -1,0 +1,131 @@
+"""Blockwise (flash-style) bidirectional attention for long buckets.
+
+The encoder's naive attention materializes (B, H, S, S) float32 logits
+in HBM — at S=2048 that is 16 MB per (batch row, head) and it caps the
+batch size long before the MXU saturates.  The reference never faces
+this because it REJECTS long inputs outright (splinference.cpp:226-233
+marks >=0.9*n_ctx as context-exceeded); this framework embeds them, so
+the long-bucket path gets a Pallas kernel:
+
+  grid = (B, H, S / block_q); each program computes one query block's
+  attention with the full K/V for its (batch, head) resident in VMEM —
+  the (block_q, S) logits tile lives ONLY in VMEM, nothing quadratic
+  ever reaches HBM.  Softmax runs in f32 with the finite NEG_INF mask
+  (all-masked rows — fully padded batch rows — degrade to a uniform
+  distribution instead of NaN, matching the naive path's -1e9 bias).
+
+K/V VMEM budget: S * D * 4 B * 2 = 1 MB at S=2048, D=64 — comfortably
+inside VMEM, so no online-softmax streaming is needed at the window
+sizes this encoder serves (the ring-attention path, parallel/
+ring_attention.py, covers sequences beyond one chip).
+
+On non-TPU backends the same math runs as plain jnp (tests exercise the
+kernel itself via interpret=True).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mha_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float):
+    """One (batch, head, q-block) program.
+
+    q_ref:   (1, 1, BQ, D)   query block
+    k_ref:   (1, 1, S, D)    full keys for this (b, h)
+    v_ref:   (1, 1, S, D)    full values
+    mask_ref:(1, 1, S)       f32 key validity (1.0 = real token)
+    out_ref: (1, 1, BQ, D)
+    """
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    logits = jnp.dot(q, k.T,
+                     preferred_element_type=jnp.float32) * scale
+    m = mask_ref[0]                               # (1, S) broadcasts
+    logits = jnp.where(m > 0.0, logits, NEG_INF)  # (BQ, S)
+    logits = logits - jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out_ref[0, 0] = jnp.dot(p.astype(v.dtype), v,
+                            preferred_element_type=jnp.float32
+                            ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "interpret"))
+def _flash_pallas(q, k, v, maskf, *, block_q: int, interpret: bool):
+    """q/k/v: (B, H, S, D); maskf: (B, 1, S) f32.  Returns (B, H, S, D)."""
+    B, H, S, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    grid = (B, H, S // block_q)
+    return pl.pallas_call(
+        functools.partial(_mha_kernel, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, maskf)
+
+
+def _mha_jnp(q, k, v, mask):
+    """Reference math, (B, S, H, D) layout — identical to the encoder's
+    naive path (encoder.py SelfAttention) up to the finite mask value."""
+    D = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    bias = jnp.where(mask[:, None, None, :], 0.0, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32) + bias,
+                           axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(q, k, v, mask, *, block_q: int = 256,
+                    interpret: bool = False,
+                    force_pallas: bool = False):
+    """Bidirectional masked attention without HBM-quadratic logits.
+
+    q/k/v: (B, S, H, D); mask: (B, S) bool key validity.
+    Returns (B, S, H, D) in q's dtype.  The Pallas kernel runs on TPU
+    (or under interpret/force_pallas for tests); other backends use the
+    identical jnp math.
+    """
+    use_pallas = (force_pallas or interpret
+                  or jax.default_backend() == "tpu")
+    if not use_pallas:
+        return _mha_jnp(q, k, v, mask)
+    B, S, H, D = q.shape
+    bq = min(block_q, S)
+    pad = (-S) % bq
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    qt = q.transpose(0, 2, 1, 3)                   # (B, H, S', D)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    maskf = mask.astype(jnp.float32)[:, None, :]   # (B, 1, S')
+    out = _flash_pallas(qt, kt, vt, maskf, block_q=bq,
+                        interpret=interpret)
+    out = out.transpose(0, 2, 1, 3)
+    return out[:, :S] if pad else out

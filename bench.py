@@ -315,12 +315,48 @@ def _last_stage(stagefile: str) -> str:
         return "(no stage file)"
 
 
+def _acquire_watch_lock(deadline: float):
+    """Coordinate with scripts/tpu_bench_watch.sh: the tunnel admits ONE
+    client, so a driver-invoked bench must not start a child while a
+    watcher cycle's child may hold the claim (two clients = the wedge).
+    Takes the watcher's flock (waiting for any active cycle to finish)
+    and holds it for our lifetime so no watcher starts mid-bench.
+    The watcher's own bench invocation sets BENCH_FROM_WATCHER=1 — its
+    parent already holds the lock."""
+    if CPU_MODE or os.environ.get("BENCH_FROM_WATCHER") == "1":
+        return None                   # no tunnel involved / lock inherited
+    try:
+        import fcntl
+        lk = open("/tmp/tpu_bench_watch.lock", "w")
+    except OSError:
+        return None
+    waited = False
+    while True:                       # always try at least once
+        try:
+            fcntl.flock(lk, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            if waited:
+                log("[bench] watcher released the tunnel lock")
+            return lk
+        except OSError:
+            if not waited:
+                log("[bench] a bench watcher holds the tunnel lock; "
+                    "waiting for its cycle to finish ...")
+                waited = True
+        if time.monotonic() >= deadline - 60:
+            break
+        time.sleep(15)
+    log("[bench] lock still held at window end; proceeding WITHOUT it "
+        "(risk: a concurrent tunnel client)")
+    return None
+
+
 def main() -> int:
     if os.environ.get("SPTPU_BENCH_CHILD") == "1":
         return child()
 
     t_start = time.monotonic()
     deadline = t_start + TIMEOUT_S
+    _watch_lock = _acquire_watch_lock(deadline)  # held until exit
     store_name = f"/spt-bench-{os.getpid()}"
     stagefile = f"/tmp/spt-bench-stage-{os.getpid()}"
     env = dict(os.environ, SPTPU_BENCH_CHILD="1",

@@ -124,12 +124,11 @@ class SelfAttention(nn.Module):
             from ..ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, mask)
         else:
-            scale = 1.0 / np.sqrt(head_dim)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            bias = jnp.where(mask[:, None, None, :], 0.0, -1e9)
-            probs = jax.nn.softmax(
-                logits.astype(jnp.float32) + bias, axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            # short buckets: the plain masked-softmax math, shared with
+            # the kernel's fallback so the three attention paths cannot
+            # drift (ops/flash_attention._mha_jnp)
+            from ..ops.flash_attention import _mha_jnp
+            out = _mha_jnp(q, k, v, mask)
         out = out.reshape(B, S, cfg.hidden)
         return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="out")(out)
 

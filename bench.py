@@ -330,24 +330,36 @@ def _acquire_watch_lock(deadline: float):
         lk = open("/tmp/tpu_bench_watch.lock", "w")
     except OSError:
         return None
-    waited = False
-    while True:                       # always try at least once
+    import threading
+
+    # BLOCKING acquire in a helper thread: the kernel queues us, so we
+    # win the instant the watcher releases between cycles — a
+    # non-blocking poll would almost never land in that microsecond gap
+    # and would starve for the whole window
+    acquired = threading.Event()
+
+    def _block():
         try:
-            fcntl.flock(lk, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            if waited:
-                log("[bench] watcher released the tunnel lock")
-            return lk
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            acquired.set()
         except OSError:
-            if not waited:
-                log("[bench] a bench watcher holds the tunnel lock; "
-                    "waiting for its cycle to finish ...")
-                waited = True
-        if time.monotonic() >= deadline - 60:
-            break
-        time.sleep(15)
-    log("[bench] lock still held at window end; proceeding WITHOUT it "
-        "(risk: a concurrent tunnel client)")
-    return None
+            pass
+
+    th = threading.Thread(target=_block, daemon=True)
+    th.start()
+    th.join(timeout=0.2)
+    if not acquired.is_set():
+        log("[bench] a bench watcher holds the tunnel lock; queued "
+            "for its cycle to finish ...")
+        th.join(timeout=max(0.0, deadline - 60 - time.monotonic()))
+    if acquired.is_set():
+        log("[bench] tunnel lock acquired")
+    else:
+        # the queued flock stays armed: if it lands later we simply
+        # hold the lock from then on, keeping watchers out mid-bench
+        log("[bench] lock still held at window end; proceeding WITHOUT "
+            "it (risk: a concurrent tunnel client)")
+    return lk
 
 
 def main() -> int:

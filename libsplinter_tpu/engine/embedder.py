@@ -34,6 +34,7 @@ import numpy as np
 
 from .. import _native as N
 from ..store import Store
+from ..utils.trace import device_profile, tracer
 from . import protocol as P
 
 log = logging.getLogger("libsplinter_tpu.embedder")
@@ -297,8 +298,9 @@ class Embedder:
         def commit_oldest():
             nonlocal committed_total
             r, e, pend = inflight.popleft()
-            committed_total += self._commit_batch(
-                r, e, pend.materialize(), t_start)
+            with tracer.span("embed.commit"):
+                committed_total += self._commit_batch(
+                    r, e, pend.materialize(), t_start)
 
         def enqueue(rows_b, eps_b, pend):
             inflight.append((rows_b, eps_b, pend))
@@ -315,7 +317,8 @@ class Embedder:
             ch_rows, ch_texts, ch_eps = keep[ch], texts[ch], epochs[ch]
 
             # context-window guard (reference: splinference.cpp:226-233)
-            too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
+            with tracer.span("embed.tokenize"):
+                too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
             ok_rows, ok_texts, ok_epochs, ok_i = [], [], [], []
             for j, (idx, text, e) in enumerate(
                     zip(ch_rows, ch_texts, ch_eps)):
@@ -331,13 +334,16 @@ class Embedder:
 
             if ids is not None:
                 # ids already tokenized by the guard pass: group by
-                # per-row bucket and dispatch without forcing
+                # per-row bucket and dispatch without forcing (the
+                # span measures host-side dispatch; device time shows
+                # up in embed.commit's materialize wait)
                 rows_a = np.asarray(ok_rows)
                 eps_a = np.asarray(ok_epochs)
-                for ss, pend in self._dispatch_bucketed(
-                        ids[ok_i], lens[ok_i]):
-                    enqueue([int(x) for x in rows_a[ss]],
-                            [int(x) for x in eps_a[ss]], pend)
+                with tracer.span("embed.dispatch"):
+                    for ss, pend in self._dispatch_bucketed(
+                            ids[ok_i], lens[ok_i]):
+                        enqueue([int(x) for x in rows_a[ss]],
+                                [int(x) for x in eps_a[ss]], pend)
             else:
                 for slo in range(0, len(ok_rows), self.batch_cap):
                     sl = slice(slo, slo + self.batch_cap)
@@ -406,18 +412,25 @@ class Embedder:
         periodic reconciliation that catches labels whose dirty bits a
         crashed consumer drained and lost)."""
         st = self.store
-        bits = st.drain_dirty()
-        rows = set(st.dirty_to_indices(bits))
-        rows.update(self._pending)
-        if sweep:
-            rows.update(st.enumerate_indices(P.LBL_EMBED_REQ))
-        if self._bid >= 0:
-            try:
-                st.shard_rebid(self._bid)
-                st.madvise(self._bid, N.ADV_WILLNEED, timeout_ms=0)
-            except OSError:
-                pass
-        return self.process_rows(sorted(rows))
+        with tracer.span("embed.drain"):
+            bits = st.drain_dirty()
+            rows = set(st.dirty_to_indices(bits))
+            rows.update(self._pending)
+            if sweep:
+                rows.update(st.enumerate_indices(P.LBL_EMBED_REQ))
+            if self._bid >= 0:
+                try:
+                    st.shard_rebid(self._bid)
+                    st.madvise(self._bid, N.ADV_WILLNEED, timeout_ms=0)
+                except OSError:
+                    pass
+            if not rows:
+                return 0
+            # device profile only around real work: a busy daemon runs
+            # many empty sweep drains per second — capturing those
+            # would pile up trace dirs with nothing in them
+            with device_profile("drain"):
+                return self.process_rows(sorted(rows))
 
     def run_once(self) -> int:
         """One full drain cycle (--oneshot): dirty mask + label sweep."""
@@ -428,9 +441,11 @@ class Embedder:
         __embedder_stats key (observability counterpart of the
         reference's __debug channel; the sidecar's group-63 watch
         surfaces every update)."""
-        P.publish_heartbeat(self.store, P.KEY_EMBED_STATS,
-                            {**dataclasses.asdict(self.stats),
-                             "pending": len(self._pending)})
+        payload = {**dataclasses.asdict(self.stats),
+                   "pending": len(self._pending)}
+        if tracer.enabled:
+            payload["spans"] = tracer.snapshot()
+        P.publish_heartbeat(self.store, P.KEY_EMBED_STATS, payload)
 
     def run(self, *, idle_timeout_ms: int = 100,
             stop_after: float | None = None,

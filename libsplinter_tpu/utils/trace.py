@@ -1,0 +1,88 @@
+"""Lightweight span tracing for the serving daemons.
+
+The reference's only tracing primitives are raw cycle reads
+(splinter_now(), splinter.h:872-893) and post-hoc ctime backfill
+(splinter.c:682-707); operators correlate latency by hand.  Here the
+daemons get nestable wall-clock spans with near-zero disabled cost:
+
+    from ..utils.trace import tracer
+    with tracer.span("drain"):
+        ...
+
+Aggregates (count / total_ms / max_ms per span name) ride the stats
+heartbeat (engine/protocol.publish_heartbeat) so `spt head
+__embedder_stats` — or the sidecar's debug watch — shows where wall
+time goes without attaching anything.
+
+Enabled with SPTPU_TRACE=1 (default off: span() returns a shared
+no-op).  SPTPU_JAX_PROFILE=<dir> additionally wraps whole drains in
+jax.profiler traces for device-level timelines (TensorBoard-loadable);
+that one is for deliberate profiling sessions, not production.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+
+class Tracer:
+    """Aggregating span tracer.  Thread-safe; span() is a context
+    manager.  Disabled tracers hand back one shared no-op context, so
+    the hot path pays a dict lookup and nothing else."""
+
+    def __init__(self, enabled: bool | None = None):
+        self.enabled = (os.environ.get("SPTPU_TRACE") == "1"
+                        if enabled is None else enabled)
+        self._lock = threading.Lock()
+        self._agg: dict[str, list[float]] = {}   # name -> [n, total, max]
+
+    @contextlib.contextmanager
+    def _timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                a = self._agg.setdefault(name, [0, 0.0, 0.0])
+                a[0] += 1
+                a[1] += dt
+                a[2] = max(a[2], dt)
+
+    _NOOP = contextlib.nullcontext()
+
+    def span(self, name: str):
+        return self._timed(name) if self.enabled else self._NOOP
+
+    def snapshot(self) -> dict:
+        """{name: {n, total_ms, max_ms}} — merged into heartbeats."""
+        with self._lock:
+            return {k: {"n": int(v[0]), "total_ms": round(v[1], 2),
+                        "max_ms": round(v[2], 2)}
+                    for k, v in self._agg.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._agg.clear()
+
+
+tracer = Tracer()                     # process-wide default
+
+
+@contextlib.contextmanager
+def device_profile(tag: str):
+    """jax.profiler capture into $SPTPU_JAX_PROFILE/<tag>-<ts> when the
+    env var names a directory; otherwise free."""
+    root = os.environ.get("SPTPU_JAX_PROFILE")
+    if not root:
+        yield
+        return
+    import jax
+
+    # perf_counter_ns: unique per capture — second-resolution names
+    # collide across the many drains a busy daemon runs per second
+    path = os.path.join(root, f"{tag}-{time.perf_counter_ns()}")
+    with jax.profiler.trace(path):
+        yield

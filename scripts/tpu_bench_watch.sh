@@ -17,18 +17,31 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
 DEADLINE="${1:-$(($(date +%s) + 30600))}"   # default: +8.5h
 
-# One tunnel client at a time: the flock is held PER CYCLE (acquired
-# before each bench, released after), so a driver-invoked bench.py —
-# which waits on this same lock — gets its turn between cycles instead
-# of starving for the watcher's whole lifetime.  Two watchers simply
-# alternate cycles; the single-client invariant is what matters.
+# Two locks with different lifetimes:
+#   - instance lock (fd 8, held for our lifetime): one watcher process
+#     total — a second launch exits instead of queueing duplicate
+#     post-success bench series;
+#   - cycle lock (fd 9, held per bench cycle): one tunnel CLIENT at a
+#     time — released between cycles so a driver-invoked bench.py
+#     (which queues on this lock) gets its turn.
+INSTANCE=/tmp/tpu_bench_watch.instance
+exec 8>"$INSTANCE"
+if ! flock -n 8; then
+    echo "[watch] another watcher instance is live; exiting" >&2
+    exit 1
+fi
 LOCK=/tmp/tpu_bench_watch.lock
 exec 9>"$LOCK"
 OUT="/tmp/bench_cycle.$$.json"
 LOG="/tmp/bench_cycle.$$.log"
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-    flock 9        # blocking: wait out any driver bench / other watcher
+    # bounded blocking acquire: never start a cycle past the deadline
+    # just because a long driver bench held the lock
+    if ! flock -w "$((DEADLINE - $(date +%s)))" 9; then
+        echo "[watch] deadline passed while waiting for the lock" >&2
+        break
+    fi
     echo "[watch] $(date -u +%H:%M:%S) bench cycle starting" >&2
     BENCH_FROM_WATCHER=1 \
     BENCH_SKIP_PROBE=1 BENCH_ATTEMPT_TIMEOUT=2700 BENCH_TIMEOUT=3000 \

@@ -1,0 +1,77 @@
+"""Span tracer (utils/trace.py): aggregation, thread safety, no-op
+cost path, and heartbeat integration."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from libsplinter_tpu.utils.trace import Tracer
+
+
+def test_disabled_tracer_is_noop():
+    t = Tracer(enabled=False)
+    with t.span("x"):
+        pass
+    assert t.snapshot() == {}
+    # disabled spans share one context object (no per-call allocation)
+    assert t.span("a") is t.span("b")
+
+
+def test_span_aggregation():
+    t = Tracer(enabled=True)
+    for _ in range(3):
+        with t.span("work"):
+            time.sleep(0.002)
+    snap = t.snapshot()
+    assert snap["work"]["n"] == 3
+    assert snap["work"]["total_ms"] >= 5
+    assert snap["work"]["max_ms"] >= snap["work"]["total_ms"] / 3 - 1e-6
+    t.reset()
+    assert t.snapshot() == {}
+
+
+def test_span_thread_safety():
+    t = Tracer(enabled=True)
+
+    def worker():
+        for _ in range(200):
+            with t.span("w"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.snapshot()["w"]["n"] == 1600
+
+
+def test_embedder_heartbeat_carries_spans(tmp_path, monkeypatch):
+    from libsplinter_tpu import Store, T_VARTEXT
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine import embedder as emod
+
+    monkeypatch.setattr(emod.tracer, "enabled", True)
+    emod.tracer.reset()
+    name = f"/spt-trace-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=512, vec_dim=8)
+    try:
+        emb = emod.Embedder(st, encoder_fn=lambda ts: np.zeros(
+            (len(ts), 8), np.float32), max_ctx=64)
+        emb.attach()
+        st.set("k", "text")
+        st.set_type("k", T_VARTEXT)
+        st.label_or("k", P.LBL_EMBED_REQ)
+        emb.run_once()
+        emb.publish_stats()
+        snap = json.loads(st.get(P.KEY_EMBED_STATS).rstrip(b"\0"))
+        assert "spans" in snap
+        assert snap["spans"]["embed.drain"]["n"] >= 1
+        assert snap["spans"]["embed.commit"]["n"] >= 1
+    finally:
+        st.close()
+        Store.unlink(name)

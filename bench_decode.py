@@ -101,6 +101,8 @@ def main() -> int:
     def tokens_per_sec(chunk: int, n: int) -> float:
         model.reset()
         model.prefill(prompt)
+        # never overrun the KV window (tiny geometries have small ones)
+        n = min(n, cfg.max_len - model.pos - chunk - 1)
         t0 = time.perf_counter()
         got = 0
         tok = 1
@@ -140,6 +142,28 @@ def main() -> int:
     tps_b8 = batch_tokens_per_sec(8, N_TOKENS)
     log(f"batched decode: {tps_b8:,.1f} aggregate tok/s (batch=8, "
         f"chunk={CHUNK})")
+
+    # speculative decoding: tiny draft proposes gamma tokens per
+    # target verify forward (models/speculative.py)
+    tps_spec = accept = None
+    if os.environ.get("DECODE_SPEC", "1") == "1":
+        from libsplinter_tpu.models import (DecoderConfig as _DC,
+                                            SpeculativeCompletionModel)
+        gamma = int(os.environ.get("DECODE_GAMMA", "4"))
+        draft = CompletionModel(
+            _DC.tiny(vocab_size=cfg.vocab_size, max_len=cfg.max_len),
+            buckets=(64,), temp=model.temp, top_p=model.top_p,
+            seed=123)   # distinct weights: tiny-geometry runs would
+        #               otherwise make draft == target (vacuous accept)
+        spec = SpeculativeCompletionModel(model, draft, gamma=gamma)
+        spec.warmup()
+        t0 = time.perf_counter()
+        n_spec = sum(1 for _ in spec.generate_tokens(prompt, N_TOKENS))
+        tps_spec = n_spec / (time.perf_counter() - t0)
+        accept = spec.acceptance_rate
+        spec.reset()
+        log(f"speculative decode: {tps_spec:,.1f} tok/s "
+            f"(gamma={gamma}, acceptance={accept:.2f})")
 
     # -- completion daemon e2e --------------------------------------------
     from libsplinter_tpu import Store
@@ -183,6 +207,10 @@ def main() -> int:
             "tokens_per_sec_serial_sync": round(tps_serial, 1),
             "tokens_per_sec_chunk32": round(tps_c32, 1),
             "tokens_per_sec_batch8_aggregate": round(tps_b8, 1),
+            "tokens_per_sec_speculative": (round(tps_spec, 1)
+                                           if tps_spec else None),
+            "speculative_acceptance": (round(accept, 3)
+                                       if accept is not None else None),
             "completer_e2e_ms_32tok": round(e2e_ms, 0),
         },
     }

@@ -483,6 +483,7 @@ class Completer:
         batched = getattr(self, "_model", None) is not None \
             and self.generate_fn == self._model_generate \
             and self.batch_cap > 1 \
+            and hasattr(self._model, "prefill_batch") \
             and self._batched_budget() is not None
         if batched:
             for lo in range(0, len(idxs), self.batch_cap):
@@ -582,6 +583,14 @@ def main(argv: list[str] | None = None) -> int:
                          "programs before serving (first requests "
                          "otherwise pay the compiles; .xla_cache "
                          "persists them across restarts)")
+    ap.add_argument("--draft-weights",
+                    help="speculative decoding: a small draft .gguf "
+                         "(same tokenizer family; geometry from its "
+                         "metadata) proposes --gamma tokens per "
+                         "target forward (models/speculative.py); "
+                         "serial serving only")
+    ap.add_argument("--gamma", type=int, default=4,
+                    help="speculative proposal length per verify step")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -641,6 +650,25 @@ def main(argv: list[str] | None = None) -> int:
         model = ShardedCompletionModel(cfg, mesh, **mkw)
     else:
         model = CompletionModel(cfg, **mkw)
+    if args.draft_weights:
+        from ..models import SpeculativeCompletionModel
+        if not args.draft_weights.endswith(".gguf"):
+            # a safetensors file carries no geometry metadata, and a
+            # draft small enough to be useful is never default-sized —
+            # guessing would crash deep in the loader
+            raise SystemExit(
+                "--draft-weights requires a .gguf draft (geometry and "
+                "tokenizer come from its metadata); export the draft "
+                "via models/gguf_writer.py if needed")
+        from ..models.gguf import GgufFile, decoder_config_from_gguf
+        with GgufFile(args.draft_weights) as gf:
+            dcfg = decoder_config_from_gguf(gf)
+        draft = CompletionModel(dcfg, weights=args.draft_weights,
+                                top_p=args.top_p, temp=args.temp)
+        model = SpeculativeCompletionModel(model, draft,
+                                           gamma=args.gamma)
+        log.info("speculative decoding: gamma=%d draft=%s",
+                 args.gamma, args.draft_weights)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
                      template=template, batch_cap=args.batch_cap)

@@ -55,13 +55,26 @@ CTX_GUARD_FRACTION = 0.9
 def publish_heartbeat(store, key: str, payload: dict) -> None:
     """Write a timestamped JSON stats snapshot into a debug-labeled
     key.  Telemetry must never wedge serving: a concurrently deleted
-    key (KeyError) or a full/failed store op (OSError) is swallowed."""
+    key (KeyError) or a failed store op (OSError) is swallowed — but a
+    snapshot too big for the store's max_val degrades to the core
+    counters (marking what was dropped) instead of silently removing
+    the heartbeat the moment tracing is enabled."""
     import json
     import time
 
-    try:
-        store.set(key, json.dumps({"ts": time.time(), **payload}))
-        store.label_or(key, LBL_DEBUG)
-    except (KeyError, OSError):
-        pass
+    rec = {"ts": time.time(), **payload}
+    for attempt in (0, 1):
+        try:
+            store.set(key, json.dumps(rec))
+            store.label_or(key, LBL_DEBUG)
+            return
+        except KeyError:
+            return
+        except OSError:
+            if attempt == 1:
+                return
+            # drop the bulky optional sections and retry once
+            rec = {k: v for k, v in rec.items()
+                   if not isinstance(v, (dict, list))}
+            rec["truncated"] = True
 CTX_EXCEEDED_DIAGNOSTIC = b"[context exceeded: input too long for model]"

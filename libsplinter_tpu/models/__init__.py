@@ -2,6 +2,7 @@ from .decoder import (CompletionModel, Decoder, DecoderConfig, init_cache,
                       sample_top_p)
 from .encoder import Encoder, EncoderConfig, EmbeddingModel
 from .moe import MoeDecoder, MoeDecoderConfig, moe_completion_model
+from .speculative import SpeculativeCompletionModel
 from .tokenizer import (ByteTokenizer, HashTokenizer, WordPieceTokenizer,
                         batch_encode, default_tokenizer)
 
@@ -9,4 +10,5 @@ __all__ = ["Encoder", "EncoderConfig", "EmbeddingModel", "HashTokenizer",
            "WordPieceTokenizer", "ByteTokenizer", "batch_encode",
            "default_tokenizer", "CompletionModel", "Decoder",
            "DecoderConfig", "init_cache", "sample_top_p",
-           "MoeDecoder", "MoeDecoderConfig", "moe_completion_model"]
+           "MoeDecoder", "MoeDecoderConfig", "moe_completion_model",
+           "SpeculativeCompletionModel"]

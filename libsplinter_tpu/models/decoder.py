@@ -211,17 +211,26 @@ class Decoder(nn.Module):
 
 # ---------------------------------------------------------------- sampling
 
-def _sample_graph(rng, logits, top_p: float, temp: float):
-    """In-graph sampler body (traceable under scan): top-p nucleus
-    filter → temperature → categorical draw.  temp <= 0 means greedy."""
-    if temp <= 0:
-        return jnp.argmax(logits).astype(jnp.int32)
+def _nucleus_logits(logits, top_p: float, temp: float):
+    """The sampler chain's filter, shared by the categorical draw
+    (_sample_graph) and the speculative verifier's explicit
+    distribution (speculative._filtered_probs) — the acceptance rule
+    is only distribution-exact while both read the SAME chain.
+    Returns (order, masked sorted logits)."""
     order = jnp.argsort(-logits)
     sorted_logits = logits[order] / temp
     probs = jax.nn.softmax(sorted_logits)
     cum = jnp.cumsum(probs)
     keep = (cum - probs) < top_p          # always keeps the top token
-    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    return order, jnp.where(keep, sorted_logits, -jnp.inf)
+
+
+def _sample_graph(rng, logits, top_p: float, temp: float):
+    """In-graph sampler body (traceable under scan): top-p nucleus
+    filter → temperature → categorical draw.  temp <= 0 means greedy."""
+    if temp <= 0:
+        return jnp.argmax(logits).astype(jnp.int32)
+    order, masked = _nucleus_logits(logits, top_p, temp)
     choice = jax.random.categorical(rng, masked)
     return order[choice].astype(jnp.int32)
 

@@ -1,0 +1,230 @@
+"""Speculative decoding: a draft decoder proposes, the target verifies.
+
+The reference decodes strictly serially — one llama.cpp forward per
+token (splainference.cpp:306-365).  The chunked scan (decoder.py)
+already amortizes the host sync; speculative decoding additionally
+amortizes the TARGET MODEL's sequential depth: a cheap draft model
+runs gamma autoregressive steps, then the target scores all gamma+1
+positions in ONE forward (its KV cache ingests the whole proposal like
+a prefill), and the standard rejection rule keeps the target's exact
+distribution:
+
+  accept draft token x_i with prob min(1, p_t(x_i) / p_d(x_i));
+  at the first rejection resample from normalize(max(p_t - p_d, 0));
+  if all gamma accepted, sample one bonus token from the target's
+  last-position distribution.
+
+Greedy (temp=0) degenerates to: accept while the draft token equals
+the target argmax — so speculative greedy output is BYTE-IDENTICAL to
+target-only greedy output (the correctness bar in tests).
+
+Cache discipline: both models park their decode position at the end of
+the ACCEPTED history; rejected slots' K/V rows go stale in place and
+are overwritten by later writes before any query can attend to them
+(the same rewind argument as bucketed prefill, decoder.py prefill).
+
+The whole propose+verify+accept step is ONE jitted program per
+(gamma,) — draft scan, target forward, acceptance scan, resampling all
+stay on device; the host sees only (tokens, n_valid) per step, so a
+speculative step costs the same tunnel round trips as one chunked
+decode step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decoder import CompletionModel, _nucleus_logits, init_cache
+
+
+def _filtered_probs(logits, top_p: float, temp: float):
+    """The sampler chain's categorical distribution (decoder.py
+    _sample_graph draws from exactly this — both read the shared
+    _nucleus_logits filter).  temp<=0 is greedy: a one-hot at
+    argmax."""
+    if temp <= 0:
+        return jax.nn.one_hot(jnp.argmax(logits), logits.shape[-1],
+                              dtype=jnp.float32)
+    order, masked = _nucleus_logits(logits, top_p, temp)
+    p_sorted = jax.nn.softmax(masked)
+    # scatter back to vocab order
+    return jnp.zeros_like(p_sorted).at[order].set(p_sorted)
+
+
+class SpeculativeCompletionModel:
+    """generate_tokens-compatible front end over (target, draft).
+
+    Both models must share tokenizer/vocab; sampler settings come from
+    the TARGET (the draft's own top_p/temp fields are ignored — the
+    proposal distribution must be the one the acceptance rule divides
+    by, so both use the target's chain).
+    """
+
+    def __init__(self, target: CompletionModel, draft: CompletionModel,
+                 *, gamma: int = 4, seed: int = 0):
+        if target.cfg.vocab_size != draft.cfg.vocab_size:
+            raise ValueError("target/draft vocab mismatch")
+        if gamma < 1:
+            raise ValueError("gamma must be >= 1")
+        self.target = target
+        self.draft = draft
+        self.gamma = gamma
+        self.cfg = target.cfg
+        self._rng = jax.random.PRNGKey(seed + 17)
+        self._progs: dict[tuple, Any] = {}
+        self.stats_proposed = 0
+        self.stats_accepted = 0
+
+    # -- the fused propose+verify+accept program ---------------------------
+
+    def _step_program(self, gamma: int):
+        key = (gamma, self.target.top_p, self.target.temp)
+        fn = self._progs.get(key)
+        if fn is not None:
+            return fn
+        t_mod, d_mod = self.target.module, self.draft.module
+        top_p, temp = self.target.top_p, self.target.temp
+        fprobs = functools.partial(_filtered_probs, top_p=top_p,
+                                   temp=temp)
+
+        def run(tp, dp, tcache, dcache, pos, rng, tok):
+            # -- draft: gamma autoregressive steps, keeping its
+            #    (filtered) proposal distribution per step
+            def dstep(carry, _):
+                dcache, dpos, rng, tok = carry
+                logits, dcache = d_mod.apply(dp, tok.reshape(1, 1),
+                                             dcache, dpos)
+                p = fprobs(logits[0, 0])
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(
+                    sub, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
+                return (dcache, dpos + 1, rng, nxt), (nxt, p)
+
+            (dcache, _, rng, _), (toks, dprobs) = jax.lax.scan(
+                dstep, (dcache, pos, rng, tok), None, length=gamma)
+            # the scan fed [tok, d_1..d_{gamma-1}] (slots pos..pos+g-1);
+            # ingest d_gamma too so an all-accept step leaves no K/V
+            # hole at slot pos+gamma for the next step to attend into
+            _, dcache = d_mod.apply(dp, toks[gamma - 1].reshape(1, 1),
+                                    dcache, pos + gamma)
+
+            # -- target: ONE forward over [tok, d_1..d_gamma]
+            seq = jnp.concatenate([tok.reshape(1), toks]).reshape(1, -1)
+            tlogits, tcache = t_mod.apply(tp, seq, tcache, pos)
+            tprobs = jax.vmap(fprobs)(tlogits[0])     # (gamma+1, V)
+
+            # -- acceptance scan (first rejection sticks)
+            def astep(carry, i):
+                rng, n_acc, rejected = carry
+                rng, sub = jax.random.split(rng)
+                x = toks[i]
+                ratio = tprobs[i, x] / jnp.maximum(dprobs[i, x], 1e-30)
+                ok = (~rejected) & (jax.random.uniform(sub) <
+                                    jnp.minimum(ratio, 1.0))
+                return (rng, n_acc + ok.astype(jnp.int32),
+                        rejected | ~ok), ok
+
+            (rng, n_acc, _), _ = jax.lax.scan(
+                astep, (rng, jnp.int32(0), jnp.bool_(False)),
+                jnp.arange(gamma))
+
+            # -- the step's final token: resampled residual at the
+            #    first rejected position, or a bonus draw at gamma
+            resid = jnp.maximum(tprobs[n_acc] - jnp.where(
+                n_acc < gamma, dprobs[jnp.minimum(n_acc, gamma - 1)],
+                jnp.zeros_like(tprobs[0])), 0.0)
+            resid_sum = resid.sum()
+            dist = jnp.where(resid_sum > 1e-30, resid / resid_sum,
+                             tprobs[n_acc])
+            rng, sub = jax.random.split(rng)
+            if temp <= 0:
+                final = jnp.argmax(dist).astype(jnp.int32)
+            else:
+                final = jax.random.categorical(
+                    sub, jnp.log(jnp.maximum(dist, 1e-30))
+                ).astype(jnp.int32)
+
+            # accepted tokens then the final token, then zero padding
+            out = jnp.zeros((gamma + 1,), jnp.int32)
+            idx = jnp.arange(gamma + 1)
+            out = jnp.where(idx < n_acc, jnp.pad(toks, (0, 1)), out)
+            out = jnp.where(idx == n_acc, final, out)
+            return tcache, dcache, rng, out, n_acc + 1
+
+        fn = jax.jit(run, donate_argnums=(2, 3))
+        self._progs[key] = fn
+        if len(self._progs) > 8:
+            cur = (self.target.top_p, self.target.temp)
+            self._progs = {k: v for k, v in self._progs.items()
+                           if k[-2:] == cur}
+        return fn
+
+    # -- generation surface ------------------------------------------------
+
+    def reset(self) -> None:
+        self.target.reset()
+        self.draft.reset()
+
+    def generate_tokens(self, prompt_ids: np.ndarray, max_new: int,
+                        *, eos_id: int | None = None, chunk: int = 0):
+        """Generator of sampled ids (generate_tokens contract,
+        decoder.py).  `chunk` is accepted for signature compatibility
+        and ignored — the speculative step IS the chunk."""
+        t, d = self.target, self.draft
+        logits = t.prefill(np.asarray(prompt_ids, np.int32))
+        d.prefill(np.asarray(prompt_ids, np.int32))
+        tok = t.sample(logits)
+        yield int(tok)
+        if eos_id is not None and tok == eos_id:
+            return
+        produced = 1
+        while produced < max_new:
+            room = min(t.cfg.max_len - t._pos - 1,
+                       d.cfg.max_len - d._pos - 1,
+                       max_new - produced)
+            if room <= 0:
+                break
+            g = min(self.gamma, room)
+            prog = self._step_program(g)
+            self._rng, sub = jax.random.split(self._rng)
+            t._cache, d._cache, _, out, n_valid = prog(
+                t.params, d.params, t._cache, d._cache,
+                jnp.int32(t._pos), sub, jnp.int32(int(tok)))
+            out = np.asarray(out)
+            n_valid = int(n_valid)
+            # both caches hold rows written beyond the accepted
+            # history; parking pos at the accepted end makes them
+            # unreachable until overwritten (decoder.py prefill note)
+            t._pos += n_valid
+            d._pos += n_valid
+            self.stats_proposed += g
+            self.stats_accepted += n_valid - 1
+            for i in range(n_valid):
+                tokn = int(out[i])
+                yield tokn
+                produced += 1
+                if eos_id is not None and tokn == eos_id:
+                    return
+                if produced >= max_new:
+                    return
+            tok = int(out[n_valid - 1])
+
+    def warmup(self, chunk: int = 8) -> None:
+        """Pre-compile the prefill + step programs (one short
+        generation); further prompt buckets compile on first use and
+        persist in the XLA cache.  `chunk` accepted for surface
+        compatibility with CompletionModel.warmup."""
+        n = min(8, self.cfg.max_len - self.gamma - 3)
+        ids = np.ones((max(1, n),), np.int32)
+        for _ in self.generate_tokens(ids, self.gamma + 1):
+            pass
+        self.reset()
+
+    @property
+    def acceptance_rate(self) -> float:
+        return (self.stats_accepted / self.stats_proposed
+                if self.stats_proposed else 0.0)

@@ -1,0 +1,117 @@
+"""Speculative decoding (models/speculative.py).
+
+Correctness bars: greedy speculative output is token-identical to the
+target decoding alone (any draft); a draft that IS the target accepts
+every proposal; the filtered-probability helper matches the sampler
+chain's distribution; cache discipline survives many steps and
+rejections.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libsplinter_tpu.models.decoder import (CompletionModel,
+                                            DecoderConfig, _sample_graph)
+from libsplinter_tpu.models.speculative import (SpeculativeCompletionModel,
+                                                _filtered_probs)
+
+CFG = DecoderConfig.tiny(dtype=jnp.float32)
+SMALL = DecoderConfig.tiny(dtype=jnp.float32, layers=1, hidden=32,
+                           heads=2, kv_heads=2, mlp_dim=64)
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2], np.int32)
+
+
+def _target():
+    return CompletionModel(CFG, buckets=(16,), temp=0.0, seed=2)
+
+
+def _draft():
+    return CompletionModel(SMALL, buckets=(16,), temp=0.0, seed=5)
+
+
+def test_greedy_equals_target_only():
+    """Whatever the draft proposes, greedy speculative output must be
+    exactly the target's own greedy sequence."""
+    t = _target()
+    want = [int(x) for x in t.generate_tokens(PROMPT, 24, chunk=8)]
+    t.reset()
+    for gamma in (1, 3, 4):
+        spec = SpeculativeCompletionModel(_target(), _draft(),
+                                          gamma=gamma)
+        got = [int(x) for x in spec.generate_tokens(PROMPT, 24)]
+        spec.reset()
+        assert got == want, f"gamma={gamma}: {got} != {want}"
+
+
+def test_draft_equals_target_accepts_everything():
+    """With the draft sharing the target's params, the acceptance
+    ratio is 1 everywhere: every proposal accepted."""
+    t = _target()
+    d = CompletionModel(CFG, buckets=(16,), temp=0.0, seed=2)
+    spec = SpeculativeCompletionModel(t, d, gamma=4)
+    out = [x for x in spec.generate_tokens(PROMPT, 20)]
+    assert len(out) == 20
+    assert spec.acceptance_rate == 1.0
+
+
+def test_eos_stops_mid_step():
+    t = _target()
+    toks = [int(x) for x in t.generate_tokens(PROMPT, 24, chunk=8)]
+    t.reset()
+    eos = toks[5]                     # force a stop partway through
+    spec = SpeculativeCompletionModel(_target(), _draft(), gamma=4)
+    got = [int(x) for x in spec.generate_tokens(PROMPT, 24, eos_id=eos)]
+    assert got[-1] == eos
+    assert eos not in got[:-1]
+    assert got == toks[: toks.index(eos) + 1]
+
+
+def test_filtered_probs_matches_sampler_chain():
+    """_filtered_probs must be the categorical distribution
+    _sample_graph draws from: empirical frequencies agree."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 2, 32).astype(np.float32))
+    p = np.asarray(_filtered_probs(logits, top_p=0.8, temp=0.9))
+    assert abs(p.sum() - 1.0) < 1e-5
+    draws = np.array([int(_sample_graph(jax.random.PRNGKey(i), logits,
+                                        0.8, 0.9)) for i in range(400)])
+    freq = np.bincount(draws, minlength=32) / len(draws)
+    # support must match exactly; frequencies within sampling noise
+    assert set(np.nonzero(freq)[0]) <= set(np.nonzero(p > 1e-9)[0])
+    top = int(np.argmax(p))
+    assert abs(freq[top] - p[top]) < 0.08
+
+
+def test_filtered_probs_greedy_one_hot():
+    logits = jnp.asarray(np.array([0.1, 3.0, -1.0], np.float32))
+    p = np.asarray(_filtered_probs(logits, top_p=0.9, temp=0.0))
+    assert p[1] == 1.0 and p.sum() == 1.0
+
+
+def test_sampled_mode_runs_and_counts():
+    """temp>0: generation completes, stats tally, tokens in vocab."""
+    t = CompletionModel(CFG, buckets=(16,), temp=0.7, seed=2)
+    spec = SpeculativeCompletionModel(t, _draft(), gamma=3)
+    out = [int(x) for x in spec.generate_tokens(PROMPT, 18)]
+    assert len(out) == 18
+    assert all(0 <= x < CFG.vocab_size for x in out)
+    assert spec.stats_proposed > 0
+    assert 0.0 <= spec.acceptance_rate <= 1.0
+
+
+def test_window_tail_respected():
+    """Generation near the context window shrinks gamma instead of
+    overrunning the cache."""
+    cfg = DecoderConfig.tiny(dtype=jnp.float32, max_len=32)
+    t = CompletionModel(cfg, buckets=(16,), temp=0.0, seed=2)
+    d = CompletionModel(
+        DecoderConfig.tiny(dtype=jnp.float32, layers=1, max_len=32),
+        buckets=(16,), temp=0.0, seed=5)
+    spec = SpeculativeCompletionModel(t, d, gamma=4)
+    out = [int(x) for x in spec.generate_tokens(PROMPT, 64)]
+    # window 32, prompt 7: at most ~24 decodable tokens, never a crash
+    assert 1 <= len(out) <= 25
+    assert t._pos < cfg.max_len

@@ -42,6 +42,24 @@ def test_embedder_stats_heartbeat(tmp_path):
         Store.unlink(name)
 
 
+def test_heartbeat_degrades_on_overflow(tmp_path):
+    """A snapshot too big for max_val must degrade to the scalar
+    counters (truncated flag set), not silently vanish — enabling
+    tracing must never remove the heartbeat."""
+    name, st = _mkstore(f"ovf-{tmp_path.name}")
+    try:
+        big = {"completions": 7, "spans": {f"s{i}": {"n": i,
+               "total_ms": 1.0, "max_ms": 1.0} for i in range(200)}}
+        P.publish_heartbeat(st, "__hb", big)
+        snap = json.loads(st.get("__hb").rstrip(b"\0"))
+        assert snap["completions"] == 7
+        assert snap.get("truncated") is True
+        assert "spans" not in snap
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
 def test_completer_stats_heartbeat(tmp_path):
     name, st = _mkstore(tmp_path.name)
     try:

@@ -5,6 +5,8 @@ Mirrors the reference's label state machine and well-known keys
 splainference.cpp:51-109; SURVEY.md §2.2) so a client written against the
 reference's conventions finds identical behavior here.
 """
+import json
+import time
 
 # --- bloom labels (bit masks) -------------------------------------------
 LBL_EMBED_REQ = 0x1            # "embed me" — wakes the embedding daemon
@@ -59,9 +61,6 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
     snapshot too big for the store's max_val degrades to the core
     counters (marking what was dropped) instead of silently removing
     the heartbeat the moment tracing is enabled."""
-    import json
-    import time
-
     rec = {"ts": time.time(), **payload}
     for attempt in (0, 1):
         try:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decoder import CompletionModel, _nucleus_logits, init_cache
+from .decoder import CompletionModel, _nucleus_logits
 
 
 def _filtered_probs(logits, top_p: float, temp: float):

@@ -102,6 +102,21 @@ def test_sampled_mode_runs_and_counts():
     assert 0.0 <= spec.acceptance_rate <= 1.0
 
 
+def test_speculative_over_quantized_target():
+    """Features compose: an int8-resident target behind speculative
+    decoding still matches ITS own greedy output."""
+    qcfg = DecoderConfig.tiny(dtype=jnp.float32, quantized=True)
+    t = CompletionModel(qcfg, buckets=(16,), temp=0.0, seed=2)
+    want = [int(x) for x in t.generate_tokens(PROMPT, 14, chunk=4)]
+    t.reset()
+    spec = SpeculativeCompletionModel(
+        CompletionModel(qcfg, buckets=(16,), temp=0.0, seed=2),
+        _draft(), gamma=3)
+    got = [int(x) for x in spec.generate_tokens(PROMPT, 14)]
+    spec.reset()
+    assert got == want
+
+
 def test_window_tail_respected():
     """Generation near the context window shrinks gamma instead of
     overrunning the cache."""

@@ -242,11 +242,11 @@ class Completer:
 
     # -- the completion ----------------------------------------------------
 
-    def _prepare(self, idx: int):
-        """The per-key request head (splainference.cpp:190-269): guarded
-        prompt read, fresh system-prompt fetch, template render,
-        WAITING→SERVICING flip, slot overwrite with the rendered
-        prompt.  Returns (key, rendered, t0) or None."""
+    def _read_rendered(self, idx: int):
+        """Guarded prompt read + fresh system-prompt fetch + template
+        render — NO side effects, so callers can peek a request (e.g.
+        to check it fits a live batch) without claiming it.  Returns
+        (key, rendered) or None."""
         st = self.store
         e = st.epoch_at(idx)
         if e & 1:
@@ -273,7 +273,18 @@ class Completer:
                 "utf-8", errors="replace")
         except KeyError:
             pass
-        rendered = render_prompt(prompt, system, self.template)
+        return key, render_prompt(prompt, system, self.template)
+
+    def _prepare(self, idx: int):
+        """The per-key request head (splainference.cpp:190-269):
+        _read_rendered plus the claim side effects — WAITING→SERVICING
+        flip, slot overwrite with the rendered prompt.  Returns
+        (key, rendered, t0) or None."""
+        st = self.store
+        peek = self._read_rendered(idx)
+        if peek is None:
+            return None
+        key, rendered = peek
 
         # WAITING → SERVICING, visible to watchers immediately
         st.label_clear(key, P.LBL_INFER_REQ | P.LBL_WAITING)
@@ -461,6 +472,220 @@ class Completer:
                 pass
             return "full"
 
+    # -- continuous batching ----------------------------------------------
+
+    def run_continuous(self, *, idle_timeout_ms: int = 100,
+                       stop_after: float | None = None) -> None:
+        """Continuous batched serving: requests join and leave the live
+        batch at chunk boundaries instead of waiting for the whole
+        drain to finish (engine-level vLLM-style slot scheduling over
+        decoder.join_row).
+
+        batch_cap slots decode together; after every flush_tokens-step
+        chunk, finished rows finalize (label trifecta, stamp) and free
+        their slot, and newly WAITING keys join mid-flight — their
+        prompt prefills into the freed row ending at the batch's
+        current position (decoder.py join_row; token-exact vs serial).
+        A row joining late in the window may be truncated at the
+        window before reaching max_new_tokens (the window is shared);
+        when every slot is free the cache resets and the window starts
+        fresh.  Serial-only models (speculative) and window-only
+        bucket geometries fall back to run()."""
+        m = getattr(self, "_model", None)
+        if (m is None or not hasattr(m, "join_row")
+                or self.batch_cap < 2
+                or self._batched_budget() is None):
+            return self.run(idle_timeout_ms=idle_timeout_ms,
+                            stop_after=stop_after)
+        import numpy as np
+
+        st = self.store
+        tok_izer = self._tok
+        B = self.batch_cap
+        self._running = True
+        deadline = (time.monotonic() + stop_after) if stop_after else None
+        last = st.signal_count(self.group)
+        next_beat = time.monotonic() + 2.0
+
+        rows: list[dict | None] = [None] * B
+        toks = np.zeros((B,), np.int32)
+
+        def admit(limit: int | None = None) -> int:
+            """Fill free slots from waiting keys.  Starting a FRESH
+            batch prefills all admitted prompts together; a live batch
+            takes joiners one join_row each.  With `limit` set (the
+            live batch's join_budget), longer prompts are put BACK to
+            WAITING for the next fresh batch — joining would silently
+            clip their context."""
+            free = [r for r in range(B) if rows[r] is None]
+            if not free:
+                return 0
+            n = 0
+            for idx in st.enumerate_indices(P.LBL_INFER_REQ):
+                if not free:
+                    break
+                if limit is not None:
+                    # peek BEFORE claiming: an oversized joiner stays
+                    # WAITING untouched (a claim would overwrite its
+                    # slot with the rendered prompt, double-rendering
+                    # it on the next admission)
+                    peek = self._read_rendered(idx)
+                    if peek is None:
+                        continue
+                    if len(self._clip_context(
+                            tok_izer.encode(peek[1]),
+                            bucketed=True)) > limit:
+                        continue
+                prep = self._prepare(idx)
+                if prep is None:
+                    continue
+                key, rendered, t0 = prep
+                ids = self._clip_context(tok_izer.encode(rendered),
+                                         bucketed=True)
+                if not len(ids):
+                    self._finalize(key, t0, 0, False)
+                    continue
+                r = free.pop(0)
+                rows[r] = {"key": key, "t0": t0, "n_tok": 0,
+                           "pending": b"", "remaining": self.max_new,
+                           "ids": np.asarray(ids, np.int32)}
+                n += 1
+            return n
+
+        def start_fresh_batch() -> None:
+            """Prefill every occupied slot together (free slots get a
+            dummy row so the cache always has B addressable rows)."""
+            prompts = [rows[r]["ids"] if rows[r] is not None
+                       else np.ones((1,), np.int32) for r in range(B)]
+            logits = m.prefill_batch(prompts)
+            first = m.sample_batch(logits)
+            for r in range(B):
+                if rows[r] is not None:
+                    emit(r, int(first[r]))
+                    toks[r] = int(first[r])
+
+        def emit(r: int, t: int) -> None:
+            """One sampled token for row r: eos / flush / budget."""
+            row = rows[r]
+            if t == tok_izer.eos_id:
+                finish(r)
+                return
+            row["pending"] += tok_izer.token_to_piece(t)
+            row["n_tok"] += 1
+            row["remaining"] -= 1
+            boundary = row["pending"].endswith((b" ", b"\n", b"\t"))
+            if boundary or row["n_tok"] % self.flush_tokens == 0:
+                res = self._flush(row["key"], row["pending"])
+                row["pending"] = b""
+                if res != "ok":
+                    finish(r, truncated=res == "full",
+                           vanished=res == "gone")
+                    return
+            if row["remaining"] <= 0:
+                finish(r)
+
+        def finish(r: int, truncated: bool = False,
+                   vanished: bool = False) -> None:
+            row = rows[r]
+            if row["pending"] and not truncated and not vanished:
+                res = self._flush(row["key"], row["pending"])
+                truncated = res == "full"
+                vanished = res == "gone"
+            self._finalize(row["key"], row["t0"], row["n_tok"],
+                           truncated, vanished)
+            rows[r] = None
+            toks[r] = 0
+
+        def abort_batch(reason: str) -> None:
+            """Model failure must not wedge WAITING/SERVICING (the
+            invariant process_key/process_batch keep): every live row
+            finalizes with what it already streamed."""
+            self._debug(f"continuous batch aborted: {reason}")
+            for r in range(B):
+                if rows[r] is not None:
+                    finish(r)
+            m.reset()
+
+        batch_live = False
+        while self._running:
+            now = time.monotonic()
+            if deadline and now > deadline:
+                break
+            if now >= next_beat:
+                next_beat = now + 2.0
+                self.publish_stats()
+
+            if not batch_live:
+                if admit() == 0:
+                    got = st.signal_wait(self.group, last,
+                                         timeout_ms=idle_timeout_ms)
+                    if got is not None:
+                        last = got
+                        self.stats.wakes += 1
+                    continue
+                try:
+                    start_fresh_batch()
+                except Exception as ex:
+                    abort_batch(f"prefill failed: {ex}")
+                    continue
+                batch_live = True
+                continue
+
+            try:
+                # live batch: joiners enter through the freed rows —
+                # but only prompts the current position can hold whole
+                if any(r is None for r in rows) \
+                        and admit(limit=m.join_budget()):
+                    for r in range(B):
+                        row = rows[r]
+                        if row is not None and row["n_tok"] == 0 \
+                                and "joined" not in row:
+                            row["joined"] = True
+                            logits = m.join_row(row["ids"], r)
+                            t = int(m.sample(logits))
+                            emit(r, t)
+                            if rows[r] is not None:
+                                toks[r] = t
+
+                if all(r is None for r in rows):
+                    m.reset()         # fresh window for the next wave
+                    batch_live = False
+                    continue
+
+                # window edge: rows still live finalize with what they
+                # have — the same "generation ends at the window"
+                # semantics as the serial path (no truncation marker;
+                # pending bytes flush inside finish)
+                step = min(self.flush_tokens,
+                           m.cfg.max_len - m.pos)
+                if step <= 0:
+                    for r in range(B):
+                        if rows[r] is not None:
+                            finish(r)
+                    continue
+
+                blk = m.decode_chunk_batch(toks, step)
+                self._rebid()
+                for c in range(step):
+                    for r in range(B):
+                        if rows[r] is not None:
+                            # tokens decoded before this row finished
+                            # mid-chunk are speculative: emit in order
+                            emit(r, int(blk[r, c]))
+                for r in range(B):
+                    if rows[r] is not None:
+                        toks[r] = int(blk[r, -1])
+            except Exception as ex:
+                abort_batch(str(ex))
+                batch_live = False
+
+        # stop()/stop_after mid-batch: never strand keys in SERVICING
+        for r in range(B):
+            if rows[r] is not None:
+                finish(r)
+        if batch_live:
+            m.reset()
+
     # -- drain loop --------------------------------------------------------
 
     def run_once(self) -> int:
@@ -591,6 +816,10 @@ def main(argv: list[str] | None = None) -> int:
                          "serial serving only")
     ap.add_argument("--gamma", type=int, default=4,
                     help="speculative proposal length per verify step")
+    ap.add_argument("--continuous", action="store_true",
+                    help="continuous batching: requests join/leave the "
+                         "live batch at chunk boundaries instead of "
+                         "waiting for whole drains (run_continuous)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -684,7 +913,10 @@ def main(argv: list[str] | None = None) -> int:
         log.info("oneshot serviced %d completions", n)
         return 0
     try:
-        comp.run(idle_timeout_ms=args.idle_timeout_ms)
+        if args.continuous:
+            comp.run_continuous(idle_timeout_ms=args.idle_timeout_ms)
+        else:
+            comp.run(idle_timeout_ms=args.idle_timeout_ms)
     except KeyboardInterrupt:
         pass
     return 0

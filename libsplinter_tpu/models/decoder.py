@@ -313,6 +313,7 @@ class CompletionModel:
         self._start = None            # (B,) left-pad offsets when batched
         self._batch = 0
         self._chunk_progs: dict[tuple, Any] = {}
+        self._join_progs: dict[int, Any] = {}     # continuous-batch joins
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -371,6 +372,13 @@ class CompletionModel:
         self._rng, sub = jax.random.split(self._rng)
         return int(sample_top_p(sub, jnp.asarray(logits),
                                 top_p=self.top_p, temp=self.temp))
+
+    def sample_batch(self, logits: np.ndarray) -> np.ndarray:
+        """(B, V) logits -> (B,) sampled ids in one dispatch."""
+        self._rng, sub = jax.random.split(self._rng)
+        return np.asarray(sample_top_p_batch(
+            sub, jnp.asarray(logits), top_p=self.top_p,
+            temp=self.temp)).astype(np.int32)
 
     # -- chunked decode (the tokens/sec path) -----------------------------
 
@@ -530,6 +538,77 @@ class CompletionModel:
         self._pos += n
         return np.asarray(out).T[: self._batch]    # (B, n)
 
+    def _join_program(self, b: int):
+        """One program prefilling a SINGLE row's prompt into the live
+        batch cache (continuous batching: a request joins mid-decode).
+        The row's prompt is left-padded so its last token lands at slot
+        pos-1 — the batch's next decode step then serves it like any
+        other row.  Returns (new_batch_cache, last_logits (V,))."""
+        fn = self._join_progs.get(b)
+        if fn is None:
+            module = self.module
+
+            def run(params, batch_cache, ids, row, pos, start_row):
+                # ids: (1, b) left-padded; writes cache slots
+                # [pos-b, pos) of row `row` only
+                row_cache = [
+                    (jax.lax.dynamic_slice_in_dim(k, row, 1, 0),
+                     jax.lax.dynamic_slice_in_dim(v, row, 1, 0))
+                    for k, v in batch_cache]
+                logits, row_cache = module.apply(
+                    params, ids, row_cache, pos - b,
+                    start_row.reshape(1))
+                new_cache = [
+                    (jax.lax.dynamic_update_slice_in_dim(bk, rk, row, 0),
+                     jax.lax.dynamic_update_slice_in_dim(bv, rv, row, 0))
+                    for (bk, bv), (rk, rv) in zip(batch_cache, row_cache)]
+                return new_cache, logits[0, b - 1]
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            self._join_progs[b] = fn
+        return fn
+
+    def join_row(self, prompt_ids: np.ndarray, row: int) -> np.ndarray:
+        """Prefill `prompt_ids` into row `row` of the live batched
+        cache, ending at the current decode position.  The prompt is
+        clipped to the most recent `pos` tokens when longer (a joiner
+        cannot reach behind the batch's shared position).  Updates
+        self._start for the row; returns the row's last-token logits
+        (V,) for sampling its first output token."""
+        if self._cache is None or getattr(self, "_start", None) is None:
+            raise RuntimeError("prefill_batch first")
+        P = len(prompt_ids)
+        if P == 0:
+            raise ValueError("empty prompt")
+        # the pad width must come from the FIXED bucket set (one join
+        # program per bucket, like every other program here) and fit
+        # below the current position; pos starts at a bucket, so at
+        # least the smallest bucket always fits
+        fit = [bb for bb in self.buckets if bb <= self._pos]
+        b = next((bb for bb in fit if bb >= P), fit[-1])
+        if P > b:
+            prompt_ids = prompt_ids[-b:]      # keep recent context
+            P = b
+        ids = np.zeros((1, b), np.int32)
+        ids[0, b - P:] = prompt_ids[-P:]
+        start_row = np.int32(self._pos - P)
+        self._cache, logits = self._join_program(b)(
+            self.params, self._cache, jnp.asarray(ids),
+            jnp.int32(row), jnp.int32(self._pos), jnp.asarray(start_row))
+        start = np.array(self._start)             # writable copy
+        start[row] = self._pos - P
+        self._start = jnp.asarray(start)
+        return np.asarray(logits)
+
+    def join_budget(self) -> int:
+        """Largest prompt length a joiner can bring into the live
+        batch without losing context: the widest bucket at or below
+        the current decode position."""
+        if self._cache is None:
+            return 0
+        return max((b for b in self.buckets if b <= self._pos),
+                   default=0)
+
     def generate_batch(self, prompts: list[np.ndarray], max_new: int,
                        *, chunk: int = 8):
         """Generator over token COLUMNS for a batch of prompts: first
@@ -539,10 +618,7 @@ class CompletionModel:
         consumer tracks per-row completion and discards (same contract
         as generate_tokens with eos_id=None)."""
         logits = self.prefill_batch(prompts)
-        self._rng, sub = jax.random.split(self._rng)
-        toks = np.asarray(sample_top_p_batch(
-            sub, jnp.asarray(logits), top_p=self.top_p,
-            temp=self.temp)).astype(np.int32)
+        toks = self.sample_batch(logits)
         yield toks.copy()
         produced = 1
         while produced < max_new:

@@ -166,6 +166,8 @@ def main() -> int:
             f"(gamma={gamma}, acceptance={accept:.2f})")
 
     # -- completion daemon e2e --------------------------------------------
+    import threading
+
     from libsplinter_tpu import Store
     from libsplinter_tpu.engine import protocol as P
     from libsplinter_tpu.engine.completer import Completer
@@ -189,6 +191,39 @@ def main() -> int:
         log(f"completer e2e request {i}: {e2e[-1]:.0f} ms")
     e2e_ms = float(np.median(e2e))
     log(f"completer e2e (32 new tokens): {e2e_ms:.0f} ms")
+
+    # -- continuous serving: 12 staggered requests through the slot
+    #    scheduler (engine/completer.py run_continuous)
+    comp2 = Completer(st, model=model, max_new_tokens=32,
+                      flush_tokens=CHUNK, template="none", batch_cap=8)
+    comp2.attach()
+    runner = threading.Thread(
+        target=comp2.run_continuous,
+        kwargs=dict(idle_timeout_ms=20, stop_after=600.0), daemon=True)
+    runner.start()
+    time.sleep(0.2)
+    t0 = time.perf_counter()
+    keys = []
+    for i in range(12):
+        key = f"c/{i}"
+        keys.append(key)
+        st.set(key, f"Question number {i} about accelerators?")
+        st.label_or(key, P.LBL_INFER_REQ)
+        st.bump(key)
+        if i % 4 == 3:
+            time.sleep(0.1)           # staggered arrival waves
+    deadline = time.perf_counter() + 420
+    while time.perf_counter() < deadline:
+        if all(st.labels(k) & P.LBL_READY for k in keys):
+            break
+        time.sleep(0.01)
+    cont_s = time.perf_counter() - t0
+    comp2.stop()
+    runner.join(timeout=5)
+    done = sum(1 for k in keys if st.labels(k) & P.LBL_READY)
+    cont_tps = comp2.stats.tokens / cont_s if done else 0.0
+    log(f"continuous serving: {done}/12 ready in {cont_s:.2f}s, "
+        f"{cont_tps:,.1f} aggregate tok/s (batch_cap=8)")
     st.close()
     Store.unlink(name)
 
@@ -212,6 +247,9 @@ def main() -> int:
             "speculative_acceptance": (round(accept, 3)
                                        if accept is not None else None),
             "completer_e2e_ms_32tok": round(e2e_ms, 0),
+            "continuous_12req_s": round(cont_s, 2),
+            "continuous_aggregate_tok_s": round(cont_tps, 1),
+            "continuous_ready": done,
         },
     }
     print(json.dumps(rec), flush=True)

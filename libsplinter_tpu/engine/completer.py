@@ -275,13 +275,15 @@ class Completer:
             pass
         return key, render_prompt(prompt, system, self.template)
 
-    def _prepare(self, idx: int):
+    def _prepare(self, idx: int, peek: tuple | None = None):
         """The per-key request head (splainference.cpp:190-269):
         _read_rendered plus the claim side effects — WAITING→SERVICING
-        flip, slot overwrite with the rendered prompt.  Returns
-        (key, rendered, t0) or None."""
+        flip, slot overwrite with the rendered prompt.  A caller that
+        already peeked passes its (key, rendered) to avoid re-reading.
+        Returns (key, rendered, t0) or None."""
         st = self.store
-        peek = self._read_rendered(idx)
+        if peek is None:
+            peek = self._read_rendered(idx)
         if peek is None:
             return None
         key, rendered = peek
@@ -509,6 +511,8 @@ class Completer:
 
         rows: list[dict | None] = [None] * B
         toks = np.zeros((B,), np.int32)
+        deferred: set[int] = set()    # oversized joiners, per window
+        rebid_due = 0                 # decoded steps since last rebid
 
         def admit(limit: int | None = None) -> int:
             """Fill free slots from waiting keys.  Starting a FRESH
@@ -524,7 +528,10 @@ class Completer:
             for idx in st.enumerate_indices(P.LBL_INFER_REQ):
                 if not free:
                     break
+                peek = ids = None
                 if limit is not None:
+                    if idx in deferred:
+                        continue      # known oversized: fresh batch only
                     # peek BEFORE claiming: an oversized joiner stays
                     # WAITING untouched (a claim would overwrite its
                     # slot with the rendered prompt, double-rendering
@@ -532,16 +539,18 @@ class Completer:
                     peek = self._read_rendered(idx)
                     if peek is None:
                         continue
-                    if len(self._clip_context(
-                            tok_izer.encode(peek[1]),
-                            bucketed=True)) > limit:
+                    ids = self._clip_context(tok_izer.encode(peek[1]),
+                                             bucketed=True)
+                    if len(ids) > limit:
+                        deferred.add(idx)
                         continue
-                prep = self._prepare(idx)
+                prep = self._prepare(idx, peek=peek)
                 if prep is None:
                     continue
                 key, rendered, t0 = prep
-                ids = self._clip_context(tok_izer.encode(rendered),
-                                         bucketed=True)
+                if ids is None:
+                    ids = self._clip_context(tok_izer.encode(rendered),
+                                             bucketed=True)
                 if not len(ids):
                     self._finalize(key, t0, 0, False)
                     continue
@@ -632,6 +641,14 @@ class Completer:
                 continue
 
             try:
+                # every slot free: reset FIRST — new arrivals get a
+                # fresh window, never a join into the drained one
+                if all(r is None for r in rows):
+                    m.reset()
+                    deferred.clear()
+                    batch_live = False
+                    continue
+
                 # live batch: joiners enter through the freed rows —
                 # but only prompts the current position can hold whole
                 if any(r is None for r in rows) \
@@ -648,7 +665,8 @@ class Completer:
                                 toks[r] = t
 
                 if all(r is None for r in rows):
-                    m.reset()         # fresh window for the next wave
+                    m.reset()         # the joins all finished at once
+                    deferred.clear()
                     batch_live = False
                     continue
 
@@ -665,7 +683,10 @@ class Completer:
                     continue
 
                 blk = m.decode_chunk_batch(toks, step)
-                self._rebid()
+                rebid_due += step
+                if self.rebid_tokens and rebid_due >= self.rebid_tokens:
+                    rebid_due = 0
+                    self._rebid()
                 for c in range(step):
                     for r in range(B):
                         if rows[r] is not None:

@@ -14,6 +14,7 @@ Environment:
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import shlex
@@ -469,6 +470,48 @@ def cmd_caps(ses, args):
               f"[{jax.default_backend()}]")
     except Exception:
         print("jax            unavailable")
+
+
+@command("health", "health", "daemon liveness + store vitals")
+def cmd_health(ses, args):
+    """Operator one-look: daemon heartbeat ages (__embedder_stats /
+    __completer_stats, engine/protocol.publish_heartbeat), live shard
+    bids, active signal groups, store occupancy.  The reference's
+    nearest analog is eyeballing the sidecar TUI + `head __debug`."""
+    st = ses.store
+    h = st.header()
+    print(f"store          {h.used_slots}/{st.nslots} slots, "
+          f"global epoch {h.global_epoch}")
+    # heartbeat keys are daemon-owned well-known names: NOT namespaced
+    # (the daemons write the literal protocol constants)
+    for label, key in (("embedder", P.KEY_EMBED_STATS),
+                       ("completer", P.KEY_COMPLETE_STATS)):
+        try:
+            snap = json.loads(st.get(key).rstrip(b"\0"))
+            age = time.time() - snap.pop("ts", 0)
+            spans = snap.pop("spans", None)
+            vitals = ", ".join(f"{k}={v}" for k, v in snap.items())
+            stale = "  [STALE]" if age > 30 else ""
+            print(f"{label:<14} {age:5.1f}s ago{stale}  {vitals}")
+            if spans:
+                for name, s in spans.items():
+                    print(f"    {name:<18} n={s['n']} "
+                          f"total={s['total_ms']}ms max={s['max_ms']}ms")
+        except KeyError:
+            print(f"{label:<14} no heartbeat (daemon not attached?)")
+        except (ValueError, AttributeError, TypeError):
+            print(f"{label:<14} unparseable heartbeat")
+    live_bids = [b for b in st.bid_table() if b.pid and b.live]
+    if live_bids:
+        for b in live_bids:
+            print(f"bid            shard {b.shard_id:#x} pid {b.pid} "
+                  f"prio {b.priority} intent {b.intent}")
+    else:
+        print("bid            none (or expired)")
+    active = [(g, st.signal_count(g)) for g in range(N.SIGNAL_GROUPS)]
+    active = [(g, c) for g, c in active if c]
+    print("signals        " + (", ".join(
+        f"g{g}={c}" for g, c in active[:12]) if active else "quiet"))
 
 
 @command("uuid", "uuid [KEY]", "generate a uuid (optionally store it)")

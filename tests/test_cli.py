@@ -104,6 +104,44 @@ def test_head_shows_vector_stats(cli, capsys):
     assert "epoch" in out and "|v|=" in out
 
 
+def test_health_reports_daemon_vitals(cli, capsys):
+    """`health` shows heartbeat ages, shard bids, and signal activity
+    for an operator's one-look liveness check."""
+    run, name = cli
+    st = Store.open(name)
+    emb = Embedder(st, encoder_fn=lambda ts: np.zeros(
+        (len(ts), 32), np.float32), max_ctx=64)
+    emb.attach()
+    st.set("k", "text")
+    st.set_type("k", 0x80)
+    st.label_or("k", P.LBL_EMBED_REQ)
+    emb.run_once()
+    emb.publish_stats()
+    st.close()
+    assert run("health") == 0
+    out = out_of(capsys)
+    assert "embedder" in out and "embedded=1" in out
+    assert "no heartbeat" in out          # completer not attached
+    assert "bid" in out and "0x5f10" in out
+    assert "signals" in out
+
+
+def test_health_ignores_ns_prefix(cli, capsys, monkeypatch):
+    """Heartbeat keys are daemon-owned well-known names; a client-side
+    namespace prefix must not make health report daemons down."""
+    run, name = cli
+    st = Store.open(name)
+    emb = Embedder(st, encoder_fn=lambda ts: np.zeros(
+        (len(ts), 32), np.float32), max_ctx=64)
+    emb.attach()
+    emb.publish_stats()
+    st.close()
+    monkeypatch.setenv("SPTPU_NS_PREFIX", "teamA.")
+    assert run("health") == 0
+    out = out_of(capsys)
+    assert "no heartbeat" not in out.split("completer")[0], out
+
+
 def test_config_dump_and_purge(cli, capsys):
     run, _ = cli
     run("config")

@@ -487,7 +487,15 @@ def cmd_health(ses, args):
     for label, key in (("embedder", P.KEY_EMBED_STATS),
                        ("completer", P.KEY_COMPLETE_STATS)):
         try:
-            snap = json.loads(st.get(key).rstrip(b"\0"))
+            raw = st.get(key)
+        except KeyError:
+            print(f"{label:<14} no heartbeat (daemon not attached?)")
+            continue
+        except OSError:               # sustained writer contention
+            print(f"{label:<14} heartbeat unreadable (contended)")
+            continue
+        try:
+            snap = json.loads(raw.rstrip(b"\0"))
             age = time.time() - snap.pop("ts", 0)
             spans = snap.pop("spans", None)
             vitals = ", ".join(f"{k}={v}" for k, v in snap.items())
@@ -497,9 +505,7 @@ def cmd_health(ses, args):
                 for name, s in spans.items():
                     print(f"    {name:<18} n={s['n']} "
                           f"total={s['total_ms']}ms max={s['max_ms']}ms")
-        except KeyError:
-            print(f"{label:<14} no heartbeat (daemon not attached?)")
-        except (ValueError, AttributeError, TypeError):
+        except (ValueError, AttributeError, TypeError, KeyError):
             print(f"{label:<14} unparseable heartbeat")
     live_bids = [b for b in st.bid_table() if b.pid and b.live]
     if live_bids:
@@ -510,8 +516,9 @@ def cmd_health(ses, args):
         print("bid            none (or expired)")
     active = [(g, st.signal_count(g)) for g in range(N.SIGNAL_GROUPS)]
     active = [(g, c) for g, c in active if c]
-    print("signals        " + (", ".join(
-        f"g{g}={c}" for g, c in active[:12]) if active else "quiet"))
+    shown = ", ".join(f"g{g}={c}" for g, c in active[:12])
+    more = f", +{len(active) - 12} more" if len(active) > 12 else ""
+    print("signals        " + (shown + more if active else "quiet"))
 
 
 @command("uuid", "uuid [KEY]", "generate a uuid (optionally store it)")

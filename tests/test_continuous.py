@@ -158,6 +158,46 @@ def test_continuous_defers_oversized_joiner(tmp_path):
         Store.unlink(name)
 
 
+def test_continuous_over_quantized_model(tmp_path):
+    """Feature lattice: the slot scheduler serves an int8-resident
+    model (join_row included) with the full protocol."""
+    name = f"/spt-contq-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=2048, vec_dim=8)
+    try:
+        model = CompletionModel(
+            DecoderConfig.tiny(max_len=128, quantized=True),
+            buckets=(16, 32), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=16,
+                         flush_tokens=4, template="none", batch_cap=2)
+        comp.attach()
+        runner = threading.Thread(
+            target=comp.run_continuous,
+            kwargs=dict(idle_timeout_ms=20, stop_after=90.0),
+            daemon=True)
+        runner.start()
+        time.sleep(0.2)
+        st.set("a", b"first question")
+        st.label_or("a", P.LBL_INFER_REQ)
+        st.bump("a")
+        time.sleep(0.8)
+        st.set("b", b"late arrival")    # joins the live batch
+        st.label_or("b", P.LBL_INFER_REQ)
+        st.bump("b")
+        deadline = time.time() + 75
+        while time.time() < deadline:
+            if all(st.labels(k) & P.LBL_READY for k in ("a", "b")):
+                break
+            time.sleep(0.05)
+        comp.stop()
+        runner.join(timeout=5)
+        for k in ("a", "b"):
+            assert st.labels(k) & P.LBL_READY, (k, comp.stats)
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
 def test_continuous_falls_back_for_serial_models(tmp_path):
     """Models without join_row (speculative) serve through run()."""
     from libsplinter_tpu.models import SpeculativeCompletionModel

@@ -45,9 +45,11 @@ timestamp + config); if the live window fails, the error JSON carries the
 most recent in-round measurement as detail.last_measured so one unlucky
 end-of-round claim never erases the round's evidence again.
 
-Env knobs: BENCH_TEXTS, BENCH_BATCH, BENCH_BUCKET, BENCH_TIMEOUT,
-BENCH_ATTEMPT_TIMEOUT, BENCH_CPU=1 (skip probe, run on host CPU —
-for in-round tracking where the chip is unavailable), BENCH_SKIP_PROBE=1.
+Env knobs: BENCH_TEXTS, BENCH_BATCH, BENCH_BUCKET, BENCH_BUCKETS,
+BENCH_TIMEOUT, BENCH_ATTEMPT_TIMEOUT, BENCH_CPU=1 (run on host CPU —
+for in-round tracking where the chip is unavailable),
+BENCH_SKIP_PROBE=0 (re-enable the pre-flight probe; probing is OFF by
+default — a timed-out probe is itself a killed tunnel client).
 
 Tunnel semantics (learned rounds 1-3, see .claude/skills/verify/SKILL.md):
 the claim server admits ONE client; concurrent clients wedge the claim and
@@ -420,10 +422,11 @@ def main() -> int:
             log("[bench] probe ok — tunnel claimable, starting child")
 
         attempt_budget = min(ATTEMPT_S, deadline - time.monotonic() - 5)
-        if attempt_budget < 240:
-            # a shorter child would be killed mid client-init/compile —
-            # a killed short-lived tunnel client is the wedge trigger;
-            # better to end the window than to poison the next one
+        # a TPU child too short to survive client-init + compile would
+        # be killed mid-claim — the wedge trigger; better to end the
+        # window than to poison the next one.  CPU mode has no tunnel
+        # to protect and honors short quick-tracking windows.
+        if attempt_budget < (30 if CPU_MODE else 240):
             break
         attempts += 1
         try:

@@ -1,0 +1,74 @@
+"""Daemons over the persistent (file-backed) store: the reference
+doubles every binary for its persistent variant (CMakeLists dual
+targets); here the backend is a runtime flag, so the serving lattice
+must hold over it — including daemon restart against the surviving
+file (the store IS the checkpoint, SURVEY.md §5)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store, T_VARTEXT
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.engine.embedder import Embedder
+from libsplinter_tpu.models.decoder import CompletionModel, DecoderConfig
+
+
+@pytest.fixture
+def pstore(tmp_path):
+    path = str(tmp_path / "persist.spt")
+    st = Store.create(path, nslots=64, max_val=1024, vec_dim=8,
+                      persistent=True)
+    yield path, st
+    try:
+        st.close()
+    except Exception:
+        pass
+    if os.path.exists(path):
+        os.unlink(path)
+
+
+def test_embedder_over_persistent_store(pstore):
+    path, st = pstore
+    emb = Embedder(st, encoder_fn=lambda ts: np.full(
+        (len(ts), 8), 2.0, np.float32), max_ctx=64)
+    emb.attach()
+    st.set("k", "persistent text")
+    st.set_type("k", T_VARTEXT)
+    st.label_or("k", P.LBL_EMBED_REQ)
+    assert emb.run_once() == 1
+    assert st.vec_get("k")[0] == 2.0
+
+    # the file survives close; a fresh open sees the committed vector
+    st.close()
+    st2 = Store.open(path, persistent=True)
+    try:
+        assert st2.vec_get("k")[0] == 2.0
+        assert not st2.labels("k") & P.LBL_EMBED_REQ
+    finally:
+        st2.close()
+
+
+def test_completer_restart_drains_surviving_requests(pstore):
+    """A WAITING key written before a crash survives in the file; the
+    restarted daemon's cold-start drain services it (the reference's
+    splainference cold-start, over OUR persistent backend)."""
+    path, st = pstore
+    st.set("q", "question before the crash")
+    st.label_or("q", P.LBL_INFER_REQ | P.LBL_WAITING)
+    st.close()                        # "crash": nothing serviced it
+
+    st2 = Store.open(path, persistent=True)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(), buckets=(32,),
+                                temp=0.0)
+        comp = Completer(st2, model=model, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=4)
+        comp.attach()
+        assert comp.run_once() == 1
+        assert st2.labels("q") & P.LBL_READY
+    finally:
+        st2.close()

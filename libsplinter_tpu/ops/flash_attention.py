@@ -98,20 +98,9 @@ def _mha_jnp(q, k, v, mask):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def flash_attention(q, k, v, mask, *, block_q: int = 256,
-                    interpret: bool = False,
-                    force_pallas: bool = False):
-    """Bidirectional masked attention without HBM-quadratic logits.
-
-    q/k/v: (B, S, H, D); mask: (B, S) bool key validity.
-    Returns (B, S, H, D) in q's dtype.  The Pallas kernel runs on TPU
-    (or under interpret/force_pallas for tests); other backends use the
-    identical jnp math.
-    """
-    use_pallas = (force_pallas or interpret
-                  or jax.default_backend() == "tpu")
-    if not use_pallas:
-        return _mha_jnp(q, k, v, mask)
+def _flash_fwd_only(q, k, v, mask, block_q: int, interpret: bool):
+    """The Pallas forward: pad S to a block multiple, transpose to
+    (B, H, S, D), run the kernel, undo."""
     B, S, H, D = q.shape
     bq = min(block_q, S)
     pad = (-S) % bq
@@ -129,3 +118,51 @@ def flash_attention(q, k, v, mask, *, block_q: int = 256,
                         interpret=interpret)
     out = out.transpose(0, 2, 1, 3)
     return out[:, :S] if pad else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_diff(q, k, v, mask, block_q, interpret):
+    """Differentiable wrapper: a raw pallas_call has no autodiff rule,
+    and the encoder's TRAINING path hits this kernel whenever a long
+    bucket trains (train.py over S >= flash_min_seq).  Forward runs
+    the kernel; backward recomputes through the reference jnp math.
+
+    HONEST LIMIT: that backward materializes the (B, H, S, S) logits,
+    so TRAINING long buckets is still quadratic-memory — the kernel's
+    HBM headroom applies to the forward/inference path only, and
+    training batch sizes must be sized for the naive backward.  A
+    blockwise backward kernel (the full flash-attention backward) is
+    the known fix and is future work."""
+    return _flash_fwd_only(q, k, v, mask, block_q, interpret)
+
+
+def _flash_diff_fwd(q, k, v, mask, block_q, interpret):
+    return _flash_fwd_only(q, k, v, mask, block_q, interpret), \
+        (q, k, v, mask)
+
+
+def _flash_diff_bwd(block_q, interpret, res, g):
+    q, k, v, mask = res
+    _, vjp = jax.vjp(lambda a, b, c: _mha_jnp(a, b, c, mask), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention(q, k, v, mask, *, block_q: int = 256,
+                    interpret: bool = False,
+                    force_pallas: bool = False):
+    """Bidirectional masked attention without HBM-quadratic logits.
+
+    q/k/v: (B, S, H, D); mask: (B, S) bool key validity.
+    Returns (B, S, H, D) in q's dtype.  The Pallas kernel runs on TPU
+    (or under interpret/force_pallas for tests); other backends use the
+    identical jnp math.  Differentiable either way (custom VJP
+    recomputes the backward through the jnp reference)."""
+    use_pallas = (force_pallas or interpret
+                  or jax.default_backend() == "tpu")
+    if not use_pallas:
+        return _mha_jnp(q, k, v, mask)
+    return _flash_diff(q, k, v, mask, block_q, interpret)

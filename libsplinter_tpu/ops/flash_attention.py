@@ -87,6 +87,84 @@ def _flash_pallas(q, k, v, maskf, *, block_q: int, interpret: bool):
     )(q, k, v, maskf)
 
 
+def _mha_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, mask_ref,
+                    dq_ref, dk_ref, dv_ref, *, scale: float,
+                    block_q: int):
+    """Blockwise backward for one (batch, head): recomputes each
+    (block_q, S) probability tile in VMEM (the standard flash-attention
+    backward identity), accumulating dK/dV across query blocks and
+    writing dQ per block — nothing quadratic ever reaches HBM.
+
+    refs are (1, 1, S, D) per (b, h) except mask (1, 1, S); outputs
+    mirror inputs.  Derivation: with P = softmax(QK^T*scale + maskbias),
+    D_i = rowsum(dO_i ∘ O_i):
+        dV = P^T dO
+        dS = P ∘ (dO V^T - D)
+        dQ = dS K * scale ;  dK = dS^T Q * scale
+    """
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    m = mask_ref[0]                                # (1, S)
+    S, D = k.shape
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry                     # f32: bf16 outputs
+        sl = pl.dslice(i * block_q, block_q)       # must not compound
+        q = q_ref[0, 0, sl]                        # per-block rounding
+        o = o_ref[0, 0, sl]
+        do = do_ref[0, 0, sl]
+        logits = jnp.dot(q, k.T,
+                         preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(m > 0.0, logits, NEG_INF)
+        logits = logits - jnp.max(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)       # (BQ, S) f32
+        dof = do.astype(jnp.float32)
+        of = o.astype(jnp.float32)
+        d_i = jnp.sum(dof * of, axis=-1, keepdims=True)  # (BQ, 1)
+        dp = jnp.dot(dof, v.astype(jnp.float32).T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - d_i) * scale                      # (BQ, S)
+        dq_ref[0, 0, sl] = jnp.dot(
+            ds, k.astype(jnp.float32),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_acc += jnp.dot(ds.T, q.astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+        dv_acc += jnp.dot(p.T, dof,
+                          preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    zero = jnp.zeros((S, D), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(0, S // block_q, body,
+                                       (zero, zero))
+    dk_ref[0, 0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def _flash_bwd_pallas(q, k, v, o, do, maskf, *, block_q: int,
+                      interpret: bool):
+    """q/k/v/o/do: (B, H, S, D); maskf: (B, 1, S).
+    Returns (dq, dk, dv) each (B, H, S, D)."""
+    B, H, S, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    grid = (B, H)
+    full = pl.BlockSpec((1, 1, S, D), lambda b, h: (b, h, 0, 0),
+                        memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((B, H, S, D), q.dtype)
+    return pl.pallas_call(
+        functools.partial(_mha_bwd_kernel, scale=scale,
+                          block_q=min(block_q, S)),
+        grid=grid,
+        in_specs=[full, full, full, full, full,
+                  pl.BlockSpec((1, 1, S), lambda b, h: (b, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[full, full, full],
+        out_shape=[shape, shape, shape],
+        interpret=interpret,
+    )(q, k, v, o, do, maskf)
+
+
 def _mha_jnp(q, k, v, mask):
     """Reference math, (B, S, H, D) layout — identical to the encoder's
     naive path (encoder.py SelfAttention) up to the finite mask value."""
@@ -98,22 +176,27 @@ def _mha_jnp(q, k, v, mask):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_fwd_only(q, k, v, mask, block_q: int, interpret: bool):
-    """The Pallas forward: pad S to a block multiple, transpose to
-    (B, H, S, D), run the kernel, undo."""
-    B, S, H, D = q.shape
-    bq = min(block_q, S)
+def _to_kernel_layout(tensors, mask, bq: int):
+    """Shared pad/transpose for forward AND backward (they must agree
+    or padded-case gradients silently diverge): (B, S, H, D) tensors →
+    (B, H, S', D) with S' a block multiple, mask → (B, 1, S') f32.
+    Returns (transposed list, maskf, pad)."""
+    S = tensors[0].shape[1]
     pad = (-S) % bq
     if pad:
         widths = ((0, 0), (0, pad), (0, 0), (0, 0))
-        q = jnp.pad(q, widths)
-        k = jnp.pad(k, widths)
-        v = jnp.pad(v, widths)
+        tensors = [jnp.pad(t, widths) for t in tensors]
         mask = jnp.pad(mask, ((0, 0), (0, pad)))
-    qt = q.transpose(0, 2, 1, 3)                   # (B, H, S', D)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    maskf = mask.astype(jnp.float32)[:, None, :]   # (B, 1, S')
+    return ([t.transpose(0, 2, 1, 3) for t in tensors],
+            mask.astype(jnp.float32)[:, None, :], pad)
+
+
+def _flash_fwd_only(q, k, v, mask, block_q: int, interpret: bool):
+    """The Pallas forward: pad S to a block multiple, transpose to
+    (B, H, S, D), run the kernel, undo."""
+    S = q.shape[1]
+    bq = min(block_q, S)
+    (qt, kt, vt), maskf, pad = _to_kernel_layout([q, k, v], mask, bq)
     out = _flash_pallas(qt, kt, vt, maskf, block_q=bq,
                         interpret=interpret)
     out = out.transpose(0, 2, 1, 3)
@@ -125,27 +208,31 @@ def _flash_diff(q, k, v, mask, block_q, interpret):
     """Differentiable wrapper: a raw pallas_call has no autodiff rule,
     and the encoder's TRAINING path hits this kernel whenever a long
     bucket trains (train.py over S >= flash_min_seq).  Forward runs
-    the kernel; backward recomputes through the reference jnp math.
-
-    HONEST LIMIT: that backward materializes the (B, H, S, S) logits,
-    so TRAINING long buckets is still quadratic-memory — the kernel's
-    HBM headroom applies to the forward/inference path only, and
-    training batch sizes must be sized for the naive backward.  A
-    blockwise backward kernel (the full flash-attention backward) is
-    the known fix and is future work."""
+    the forward kernel; backward runs the blockwise backward kernel
+    (_mha_bwd_kernel) — probability tiles are recomputed in VMEM per
+    query block, so the TRAINING path is as HBM-linear as inference."""
     return _flash_fwd_only(q, k, v, mask, block_q, interpret)
 
 
 def _flash_diff_fwd(q, k, v, mask, block_q, interpret):
-    return _flash_fwd_only(q, k, v, mask, block_q, interpret), \
-        (q, k, v, mask)
+    out = _flash_fwd_only(q, k, v, mask, block_q, interpret)
+    return out, (q, k, v, mask, out)
 
 
 def _flash_diff_bwd(block_q, interpret, res, g):
-    q, k, v, mask = res
-    _, vjp = jax.vjp(lambda a, b, c: _mha_jnp(a, b, c, mask), q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
+    q, k, v, mask, out = res
+    S = q.shape[1]
+    bq = min(block_q, S)
+    (qt, kt, vt, ot, gt), maskf, pad = _to_kernel_layout(
+        [q, k, v, out, g], mask, bq)
+    dq, dk, dv = _flash_bwd_pallas(qt, kt, vt, ot, gt, maskf,
+                                   block_q=bq, interpret=interpret)
+
+    def unpadded(x):
+        x = x.transpose(0, 2, 1, 3)
+        return x[:, :S] if pad else x
+
+    return unpadded(dq), unpadded(dk), unpadded(dv), None
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -159,8 +246,10 @@ def flash_attention(q, k, v, mask, *, block_q: int = 256,
     q/k/v: (B, S, H, D); mask: (B, S) bool key validity.
     Returns (B, S, H, D) in q's dtype.  The Pallas kernel runs on TPU
     (or under interpret/force_pallas for tests); other backends use the
-    identical jnp math.  Differentiable either way (custom VJP
-    recomputes the backward through the jnp reference)."""
+    identical jnp math.  Differentiable either way: the custom VJP
+    runs the BLOCKWISE backward kernel (probability tiles recomputed
+    in VMEM, dK/dV accumulated in f32), so training stays HBM-linear
+    like the forward."""
     use_pallas = (force_pallas or interpret
                   or jax.default_backend() == "tpu")
     if not use_pallas:

@@ -88,6 +88,30 @@ def test_flash_gradients_match_naive():
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("S,bq,lens", [
+    (48, 32, (48, 20, 1)),    # S not a block multiple: padded backward
+    (32, 16, (32, 0, 7)),     # one fully-masked row in the batch
+])
+def test_flash_gradients_padded_and_masked(S, bq, lens):
+    B, H, D = 3, 2, 8
+    q, k, v = (jnp.asarray(_rand((B, S, H, D), s)) for s in (4, 5, 6))
+    mask = jnp.asarray(np.arange(S)[None, :] <
+                       np.asarray(lens).reshape(B, 1))
+
+    def lf(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask, block_q=bq,
+                                       interpret=True) ** 2)
+
+    def ln(q, k, v):
+        return jnp.sum(_mha_jnp(q, k, v, mask) ** 2)
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(ln, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
 def test_encoder_flash_path_matches_naive(monkeypatch):
     """Encoder-level: the same params produce (near-)identical pooled
     embeddings whether attention runs naive or through the ACTUAL

@@ -925,10 +925,13 @@ def main(argv: list[str] | None = None) -> int:
     comp.attach()
     if args.warmup:
         t0 = time.monotonic()
-        model.warmup(chunk=comp.flush_tokens)
-        log.info("warmup compiled in %.1fs (batched shapes compile on "
-                 "first batch; .xla_cache persists them)",
-                 time.monotonic() - t0)
+        kw = {}
+        if args.batch_cap > 1 and hasattr(model, "prefill_batch") \
+                and comp._batched_budget() is not None:
+            kw["batch"] = args.batch_cap   # batched/continuous shapes
+        model.warmup(chunk=comp.flush_tokens, **kw)
+        log.info("warmup compiled in %.1fs (.xla_cache persists "
+                 "programs across restarts)", time.monotonic() - t0)
     if args.oneshot:
         n = comp.run_once()
         log.info("oneshot serviced %d completions", n)

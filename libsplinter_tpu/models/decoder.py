@@ -636,9 +636,11 @@ class CompletionModel:
     def pos(self) -> int:
         return self._pos
 
-    def warmup(self, chunk: int = 8) -> None:
+    def warmup(self, chunk: int = 8, batch: int = 1) -> None:
         """Pre-compile prefill buckets, decode-one, and the chunked
-        decode program."""
+        decode program; batch > 1 additionally compiles the batched
+        serving shapes (prefill_batch + batched chunk program) under
+        the same window guard."""
         for b in self.buckets:
             self.prefill(np.ones((max(1, b - 1),), np.int32))
             self.decode_one(1)
@@ -650,6 +652,13 @@ class CompletionModel:
         if self._pos + chunk <= self.cfg.max_len:
             self.decode_chunk(1, chunk)
         self.reset()
+        if batch > 1:
+            n = max(1, self.buckets[0] - 1)
+            self.prefill_batch([np.ones((n,), np.int32)] * batch)
+            if self._pos + chunk <= self.cfg.max_len:
+                self.decode_chunk_batch(np.ones((batch,), np.int32),
+                                        chunk)
+            self.reset()
 
 
 # ------------------------------------------------------ checkpoint loading

@@ -14,6 +14,13 @@ the long-bucket path gets a Pallas kernel:
   (all-masked rows — fully padded batch rows — degrade to a uniform
   distribution instead of NaN, matching the naive path's -1e9 bias).
 
+  Fully-masked rows are DON'T-CARE values: the encoder's pooling
+  multiplies by the mask, so their outputs never reach the loss and
+  their cotangents are zero in training.  When S is padded to a block
+  multiple their uniform fallback spreads over S' instead of S — a
+  difference visible only to a consumer that reads excluded rows
+  directly (tests pin the contract with encoder-semantics cotangents).
+
 K/V VMEM budget: S * D * 4 B * 2 = 1 MB at S=2048, D=64 — comfortably
 inside VMEM, so no online-softmax streaming is needed at the window
 sizes this encoder serves (the ring-attention path, parallel/

@@ -91,19 +91,25 @@ def test_flash_gradients_match_naive():
 @pytest.mark.parametrize("S,bq,lens", [
     (48, 32, (48, 20, 1)),    # S not a block multiple: padded backward
     (32, 16, (32, 0, 7)),     # one fully-masked row in the batch
+    (48, 32, (48, 20, 0)),    # BOTH: padding + a fully-masked row
 ])
 def test_flash_gradients_padded_and_masked(S, bq, lens):
+    """Gradient parity under the module's contract: fully-masked rows
+    are pooling-excluded don't-cares, so the loss (like the encoder's
+    pool_normalize) multiplies outputs by row validity — their
+    cotangents are zero and the padded-uniform fallback can't leak."""
     B, H, D = 3, 2, 8
     q, k, v = (jnp.asarray(_rand((B, S, H, D), s)) for s in (4, 5, 6))
     mask = jnp.asarray(np.arange(S)[None, :] <
                        np.asarray(lens).reshape(B, 1))
+    roww = mask.any(axis=1).astype(jnp.float32)[:, None, None, None]
 
     def lf(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, mask, block_q=bq,
-                                       interpret=True) ** 2)
+        return jnp.sum((flash_attention(q, k, v, mask, block_q=bq,
+                                        interpret=True) * roww) ** 2)
 
     def ln(q, k, v):
-        return jnp.sum(_mha_jnp(q, k, v, mask) ** 2)
+        return jnp.sum((_mha_jnp(q, k, v, mask) * roww) ** 2)
 
     gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
     gn = jax.grad(ln, argnums=(0, 1, 2))(q, k, v)

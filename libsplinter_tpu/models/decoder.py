@@ -51,6 +51,10 @@ class DecoderConfig:
     # — half bf16's weight bandwidth on the decode path.  Embeddings,
     # norms, and the LM head stay float.
     quantized: bool = False
+    # prefill chunks at/above this width attend through the causal
+    # Pallas kernel (ops/flash_attention.causal_flash_attention): long
+    # prompts stop materializing (B, H, S, T) logits in HBM.  0 = off.
+    flash_min_seq: int = 512
 
     @classmethod
     def tiny(cls, **kw) -> "DecoderConfig":
@@ -131,26 +135,25 @@ class CausalAttention(nn.Module):
         ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
 
-        # GQA: repeat kv heads up to query heads
-        rep = cfg.heads // cfg.kv_heads
-        kk = jnp.repeat(ck, rep, axis=2) if rep > 1 else ck
-        vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
-
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(D)
-        # key slot j visible to the query at slot pos+i iff j <= pos+i
-        # (and, batched, iff j is at/after the row's first real slot)
-        jpos = jnp.arange(cfg.max_len)[None, :]
-        visible = jpos <= idx[:, None]             # (S, T)
-        if start is None:
-            mask = visible[None, None]             # (1, 1, S, T)
+        if cfg.flash_min_seq and S >= cfg.flash_min_seq:
+            # long-prompt prefill: blockwise causal kernel — the
+            # (B, H, S, T) logits never reach HBM, and the kv heads go
+            # in UNREPEATED (the kernel maps query head -> kv head)
+            # (serving-only path; the decoder trains nowhere here)
+            from ..ops.flash_attention import causal_flash_attention
+            out = causal_flash_attention(q, ck, cv, pos, start)
         else:
-            mask = (visible[None, :, :] &
-                    (jnp.arange(cfg.max_len)[None, None, :]
-                     >= start[:, None, None]))[:, None]   # (B, 1, S, T)
-        logits = jnp.where(mask, logits.astype(jnp.float32), -1e9)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(
-            B, S, cfg.heads * D)
+            # short chunks: the shared reference math (one mask
+            # implementation across naive / fallback / kernel —
+            # ops/flash_attention pins kernel == _causal_jnp)
+            from ..ops.flash_attention import _causal_jnp
+            rep = cfg.heads // cfg.kv_heads
+            kk = jnp.repeat(ck, rep, axis=2) if rep > 1 else ck
+            vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
+            st0 = start if start is not None \
+                else jnp.zeros((B,), jnp.int32)
+            out = _causal_jnp(q, kk, vv, pos, st0)
+        out = out.reshape(B, S, cfg.heads * D)
         out = _proj(cfg, cfg.hidden, "out")(out)
         return out, (ck, cv)
 

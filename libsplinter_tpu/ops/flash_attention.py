@@ -172,6 +172,133 @@ def _flash_bwd_pallas(q, k, v, o, do, maskf, *, block_q: int,
     )(q, k, v, o, do, maskf)
 
 
+def _causal_kernel(q_ref, k_ref, v_ref, pos_ref, start_ref, out_ref, *,
+                   scale: float):
+    """One (batch, head, q-block) program of DECODER PREFILL attention:
+    queries at cache slots pos..pos+S-1 attend keys j with
+    start[b] <= j <= pos + i (the decoder's causal + left-pad mask,
+    models/decoder.py CausalAttention).  Full cache K/V for the
+    (batch, head) resident in VMEM; the (block_q, T) logits tile never
+    reaches HBM.
+
+    q_ref: (1, 1, BQ, D); k/v_ref: (1, 1, T, D); pos_ref: (1,) SMEM;
+    start_ref: (1,) SMEM (this batch row's left-pad offset);
+    out_ref: (1, 1, BQ, D).
+    """
+    i = pl.program_id(2)
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    BQ = q.shape[0]
+    T = k.shape[0]
+    pos = pos_ref[0]
+    start = start_ref[0]
+    logits = jnp.dot(q, k.T,
+                     preferred_element_type=jnp.float32) * scale
+    qi = pos + i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, T), 0)
+    kj = jax.lax.broadcasted_iota(jnp.int32, (BQ, T), 1)
+    visible = (kj <= qi) & (kj >= start)
+    logits = jnp.where(visible, logits, NEG_INF)
+    logits = logits - jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out_ref[0, 0] = jnp.dot(p.astype(v.dtype), v,
+                            preferred_element_type=jnp.float32
+                            ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def _causal_flash_pallas(q, k, v, pos, start, *, block_q: int,
+                         interpret: bool):
+    """q: (B, H, S, D); k/v: (B, KH, T, D) — KH may be smaller than H
+    (GQA): the index map routes query head h to kv head h // rep, so
+    the repeated K/V never materializes in HBM.  pos: (1,) i32;
+    start: (B,) i32.  Returns (B, H, S, D)."""
+    B, H, S, D = q.shape
+    KH, T = k.shape[1], k.shape[2]
+    rep = H // KH
+    scale = 1.0 / np.sqrt(D)
+    grid = (B, H, S // block_q)
+    kv_spec = pl.BlockSpec((1, 1, T, D),
+                           lambda b, h, i: (b, h // rep, 0, 0),
+                           memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_causal_kernel, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1,), lambda b, h, i: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda b, h, i: (b,),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, pos, start)
+
+
+def causal_flash_attention(q, kk, vv, pos, start=None, *,
+                           block_q: int = 256, interpret: bool = False,
+                           force_pallas: bool = False):
+    """Decoder-prefill attention without HBM-quadratic logits
+    (FORWARD/serving only — the decoder trains nowhere in this
+    framework, so no VJP is defined; jax.grad through this raises).
+
+    q: (B, S, H, D) queries at cache slots pos..pos+S-1;
+    kk/vv: (B, T, KH, D) the updated cache — pass kv heads UNREPEATED
+    (GQA): the kernel maps query head h to kv head h // (H//KH), so
+    the repeated cache never hits HBM;
+    pos: scalar int32; start: None or (B,) left-pad offsets.
+    Returns (B, S, H, D).
+    """
+    B, S, H, D = q.shape
+    if start is None:
+        start = jnp.zeros((B,), jnp.int32)
+    use_pallas = (force_pallas or interpret
+                  or jax.default_backend() == "tpu")
+    if not use_pallas:
+        rep = H // kk.shape[2]
+        if rep > 1:                   # the einsum fallback needs H heads
+            kk = jnp.repeat(kk, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        return _causal_jnp(q, kk, vv, pos, start)
+    bq = min(block_q, S)
+    pad = (-S) % bq
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    qt = q.transpose(0, 2, 1, 3)
+    kt = kk.transpose(0, 2, 1, 3)
+    vt = vv.transpose(0, 2, 1, 3)
+    out = _causal_flash_pallas(
+        qt, kt, vt, jnp.asarray(pos, jnp.int32).reshape(1),
+        jnp.asarray(start, jnp.int32), block_q=bq,
+        interpret=interpret)
+    out = out.transpose(0, 2, 1, 3)
+    return out[:, :S] if pad else out
+
+
+def _causal_jnp(q, kk, vv, pos, start):
+    """Reference math — mirrors models/decoder.py CausalAttention's
+    masked softmax exactly (slot-causal + per-row start)."""
+    D = q.shape[-1]
+    S = q.shape[1]
+    T = kk.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(D)
+    idx = pos + jnp.arange(S)
+    visible = (jnp.arange(T)[None, :] <= idx[:, None])[None, :, :] \
+        & (jnp.arange(T)[None, None, :] >= start[:, None, None])
+    logits = jnp.where(visible[:, None], logits.astype(jnp.float32),
+                       NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+
+
 def _mha_jnp(q, k, v, mask):
     """Reference math, (B, S, H, D) layout — identical to the encoder's
     naive path (encoder.py SelfAttention) up to the finite mask value."""

@@ -24,7 +24,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SHAPES = os.environ.get("PROFILE_SHAPES",
-                        "512x16,512x32,512x64,1x16,1x64")
+                        "512x16,512x32,512x64,8x1024,1x16,1x64")
+# 8x1024 exercises the flash-attention bucket (>= flash_min_seq=512)
 REPS = int(os.environ.get("PROFILE_REPS", "10"))
 
 

@@ -85,12 +85,21 @@ class ShardedCompletionModel(CompletionModel):
     """
 
     def __init__(self, cfg, mesh: Mesh | None = None, **kw):
+        import dataclasses
+
         self.mesh = mesh or make_mesh()
         tp = self.mesh.shape["tp"]
         if cfg.heads % tp or cfg.kv_heads % tp:
             raise ValueError(
                 f"heads={cfg.heads}/kv_heads={cfg.kv_heads} must divide "
                 f"the tp={tp} mesh axis")
+        if cfg.flash_min_seq:
+            # GSPMD cannot partition a Mosaic (Pallas) custom call, so
+            # the flash prefill kernel would break (or force full
+            # replication of) the tp-sharded program — sharded serving
+            # prefills through the naive path; a shard_map'd kernel is
+            # future work
+            cfg = dataclasses.replace(cfg, flash_min_seq=0)
         super().__init__(cfg, **kw)
         self.params = shard_decoder_params(self.params, self.mesh)
 

@@ -62,8 +62,10 @@ def main() -> int:
 
     rng = np.random.default_rng(0)
     lane = rng.normal(size=(n, D)).astype(np.float32)
-    # enough rows for the fixed 32-query batch regardless of REPS
-    queries = rng.normal(size=(max(REPS, 32), D)).astype(np.float32)
+    QB = 32                           # batched-query point size
+    use_pallas = backend == "tpu"
+    # enough rows for the QB-query batch regardless of REPS
+    queries = rng.normal(size=(max(REPS, QB), D)).astype(np.float32)
     lane_dev = jax.device_put(lane)
     # session steady state: the lane is staged once (StagedLane), so its
     # row norms are lane-static data computed at stage time
@@ -71,7 +73,6 @@ def main() -> int:
                                .astype(np.float32))
 
     def bench_kernel(mxu_bf16: bool) -> float:
-        use_pallas = backend == "tpu"
         cosine_topk(lane_dev, queries[0], K, use_pallas=use_pallas,
                     mxu_bf16=mxu_bf16, vnorm=vnorm_dev)  # compile+warm
         t0 = time.perf_counter()
@@ -89,8 +90,6 @@ def main() -> int:
     # batched queries: one kernel pass scoring QB queries amortizes
     # the lane read (the dominant cost at 1M rows)
     from libsplinter_tpu.ops.similarity import cosine_topk_batch
-    QB = 32
-    use_pallas = backend == "tpu"
     cosine_topk_batch(lane_dev, queries[:QB], K, use_pallas=use_pallas,
                       vnorm=vnorm_dev)            # compile+warm
     t0 = time.perf_counter()

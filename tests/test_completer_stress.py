@@ -1,0 +1,87 @@
+"""Completion daemon under concurrent writer churn: clients post,
+overwrite, and delete prompts while the continuous scheduler serves.
+The invariant is liveness — no key may end wedged in SERVICING, and
+the daemon must survive every race (the engine-level analog of the
+chi-sao harness, run against the LIVE serving loop)."""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.models.decoder import CompletionModel, DecoderConfig
+
+N_CLIENTS = 6
+REQS_PER_CLIENT = 5
+
+
+@pytest.mark.slow
+def test_continuous_daemon_survives_writer_churn(tmp_path):
+    name = f"/spt-cstress-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=256, max_val=2048, vec_dim=8)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=128),
+                                buckets=(16, 32), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=12,
+                         flush_tokens=4, template="none", batch_cap=4)
+        comp.attach()
+        runner = threading.Thread(
+            target=comp.run_continuous,
+            kwargs=dict(idle_timeout_ms=20, stop_after=240.0),
+            daemon=True)
+        runner.start()
+        time.sleep(0.2)
+
+        def client(c: int):
+            rng = np.random.default_rng(c)
+            for r in range(REQS_PER_CLIENT):
+                k = f"c{c}/r{r}"
+                st.set(k, f"client {c} request {r}")
+                st.label_or(k, P.LBL_INFER_REQ)
+                st.bump(k)
+                if rng.uniform() < 0.3:
+                    # churn: overwrite the prompt right after posting
+                    # (the daemon may catch either version; the label
+                    # protocol must resolve it without wedging)
+                    st.set(k, f"client {c} request {r} v2")
+                    st.label_or(k, P.LBL_INFER_REQ)
+                    st.bump(k)
+                time.sleep(float(rng.uniform(0.005, 0.05)))
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True)
+                   for c in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "client wedged"
+
+        keys = [f"c{c}/r{r}" for c in range(N_CLIENTS)
+                for r in range(REQS_PER_CLIENT)]
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if all(st.labels(k) & P.LBL_READY
+                   and not st.labels(k) & P.LBL_SERVICING
+                   for k in keys):
+                break
+            time.sleep(0.1)
+        assert runner.is_alive(), "daemon crashed under churn"
+        comp.stop()
+        runner.join(timeout=10)
+
+        wedged = [k for k in keys
+                  if st.labels(k) & P.LBL_SERVICING
+                  or not st.labels(k) & P.LBL_READY]
+        assert not wedged, (wedged[:6], comp.stats)
+        assert comp.stats.completions >= len(keys)
+        print(f"stats: {comp.stats}")
+    finally:
+        st.close()
+        Store.unlink(name)

@@ -128,6 +128,11 @@ const SYMBOLS: Record<string, { args: string[]; ret: string }> = {
   spt_bump: { args: ["p", "b"], ret: "i32" },
   spt_vec_set: { args: ["p", "b", "b", "u32"], ret: "i32" },
   spt_vec_get: { args: ["p", "b", "b", "u32"], ret: "i32" },
+  spt_signal_wait: { args: ["p", "u32", "u64", "i32", "b"], ret: "i32" },
+  spt_epochs: { args: ["p", "b"], ret: "i32" },
+  spt_vec_gather: { args: ["p", "b", "u32", "b", "b"], ret: "i32" },
+  spt_vec_commit_batch: {
+    args: ["p", "b", "b", "b", "u32", "u32", "i32", "b"], ret: "i32" },
   spt_bus_init: { args: ["p"], ret: "i32" },
   spt_bus_open: { args: ["p"], ret: "i32" },
   spt_bus_wait: { args: ["p", "i32"], ret: "i32" },
@@ -294,8 +299,19 @@ export class Store implements SptStore {
     return Number(this.rt.symbols.spt_poll(this.h, cstr(key), timeoutMs));
   }
 
+  /** Slot index for a key (negative errno when absent) — the handle
+   *  the bulk lane APIs (vecGather / vecCommitBatch) address rows by. */
+  findIndex(key: string): number {
+    return Number(this.rt.symbols.spt_find_index(this.h, cstr(key)));
+  }
+
+  /** The store's vector dimensionality. */
+  vecDim(): number {
+    return this.dim;
+  }
+
   getEpoch(key: string): bigint {
-    const idx = Number(this.rt.symbols.spt_find_index(this.h, cstr(key)));
+    const idx = this.findIndex(key);
     if (idx < 0) return -1n;
     return BigInt(this.rt.symbols.spt_epoch_at(this.h, idx) as bigint);
   }
@@ -440,6 +456,61 @@ export class Store implements SptStore {
         this.dim,
       ),
     );
+  }
+
+  /** Block until the group's signal count changes from `last`
+   *  (event-bus wake when armed, 1 ms poll otherwise).  Returns the
+   *  new count, or null on timeout. */
+  signalWait(group: number, last: bigint,
+             timeoutMs: number): bigint | null {
+    const out = new BigUint64Array(1);
+    const rc = Number(
+      this.rt.symbols.spt_signal_wait(
+        this.h, group, last, timeoutMs, new Uint8Array(out.buffer)),
+    );
+    return rc === 0 ? out[0] : null;
+  }
+
+  /** Bulk epoch snapshot (one acquire load per slot); diff two
+   *  snapshots for the changed-row set. */
+  epochs(): BigUint64Array {
+    const out = new BigUint64Array(this.nslots());
+    this.rt.symbols.spt_epochs(this.h, new Uint8Array(out.buffer));
+    return out;
+  }
+
+  /** Torn-safe bulk gather of vector rows.  epochs[i] is the stable
+   *  epoch, or SPT_GATHER_TORN (2^64-1) when the row was mid-write
+   *  (retry next pass).  Returns {vecs, epochs, stable}. */
+  vecGather(rows: Uint32Array): {
+    vecs: Float32Array; epochs: BigUint64Array; stable: number;
+  } {
+    const vecs = new Float32Array(rows.length * this.dim);
+    const eps = new BigUint64Array(rows.length);
+    const stable = Number(
+      this.rt.symbols.spt_vec_gather(
+        this.h, new Uint8Array(rows.buffer), rows.length,
+        new Uint8Array(vecs.buffer), new Uint8Array(eps.buffer)),
+    );
+    return { vecs, epochs: eps, stable };
+  }
+
+  /** Epoch-gated batch vector commit (the TPU micro-batcher's path):
+   *  per-row results 0 committed / -ESTALE raced / -EEXIST write-once
+   *  skip.  Returns {committed, results}. */
+  vecCommitBatch(rows: Uint32Array, epochs: BigUint64Array,
+                 vecs: Float32Array, writeOnce = false): {
+    committed: number; results: Int32Array;
+  } {
+    const results = new Int32Array(rows.length);
+    const committed = Number(
+      this.rt.symbols.spt_vec_commit_batch(
+        this.h, new Uint8Array(rows.buffer),
+        new Uint8Array(epochs.buffer), new Uint8Array(vecs.buffer),
+        rows.length, this.dim, writeOnce ? 1 : 0,
+        new Uint8Array(results.buffer)),
+    );
+    return { committed, results };
   }
 
   busInit(): number {

@@ -91,6 +91,19 @@ export async function runAll(): Promise<void> {
     st.append("log", "bc");
     assertEq(st.getString("log"), "abc", "append");
 
+    // bulk lane APIs (the TPU micro-batcher's path over FFI)
+    const idx = st.findIndex("doc");
+    const rows = new Uint32Array([idx >>> 0]);
+    const g0 = st.vecGather(rows);
+    assertEq(g0.stable, 1, "gather stable");
+    const vec = new Float32Array(st.vecDim()).fill(0.5);
+    const cb = st.vecCommitBatch(rows, g0.epochs, vec);
+    assertEq(cb.committed, 1, "batch commit");
+    const g1 = st.vecGather(rows);
+    assertEq(g1.vecs[0], 0.5, "committed value readable");
+    const snap = st.epochs();
+    assertEq(snap.length, st.nslots(), "epoch snapshot length");
+
     // async watcher observes a pulse
     const w = new SptWatcher(st, 7, 5);
     const seen: bigint[] = [];

@@ -66,6 +66,18 @@ export interface SptStore {
   getSignalCount(group: number): bigint;
   pulse(group: number): number;
   bump(key: string): number;
+  signalWait(group: number, last: bigint,
+             timeoutMs: number): bigint | null;
+  // bulk lane (the TPU micro-batcher surface)
+  findIndex(key: string): number;
+  epochs(): BigUint64Array;
+  vecGather(rows: Uint32Array): {
+    vecs: Float32Array; epochs: BigUint64Array; stable: number;
+  };
+  vecCommitBatch(rows: Uint32Array, epochs: BigUint64Array,
+                 vecs: Float32Array, writeOnce?: boolean): {
+    committed: number; results: Int32Array;
+  };
   watchRegister(key: string, group: number): number;
   watchUnregister(key: string, group: number): number;
   watchLabelRegister(bloomBit: number, group: number): number;
@@ -152,6 +164,14 @@ const dec = new TextDecoder();
 
 function cstr(s: string): Uint8Array {
   return enc.encode(s + "\0");
+}
+
+/** Byte view that RESPECTS a typed array's offset/length — passing
+ *  `new Uint8Array(x.buffer)` would address the backing buffer's
+ *  start, silently reading/writing the wrong memory for subarrays. */
+function view(x: { buffer: ArrayBufferLike; byteOffset: number;
+                   byteLength: number }): Uint8Array {
+  return new Uint8Array(x.buffer, x.byteOffset, x.byteLength);
 }
 
 function toBytes(v: string | Uint8Array): Uint8Array {
@@ -305,11 +325,6 @@ export class Store implements SptStore {
     return Number(this.rt.symbols.spt_find_index(this.h, cstr(key)));
   }
 
-  /** The store's vector dimensionality. */
-  vecDim(): number {
-    return this.dim;
-  }
-
   getEpoch(key: string): bigint {
     const idx = this.findIndex(key);
     if (idx < 0) return -1n;
@@ -460,22 +475,25 @@ export class Store implements SptStore {
 
   /** Block until the group's signal count changes from `last`
    *  (event-bus wake when armed, 1 ms poll otherwise).  Returns the
-   *  new count, or null on timeout. */
+   *  new count, null on TIMEOUT; hard errors (bad group) throw rather
+   *  than masquerade as timeouts. */
   signalWait(group: number, last: bigint,
              timeoutMs: number): bigint | null {
     const out = new BigUint64Array(1);
     const rc = Number(
       this.rt.symbols.spt_signal_wait(
-        this.h, group, last, timeoutMs, new Uint8Array(out.buffer)),
+        this.h, group, last, timeoutMs, view(out)),
     );
-    return rc === 0 ? out[0] : null;
+    if (rc === 0) return out[0];
+    if (rc === -110) return null;     // -ETIMEDOUT
+    throw new Error(`spt_signal_wait failed: errno ${-rc}`);
   }
 
   /** Bulk epoch snapshot (one acquire load per slot); diff two
    *  snapshots for the changed-row set. */
   epochs(): BigUint64Array {
     const out = new BigUint64Array(this.nslots());
-    this.rt.symbols.spt_epochs(this.h, new Uint8Array(out.buffer));
+    this.rt.symbols.spt_epochs(this.h, view(out));
     return out;
   }
 
@@ -489,26 +507,29 @@ export class Store implements SptStore {
     const eps = new BigUint64Array(rows.length);
     const stable = Number(
       this.rt.symbols.spt_vec_gather(
-        this.h, new Uint8Array(rows.buffer), rows.length,
-        new Uint8Array(vecs.buffer), new Uint8Array(eps.buffer)),
+        this.h, view(rows), rows.length, view(vecs), view(eps)),
     );
     return { vecs, epochs: eps, stable };
   }
 
   /** Epoch-gated batch vector commit (the TPU micro-batcher's path):
    *  per-row results 0 committed / -ESTALE raced / -EEXIST write-once
-   *  skip.  Returns {committed, results}. */
+   *  skip.  Returns {committed, results}; committed is -EINVAL (-22)
+   *  on mismatched array lengths (the native side would otherwise
+   *  read past the JS buffers). */
   vecCommitBatch(rows: Uint32Array, epochs: BigUint64Array,
                  vecs: Float32Array, writeOnce = false): {
     committed: number; results: Int32Array;
   } {
     const results = new Int32Array(rows.length);
+    if (epochs.length !== rows.length ||
+        vecs.length !== rows.length * this.dim) {
+      return { committed: -22, results };
+    }
     const committed = Number(
       this.rt.symbols.spt_vec_commit_batch(
-        this.h, new Uint8Array(rows.buffer),
-        new Uint8Array(epochs.buffer), new Uint8Array(vecs.buffer),
-        rows.length, this.dim, writeOnce ? 1 : 0,
-        new Uint8Array(results.buffer)),
+        this.h, view(rows), view(epochs), view(vecs),
+        rows.length, this.dim, writeOnce ? 1 : 0, view(results)),
     );
     return { committed, results };
   }

@@ -96,8 +96,8 @@ export async function runAll(): Promise<void> {
     const rows = new Uint32Array([idx >>> 0]);
     const g0 = st.vecGather(rows);
     assertEq(g0.stable, 1, "gather stable");
-    const vec = new Float32Array(st.vecDim()).fill(0.5);
-    const cb = st.vecCommitBatch(rows, g0.epochs, vec);
+    const bvec = new Float32Array(st.vecDim()).fill(0.5);
+    const cb = st.vecCommitBatch(rows, g0.epochs, bvec);
     assertEq(cb.committed, 1, "batch commit");
     const g1 = st.vecGather(rows);
     assertEq(g1.vecs[0], 0.5, "committed value readable");

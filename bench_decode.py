@@ -1,267 +1,36 @@
 """Decode-path benchmark: completion tokens/sec + daemon e2e latency.
 
-Measures the three numbers the completion story is judged on
-(VERDICT r2 #4; the reference's streaming cadence is
-splainference.cpp:333-354 — a serial per-token llama.cpp decode with an
-8-token flush):
+Thin standalone wrapper over bench_series' decode phases (the single
+implementation every tunnel client runs, VERDICT r3 #1):
 
-  - prefill latency for a bucketed prompt (one compiled program);
-  - steady-state decode tokens/sec through CompletionModel's
-    chunk-at-a-time on-device lax.scan loop (the KV cache never
-    round-trips to the host; the host syncs once per chunk);
-  - completion-daemon end-to-end latency: prompt set in the native
-    store -> label wake -> Completer drains -> first flush appended.
+  decode         prefill latency, chunked / per-token-sync / wide-chunk
+                 / batched / speculative tokens per second (the
+                 reference's cadence is a serial per-token llama.cpp
+                 decode with an 8-token flush, splainference.cpp:333-354;
+                 vs_baseline = chunked / per-token-sync on the SAME
+                 hardware and weights)
+  decode_daemon  completion-daemon e2e + continuous serving
 
-Prints ONE JSON line:
-  {"metric": "decode_tokens_per_sec", "value": N, "unit": "tokens/s",
-   "vs_baseline": N}
+Prints ONE JSON line {"metric": "decode_tokens_per_sec", ...}; every
+phase record appends to bench_results.jsonl.
 
-The reference publishes no tokens/sec number (BASELINE.md), so
-vs_baseline compares against its architectural cadence instead: the
-serial loop syncs host<->device per token, ours per chunk; we report
-value / (value measured with chunk=1) — i.e. the speedup the chunked
-design buys over the reference's per-token sync pattern ON THE SAME
-hardware and weights.  >1.0 means the TPU-first design wins.
-
-Env knobs: BENCH_CPU=1 (force host CPU), DECODE_TOKENS (default 256),
-DECODE_CHUNK (default 8), DECODE_GEOMETRY=tiny|flagship (default
-flagship; tiny for quick CI-style runs).
-
-Run it on the real chip opportunistically (the tunnel is single-client;
-see bench.py's docstring): `python bench_decode.py`.  Results append to
-bench_results.jsonl with timestamps for docs/performance.md.
+Run strictly alone: the tunneled TPU admits one client.  Env:
+BENCH_CPU=1, DECODE_TOKENS (256), DECODE_CHUNK (8),
+DECODE_GEOMETRY=tiny|flagship, DECODE_QUANT=1 (int8 weight residency),
+DECODE_DAEMON=0 (skip the daemon phase).
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-N_TOKENS = int(os.environ.get("DECODE_TOKENS", "256"))
-CHUNK = int(os.environ.get("DECODE_CHUNK", "8"))
-GEOMETRY = os.environ.get("DECODE_GEOMETRY", "flagship")
-CPU_MODE = os.environ.get("BENCH_CPU") == "1"
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def main() -> int:
-    import faulthandler
-
-    import numpy as np
-
-    # a phase that hangs (tunnel stall, surprise compile) must leave a
-    # stack in the log before the watcher's timeout SIGKILLs us
-    faulthandler.dump_traceback_later(300, repeat=True, file=sys.stderr)
-
-    if CPU_MODE:
-        from libsplinter_tpu.utils.jaxplatform import force_cpu
-        force_cpu()
-    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
-    enable_compile_cache()
-    import jax
-
-    from libsplinter_tpu.models import CompletionModel, DecoderConfig
-
-    backend = jax.default_backend()
-    log(f"backend={backend}")
-
-    quant = os.environ.get("DECODE_QUANT") == "1"
-    if GEOMETRY == "tiny":
-        cfg = DecoderConfig.tiny(quantized=quant)
-    else:
-        # the completion daemon's default geometry (completer.py):
-        # llama-tiny-class 12x768 with the byte tokenizer's padded vocab
-        cfg = DecoderConfig(vocab_size=512, quantized=quant)
-    model = CompletionModel(cfg)
-
-    log("warmup compile (prefill buckets + decode + chunk programs) ...")
-    t0 = time.perf_counter()
-    model.warmup(chunk=CHUNK)
-    model._chunk_program(1)         # the per-token baseline program
-    log(f"compile: {time.perf_counter()-t0:.1f}s")
-
-    prompt = np.ones((48,), np.int32)
-
-    # -- prefill latency ---------------------------------------------------
-    times = []
-    for _ in range(5):
-        model.reset()
-        t0 = time.perf_counter()
-        model.prefill(prompt)
-        times.append((time.perf_counter() - t0) * 1000)
-    prefill_ms = float(np.median(times))
-
-    # -- steady-state chunked decode --------------------------------------
-    def tokens_per_sec(chunk: int, n: int) -> float:
-        model.reset()
-        model.prefill(prompt)
-        # never overrun the KV window (tiny geometries have small ones)
-        n = min(n, cfg.max_len - model.pos - chunk - 1)
-        t0 = time.perf_counter()
-        got = 0
-        tok = 1
-        while got < n:
-            toks = model.decode_chunk(tok, chunk)
-            tok = int(toks[-1])
-            got += chunk
-        dt = time.perf_counter() - t0
-        return got / dt
-
-    tokens_per_sec(CHUNK, CHUNK * 2)          # warm the path
-    tps_chunked = tokens_per_sec(CHUNK, N_TOKENS)
-    # the reference's cadence: host<->device sync every token
-    tps_serial = tokens_per_sec(1, max(32, N_TOKENS // 4))
-    # wide-chunk point: how far does amortizing the host sync scale?
-    model.warmup(chunk=32)
-    tokens_per_sec(32, 64)
-    tps_c32 = tokens_per_sec(32, max(N_TOKENS, 128))
-    log(f"decode: {tps_chunked:,.1f} tok/s chunked (chunk={CHUNK}), "
-        f"{tps_c32:,.1f} tok/s (chunk=32), "
-        f"{tps_serial:,.1f} tok/s per-token sync")
-
-    # batched serving: aggregate tok/s over 8 concurrent rows — the
-    # completion daemon's batch_cap path (engine/completer.py
-    # process_batch); a decode step for 8 rows costs ~one row's step
-    def batch_tokens_per_sec(bsz: int, n: int) -> float:
-        prompts = [np.ones((24 + r,), np.int32) for r in range(bsz)]
-        model.reset()
-        t0 = time.perf_counter()
-        got = 0
-        for _col in model.generate_batch(prompts, n, chunk=CHUNK):
-            got += bsz
-        model.reset()
-        return got / (time.perf_counter() - t0)
-
-    batch_tokens_per_sec(8, CHUNK * 2)        # warm (prefill + chunk progs)
-    tps_b8 = batch_tokens_per_sec(8, N_TOKENS)
-    log(f"batched decode: {tps_b8:,.1f} aggregate tok/s (batch=8, "
-        f"chunk={CHUNK})")
-
-    # speculative decoding: tiny draft proposes gamma tokens per
-    # target verify forward (models/speculative.py)
-    tps_spec = accept = None
-    if os.environ.get("DECODE_SPEC", "1") == "1":
-        from libsplinter_tpu.models import (DecoderConfig as _DC,
-                                            SpeculativeCompletionModel)
-        gamma = int(os.environ.get("DECODE_GAMMA", "4"))
-        draft = CompletionModel(
-            _DC.tiny(vocab_size=cfg.vocab_size, max_len=cfg.max_len),
-            buckets=(64,), temp=model.temp, top_p=model.top_p,
-            seed=123)   # distinct weights: tiny-geometry runs would
-        #               otherwise make draft == target (vacuous accept)
-        spec = SpeculativeCompletionModel(model, draft, gamma=gamma)
-        spec.warmup()
-        t0 = time.perf_counter()
-        n_spec = sum(1 for _ in spec.generate_tokens(prompt, N_TOKENS))
-        tps_spec = n_spec / (time.perf_counter() - t0)
-        accept = spec.acceptance_rate
-        spec.reset()
-        log(f"speculative decode: {tps_spec:,.1f} tok/s "
-            f"(gamma={gamma}, acceptance={accept:.2f})")
-
-    # -- completion daemon e2e --------------------------------------------
-    import threading
-
-    from libsplinter_tpu import Store
-    from libsplinter_tpu.engine import protocol as P
-    from libsplinter_tpu.engine.completer import Completer
-
-    name = f"/spt-bench-dec-{os.getpid()}"
-    Store.unlink(name)
-    st = Store.create(name, nslots=256, max_val=4096, vec_dim=8)
-    comp = Completer(st, model=model, max_new_tokens=32,
-                     flush_tokens=CHUNK, template="none")
-    comp.attach()
-    log("completer e2e ...")
-    e2e = []
-    for i in range(3):
-        key = f"q/{i}"
-        t0 = time.perf_counter()
-        st.set(key, "Say something interesting about TPUs.")
-        st.label_or(key, P.LBL_INFER_REQ)
-        st.bump(key)
-        comp.run_once()
-        e2e.append((time.perf_counter() - t0) * 1000)
-        log(f"completer e2e request {i}: {e2e[-1]:.0f} ms")
-    e2e_ms = float(np.median(e2e))
-    log(f"completer e2e (32 new tokens): {e2e_ms:.0f} ms")
-
-    # -- continuous serving: 12 staggered requests through the slot
-    #    scheduler (engine/completer.py run_continuous)
-    comp2 = Completer(st, model=model, max_new_tokens=32,
-                      flush_tokens=CHUNK, template="none", batch_cap=8)
-    comp2.attach()
-    runner = threading.Thread(
-        target=comp2.run_continuous,
-        kwargs=dict(idle_timeout_ms=20, stop_after=600.0), daemon=True)
-    runner.start()
-    time.sleep(0.2)
-    t0 = time.perf_counter()
-    keys = []
-    for i in range(12):
-        key = f"c/{i}"
-        keys.append(key)
-        st.set(key, f"Question number {i} about accelerators?")
-        st.label_or(key, P.LBL_INFER_REQ)
-        st.bump(key)
-        if i % 4 == 3:
-            time.sleep(0.1)           # staggered arrival waves
-    deadline = time.perf_counter() + 420
-    while time.perf_counter() < deadline:
-        if all(st.labels(k) & P.LBL_READY for k in keys):
-            break
-        time.sleep(0.01)
-    cont_s = time.perf_counter() - t0
-    comp2.stop()
-    runner.join(timeout=5)
-    done = sum(1 for k in keys if st.labels(k) & P.LBL_READY)
-    cont_tps = comp2.stats.tokens / cont_s if done else 0.0
-    log(f"continuous serving: {done}/12 ready in {cont_s:.2f}s, "
-        f"{cont_tps:,.1f} aggregate tok/s (batch_cap=8)")
-    st.close()
-    Store.unlink(name)
-
-    rec = {
-        "metric": "decode_tokens_per_sec",
-        "value": round(tps_chunked, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tps_chunked / tps_serial, 3)
-        if tps_serial > 0 else 0.0,
-        "detail": {
-            "backend": backend, "geometry": GEOMETRY,
-            "quantized": quant,
-            "layers": cfg.layers, "hidden": cfg.hidden,
-            "chunk": CHUNK, "n_tokens": N_TOKENS,
-            "prefill_ms_bucket64": round(prefill_ms, 2),
-            "tokens_per_sec_serial_sync": round(tps_serial, 1),
-            "tokens_per_sec_chunk32": round(tps_c32, 1),
-            "tokens_per_sec_batch8_aggregate": round(tps_b8, 1),
-            "tokens_per_sec_speculative": (round(tps_spec, 1)
-                                           if tps_spec else None),
-            "speculative_acceptance": (round(accept, 3)
-                                       if accept is not None else None),
-            "completer_e2e_ms_32tok": round(e2e_ms, 0),
-            "continuous_12req_s": round(cont_s, 2),
-            "continuous_aggregate_tok_s": round(cont_tps, 1),
-            "continuous_ready": done,
-        },
-    }
-    print(json.dumps(rec), flush=True)
-    try:
-        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "bench_results.jsonl"), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-    except OSError:
-        pass
-    return 0
-
+from bench_series import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    phases = ["decode_quant" if os.environ.get("DECODE_QUANT") == "1"
+              else "decode"]
+    if os.environ.get("DECODE_DAEMON", "1") == "1":
+        phases.append("decode_daemon")
+    raise SystemExit(shim_main(*phases))

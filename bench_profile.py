@@ -1,113 +1,22 @@
 """Decomposition profile: where does an embedding batch's time go?
 
-Separates the three layers the e2e number (bench.py) mixes:
-  1. device-only encode: steady-state jit call on resident inputs,
-     block_until_ready (compute + dispatch, no host pipeline);
-  2. dispatch+transfer overhead: same call on fresh host numpy inputs,
-     forced per call (what a sync drain pays per batch);
-  3. batch-1 latency per bucket (the p50 set->vector floor).
+Thin standalone wrapper over bench_series.phase_profile (the single
+implementation every tunnel client runs, VERDICT r3 #1): steady-state
+device ms, sync-dispatch ms, and async-pipelined ms per (batch,
+bucket) shape.  Prints ONE JSON line and appends to
+bench_results.jsonl.
 
-Prints ONE JSON line:
-  {"metric": "encode_device_ms_per_batch", "value": N, "unit": "ms", ...}
-with per-shape breakdowns in detail.  Appends to bench_results.jsonl.
-
-Run strictly alone: the tunneled TPU admits one client
-(.claude/skills/verify/SKILL.md).  BENCH_CPU=1 for a host-CPU run.
+Run strictly alone: the tunneled TPU admits one client.  BENCH_CPU=1
+for a host-CPU run.  Env: PROFILE_SHAPES, PROFILE_REPS.
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-SHAPES = os.environ.get("PROFILE_SHAPES",
-                        "512x16,512x32,512x64,8x1024,1x16,1x64")
-# 8x1024 exercises the flash-attention bucket (>= flash_min_seq=512)
-REPS = int(os.environ.get("PROFILE_REPS", "10"))
-
-
-def main() -> int:
-    import numpy as np
-
-    import jax
-
-    if os.environ.get("BENCH_CPU") == "1":
-        from libsplinter_tpu.utils.jaxplatform import force_cpu
-        force_cpu()
-    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
-    enable_compile_cache()
-
-    from libsplinter_tpu.models import EmbeddingModel, EncoderConfig
-
-    backend = jax.default_backend()
-    print(f"backend={backend}", file=sys.stderr, flush=True)
-
-    cfg = EncoderConfig(out_dim=768, max_len=2048)
-    shapes = [tuple(int(x) for x in s.split("x"))
-              for s in SHAPES.split(",")]
-    buckets = tuple(sorted({b for _, b in shapes}))
-    model = EmbeddingModel(cfg, buckets=buckets)
-
-    detail: dict = {"backend": backend, "reps": REPS}
-    rows = []
-    for bsz, bucket in shapes:
-        ids_h = np.random.default_rng(0).integers(
-            0, cfg.vocab_size, (bsz, bucket)).astype(np.int32)
-        lens_h = np.full((bsz,), bucket, np.int32)
-
-        model.encode_ids(ids_h, lens_h)          # compile
-
-        # 1. device-resident steady state
-        ids_d, lens_d = jax.device_put(ids_h), jax.device_put(lens_h)
-        fn = model._fn
-        fn(model.params, ids_d, lens_d).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(REPS):
-            out = fn(model.params, ids_d, lens_d)
-        out.block_until_ready()
-        dev_ms = (time.perf_counter() - t0) / REPS * 1e3
-
-        # 2. host->device each call, forced each call (sync drain cost)
-        t0 = time.perf_counter()
-        for _ in range(REPS):
-            model.encode_ids(ids_h, lens_h)
-        e2e_ms = (time.perf_counter() - t0) / REPS * 1e3
-
-        # 3. pipelined: dispatch all, force at the end (async drain cost)
-        t0 = time.perf_counter()
-        pends = [model.encode_ids_async(ids_h, lens_h)
-                 for _ in range(REPS)]
-        for p in pends:
-            p.materialize()
-        pipe_ms = (time.perf_counter() - t0) / REPS * 1e3
-
-        r = {"batch": bsz, "bucket": bucket,
-             "device_ms": round(dev_ms, 2),
-             "sync_ms": round(e2e_ms, 2),
-             "pipelined_ms": round(pipe_ms, 2),
-             "device_emb_s": round(bsz / dev_ms * 1e3, 0),
-             "pipelined_emb_s": round(bsz / pipe_ms * 1e3, 0)}
-        rows.append(r)
-        print(json.dumps(r), file=sys.stderr, flush=True)
-
-    detail["shapes"] = rows
-    big = max(rows, key=lambda r: r["batch"])
-    rec = {"metric": "encode_device_ms_per_batch",
-           "value": big["device_ms"], "unit": "ms",
-           "vs_baseline": 0.0, "detail": detail}
-    print(json.dumps(rec), flush=True)
-    try:
-        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "bench_results.jsonl"), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-    except OSError:
-        pass
-    return 0
-
+from bench_series import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(shim_main("profile"))

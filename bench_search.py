@@ -1,144 +1,26 @@
 """Similarity-kernel benchmark: cosine top-k over a large vector lane.
 
-BASELINE.md row: "Cosine top-k over 1M-vector arena — Pallas kernel
-(beat the reference's O(N*768) scalar scan,
-splinter_cli_cmd_search.c:374-412)".  Measures:
-
-  - fused cosine+top-k queries/sec over an (N, 768) lane (the CLI
-    search hot path after staging) with the f32 kernel;
-  - the same with --fast's bf16 MXU path (mxu_bf16=True) — the number
-    that justifies the flag's existence;
-  - a numpy dot-product scan as the host-side stand-in for the
-    reference's CPU scan (the reference is scalar C, i.e. strictly
-    slower than numpy's vectorized BLAS loop).
+Thin standalone wrapper over bench_series.phase_search (the single
+implementation every tunnel client runs, VERDICT r3 #1).  BASELINE.md
+row: "Cosine top-k over 1M-vector arena — Pallas kernel (beat the
+reference's O(N*768) scalar scan, splinter_cli_cmd_search.c:374-412)".
 
 Prints ONE JSON line {"metric": "search_queries_per_sec", ...};
-vs_baseline = kernel qps / numpy qps.  Appends to bench_results.jsonl.
+vs_baseline = kernel qps / numpy host-scan qps.  Appends to
+bench_results.jsonl.
 
-Env: BENCH_CPU=1 (jnp path on host CPU), SEARCH_N (default 1,000,000 on
-TPU / 100,000 on CPU), SEARCH_D (768), SEARCH_K (10), SEARCH_REPS (20).
+Run strictly alone: the tunneled TPU admits one client.  Env:
+BENCH_CPU=1, SEARCH_N (default 1,000,000 on TPU / 100,000 on CPU),
+SEARCH_D (768), SEARCH_K (10), SEARCH_REPS (20).
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-CPU_MODE = os.environ.get("BENCH_CPU") == "1"
-D = int(os.environ.get("SEARCH_D", "768"))
-K = int(os.environ.get("SEARCH_K", "10"))
-REPS = int(os.environ.get("SEARCH_REPS", "20"))
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def main() -> int:
-    import faulthandler
-
-    import numpy as np
-
-    # a hang (tunnel stall, surprise compile) must leave a stack in
-    # the log before the watcher's timeout SIGKILLs us
-    faulthandler.dump_traceback_later(300, repeat=True, file=sys.stderr)
-
-    if CPU_MODE:
-        from libsplinter_tpu.utils.jaxplatform import force_cpu
-        force_cpu()
-    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
-    enable_compile_cache()
-    import jax
-
-    from libsplinter_tpu.ops.similarity import cosine_topk
-
-    backend = jax.default_backend()
-    n = int(os.environ.get("SEARCH_N",
-                           "1000000" if backend == "tpu" else "100000"))
-    log(f"backend={backend} lane=({n}, {D})")
-
-    rng = np.random.default_rng(0)
-    lane = rng.normal(size=(n, D)).astype(np.float32)
-    QB = 32                           # batched-query point size
-    use_pallas = backend == "tpu"
-    # enough rows for the QB-query batch regardless of REPS
-    queries = rng.normal(size=(max(REPS, QB), D)).astype(np.float32)
-    lane_dev = jax.device_put(lane)
-    # session steady state: the lane is staged once (StagedLane), so its
-    # row norms are lane-static data computed at stage time
-    vnorm_dev = jax.device_put(np.linalg.norm(lane, axis=1)
-                               .astype(np.float32))
-
-    def bench_kernel(mxu_bf16: bool) -> float:
-        cosine_topk(lane_dev, queries[0], K, use_pallas=use_pallas,
-                    mxu_bf16=mxu_bf16, vnorm=vnorm_dev)  # compile+warm
-        t0 = time.perf_counter()
-        for i in range(REPS):
-            cosine_topk(lane_dev, queries[i], K,
-                        use_pallas=use_pallas, mxu_bf16=mxu_bf16,
-                        vnorm=vnorm_dev)
-        return REPS / (time.perf_counter() - t0)
-
-    qps_f32 = bench_kernel(False)
-    qps_bf16 = bench_kernel(True) if backend == "tpu" else 0.0
-    log(f"kernel: {qps_f32:.1f} q/s f32"
-        + (f", {qps_bf16:.1f} q/s bf16" if qps_bf16 else ""))
-
-    # batched queries: one kernel pass scoring QB queries amortizes
-    # the lane read (the dominant cost at 1M rows)
-    from libsplinter_tpu.ops.similarity import cosine_topk_batch
-    cosine_topk_batch(lane_dev, queries[:QB], K, use_pallas=use_pallas,
-                      vnorm=vnorm_dev)            # compile+warm
-    t0 = time.perf_counter()
-    reps_b = max(2, REPS // QB)
-    for _ in range(reps_b):
-        cosine_topk_batch(lane_dev, queries[:QB], K,
-                          use_pallas=use_pallas, vnorm=vnorm_dev)
-    qps_batch = reps_b * QB / (time.perf_counter() - t0)
-    log(f"batched: {qps_batch:.1f} q/s aggregate (QB={QB})")
-
-    # host numpy scan (vectorized stand-in for the reference's scalar C)
-    nn = min(n, 100_000)              # numpy at 1M x 768 is minutes
-    sub = lane[:nn]
-    norms = np.linalg.norm(sub, axis=1)
-    t0 = time.perf_counter()
-    reps_np = max(3, REPS // 4)
-    for i in range(reps_np):
-        q = queries[i]
-        s = sub @ q / np.maximum(norms * np.linalg.norm(q), 1e-12)
-        np.argpartition(-s, K)[:K]
-    qps_np = reps_np / (time.perf_counter() - t0) * (nn / n)
-    log(f"numpy scan (scaled to {n} rows): {qps_np:.2f} q/s")
-
-    best = max(qps_f32, qps_bf16)
-    rec = {
-        "metric": "search_queries_per_sec",
-        "value": round(best, 1),
-        "unit": "queries/s",
-        "vs_baseline": round(best / qps_np, 2) if qps_np > 0 else 0.0,
-        "detail": {
-            "backend": backend, "n": n, "d": D, "k": K,
-            "qps_f32": round(qps_f32, 1),
-            "qps_bf16_fast": round(qps_bf16, 1),
-            "qps_batch32_aggregate": round(qps_batch, 1),
-            "bf16_speedup": round(qps_bf16 / qps_f32, 2)
-            if qps_f32 > 0 and qps_bf16 > 0 else None,
-            "qps_numpy_hostscan": round(qps_np, 2),
-        },
-    }
-    print(json.dumps(rec), flush=True)
-    try:
-        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "bench_results.jsonl"), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-    except OSError:
-        pass
-    return 0
-
+from bench_series import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(shim_main("search"))

@@ -1,0 +1,981 @@
+"""Unified single-claim TPU bench series (VERDICT r3 #1).
+
+The chip sits behind a single-client claim tunnel that can be
+unclaimable for hours.  Rounds 1-3 split the measurement across
+separate scripts (bench.py, bench_profile.py, bench_decode.py,
+bench_search.py), each its own PJRT client — so one claim window
+yielded ONE metric and the next script had to win the tunnel again.
+
+This module is the fix: ONE process, ONE client, the WHOLE series.
+Once the claim lands, it runs every phase back to back and appends
+each record to bench_results.jsonl the moment it completes, so a
+single claim window produces the complete evidence set:
+
+  embed          e2e embedding throughput + event-driven p50
+                 set->vector with per-stage span decomposition
+                 (the headline metric; written to a recovery file
+                 the parent can read even if a later phase hangs)
+  profile        device / sync / pipelined ms per (batch, bucket)
+  kernels        every Pallas kernel executed + checked vs the jnp
+                 math on the same backend: flash fwd, blockwise bwd,
+                 causal prefill w/ GQA, fused cosine top-k (f32+bf16)
+  search         cosine top-k queries/sec over the largest lane the
+                 remaining window affords (target 1M rows)
+  decode         prefill / chunked / per-token-sync / batched /
+                 speculative tokens per second
+  decode_quant   the same core decode with int8 weight residency
+  decode_daemon  completion-daemon e2e + continuous serving (the
+                 only phase that ever hung on-chip, so it runs LAST)
+
+Phases are ordered headline-first / riskiest-last and each is fenced:
+a phase failure logs and moves on (its record is simply absent), and
+every phase checks the remaining window before starting.  The ledger
+(bench_results.jsonl) is the single source of truth (VERDICT r3 #5);
+docs quote it, never the other way around.
+
+Entry points:
+  python bench_series.py             run BENCH_PHASES (default: all)
+  bench.py                           tunnel-disciplined parent; its
+                                     child runs this series
+  bench_profile/decode/search.py     thin shims over single phases
+
+Env: BENCH_CPU=1 (host CPU), BENCH_PHASES=embed,kernels,...,
+SPTPU_BENCH_DEADLINE_EPOCH (wall-clock budget; phases that can't fit
+are skipped), SPTPU_BENCH_RESULTFILE (headline recovery file), plus
+the per-phase knobs documented on each phase function.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+RESULTS_LOG = os.environ.get(
+    "SPTPU_BENCH_LEDGER", os.path.join(REPO, "bench_results.jsonl"))
+BASELINE_PER_CHIP = 12_500.0
+
+ALL_PHASES = ("embed", "profile", "kernels", "search", "decode",
+              "decode_quant", "decode_daemon")
+
+# conservative floor (seconds) a phase needs to be worth starting;
+# compile costs dominate these on a cold .xla_cache
+PHASE_MIN_S = {"embed": 0, "profile": 90, "kernels": 120, "search": 150,
+               "decode": 180, "decode_quant": 150, "decode_daemon": 120}
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class SeriesCtx:
+    """Shared state for one series run: backend, deadline, ledger."""
+
+    def __init__(self, deadline_epoch: float | None = None):
+        self.deadline = deadline_epoch or float(os.environ.get(
+            "SPTPU_BENCH_DEADLINE_EPOCH", time.time() + 86400))
+        self.backend = "?"
+        self.n_devices = 0
+        self.headline: dict | None = None
+        self.records: list[dict] = []
+        # phase name -> "ok" | "failed" | "skipped" (set by run_series)
+        self.phase_status: dict[str, str] = {}
+
+    def remaining(self) -> float:
+        return self.deadline - time.time()
+
+    def record(self, rec: dict) -> dict:
+        """Append one measurement to the ledger immediately (atomic
+        single write): evidence must survive a later phase hanging."""
+        rec = dict(rec)
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        try:
+            with open(RESULTS_LOG, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            log(f"[series] ledger append failed: {e}")
+        self.records.append(rec)
+        return rec
+
+
+def _stage(name: str) -> None:
+    """Stage marker (see bench.py: the parent reads the stage file to
+    attribute a hang post-mortem)."""
+    log(f"STAGE {name} t={time.strftime('%H:%M:%S')}")
+    path = os.environ.get("SPTPU_BENCH_STAGEFILE")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(f"{time.time():.1f} {name}\n")
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# phase: embed — the headline metric
+# ---------------------------------------------------------------------------
+
+def make_texts(n: int) -> list[str]:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    words = ["tpu", "vector", "store", "seqlock", "arena", "signal",
+             "epoch", "shard", "bloom", "label", "kernel", "mesh",
+             "gather", "commit", "batch", "embed"]
+    return [" ".join(rng.choice(words, size=int(rng.integers(4, 24))))
+            for _ in range(n)]
+
+
+def phase_embed(ctx: SeriesCtx) -> dict:
+    """End-to-end embedding throughput per chip + p50 set->vector on
+    the event-driven wake path, with the per-stage span table VERDICT
+    r3 #3 asks for (wake / drain / tokenize / dispatch / commit).
+
+    Env: BENCH_TEXTS (4096), BENCH_BATCH (512), BENCH_BUCKET (64),
+    BENCH_BUCKETS (16,32,BUCKET)."""
+    import threading
+
+    import numpy as np
+
+    from libsplinter_tpu import Store, T_VARTEXT
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.embedder import Embedder
+    from libsplinter_tpu.models import (EmbeddingModel, EncoderConfig,
+                                        default_tokenizer)
+    from libsplinter_tpu.utils.trace import tracer
+
+    n_texts = int(os.environ.get("BENCH_TEXTS", "4096"))
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    bucket = int(os.environ.get("BENCH_BUCKET", "64"))
+    buckets = tuple(int(x) for x in os.environ.get(
+        "BENCH_BUCKETS", f"16,32,{bucket}").split(",")) \
+        if os.environ.get("BENCH_BUCKETS") != "" else (bucket,)
+
+    cfg = EncoderConfig(out_dim=768, max_len=2048)
+    model = EmbeddingModel(cfg, buckets=buckets)
+    tok = default_tokenizer(cfg.vocab_size)
+
+    _stage("compile")
+    t0 = time.perf_counter()
+    for bsz in (1, batch):          # p50 probe path + throughput path
+        for b in model.buckets[:-1] if len(model.buckets) > 1 \
+                else model.buckets:
+            ids = np.zeros((bsz, b), np.int32)
+            lens = np.full((bsz,), b, np.int32)
+            model.encode_ids(ids, lens)
+    compile_s = time.perf_counter() - t0
+    log(f"compile: {compile_s:.1f}s")
+
+    _stage("stage-store")
+    name = os.environ.get("SPTPU_BENCH_STORE",
+                          f"/spt-series-{os.getpid()}")
+    Store.unlink(name)
+    st = Store.create(name, nslots=max(8192, n_texts * 2), max_val=2048,
+                      vec_dim=768)
+    runner = None
+    try:
+        texts = make_texts(n_texts)
+        for i, t in enumerate(texts):
+            key = f"bench/{i}"
+            st.set(key, t)
+            st.set_type(key, T_VARTEXT)
+            st.label_or(key, P.LBL_EMBED_REQ)
+
+        emb = Embedder(st, model=model, tokenizer=tok, max_ctx=2048,
+                       batch_cap=batch)
+        emb.attach()
+
+        # untimed first drain: absorbs every data-dependent program
+        # compile (tail batches pad to powers of two)
+        _stage("throughput-warm-drain")
+        t0 = time.perf_counter()
+        done = emb.run_once()
+        log(f"warm drain: {done}/{n_texts} in "
+            f"{time.perf_counter() - t0:.2f}s (compiles included)")
+
+        for i, t in enumerate(texts):       # re-arm every key
+            key = f"bench/{i}"
+            st.set(key, t)
+            st.label_or(key, P.LBL_EMBED_REQ)
+
+        _stage("throughput")
+        t0 = time.perf_counter()
+        done = emb.run_once()
+        dt = time.perf_counter() - t0
+        eps = done / dt if dt > 0 else 0.0
+        log(f"embedded={done}/{n_texts} in {dt:.2f}s -> "
+            f"{eps:,.0f} emb/s/chip")
+
+        # p50 set->vector on the EVENT-DRIVEN wake path, with spans
+        # enabled so the latency decomposes into stages: wake (e2e
+        # minus drain), gather+tokenize, host dispatch, commit (which
+        # contains the device wait — materialize blocks there).
+        # The daemon thread MUST be stopped on every exit path: later
+        # phases share this process, and a still-running daemon would
+        # use the store after the finally below closes/unlinks it.
+        _stage("p50-wake")
+        was_enabled = tracer.enabled
+        tracer.enabled = True
+        tracer.reset()
+        runner = threading.Thread(
+            target=emb.run,
+            kwargs=dict(idle_timeout_ms=20, sweep_interval_s=3600.0),
+            daemon=True)
+        try:
+            runner.start()
+            time.sleep(0.05)
+
+            lat, lat_timeouts = [], 0
+            for i in range(30):
+                key = f"lat/{i}"
+                t1 = time.perf_counter()
+                st.set(key, "latency probe text sample")
+                st.set_type(key, T_VARTEXT)
+                st.label_or(key, P.LBL_EMBED_REQ)
+                st.bump(key)
+                idx = st.find_index(key)
+                deadline = t1 + 10.0
+                timed_out = False
+                while st.labels_at(idx) & P.LBL_EMBED_REQ:
+                    if time.perf_counter() > deadline:
+                        timed_out = True
+                        break
+                    time.sleep(0.0001)
+                if timed_out:
+                    lat_timeouts += 1
+                else:
+                    lat.append((time.perf_counter() - t1) * 1000)
+        finally:
+            emb.stop()
+            runner.join(timeout=5.0)
+            spans = tracer.snapshot()
+            tracer.enabled = was_enabled
+        p50 = float(np.percentile(lat, 50)) if lat else -1.0
+        p95 = float(np.percentile(lat, 95)) if lat else -1.0
+
+        # per-stage means over the p50 loop's requests.  The drain span
+        # fires on EVERY wake including empty idle-timeout sweeps, so
+        # per-request means divide each span's TOTAL by the number of
+        # real requests (the commit count) — not by the span's own n.
+        n_req = max(spans.get("embed.commit", {}).get("n", 0), 1)
+
+        def per_req_ms(span: str) -> float:
+            a = spans.get(span)
+            return round(a["total_ms"] / n_req, 3) if a else 0.0
+
+        e2e_mean = float(np.mean(lat)) if lat else 0.0
+        drain_pr = per_req_ms("embed.drain")
+        stage_tbl = {
+            "e2e_mean_ms": round(e2e_mean, 3),
+            "requests": n_req,
+            "drain_ms": drain_pr,
+            "tokenize_ms": per_req_ms("embed.tokenize"),
+            "dispatch_ms": per_req_ms("embed.dispatch"),
+            "commit_incl_device_wait_ms": per_req_ms("embed.commit"),
+            # wake = client set() -> daemon drain start (signal_wait
+            # wake + thread handoff): everything e2e that is not drain
+            "wake_ms": round(max(e2e_mean - drain_pr, 0.0), 3),
+        }
+        log(f"p50 set->vector (event-driven): {p50:.2f} ms  p95: "
+            f"{p95:.2f} ms  timeouts={lat_timeouts}  spans={stage_tbl}")
+    finally:
+        if runner is not None and runner.is_alive():
+            # a wedged daemon thread still holds the mapping: closing
+            # it under the thread could crash the whole series — leak
+            # the store instead (the bench parent unlinks the name on
+            # every failure path)
+            log("[series] WARNING: daemon thread did not stop; "
+                "leaking the bench store to avoid use-after-close")
+        else:
+            st.close()
+            Store.unlink(name)
+
+    rec = ctx.record({
+        "metric": "embeddings_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "embeddings/s",
+        "vs_baseline": round(eps / BASELINE_PER_CHIP, 4),
+        "detail": {
+            "backend": ctx.backend, "n_chips_visible": ctx.n_devices,
+            "bucket": bucket, "buckets": list(model.buckets[:-1]),
+            "batch": batch, "n_texts": n_texts,
+            "compile_s": round(compile_s, 1),
+            "p50_set_to_vector_ms": round(p50, 2),
+            "p95_set_to_vector_ms": round(p95, 2),
+            "p50_samples": len(lat), "p50_timeouts": lat_timeouts,
+            "p50_stage_means": stage_tbl,
+        }})
+    ctx.headline = rec
+
+    # recovery file: the parent prints this even if a LATER phase hangs
+    # and the child is killed mid-series
+    path = os.environ.get("SPTPU_BENCH_RESULTFILE")
+    if path:
+        try:
+            with open(path, "w") as f:
+                json.dump({k: v for k, v in rec.items() if k != "ts"}, f)
+        except OSError:
+            pass
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# phase: profile — device / sync / pipelined per shape
+# ---------------------------------------------------------------------------
+
+def phase_profile(ctx: SeriesCtx) -> dict:
+    """Decomposition: steady-state device ms, sync-dispatch ms, and
+    async-pipelined ms per (batch, bucket) shape.  Env: PROFILE_SHAPES
+    (512x16,512x32,512x64,8x1024,1x16,1x64), PROFILE_REPS (10)."""
+    import numpy as np
+
+    import jax
+
+    from libsplinter_tpu.models import EmbeddingModel, EncoderConfig
+
+    shapes_env = os.environ.get(
+        "PROFILE_SHAPES", "512x16,512x32,512x64,8x1024,1x16,1x64")
+    reps = int(os.environ.get("PROFILE_REPS", "10"))
+    cfg = EncoderConfig(out_dim=768, max_len=2048)
+    shapes = [tuple(int(x) for x in s.split("x"))
+              for s in shapes_env.split(",")]
+    buckets = tuple(sorted({b for _, b in shapes}))
+    model = EmbeddingModel(cfg, buckets=buckets)
+
+    rows = []
+    for bsz, bucket in shapes:
+        ids_h = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (bsz, bucket)).astype(np.int32)
+        lens_h = np.full((bsz,), bucket, np.int32)
+        model.encode_ids(ids_h, lens_h)          # compile
+
+        ids_d, lens_d = jax.device_put(ids_h), jax.device_put(lens_h)
+        fn = model._fn
+        fn(model.params, ids_d, lens_d).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(model.params, ids_d, lens_d)
+        out.block_until_ready()
+        dev_ms = (time.perf_counter() - t0) / reps * 1e3
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            model.encode_ids(ids_h, lens_h)
+        e2e_ms = (time.perf_counter() - t0) / reps * 1e3
+
+        t0 = time.perf_counter()
+        pends = [model.encode_ids_async(ids_h, lens_h)
+                 for _ in range(reps)]
+        for p in pends:
+            p.materialize()
+        pipe_ms = (time.perf_counter() - t0) / reps * 1e3
+
+        r = {"batch": bsz, "bucket": bucket,
+             "device_ms": round(dev_ms, 2),
+             "sync_ms": round(e2e_ms, 2),
+             "pipelined_ms": round(pipe_ms, 2),
+             "device_emb_s": round(bsz / dev_ms * 1e3, 0),
+             "pipelined_emb_s": round(bsz / pipe_ms * 1e3, 0)}
+        rows.append(r)
+        log(json.dumps(r))
+
+    big = max(rows, key=lambda r: r["batch"])
+    return ctx.record({
+        "metric": "encode_device_ms_per_batch",
+        "value": big["device_ms"], "unit": "ms", "vs_baseline": 0.0,
+        "detail": {"backend": ctx.backend, "reps": reps, "shapes": rows}})
+
+
+# ---------------------------------------------------------------------------
+# phase: kernels — every Pallas kernel executed + checked on this backend
+# ---------------------------------------------------------------------------
+
+def phase_kernels(ctx: SeriesCtx) -> dict:
+    """VERDICT r3 #4: run the full Pallas tier on the real backend once —
+    flash forward, blockwise backward (grad check vs naive), causal
+    prefill with GQA head routing, and the fused cosine top-k (f32 and
+    bf16-MXU) over a large lane — asserting numerics against the jnp
+    path on the SAME device and recording timings.
+
+    On TPU the kernels lower through Mosaic (the thing interpret-mode
+    tests cannot prove); on CPU (BENCH_CPU=1 quick-tracking) the same
+    comparisons run with interpret=True at reduced sizes.
+
+    Env: KERNELS_SEQ (512), KERNELS_ROWS (262144; auto-shrunk to fit
+    the window), KERNELS_REPS (10)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from libsplinter_tpu.ops.flash_attention import (
+        _causal_jnp, _mha_jnp, causal_flash_attention, flash_attention)
+    from libsplinter_tpu.ops.similarity import cosine_topk
+
+    on_tpu = ctx.backend == "tpu"
+    interp = not on_tpu
+    S = int(os.environ.get("KERNELS_SEQ", "512" if on_tpu else "128"))
+    n_rows = int(os.environ.get("KERNELS_ROWS",
+                                "262144" if on_tpu else "8192"))
+    reps = int(os.environ.get("KERNELS_REPS", "10"))
+    detail: dict = {"backend": ctx.backend, "interpret": interp,
+                    "seq": S, "rows": n_rows}
+    rng = np.random.default_rng(7)
+
+    def timed(fn, *args, **kw):
+        out = fn(*args, **kw)           # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        return out, (time.perf_counter() - t0) / reps * 1e3
+
+    # -- flash forward (bidirectional, masked) ------------------------------
+    B, H, D = 4, 12, 64
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    lens = np.asarray([S, S - 3, S // 2, 5])
+    mask = jnp.asarray(np.arange(S)[None, :] < lens[:, None])
+
+    flash = lambda: flash_attention(q, k, v, mask, interpret=interp,
+                                    force_pallas=True)
+    out_f, flash_ms = timed(flash)
+    out_ref = _mha_jnp(q, k, v, mask)
+    # compare only valid rows: fully-masked rows are don't-care by the
+    # encoder-pooling contract (see flash_attention.py docstring)
+    w = mask.astype(jnp.float32)[:, :, None, None]
+    fwd_diff = float(jnp.max(jnp.abs((out_f - out_ref) * w)))
+    detail["flash_fwd"] = {"ms": round(flash_ms, 2),
+                           "max_abs_diff": fwd_diff,
+                           "ok": bool(fwd_diff < 2e-3)}
+    log(f"flash fwd S={S}: {flash_ms:.2f} ms, diff={fwd_diff:.2e}")
+
+    # -- flash blockwise backward (grad check vs naive) ---------------------
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, mask,
+                                       interpret=interp,
+                                       force_pallas=True) * w)
+
+    def loss_naive(q_, k_, v_):
+        return jnp.sum(_mha_jnp(q_, k_, v_, mask) * w)
+
+    grad_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    grad_naive = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
+    (gq, gk, gv), bwd_ms = timed(grad_flash, q, k, v)
+    nq, nk, nv = grad_naive(q, k, v)
+    bwd_diff = float(max(jnp.max(jnp.abs(a - b))
+                         for a, b in ((gq, nq), (gk, nk), (gv, nv))))
+    detail["flash_bwd"] = {"ms": round(bwd_ms, 2),
+                           "max_abs_diff": bwd_diff,
+                           "ok": bool(bwd_diff < 5e-3)}
+    log(f"flash bwd S={S}: {bwd_ms:.2f} ms, diff={bwd_diff:.2e}")
+
+    # -- causal prefill with GQA head routing -------------------------------
+    Bp, Sp, T, Hq, KH = 2, max(S // 2, 64), S, 8, 2
+    pos = T - Sp
+    qc = jnp.asarray(rng.normal(size=(Bp, Sp, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(Bp, T, KH, D)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(Bp, T, KH, D)), jnp.float32)
+    start = jnp.asarray([0, 7], jnp.int32)
+
+    causal = lambda: causal_flash_attention(
+        qc, kc, vc, pos, start, interpret=interp, force_pallas=True)
+    out_c, causal_ms = timed(causal)
+    rep = Hq // KH
+    out_cr = _causal_jnp(qc, jnp.repeat(kc, rep, axis=2),
+                         jnp.repeat(vc, rep, axis=2),
+                         pos, start)
+    causal_diff = float(jnp.max(jnp.abs(out_c - out_cr)))
+    detail["causal_prefill_gqa"] = {
+        "ms": round(causal_ms, 2), "max_abs_diff": causal_diff,
+        "gqa_rep": rep, "ok": bool(causal_diff < 2e-3)}
+    log(f"causal prefill S={Sp} T={T} GQA x{rep}: {causal_ms:.2f} ms, "
+        f"diff={causal_diff:.2e}")
+
+    # -- fused cosine top-k over a large lane (f32 + bf16 MXU) --------------
+    lane = rng.normal(size=(n_rows, 768)).astype(np.float32)
+    t0 = time.perf_counter()
+    lane_dev = jax.device_put(lane)
+    jax.block_until_ready(lane_dev)
+    stage_s = time.perf_counter() - t0
+    detail["lane_stage_s"] = round(stage_s, 2)
+    detail["lane_stage_mb_s"] = round(lane.nbytes / 1e6 / stage_s, 1) \
+        if stage_s > 0 else None
+    query = lane[12345 % n_rows] + 0.05 * rng.normal(size=768) \
+        .astype(np.float32)
+    k_top = 10
+
+    # the pallas path is what we're proving; the jnp path on the SAME
+    # device is the oracle
+    (s_p, i_p), pal_ms = timed(
+        cosine_topk, lane_dev, query, k_top,
+        use_pallas=(True if on_tpu else None))
+    if on_tpu:
+        (s_j, i_j), jnp_ms = timed(cosine_topk, lane_dev, query, k_top,
+                                   use_pallas=False)
+        overlap = len(set(map(int, i_p)) & set(map(int, i_j))) / k_top
+        sdiff = float(np.max(np.abs(s_p - s_j)))
+        (s_b, i_b), bf16_ms = timed(cosine_topk, lane_dev, query, k_top,
+                                    use_pallas=True, mxu_bf16=True)
+        bf16_overlap = len(set(map(int, i_b))
+                           & set(map(int, i_j))) / k_top
+        detail["cosine_topk"] = {
+            "pallas_ms": round(pal_ms, 2), "jnp_ms": round(jnp_ms, 2),
+            "bf16_ms": round(bf16_ms, 2),
+            "topk_overlap_vs_jnp": overlap,
+            "score_max_abs_diff": sdiff,
+            "bf16_topk_overlap": bf16_overlap,
+            "ok": bool(overlap >= 0.9 and sdiff < 1e-3
+                       and bf16_overlap >= 0.8)}
+        log(f"cosine_topk {n_rows}x768: pallas {pal_ms:.2f} ms vs jnp "
+            f"{jnp_ms:.2f} ms, overlap={overlap:.2f}, bf16 {bf16_ms:.2f}"
+            f" ms overlap={bf16_overlap:.2f}")
+    else:
+        detail["cosine_topk"] = {"jnp_ms": round(pal_ms, 2),
+                                 "ok": True,
+                                 "note": "cpu: jnp path only"}
+        log(f"cosine_topk {n_rows}x768 (jnp/cpu): {pal_ms:.2f} ms")
+
+    all_ok = all(v.get("ok", True) for v in detail.values()
+                 if isinstance(v, dict))
+    return ctx.record({
+        "metric": "kernels_smoke",
+        "value": 1.0 if all_ok else 0.0, "unit": "ok",
+        "vs_baseline": 0.0, "detail": detail})
+
+
+# ---------------------------------------------------------------------------
+# phase: search — cosine top-k q/s at the largest affordable lane
+# ---------------------------------------------------------------------------
+
+def phase_search(ctx: SeriesCtx) -> dict:
+    """BASELINE.md: cosine top-k over a 1M-vector arena.  Stages the
+    lane (staging time is itself reported — it is the StagedLane
+    restage cost at full-lane granularity), then measures single-query
+    and 32-query-batch q/s with the f32 kernel and the bf16 MXU path.
+
+    Env: SEARCH_N (1,000,000 on TPU / 100,000 on CPU), SEARCH_D (768),
+    SEARCH_K (10), SEARCH_REPS (20)."""
+    import numpy as np
+
+    import jax
+
+    from libsplinter_tpu.ops.similarity import cosine_topk, \
+        cosine_topk_batch
+
+    d = int(os.environ.get("SEARCH_D", "768"))
+    k = int(os.environ.get("SEARCH_K", "10"))
+    reps = int(os.environ.get("SEARCH_REPS", "20"))
+    on_tpu = ctx.backend == "tpu"
+    n = int(os.environ.get("SEARCH_N",
+                           "1000000" if on_tpu else "100000"))
+    use_pallas = on_tpu
+
+    log(f"search lane=({n}, {d})")
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    lane = rng.normal(size=(n, d)).astype(np.float32)
+    gen_s = time.perf_counter() - t0
+    QB = 32
+    queries = rng.normal(size=(max(reps, QB), d)).astype(np.float32)
+    t0 = time.perf_counter()
+    lane_dev = jax.device_put(lane)
+    jax.block_until_ready(lane_dev)
+    stage_s = time.perf_counter() - t0
+    vnorm_dev = jax.device_put(np.linalg.norm(lane, axis=1)
+                               .astype(np.float32))
+    log(f"lane host-gen {gen_s:.1f}s, staged to device in {stage_s:.1f}s"
+        f" ({lane.nbytes / 1e6 / max(stage_s, 1e-9):,.0f} MB/s)")
+
+    def bench_kernel(mxu_bf16: bool) -> float:
+        cosine_topk(lane_dev, queries[0], k, use_pallas=use_pallas,
+                    mxu_bf16=mxu_bf16, vnorm=vnorm_dev)
+        t0 = time.perf_counter()
+        for i in range(reps):
+            cosine_topk(lane_dev, queries[i], k, use_pallas=use_pallas,
+                        mxu_bf16=mxu_bf16, vnorm=vnorm_dev)
+        return reps / (time.perf_counter() - t0)
+
+    qps_f32 = bench_kernel(False)
+    qps_bf16 = bench_kernel(True) if on_tpu else 0.0
+    log(f"kernel: {qps_f32:.1f} q/s f32"
+        + (f", {qps_bf16:.1f} q/s bf16" if qps_bf16 else ""))
+
+    cosine_topk_batch(lane_dev, queries[:QB], k, use_pallas=use_pallas,
+                      vnorm=vnorm_dev)
+    t0 = time.perf_counter()
+    reps_b = max(2, reps // QB)
+    for _ in range(reps_b):
+        cosine_topk_batch(lane_dev, queries[:QB], k,
+                          use_pallas=use_pallas, vnorm=vnorm_dev)
+    qps_batch = reps_b * QB / (time.perf_counter() - t0)
+    log(f"batched: {qps_batch:.1f} q/s aggregate (QB={QB})")
+
+    # host numpy scan: vectorized stand-in for the reference's scalar C
+    # scan (splinter_cli_cmd_search.c:374-412), i.e. a GENEROUS baseline
+    nn = min(n, 100_000)
+    sub = lane[:nn]
+    norms = np.linalg.norm(sub, axis=1)
+    t0 = time.perf_counter()
+    reps_np = max(3, reps // 4)
+    for i in range(reps_np):
+        qv = queries[i]
+        s = sub @ qv / np.maximum(norms * np.linalg.norm(qv), 1e-12)
+        np.argpartition(-s, k)[:k]
+    qps_np = reps_np / (time.perf_counter() - t0) * (nn / n)
+    log(f"numpy scan (scaled to {n} rows): {qps_np:.2f} q/s")
+
+    best = max(qps_f32, qps_bf16)
+    return ctx.record({
+        "metric": "search_queries_per_sec",
+        "value": round(best, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(best / qps_np, 2) if qps_np > 0 else 0.0,
+        "detail": {
+            "backend": ctx.backend, "n": n, "d": d, "k": k,
+            "qps_f32": round(qps_f32, 1),
+            "qps_bf16_fast": round(qps_bf16, 1),
+            "qps_batch32_aggregate": round(qps_batch, 1),
+            "bf16_speedup": round(qps_bf16 / qps_f32, 2)
+            if qps_f32 > 0 and qps_bf16 > 0 else None,
+            "qps_numpy_hostscan": round(qps_np, 2),
+            "lane_stage_s": round(stage_s, 2),
+            "lane_mb": round(lane.nbytes / 1e6, 1),
+        }})
+
+
+# ---------------------------------------------------------------------------
+# phases: decode / decode_quant / decode_daemon
+# ---------------------------------------------------------------------------
+
+def _decode_model(quant: bool):
+    from libsplinter_tpu.models import CompletionModel, DecoderConfig
+
+    geometry = os.environ.get("DECODE_GEOMETRY", "flagship")
+    if geometry == "tiny":
+        cfg = DecoderConfig.tiny(quantized=quant)
+    else:
+        # the completion daemon's default geometry (completer.py):
+        # llama-tiny-class 12x768 with the byte tokenizer's padded vocab
+        cfg = DecoderConfig(vocab_size=512, quantized=quant)
+    return CompletionModel(cfg), cfg, geometry
+
+
+def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
+    """Prefill latency + chunked / per-token / wide-chunk / batched /
+    speculative decode tokens per second.  Env: DECODE_TOKENS (256),
+    DECODE_CHUNK (8), DECODE_GEOMETRY, DECODE_SPEC, DECODE_GAMMA."""
+    import numpy as np
+
+    n_tokens = int(os.environ.get("DECODE_TOKENS", "256"))
+    chunk = int(os.environ.get("DECODE_CHUNK", "8"))
+    model, cfg, geometry = _decode_model(quant)
+
+    log(f"decode{' int8' if quant else ''}: warmup compile ...")
+    t0 = time.perf_counter()
+    model.warmup(chunk=chunk)
+    model._chunk_program(1)
+    log(f"compile: {time.perf_counter() - t0:.1f}s")
+
+    prompt = np.ones((48,), np.int32)
+    times = []
+    for _ in range(5):
+        model.reset()
+        t0 = time.perf_counter()
+        model.prefill(prompt)
+        times.append((time.perf_counter() - t0) * 1000)
+    prefill_ms = float(np.median(times))
+
+    def tokens_per_sec(ch: int, n: int) -> float:
+        model.reset()
+        model.prefill(prompt)
+        n = min(n, cfg.max_len - model.pos - ch - 1)
+        t0 = time.perf_counter()
+        got = 0
+        tok = 1
+        while got < n:
+            toks = model.decode_chunk(tok, ch)
+            tok = int(toks[-1])
+            got += ch
+        return got / (time.perf_counter() - t0)
+
+    tokens_per_sec(chunk, chunk * 2)
+    tps_chunked = tokens_per_sec(chunk, n_tokens)
+    tps_serial = tokens_per_sec(1, max(32, n_tokens // 4))
+    model.warmup(chunk=32)
+    tokens_per_sec(32, 64)
+    tps_c32 = tokens_per_sec(32, max(n_tokens, 128))
+    log(f"decode: {tps_chunked:,.1f} tok/s (chunk={chunk}), "
+        f"{tps_c32:,.1f} (chunk=32), {tps_serial:,.1f} per-token sync")
+
+    def batch_tokens_per_sec(bsz: int, n: int) -> float:
+        prompts = [np.ones((24 + r,), np.int32) for r in range(bsz)]
+        model.reset()
+        t0 = time.perf_counter()
+        got = 0
+        for _col in model.generate_batch(prompts, n, chunk=chunk):
+            got += bsz
+        model.reset()
+        return got / (time.perf_counter() - t0)
+
+    batch_tokens_per_sec(8, chunk * 2)
+    tps_b8 = batch_tokens_per_sec(8, n_tokens)
+    log(f"batched decode: {tps_b8:,.1f} aggregate tok/s (batch=8)")
+
+    tps_spec = accept = None
+    if os.environ.get("DECODE_SPEC", "1") == "1":
+        from libsplinter_tpu.models import (CompletionModel,
+                                            DecoderConfig,
+                                            SpeculativeCompletionModel)
+        gamma = int(os.environ.get("DECODE_GAMMA", "4"))
+        draft = CompletionModel(
+            DecoderConfig.tiny(vocab_size=cfg.vocab_size,
+                               max_len=cfg.max_len),
+            buckets=(64,), temp=model.temp, top_p=model.top_p,
+            seed=123)
+        spec = SpeculativeCompletionModel(model, draft, gamma=gamma)
+        spec.warmup()
+        t0 = time.perf_counter()
+        n_spec = sum(1 for _ in spec.generate_tokens(prompt, n_tokens))
+        tps_spec = n_spec / (time.perf_counter() - t0)
+        accept = spec.acceptance_rate
+        spec.reset()
+        log(f"speculative: {tps_spec:,.1f} tok/s (gamma={gamma}, "
+            f"acceptance={accept:.2f})")
+
+    return ctx.record({
+        "metric": "decode_tokens_per_sec",
+        "value": round(tps_chunked, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_chunked / tps_serial, 3)
+        if tps_serial > 0 else 0.0,
+        "detail": {
+            "backend": ctx.backend, "geometry": geometry,
+            "quantized": quant,
+            "layers": cfg.layers, "hidden": cfg.hidden,
+            "chunk": chunk, "n_tokens": n_tokens,
+            "prefill_ms_bucket64": round(prefill_ms, 2),
+            "tokens_per_sec_serial_sync": round(tps_serial, 1),
+            "tokens_per_sec_chunk32": round(tps_c32, 1),
+            "tokens_per_sec_batch8_aggregate": round(tps_b8, 1),
+            "tokens_per_sec_speculative": (round(tps_spec, 1)
+                                           if tps_spec else None),
+            "speculative_acceptance": (round(accept, 3)
+                                       if accept is not None else None),
+        }})
+
+
+def phase_decode(ctx: SeriesCtx) -> dict:
+    return _decode_core(ctx, quant=False)
+
+
+def phase_decode_quant(ctx: SeriesCtx) -> dict:
+    return _decode_core(ctx, quant=True)
+
+
+def phase_decode_daemon(ctx: SeriesCtx) -> dict:
+    """Completion-daemon e2e latency + continuous serving.  Runs LAST:
+    this phase (completer e2e) is the only one that ever hung on-chip
+    (round-3 watchdog kill); faulthandler leaves a stack if it repeats.
+    Env: DECODE_CHUNK (8)."""
+    import threading
+
+    import numpy as np
+
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.completer import Completer
+
+    chunk = int(os.environ.get("DECODE_CHUNK", "8"))
+    quant = os.environ.get("DECODE_QUANT") == "1"
+    model, cfg, geometry = _decode_model(quant)
+    model.warmup(chunk=chunk)
+
+    name = f"/spt-series-dec-{os.getpid()}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=256, max_val=4096, vec_dim=8)
+    try:
+        comp = Completer(st, model=model, max_new_tokens=32,
+                         flush_tokens=chunk, template="none")
+        comp.attach()
+        log("completer e2e ...")
+        e2e = []
+        for i in range(3):
+            key = f"q/{i}"
+            t0 = time.perf_counter()
+            st.set(key, "Say something interesting about TPUs.")
+            st.label_or(key, P.LBL_INFER_REQ)
+            st.bump(key)
+            comp.run_once()
+            e2e.append((time.perf_counter() - t0) * 1000)
+            log(f"completer e2e request {i}: {e2e[-1]:.0f} ms")
+        e2e_ms = float(np.median(e2e))
+
+        comp2 = Completer(st, model=model, max_new_tokens=32,
+                          flush_tokens=chunk, template="none",
+                          batch_cap=8)
+        comp2.attach()
+        runner = threading.Thread(
+            target=comp2.run_continuous,
+            kwargs=dict(idle_timeout_ms=20, stop_after=600.0),
+            daemon=True)
+        runner.start()
+        time.sleep(0.2)
+        t0 = time.perf_counter()
+        keys = []
+        for i in range(12):
+            key = f"c/{i}"
+            keys.append(key)
+            st.set(key, f"Question number {i} about accelerators?")
+            st.label_or(key, P.LBL_INFER_REQ)
+            st.bump(key)
+            if i % 4 == 3:
+                time.sleep(0.1)
+        deadline = time.perf_counter() + 420
+        while time.perf_counter() < deadline:
+            if all(st.labels(k) & P.LBL_READY for k in keys):
+                break
+            time.sleep(0.01)
+        cont_s = time.perf_counter() - t0
+        comp2.stop()
+        runner.join(timeout=5)
+        done = sum(1 for k in keys if st.labels(k) & P.LBL_READY)
+        cont_tps = comp2.stats.tokens / cont_s if done else 0.0
+        log(f"continuous: {done}/12 ready in {cont_s:.2f}s, "
+            f"{cont_tps:,.1f} aggregate tok/s")
+    finally:
+        st.close()
+        Store.unlink(name)
+
+    return ctx.record({
+        "metric": "completer_e2e_ms",
+        "value": round(e2e_ms, 0), "unit": "ms", "vs_baseline": 0.0,
+        "detail": {
+            "backend": ctx.backend, "geometry": geometry,
+            "quantized": quant,
+            "completer_e2e_ms_32tok": round(e2e_ms, 0),
+            "continuous_12req_s": round(cont_s, 2),
+            "continuous_aggregate_tok_s": round(cont_tps, 1),
+            "continuous_ready": done,
+        }})
+
+
+# ---------------------------------------------------------------------------
+# the series driver
+# ---------------------------------------------------------------------------
+
+PHASE_FNS = {
+    "embed": phase_embed,
+    "profile": phase_profile,
+    "kernels": phase_kernels,
+    "search": phase_search,
+    "decode": phase_decode,
+    "decode_quant": phase_decode_quant,
+    "decode_daemon": phase_decode_daemon,
+}
+
+
+def run_series(phases: tuple[str, ...] | None = None,
+               deadline_epoch: float | None = None) -> SeriesCtx:
+    """Claim the backend once, then run every requested phase with
+    per-phase fencing.  Returns the ctx (ctx.headline = embed record)."""
+    import faulthandler
+
+    # a hung phase must leave a stack before any external kill
+    faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+
+    if phases is None:
+        env = os.environ.get("BENCH_PHASES", "")
+        phases = tuple(p.strip() for p in env.split(",") if p.strip())
+        if not phases:
+            # CPU mode is the quick-tracking path: embed only, so the
+            # old `BENCH_CPU=1 python bench.py` contract stays fast.
+            # A real (TPU) claim runs the full series by default.
+            phases = ("embed",) if os.environ.get("BENCH_CPU") == "1" \
+                else ALL_PHASES
+    bad = set(phases) - set(ALL_PHASES)
+    if bad:
+        raise SystemExit(f"unknown phases: {sorted(bad)}")
+
+    if os.environ.get("BENCH_CPU") == "1":
+        from libsplinter_tpu.utils.jaxplatform import force_cpu
+        force_cpu()
+    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()
+
+    ctx = SeriesCtx(deadline_epoch)
+
+    _stage("client-init")           # first device access claims the tunnel
+    import jax
+
+    ctx.n_devices = len(jax.devices())
+    ctx.backend = jax.default_backend()
+    _stage("client-init-done")
+    log(f"[series] backend={ctx.backend} devices={ctx.n_devices} "
+        f"window={ctx.remaining():.0f}s phases={','.join(phases)}")
+
+    for name in phases:
+        left = ctx.remaining()
+        # embed (the headline) always runs once the claim landed; the
+        # rest must fit the remaining window
+        if name != "embed" and left < PHASE_MIN_S[name]:
+            log(f"[series] SKIP {name}: {left:.0f}s left "
+                f"< {PHASE_MIN_S[name]}s floor")
+            ctx.phase_status[name] = "skipped"
+            continue
+        _stage(f"phase-{name}")
+        t0 = time.perf_counter()
+        try:
+            PHASE_FNS[name](ctx)
+            ctx.phase_status[name] = "ok"
+            log(f"[series] phase {name} done in "
+                f"{time.perf_counter() - t0:.1f}s")
+        except Exception:
+            ctx.phase_status[name] = "failed"
+            log(f"[series] phase {name} FAILED after "
+                f"{time.perf_counter() - t0:.1f}s:\n"
+                f"{traceback.format_exc()}")
+        _stage(f"phase-{name}-done")
+    _stage("series-done")
+    faulthandler.cancel_dump_traceback_later()
+    return ctx
+
+
+def main() -> int:
+    ctx = run_series()
+    if ctx.headline is not None:
+        out = {k: v for k, v in ctx.headline.items() if k != "ts"}
+        # the watcher keeps knocking on an incomplete series; the
+        # driver's scoring consumer ignores the extra keys
+        out["series_complete"] = all(
+            s == "ok" for s in ctx.phase_status.values())
+        out["phase_status"] = ctx.phase_status
+        print(json.dumps(out), flush=True)
+        return 0
+    # headline missing (embed not requested or failed): still exit 0 if
+    # any phase recorded — the ledger holds the evidence
+    return 0 if ctx.records else 1
+
+
+def shim_main(*phases: str) -> int:
+    """Entry point for the thin standalone wrappers (bench_profile.py,
+    bench_decode.py, bench_search.py): run the named phases and print
+    the FIRST record — the wrapper's primary metric — as the script's
+    ONE stdout JSON line (later phases still ledger their records)."""
+    ctx = run_series(phases=phases)
+    if not ctx.records:
+        return 1
+    print(json.dumps({k: v for k, v in ctx.records[0].items()
+                      if k != "ts"}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

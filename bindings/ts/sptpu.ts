@@ -490,10 +490,14 @@ export class Store implements SptStore {
   }
 
   /** Bulk epoch snapshot (one acquire load per slot); diff two
-   *  snapshots for the changed-row set. */
+   *  snapshots for the changed-row set.  Throws on a negative errno
+   *  (stale handle): an all-zero array returned on failure would be
+   *  indistinguishable from a legitimate snapshot and silently break
+   *  diff-based change detectors. */
   epochs(): BigUint64Array {
     const out = new BigUint64Array(this.nslots());
-    this.rt.symbols.spt_epochs(this.h, view(out));
+    const rc = Number(this.rt.symbols.spt_epochs(this.h, view(out)));
+    if (rc < 0) throw new Error(`spt_epochs failed: errno ${-rc}`);
     return out;
   }
 

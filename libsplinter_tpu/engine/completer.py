@@ -511,7 +511,11 @@ class Completer:
 
         rows: list[dict | None] = [None] * B
         toks = np.zeros((B,), np.int32)
-        deferred: set[int] = set()    # oversized joiners, per window
+        # oversized joiners, per window: slot idx -> epoch at deferral.
+        # Keyed on epoch so a recycled slot (deferred key unset, a new
+        # short-prompt request landing in the same slot) is re-checked
+        # instead of skipped until the window resets
+        deferred: dict[int, int] = {}
         rebid_due = 0                 # decoded steps since last rebid
 
         def admit(limit: int | None = None) -> int:
@@ -530,8 +534,14 @@ class Completer:
                     break
                 peek = ids = None
                 if limit is not None:
-                    if idx in deferred:
+                    # epoch read BEFORE the peek: if the slot recycles
+                    # mid-admission we defer under the stale epoch and
+                    # the next pass re-checks (never the reverse —
+                    # a fresh request skipped under an old verdict)
+                    e_seen = st.epoch_at(idx)
+                    if deferred.get(idx) == e_seen:
                         continue      # known oversized: fresh batch only
+                    deferred.pop(idx, None)   # slot changed: re-check
                     # peek BEFORE claiming: an oversized joiner stays
                     # WAITING untouched (a claim would overwrite its
                     # slot with the rendered prompt, double-rendering
@@ -542,7 +552,7 @@ class Completer:
                     ids = self._clip_context(tok_izer.encode(peek[1]),
                                              bucketed=True)
                     if len(ids) > limit:
-                        deferred.add(idx)
+                        deferred[idx] = e_seen
                         continue
                 prep = self._prepare(idx, peek=peek)
                 if prep is None:

@@ -656,12 +656,20 @@ class CompletionModel:
             self.decode_chunk(1, chunk)
         self.reset()
         if batch > 1:
-            n = max(1, self.buckets[0] - 1)
-            self.prefill_batch([np.ones((n,), np.int32)] * batch)
-            if self._pos + chunk <= self.cfg.max_len:
-                self.decode_chunk_batch(np.ones((batch,), np.int32),
-                                        chunk)
-            self.reset()
+            # every bucket, like the serial loop above: the first real
+            # batched/continuous request routed to a wider bucket must
+            # not pay a multi-second on-line compile despite --warmup
+            # (ADVICE r3).  prefill_batch pads to b and parks _pos
+            # there, so the chunk program only fits when
+            # b + chunk <= max_len — but the prefill program itself
+            # compiles unconditionally (the widest bucket IS max_len)
+            for b in self.buckets:
+                n = max(1, b - 1)
+                self.prefill_batch([np.ones((n,), np.int32)] * batch)
+                if b + chunk <= self.cfg.max_len:
+                    self.decode_chunk_batch(np.ones((batch,), np.int32),
+                                            chunk)
+                self.reset()
 
 
 # ------------------------------------------------------ checkpoint loading

@@ -1,0 +1,47 @@
+# libsplinter-tpu — top-level bootstrap (VERDICT r3 #8).
+#
+# One command from a clean checkout to a green suite:
+#
+#   make all        native lib + tools, TAP unit tier, full pytest
+#   make quick      native lib + TAP tier + pytest smoke subset (~2 min)
+#   make check      the native check tier (TAP + MRSW stress + MRMW
+#                   chi-sao) + full pytest
+#   make memcheck   valgrind (if installed) or ASan/UBSan native tier
+#   make bench-cpu  quick host-CPU bench series (embed phase)
+#   make clean
+#
+# Parity: the reference's `configure` + shim Makefile + bigbang.sh
+# (/root/reference/configure:1-60) — here there are no external deps to
+# install (jax & friends are baked into the image; the native tier
+# needs only cc + make), so bootstrap is just build + test.  The build
+# hash the reference stamps via scripts/genbuildh lands in
+# native/build/libsptpu.so as spt_build_id(), surfaced by `caps`.
+
+PY ?= python
+
+all: native
+	native/build/spt_unit
+	$(PY) -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C native all tests
+
+quick: native
+	native/build/spt_unit
+	$(PY) -m pytest tests/test_store.py tests/test_embedder.py \
+		tests/test_cli.py -q
+
+check: native
+	$(MAKE) -C native check
+	$(PY) -m pytest tests/ -q
+
+memcheck: native
+	$(MAKE) -C native memcheck
+
+bench-cpu:
+	BENCH_CPU=1 BENCH_TEXTS=256 BENCH_BATCH=64 $(PY) bench.py
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native quick check memcheck bench-cpu clean

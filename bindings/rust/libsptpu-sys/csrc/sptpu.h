@@ -308,6 +308,12 @@ int spt_vec_gather(spt_store *st, const uint32_t *rows, uint32_t n,
 /* ---- diagnostics ------------------------------------------------------- */
 int spt_report_parse_failure(spt_store *st);
 
+/* Build identity stamped at compile time (git describe + UTC date),
+ * surfaced by the CLI `caps` command.  Parity with the reference's
+ * generated build hash (scripts/genbuildh -> build.h, surfaced by its
+ * caps module). */
+const char *spt_build_id(void);
+
 /* ---- host tokenizer (wptok.c) ------------------------------------------
  * Native tokenization for the embedding daemon's hot path (the
  * reference tokenizes natively via llama.cpp, splinference.cpp:209-217).

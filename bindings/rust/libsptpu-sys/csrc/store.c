@@ -1075,3 +1075,10 @@ int spt_report_parse_failure(spt_store *st) {
                atomic_load(&st->h->global_epoch));
   return 0;
 }
+
+/* Build identity: the Makefile passes -DSPT_BUILD_ID="git-describe/date"
+ * (native/Makefile); a build outside make still links with a sentinel. */
+#ifndef SPT_BUILD_ID
+#define SPT_BUILD_ID "unstamped"
+#endif
+const char *spt_build_id(void) { return SPT_BUILD_ID; }

@@ -215,3 +215,19 @@ def get_lib() -> C.CDLL:
     if _lib is None:
         _lib = load()
     return _lib
+
+
+def build_id() -> str:
+    """Native build identity (git describe + build date, stamped by
+    native/Makefile).  'unstamped' for ad-hoc compiles.  Resolved as an
+    OPTIONAL symbol — a pre-stamp .so must keep loading for every other
+    caller, so spt_build_id is not in _declare's mandatory table."""
+    try:
+        fn = getattr(get_lib(), "spt_build_id", None)
+        if fn is None:
+            return "unavailable (rebuild native/)"
+        fn.restype = C.c_char_p
+        fn.argtypes = []
+        return fn().decode()
+    except OSError:
+        return "unavailable"

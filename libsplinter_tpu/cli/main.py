@@ -460,6 +460,7 @@ def cli_jax():
 @command("caps", "caps", "print build capabilities")
 def cmd_caps(ses, args):
     jax = cli_jax()
+    print(f"build          {N.build_id()}")
     print(f"store format   v{N.get_lib() and 1}")
     print(f"key max        {N.KEY_MAX}")
     print(f"signal groups  {N.SIGNAL_GROUPS}")

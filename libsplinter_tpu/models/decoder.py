@@ -663,12 +663,14 @@ class CompletionModel:
             # there, so the chunk program only fits when
             # b + chunk <= max_len — but the prefill program itself
             # compiles unconditionally (the widest bucket IS max_len)
-            for b in self.buckets:
+            chunk_done = False   # the chunk program is bucket-shape-
+            for b in self.buckets:     # independent: compile it once
                 n = max(1, b - 1)
                 self.prefill_batch([np.ones((n,), np.int32)] * batch)
-                if b + chunk <= self.cfg.max_len:
+                if not chunk_done and b + chunk <= self.cfg.max_len:
                     self.decode_chunk_batch(np.ones((batch,), np.int32),
                                             chunk)
+                    chunk_done = True
                 self.reset()
 
 

@@ -72,6 +72,23 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def append_ledger(rec: dict, *, stamp: bool = True) -> dict:
+    """THE ledger append (every bench entry point routes here so the
+    path, timestamp format, and durability stay in one place).
+    Atomic single write + fsync: evidence must survive a later hang."""
+    rec = dict(rec)
+    if stamp:
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    try:
+        with open(RESULTS_LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        log(f"[series] ledger append failed: {e}")
+    return rec
+
+
 class SeriesCtx:
     """Shared state for one series run: backend, deadline, ledger."""
 
@@ -89,17 +106,8 @@ class SeriesCtx:
         return self.deadline - time.time()
 
     def record(self, rec: dict) -> dict:
-        """Append one measurement to the ledger immediately (atomic
-        single write): evidence must survive a later phase hanging."""
-        rec = dict(rec)
-        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
-        try:
-            with open(RESULTS_LOG, "a") as f:
-                f.write(json.dumps(rec) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-        except OSError as e:
-            log(f"[series] ledger append failed: {e}")
+        """Append one measurement to the ledger immediately."""
+        rec = append_ledger(rec)
         self.records.append(rec)
         return rec
 
@@ -138,7 +146,7 @@ def phase_embed(ctx: SeriesCtx) -> dict:
     r3 #3 asks for (wake / drain / tokenize / dispatch / commit).
 
     Env: BENCH_TEXTS (4096), BENCH_BATCH (512), BENCH_BUCKET (64),
-    BENCH_BUCKETS (16,32,BUCKET)."""
+    BENCH_BUCKETS (16,32,BUCKET), BENCH_P50_PROBES (30)."""
     import threading
 
     import numpy as np
@@ -232,7 +240,8 @@ def phase_embed(ctx: SeriesCtx) -> dict:
             time.sleep(0.05)
 
             lat, lat_timeouts = [], 0
-            for i in range(30):
+            n_probes = int(os.environ.get("BENCH_P50_PROBES", "30"))
+            for i in range(n_probes):
                 key = f"lat/{i}"
                 t1 = time.perf_counter()
                 st.set(key, "latency probe text sample")

@@ -338,10 +338,41 @@ def phase_embed(ctx: SeriesCtx) -> dict:
 # phase: profile — device / sync / pipelined per shape
 # ---------------------------------------------------------------------------
 
+# bf16 peak FLOP/s per chip for MFU accounting, by device_kind prefix
+# (the tunneled dev chip reports "TPU v5 lite").  Rows record the peak
+# they were normalized against so the ledger stays self-describing.
+_TPU_PEAKS = (("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+              ("v4", 275e12), ("v6", 918e12))
+
+
+def _tpu_peak_flops() -> tuple[float, str]:
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    for pat, peak in _TPU_PEAKS:
+        if pat in kind.lower():
+            return peak, kind
+    return 197e12, f"{kind or 'unknown'} (assumed v5e-class)"
+
+
+def _encoder_flops(cfg, batch: int, seq: int) -> float:
+    """Forward matmul FLOPs for one (batch, seq) encode.  Per token
+    per layer (matmul = 2*m*n*k): QKV+O projections 8h^2, attention
+    score+apply 4*S*h, MLP 6*h*mlp for the SwiGLU 'nomic' variant
+    (gate+up+down) or 4*h*mlp for 'bert' (up+down); elementwise/norm
+    terms are noise at these shapes."""
+    h, f = cfg.hidden, cfg.mlp_dim
+    mlp_mats = 6 if cfg.variant == "nomic" else 4
+    per_tok_layer = 8 * h * h + 4 * seq * h + mlp_mats * h * f
+    return float(batch * seq * cfg.layers * per_tok_layer)
+
+
 def phase_profile(ctx: SeriesCtx) -> dict:
     """Decomposition: steady-state device ms, sync-dispatch ms, and
-    async-pipelined ms per (batch, bucket) shape.  Env: PROFILE_SHAPES
-    (512x16,512x32,512x64,8x1024,1x16,1x64), PROFILE_REPS (10)."""
+    async-pipelined ms per (batch, bucket) shape, with TFLOP/s and MFU
+    (vs bf16 peak) on TPU so the gap to target is a measured number.
+    Env: PROFILE_SHAPES (512x16,512x32,512x64,8x1024,1x16,1x64),
+    PROFILE_REPS (10)."""
     import numpy as np
 
     import jax
@@ -391,6 +422,13 @@ def phase_profile(ctx: SeriesCtx) -> dict:
              "pipelined_ms": round(pipe_ms, 2),
              "device_emb_s": round(bsz / dev_ms * 1e3, 0),
              "pipelined_emb_s": round(bsz / pipe_ms * 1e3, 0)}
+        tflops = _encoder_flops(cfg, bsz, bucket) / (dev_ms / 1e3) / 1e12
+        r["device_tflops"] = round(tflops, 2)
+        if ctx.backend == "tpu":
+            peak, kind = _tpu_peak_flops()
+            r["mfu_pct"] = round(100 * tflops * 1e12 / peak, 1)
+            r["mfu_peak_tflops"] = round(peak / 1e12)
+            r["device_kind"] = kind
         rows.append(r)
         log(json.dumps(r))
 

@@ -66,12 +66,18 @@ class Embedder:
                  max_ctx: int = 2048,
                  vector_training: bool = False,
                  group: int = P.GROUP_EMBED,
-                 batch_cap: int = 256):
+                 batch_cap: int = 256,
+                 inflight_depth: int | None = None):
         self.store = store
         self.max_ctx = max_ctx
         self.vector_training = vector_training
         self.group = group
         self.batch_cap = batch_cap
+        # None -> the class attribute, so the pre-knob tuning path
+        # (`Embedder._INFLIGHT_DEPTH = 4`) keeps working
+        self.inflight_depth = (type(self)._INFLIGHT_DEPTH
+                               if inflight_depth is None
+                               else inflight_depth)
         self.stats = EmbedderStats()
         self._known_epochs: dict[int, int] = {}
         # rows believed to need embedding: fed by the dirty mask (hot
@@ -267,7 +273,9 @@ class Embedder:
 
     # how many dispatched encode batches may be outstanding before the
     # host blocks to commit the oldest: with jax's async dispatch the
-    # TPU works on batch k+1..k+DEPTH while the host commits batch k
+    # TPU works on batch k+1..k+depth while the host commits batch k
+    # (instance knob: `inflight_depth`; class default kept for any
+    # external reader of the old name)
     _INFLIGHT_DEPTH = 2
 
     def process_rows(self, rows: list[int]) -> int:
@@ -304,7 +312,7 @@ class Embedder:
 
         def enqueue(rows_b, eps_b, pend):
             inflight.append((rows_b, eps_b, pend))
-            while len(inflight) > self._INFLIGHT_DEPTH:
+            while len(inflight) > self.inflight_depth:
                 commit_oldest()
 
         # guard + tokenize run per window (a few batch_caps): the fused

@@ -258,9 +258,14 @@ def main() -> int:
         attempt_budget = min(ATTEMPT_S, deadline - time.monotonic() - 5)
         # a TPU child too short to survive client-init + compile would
         # be killed mid-claim — the wedge trigger; better to end the
-        # window than to poison the next one.  CPU mode has no tunnel
-        # to protect and honors short quick-tracking windows.
-        if attempt_budget < (30 if CPU_MODE else 240):
+        # window than to poison the next one.  The FIRST attempt runs
+        # in any >=240 s window (an operator's short window still
+        # measures); TAIL children after a failed long attempt need
+        # 600 s — claim waits of minutes are normal, so a sub-10-min
+        # tail child is nearly guaranteed to die waiting (the round-3
+        # wedge mode).  CPU mode has no tunnel to protect.
+        floor_s = 30 if CPU_MODE else (240 if attempts == 0 else 600)
+        if attempt_budget < floor_s:
             break
         attempts += 1
         for path in (stagefile, resultfile):

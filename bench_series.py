@@ -145,6 +145,28 @@ def make_texts(n: int) -> list[str]:
             for _ in range(n)]
 
 
+def _arm_texts(st, texts) -> None:
+    """(Re-)arm bench keys: content write + VARTEXT type + the embed
+    request label — the one protocol the embed phases share."""
+    from libsplinter_tpu import T_VARTEXT
+    from libsplinter_tpu.engine import protocol as P
+
+    for i, t in enumerate(texts):
+        key = f"bench/{i}"
+        st.set(key, t)
+        st.set_type(key, T_VARTEXT)
+        st.label_or(key, P.LBL_EMBED_REQ)
+
+
+def _bench_store_name(suffix: str) -> str:
+    """Parent-chosen store name wherever one exists: bench.py unlinks
+    SPTPU_BENCH_STORE on every failure path, so phases that reuse it
+    cannot leak shm segments when the child is SIGKILLed (phases run
+    sequentially; each closes+unlinks before the next creates)."""
+    return os.environ.get("SPTPU_BENCH_STORE",
+                          f"/spt-{suffix}-{os.getpid()}")
+
+
 def phase_embed(ctx: SeriesCtx) -> dict:
     """End-to-end embedding throughput per chip + p50 set->vector on
     the event-driven wake path, with the per-stage span table VERDICT
@@ -186,19 +208,14 @@ def phase_embed(ctx: SeriesCtx) -> dict:
     log(f"compile: {compile_s:.1f}s")
 
     _stage("stage-store")
-    name = os.environ.get("SPTPU_BENCH_STORE",
-                          f"/spt-series-{os.getpid()}")
+    name = _bench_store_name("series")
     Store.unlink(name)
     st = Store.create(name, nslots=max(8192, n_texts * 2), max_val=2048,
                       vec_dim=768)
     runner = None
     try:
         texts = make_texts(n_texts)
-        for i, t in enumerate(texts):
-            key = f"bench/{i}"
-            st.set(key, t)
-            st.set_type(key, T_VARTEXT)
-            st.label_or(key, P.LBL_EMBED_REQ)
+        _arm_texts(st, texts)
 
         emb = Embedder(st, model=model, tokenizer=tok, max_ctx=2048,
                        batch_cap=batch)
@@ -212,10 +229,7 @@ def phase_embed(ctx: SeriesCtx) -> dict:
         log(f"warm drain: {done}/{n_texts} in "
             f"{time.perf_counter() - t0:.2f}s (compiles included)")
 
-        for i, t in enumerate(texts):       # re-arm every key
-            key = f"bench/{i}"
-            st.set(key, t)
-            st.label_or(key, P.LBL_EMBED_REQ)
+        _arm_texts(st, texts)               # re-arm every key
 
         _stage("throughput")
         t0 = time.perf_counter()
@@ -353,8 +367,7 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
 
     Env: SWEEP_TEXTS (4096), SWEEP_CONFIGS
     ("512x2,512x1,512x4,256x2,1024x2" as batchxdepth)."""
-    from libsplinter_tpu import Store, T_VARTEXT
-    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu import Store
     from libsplinter_tpu.engine.embedder import Embedder
     from libsplinter_tpu.models import (EmbeddingModel, EncoderConfig,
                                         default_tokenizer)
@@ -373,24 +386,21 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
     tok = default_tokenizer(cfg.vocab_size)
     texts = make_texts(n_texts)
 
-    name = f"/spt-sweep-{os.getpid()}"
+    name = _bench_store_name("sweep")
     Store.unlink(name)
     st = Store.create(name, nslots=max(8192, n_texts * 2),
                       max_val=2048, vec_dim=768)
     rows = []
     try:
-        def arm():
-            for i, t in enumerate(texts):
-                key = f"bench/{i}"
-                st.set(key, t)
-                st.set_type(key, T_VARTEXT)
-                st.label_or(key, P.LBL_EMBED_REQ)
-
         warmed: set[int] = set()      # batch_caps whose programs (incl.
         for batch, depth in cfgs:     # pow2 tail shapes) are compiled
-            if ctx.remaining() < 60:
-                log(f"[sweep] window low; stopping before "
-                    f"{batch}x{depth}")
+            # a compile-paying config costs a full untimed warm drain
+            # on top of the timed one; starting it in a thin window
+            # overruns the attempt budget -> killed child -> wedge
+            need = 90 if batch in warmed else 300
+            if ctx.remaining() < need:
+                log(f"[sweep] {ctx.remaining():.0f}s left < {need}s "
+                    f"needed; stopping before {batch}x{depth}")
                 break
             emb = Embedder(st, model=model, tokenizer=tok,
                            max_ctx=2048, batch_cap=batch,
@@ -400,10 +410,10 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
                 # untimed drain absorbs this batch_cap's compiles
                 # (tail shapes are texts+bucket-mix determined, so one
                 # warm per batch_cap covers its depth variants too)
-                arm()
+                _arm_texts(st, texts)
                 emb.run_once()
                 warmed.add(batch)
-            arm()
+            _arm_texts(st, texts)
             t0 = time.perf_counter()
             done = emb.run_once()
             dt = time.perf_counter() - t0
@@ -941,7 +951,7 @@ def phase_decode_daemon(ctx: SeriesCtx) -> dict:
     model, cfg, geometry = _decode_model(quant)
     model.warmup(chunk=chunk)
 
-    name = f"/spt-series-dec-{os.getpid()}"
+    name = _bench_store_name("dec")
     Store.unlink(name)
     st = Store.create(name, nslots=256, max_val=4096, vec_dim=8)
     try:

@@ -73,11 +73,7 @@ class Embedder:
         self.vector_training = vector_training
         self.group = group
         self.batch_cap = batch_cap
-        # None -> the class attribute, so the pre-knob tuning path
-        # (`Embedder._INFLIGHT_DEPTH = 4`) keeps working
-        self.inflight_depth = (type(self)._INFLIGHT_DEPTH
-                               if inflight_depth is None
-                               else inflight_depth)
+        self._inflight_override = inflight_depth
         self.stats = EmbedderStats()
         self._known_epochs: dict[int, int] = {}
         # rows believed to need embedding: fed by the dirty mask (hot
@@ -273,10 +269,22 @@ class Embedder:
 
     # how many dispatched encode batches may be outstanding before the
     # host blocks to commit the oldest: with jax's async dispatch the
-    # TPU works on batch k+1..k+depth while the host commits batch k
-    # (instance knob: `inflight_depth`; class default kept for any
-    # external reader of the old name)
+    # TPU works on batch k+1..k+depth while the host commits batch k.
+    # Tunable three ways, all read live on every drain: the
+    # constructor's inflight_depth, assigning .inflight_depth on an
+    # instance, or the legacy class-attribute path
+    # (`Embedder._INFLIGHT_DEPTH = 4`).
     _INFLIGHT_DEPTH = 2
+
+    @property
+    def inflight_depth(self) -> int:
+        return (type(self)._INFLIGHT_DEPTH
+                if self._inflight_override is None
+                else self._inflight_override)
+
+    @inflight_depth.setter
+    def inflight_depth(self, value: int) -> None:
+        self._inflight_override = value
 
     def process_rows(self, rows: list[int]) -> int:
         """Embed a set of candidate slot indices; returns committed count."""

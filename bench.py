@@ -264,8 +264,11 @@ def main() -> int:
         # 600 s — claim waits of minutes are normal, so a sub-10-min
         # tail child is nearly guaranteed to die waiting (the round-3
         # wedge mode).  CPU mode has no tunnel to protect.
-        floor_s = 30 if CPU_MODE else (240 if attempts == 0 else 600)
+        floor_s = 30 if CPU_MODE else (
+            240 if attempts == 0 else min(600, ATTEMPT_S))
         if attempt_budget < floor_s:
+            log(f"[bench] {attempt_budget:.0f}s left < {floor_s:.0f}s "
+                f"attempt floor; ending the window")
             break
         attempts += 1
         for path in (stagefile, resultfile):

@@ -738,6 +738,37 @@ def phase_search(ctx: SeriesCtx) -> dict:
     gen_s = time.perf_counter() - t0
     QB = 32
     queries = rng.normal(size=(max(reps, QB), d)).astype(np.float32)
+
+    # probe the host->device bandwidth on a small slice first: over
+    # the tunnel it is an unknown, and a 2.9 GB device_put that takes
+    # most of the window would starve the remaining phases.  The probe
+    # is 4096 rows (~12 MB — bounded even at 1 MB/s); n then shrinks
+    # in 2x steps to an 8192-row floor until the projected staging
+    # fits the budget, and a projection that exceeds the budget even
+    # at the floor is logged rather than silently tolerated.
+    probe_rows = min(4096, n)
+    t0 = time.perf_counter()
+    probe = jax.device_put(lane[:probe_rows])
+    jax.block_until_ready(probe)
+    probe_s = max(time.perf_counter() - t0, 1e-6)
+    mb_s = probe_rows * d * 4 / 1e6 / probe_s
+    budget_s = max(ctx.remaining() - 150, 30)
+
+    def proj_s(rows: int) -> float:
+        return rows * d * 4 / 1e6 / mb_s
+
+    while n > 8192 and proj_s(n) > budget_s:
+        n //= 2
+    if n < lane.shape[0]:
+        log(f"[search] staging at {mb_s:,.0f} MB/s would blow the "
+            f"window; lane shrunk to {n} rows")
+        lane = lane[:n]
+    if proj_s(n) > budget_s:
+        log(f"[search] WARNING: even {n} rows project to "
+            f"{proj_s(n):.0f}s staging (> {budget_s:.0f}s budget); "
+            f"proceeding — later phases may be skipped")
+    del probe
+
     t0 = time.perf_counter()
     lane_dev = jax.device_put(lane)
     jax.block_until_ready(lane_dev)

@@ -1,0 +1,195 @@
+"""Sustained MRMW-writers + live embedding daemon (BASELINE.md row
+"32-writer signal-group -> batched TPU embed: sustained, no
+corruption").
+
+The reference's MRMW harness (splinter_chi_sao.c) sustains 32
+disjoint-lane writers for a wall-clock duration and exits nonzero on
+any torn read.  This bench adds the TPU-framework claim on top: a
+CONCURRENT embedding daemon drains the same store via the dirty mask
+the whole time, and every vector it commits must equal the fingerprint
+of a version the key actually held — a torn or mixed gather would
+match NO version.  tests/test_mrmw_embed.py is the CI-scaled version;
+this is the sustained, ledgered one.
+
+Threads, not processes: this sandbox's exec'd siblings lack coherent
+MAP_SHARED views (.claude/skills/verify/SKILL.md); the seqlock
+protocol under test is identical in one address space.
+
+Env: MRMW_WRITERS (32), MRMW_DURATION_S (30), MRMW_KEYS_PER_LANE (4).
+Appends a `mrmw_embed_sustained` record to bench_results.jsonl.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from libsplinter_tpu.utils.fingerprint import (  # noqa: E402
+    DIM, fingerprint as _fingerprint, lane_text as _text)
+
+N_WRITERS = int(os.environ.get("MRMW_WRITERS", "32"))
+DURATION_S = float(os.environ.get("MRMW_DURATION_S", "30"))
+KEYS_PER_LANE = int(os.environ.get("MRMW_KEYS_PER_LANE", "4"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    from libsplinter_tpu import Store, T_VARTEXT
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.embedder import Embedder
+
+    name = f"/spt-mrmwbench-{os.getpid()}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=max(512, N_WRITERS * KEYS_PER_LANE * 4),
+                      max_val=256, vec_dim=DIM)
+    stop = threading.Event()
+    emb = None
+    runner = None
+    threads: list[threading.Thread] = []
+    try:
+        emb = Embedder(st, encoder_fn=lambda ts: np.stack(
+            [_fingerprint(t) for t in ts]), max_ctx=64, batch_cap=64)
+        emb.attach()
+
+        writes = [0] * N_WRITERS
+        max_ver = [0] * N_WRITERS
+
+        def writer(lane: int):
+            rng = np.random.default_rng(lane)
+            ver = 0
+            while not stop.is_set():
+                for i in range(KEYS_PER_LANE):
+                    k = f"lane{lane}/k{i}"
+                    st.set(k, _text(lane, i, ver))
+                    st.set_type(k, T_VARTEXT)
+                    st.label_or(k, P.LBL_EMBED_REQ)
+                    st.bump(k)
+                    writes[lane] += 1
+                max_ver[lane] = ver
+                ver += 1
+                time.sleep(float(rng.uniform(0.0005, 0.005)))
+
+        runner = threading.Thread(
+            target=emb.run,
+            kwargs=dict(idle_timeout_ms=20, sweep_interval_s=0.5),
+            daemon=True)
+        runner.start()
+        threads.extend(threading.Thread(target=writer, args=(w,),
+                                        daemon=True)
+                       for w in range(N_WRITERS))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+
+        # mid-run integrity sampling: every committed vector must match
+        # SOME version's fingerprint for its key (epoch-gated commits
+        # make a superseded-text commit impossible; a torn gather would
+        # match nothing)
+        torn = 0
+        checks = 0
+        sampler_rng = np.random.default_rng(1234)
+        deadline = t0 + DURATION_S
+        while time.perf_counter() < deadline:
+            lane = int(sampler_rng.integers(N_WRITERS))
+            i = int(sampler_rng.integers(KEYS_PER_LANE))
+            k = f"lane{lane}/k{i}"
+            try:
+                got = st.vec_get(k)
+            except KeyError:
+                continue
+            if not np.any(got):
+                continue              # not yet embedded
+            cand = [_text(lane, i, v)
+                    for v in range(max(max_ver[lane] - 2, 0),
+                                   max_ver[lane] + 2)]
+            if not any(np.array_equal(got, _fingerprint(t))
+                       for t in cand):
+                # wide re-check (sampling raced the version counter)
+                if not any(np.array_equal(got, _fingerprint(
+                        _text(lane, i, v)))
+                        for v in range(max_ver[lane] + 2)):
+                    torn += 1
+            checks += 1
+            time.sleep(0.002)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        dt = time.perf_counter() - t0
+        total_writes = sum(writes)
+
+        # convergence: daemon must settle every key to its final text
+        conv_deadline = time.time() + 60
+        remaining = {f"lane{w}/k{i}": w
+                     for w in range(N_WRITERS)
+                     for i in range(KEYS_PER_LANE)}
+        while time.time() < conv_deadline and remaining:
+            for k, w in list(remaining.items()):
+                if st.labels(k) & P.LBL_EMBED_REQ:
+                    continue
+                got = st.vec_get(k)
+                want = _fingerprint(st.get(k).rstrip(b"\0").decode())
+                if np.array_equal(got, want):
+                    del remaining[k]
+            if remaining:
+                time.sleep(0.1)
+        emb.stop()
+        runner.join(timeout=5)
+
+        rec = {
+            "metric": "mrmw_embed_sustained",
+            "value": round(total_writes / dt, 1),
+            "unit": "writes/s (32 writers + live daemon)",
+            "vs_baseline": 0.0,
+            "detail": {
+                "backend": "host+fake-encoder",
+                "writers": N_WRITERS, "duration_s": round(dt, 1),
+                "writes_per_sec": round(total_writes / dt, 1),
+                "embeds_committed": emb.stats.embedded,
+                "embeds_per_sec": round(emb.stats.embedded / dt, 1),
+                "raced_retries": emb.stats.raced,
+                "integrity_checks": checks,
+                "torn_vectors": torn,
+                "unconverged_keys": len(remaining),
+            },
+        }
+        print(json.dumps(rec), flush=True)
+        from bench_series import append_ledger
+        append_ledger(rec)
+        ok = torn == 0 and not remaining
+        log(f"sustained {dt:.1f}s: {total_writes/dt:,.0f} writes/s, "
+            f"{emb.stats.embedded/dt:,.0f} embeds/s, torn={torn}, "
+            f"unconverged={len(remaining)} -> "
+            f"{'OK' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    finally:
+        # stop every thread BEFORE closing the store: native reads on
+        # a closed mapping are use-after-close (an exception mid-run
+        # must not leave 33 threads racing the teardown)
+        stop.set()
+        if emb is not None:
+            emb.stop()
+        for t in threads:
+            t.join(timeout=10)
+        if runner is not None:
+            runner.join(timeout=10)
+        alive = any(t.is_alive() for t in threads) or (
+            runner is not None and runner.is_alive())
+        if alive:
+            log("[mrmw] WARNING: threads did not stop; leaking the "
+                "store to avoid use-after-close")
+        else:
+            st.close()
+            Store.unlink(name)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

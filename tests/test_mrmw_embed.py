@@ -23,21 +23,12 @@ import pytest
 from libsplinter_tpu import Store, T_VARTEXT
 from libsplinter_tpu.engine import protocol as P
 from libsplinter_tpu.engine.embedder import Embedder
+from libsplinter_tpu.utils.fingerprint import DIM
+from libsplinter_tpu.utils.fingerprint import fingerprint as _fingerprint
 
 N_WRITERS = 32                 # the reference harness's writer ceiling
 KEYS_PER_LANE = 4
 VERSIONS = 10
-DIM = 8
-
-
-def _fingerprint(text: str) -> np.ndarray:
-    """Deterministic text -> vector; any torn/mixed read yields a
-    vector matching no (key, version) fingerprint."""
-    h = np.frombuffer(text.encode().ljust(64, b"\0")[:64], np.uint8)
-    v = np.zeros(DIM, np.float32)
-    for i, b in enumerate(h):
-        v[i % DIM] += float(b) * (1 + i)
-    return v
 
 
 def _encoder(texts):

@@ -1128,6 +1128,12 @@ def run_series(phases: tuple[str, ...] | None = None,
                 f"{time.perf_counter() - t0:.1f}s:\n"
                 f"{traceback.format_exc()}")
         _stage(f"phase-{name}-done")
+        if os.environ.get("BENCH_TEST_SLEEP_AFTER") == name:
+            # test hook: simulate the round-3 on-chip hang (a phase
+            # that never returns) so bench.py's recovery path has
+            # automated coverage (tests/test_bench_parent.py)
+            log(f"[series] TEST HOOK: sleeping forever after {name}")
+            time.sleep(1 << 20)
     _stage("series-done")
     faulthandler.cancel_dump_traceback_later()
     return ctx

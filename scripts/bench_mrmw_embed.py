@@ -99,6 +99,9 @@ def main() -> int:
         sampler_rng = np.random.default_rng(1234)
         deadline = t0 + DURATION_S
         while time.perf_counter() < deadline:
+            # sleep FIRST: the continue paths must not busy-spin GIL
+            # time away from the workload being measured
+            time.sleep(0.002)
             lane = int(sampler_rng.integers(N_WRITERS))
             i = int(sampler_rng.integers(KEYS_PER_LANE))
             k = f"lane{lane}/k{i}"
@@ -119,7 +122,6 @@ def main() -> int:
                         for v in range(max_ver[lane] + 2)):
                     torn += 1
             checks += 1
-            time.sleep(0.002)
         stop.set()
         for t in threads:
             t.join(timeout=10)
@@ -178,7 +180,8 @@ def main() -> int:
         if emb is not None:
             emb.stop()
         for t in threads:
-            t.join(timeout=10)
+            if t.ident is not None:   # never-started threads can't join
+                t.join(timeout=10)
         if runner is not None:
             runner.join(timeout=10)
         alive = any(t.is_alive() for t in threads) or (

@@ -1,0 +1,44 @@
+"""bench.py's parent is the tunnel-discipline layer the round's
+evidence depends on; its recovery path (a later series phase hangs →
+the embed headline still gets reported, marked partial) must not
+regress.  Driven as a real subprocess the way the driver/watcher run
+it, with the BENCH_TEST_SLEEP_AFTER hook standing in for the round-3
+on-chip hang."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_timeout_recovers_headline(tmp_path):
+    env = dict(
+        os.environ,
+        BENCH_CPU="1",
+        SPTPU_BENCH_LEDGER=str(tmp_path / "ledger.jsonl"),
+        BENCH_PHASES="embed,profile",
+        BENCH_TEST_SLEEP_AFTER="embed",      # profile never runs
+        BENCH_TEXTS="8", BENCH_BATCH="4", BENCH_BUCKETS="32",
+        BENCH_P50_PROBES="2",
+        BENCH_TIMEOUT="240", BENCH_ATTEMPT_TIMEOUT="90",
+        BENCH_BACKOFF="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=230)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    # the headline survived the hang, marked as an interrupted series
+    assert rec["metric"] == "embeddings_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["series_complete"] is False
+    assert "error" not in rec
+    # and the ledger holds the embed record the child appended itself
+    led = [json.loads(ln) for ln in
+           (tmp_path / "ledger.jsonl").read_text().splitlines()]
+    assert [r["metric"] for r in led] == ["embeddings_per_sec_per_chip"]

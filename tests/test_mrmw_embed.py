@@ -23,7 +23,7 @@ import pytest
 from libsplinter_tpu import Store, T_VARTEXT
 from libsplinter_tpu.engine import protocol as P
 from libsplinter_tpu.engine.embedder import Embedder
-from libsplinter_tpu.utils.fingerprint import DIM
+from libsplinter_tpu.utils.fingerprint import DIM, lane_text
 from libsplinter_tpu.utils.fingerprint import fingerprint as _fingerprint
 
 N_WRITERS = 32                 # the reference harness's writer ceiling
@@ -53,7 +53,7 @@ def test_mrmw_writers_with_live_embedder(tmp_path):
         for ver in range(VERSIONS):
             for i in range(KEYS_PER_LANE):
                 k = f"lane{lane}/k{i}"
-                st.set(k, f"lane{lane} key{i} ver{ver}")
+                st.set(k, lane_text(lane, i, ver))
                 st.set_type(k, T_VARTEXT)
                 st.label_or(k, P.LBL_EMBED_REQ)
                 st.bump(k)
@@ -95,8 +95,9 @@ def test_mrmw_writers_with_live_embedder(tmp_path):
 
     for k in sorted(remaining):       # diagnose: torn vs merely late
         got = st.vec_get(k)
-        texts = [f"{k.split('/')[0]} key{k.split('k')[-1]} ver{v}"
-                 for v in range(VERSIONS)]
+        w = int(k.split("/")[0].removeprefix("lane"))
+        i = int(k.split("k")[-1])
+        texts = [lane_text(w, i, v) for v in range(VERSIONS)]
         matches = [t for t in texts
                    if np.array_equal(got, _fingerprint(t))]
         errors.append(f"{k}: labels={st.labels(k):#x} "

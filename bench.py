@@ -69,8 +69,10 @@ ATTEMPT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT",
 PROBE_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
 BACKOFF_S = float(os.environ.get("BENCH_BACKOFF", "45"))
 CPU_MODE = os.environ.get("BENCH_CPU") == "1"
-RESULTS_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "bench_results.jsonl")
+RESULTS_LOG = os.environ.get(
+    "SPTPU_BENCH_LEDGER",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_results.jsonl"))
 
 
 def log(*a):
@@ -172,10 +174,18 @@ def _acquire_watch_lock(deadline: float):
     client (ADVICE r3 #4)."""
     if CPU_MODE or os.environ.get("BENCH_FROM_WATCHER") == "1":
         return None, True             # no tunnel involved / lock inherited
+    lock_path = os.environ.get("SPTPU_BENCH_LOCK",
+                               "/tmp/tpu_bench_watch.lock")
     try:
         import fcntl
-        lk = open("/tmp/tpu_bench_watch.lock", "w")
+        lk = open(lock_path, "w")
     except OSError:
+        if "SPTPU_BENCH_LOCK" in os.environ:
+            # an explicitly configured lock that cannot open must fail
+            # loudly: degrading to lockless would permit a second
+            # concurrent tunnel client on a misconfigured box
+            log(f"[bench] cannot open SPTPU_BENCH_LOCK={lock_path}")
+            return None, False
         return None, True             # no lock infrastructure: sole client
     import threading
 

@@ -51,7 +51,8 @@ def _take_tunnel_lock():
     """One tunnel client at a time: queue on the watcher's flock and
     hold it for our lifetime (same lock bench.py takes)."""
     import fcntl
-    lk = open("/tmp/tpu_bench_watch.lock", "w")
+    lk = open(os.environ.get("SPTPU_BENCH_LOCK",
+                             "/tmp/tpu_bench_watch.lock"), "w")
     log("[restage] waiting for the tunnel lock ...")
     fcntl.flock(lk, fcntl.LOCK_EX)
     log("[restage] tunnel lock acquired")

@@ -30,7 +30,7 @@ if ! flock -n 8; then
     echo "[watch] another watcher instance is live; exiting" >&2
     exit 1
 fi
-LOCK=/tmp/tpu_bench_watch.lock
+LOCK="${SPTPU_BENCH_LOCK:-/tmp/tpu_bench_watch.lock}"
 exec 9>"$LOCK"
 OUT="/tmp/bench_cycle.$$.json"
 LOG="/tmp/bench_cycle.$$.log"

@@ -14,6 +14,34 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_lock_refusal_instead_of_second_client(tmp_path):
+    """ADVICE r3: with the watcher's flock held for the whole window,
+    bench.py must FAIL with an error JSON — never start a child that
+    would be a second concurrent tunnel client."""
+    import fcntl
+
+    lock_path = tmp_path / "watch.lock"
+    holder = open(lock_path, "w")
+    fcntl.flock(holder, fcntl.LOCK_EX)
+    env = dict(
+        os.environ,
+        SPTPU_BENCH_LOCK=str(lock_path),
+        SPTPU_BENCH_LEDGER=str(tmp_path / "ledger.jsonl"),
+        BENCH_TIMEOUT="75",
+    )
+    env.pop("BENCH_CPU", None)        # CPU mode would skip the lock
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    holder.close()
+    assert proc.returncode == 0
+    rec = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["value"] == 0.0
+    assert "lock not acquired" in rec["error"]
+    assert rec["detail"]["attempts"] == 0     # no child ever spawned
+
+
 def test_timeout_recovers_headline(tmp_path):
     env = dict(
         os.environ,

@@ -679,9 +679,22 @@ def phase_kernels(ctx: SeriesCtx) -> dict:
                                     use_pallas=True, mxu_bf16=True)
         bf16_overlap = len(set(map(int, i_b))
                            & set(map(int, i_j))) / k_top
+        # tile-size sweep: which N-block suits this chip's VMEM (the
+        # default-1024 timing seeds the dict so every tile lives in
+        # one comparable field)
+        bn_sweep = {"1024": round(pal_ms, 2)}
+        for bn in (512, 2048, 4096):
+            try:
+                (_, _), bn_ms = timed(cosine_topk, lane_dev, query,
+                                      k_top, use_pallas=True,
+                                      block_n=bn)
+                bn_sweep[str(bn)] = round(bn_ms, 2)
+            except Exception as e:
+                bn_sweep[str(bn)] = f"failed: {e}"[:120]
         detail["cosine_topk"] = {
             "pallas_ms": round(pal_ms, 2), "jnp_ms": round(jnp_ms, 2),
             "bf16_ms": round(bf16_ms, 2),
+            "block_n_sweep_ms": bn_sweep,
             "topk_overlap_vs_jnp": overlap,
             "score_max_abs_diff": sdiff,
             "bf16_topk_overlap": bf16_overlap,

@@ -178,16 +178,19 @@ def euclidean_distances(vectors, queries, mask=None) -> jnp.ndarray:
 
 
 @functools.lru_cache(maxsize=32)
-def _topk_fn(k: int, batch: bool, use_pallas: bool, mxu_bf16: bool):
+def _topk_fn(k: int, batch: bool, use_pallas: bool, mxu_bf16: bool,
+             block_n: int):
     """One jitted program for score + top-k: the eager per-op dispatch
     over an (N, D) lane costs more than the math on CPU (and leaves
     fusion on the table on TPU), so the whole path compiles once per
-    (k, flags) and is cached."""
+    (k, flags, block_n) and is cached.  Callers normalize block_n to
+    the default on the non-pallas path (where it is ignored) so
+    distinct values don't compile identical programs."""
 
     def run(vectors, queries, mask, vnorm):
         scores = cosine_scores(vectors, queries, mask,
                                use_pallas=use_pallas, mxu_bf16=mxu_bf16,
-                               vnorm=vnorm)
+                               vnorm=vnorm, block_n=block_n)
         if batch:
             return jax.lax.top_k(scores.T, k)
         return jax.lax.top_k(scores[:, 0], k)
@@ -197,25 +200,32 @@ def _topk_fn(k: int, batch: bool, use_pallas: bool, mxu_bf16: bool):
 
 def cosine_topk(vectors, query, k: int, mask=None, *,
                 use_pallas: bool | None = None, mxu_bf16: bool = False,
-                vnorm=None) -> tuple[np.ndarray, np.ndarray]:
+                vnorm=None, block_n: int = 1024
+                ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k most-similar rows for one query.  Returns (scores, indices),
-    scores NEG_INF-padded when fewer than k candidates exist."""
+    scores NEG_INF-padded when fewer than k candidates exist.
+    block_n: pallas N-tile (rows of the lane resident in VMEM per grid
+    step); the default suits the 1M x 768 target, kernels-phase sweeps
+    measure alternatives."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     k = min(k, int(np.asarray(vectors.shape[0])))
-    fn = _topk_fn(k, False, bool(use_pallas), bool(mxu_bf16))
+    fn = _topk_fn(k, False, bool(use_pallas), bool(mxu_bf16),
+                  int(block_n) if use_pallas else 1024)
     top_s, top_i = fn(vectors, query, mask, vnorm)
     return np.asarray(top_s), np.asarray(top_i)
 
 
 def cosine_topk_batch(vectors, queries, k: int, mask=None, *,
                       use_pallas: bool | None = None,
-                      mxu_bf16: bool = False, vnorm=None
+                      mxu_bf16: bool = False, vnorm=None,
+                      block_n: int = 1024
                       ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k per query.  Returns (Q, k) scores and indices."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     k = min(k, int(np.asarray(vectors.shape[0])))
-    fn = _topk_fn(k, True, bool(use_pallas), bool(mxu_bf16))
+    fn = _topk_fn(k, True, bool(use_pallas), bool(mxu_bf16),
+                  int(block_n) if use_pallas else 1024)
     top_s, top_i = fn(vectors, queries, mask, vnorm)
     return np.asarray(top_s), np.asarray(top_i)

@@ -64,12 +64,12 @@ RESULTS_LOG = os.environ.get(
 BASELINE_PER_CHIP = 12_500.0
 
 ALL_PHASES = ("embed", "embed_sweep", "profile", "kernels", "search",
-              "decode", "decode_quant", "decode_daemon")
+              "restage", "decode", "decode_quant", "decode_daemon")
 
 # conservative floor (seconds) a phase needs to be worth starting;
 # compile costs dominate these on a cold .xla_cache
 PHASE_MIN_S = {"embed": 0, "embed_sweep": 120, "profile": 90,
-               "kernels": 120, "search": 150,
+               "kernels": 120, "search": 150, "restage": 180,
                "decode": 180, "decode_quant": 150, "decode_daemon": 120}
 
 
@@ -849,6 +849,107 @@ def phase_search(ctx: SeriesCtx) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: restage — StagedLane O(dirty) refresh cost at scale
+# ---------------------------------------------------------------------------
+
+def phase_restage(ctx: SeriesCtx) -> dict:
+    """StagedLane full-upload vs O(dirty) refresh on a real store
+    (VERDICT r3 #6's scaling property; the 1M CPU record is the
+    at-size evidence, this phase adds the CHIP's transfer numbers at
+    a bounded default).  Env: RESTAGE_N (131072 on TPU / 1,000,000 on
+    CPU), RESTAGE_DIM (768)."""
+    import resource
+
+    import numpy as np
+
+    import jax
+
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.ops.staged_lane import StagedLane
+
+    on_tpu = ctx.backend == "tpu"
+    n = int(os.environ.get("RESTAGE_N",
+                           "131072" if on_tpu else "1000000"))
+    dim = int(os.environ.get("RESTAGE_DIM", "768"))
+    name = _bench_store_name("restage")
+    Store.unlink(name)
+    nslots = 1
+    while nslots < n * 2:
+        nslots *= 2
+    log(f"[restage] store nslots={nslots} dim={dim} "
+        f"({nslots * dim * 4 / 1e9:.2f} GB lane)")
+    st = Store.create(name, nslots=nslots, max_val=64, vec_dim=dim)
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            st.set(f"v/{i}", "x")
+        fill_keys_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rng = np.random.default_rng(0)
+        view = st.vectors
+        chunk = 65536
+        for lo in range(0, nslots, chunk):
+            hi = min(lo + chunk, nslots)
+            view[lo:hi] = rng.standard_normal(
+                (hi - lo, dim), dtype=np.float32)
+        log(f"[restage] populated {n} keys in {fill_keys_s:.1f}s, "
+            f"lane in {time.perf_counter() - t0:.1f}s")
+
+        lane = StagedLane(st)
+        t0 = time.perf_counter()
+        jax.block_until_ready(lane.refresh())
+        full_upload_s = time.perf_counter() - t0
+        log(f"[restage] full upload: {full_upload_s:.2f}s "
+            f"({nslots * dim * 4 / 1e6 / full_upload_s:,.0f} MB/s)")
+
+        def timed_refresh() -> float:
+            t0 = time.perf_counter()
+            jax.block_until_ready(lane.refresh())
+            return (time.perf_counter() - t0) * 1e3
+
+        timed_refresh()
+        clean_ms = min(timed_refresh() for _ in range(5))
+
+        results = {}
+        for k in (128, 8192):
+            # round 1 compiles this pad bucket's scatter; round 2 is
+            # the steady state a live session pays
+            for _ in (0, 1):
+                staged_before = lane.rows_staged
+                idx = rng.choice(n, size=k, replace=False)
+                for i in idx:
+                    st.set(f"v/{i}", "y")
+                ms = timed_refresh()
+                moved = lane.rows_staged - staged_before
+                assert moved == k, (moved, k)
+                results[k] = ms
+            log(f"[restage] refresh after {k} dirty: "
+                f"{results[k]:.1f} ms (warm)")
+    finally:
+        st.close()
+        Store.unlink(name)
+
+    return ctx.record({
+        "metric": "staged_lane_restage",
+        "value": round(results[8192], 1),
+        "unit": f"ms (8192 dirty of {n})",
+        "vs_baseline": 0.0,
+        "detail": {
+            "backend": ctx.backend, "n_keys": n, "nslots": nslots,
+            "dim": dim,
+            "lane_gb": round(nslots * dim * 4 / 1e9, 2),
+            "full_upload_s": round(full_upload_s, 2),
+            "upload_mb_s": round(nslots * dim * 4 / 1e6
+                                 / full_upload_s, 1),
+            "refresh_clean_ms": round(clean_ms, 1),
+            "refresh_128_dirty_ms": round(results[128], 1),
+            "refresh_8192_dirty_ms": round(results[8192], 1),
+            "max_rss_gb": round(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
+        }})
+
+
+# ---------------------------------------------------------------------------
 # phases: decode / decode_quant / decode_daemon
 # ---------------------------------------------------------------------------
 
@@ -1074,6 +1175,7 @@ PHASE_FNS = {
     "profile": phase_profile,
     "kernels": phase_kernels,
     "search": phase_search,
+    "restage": phase_restage,
     "decode": phase_decode,
     "decode_quant": phase_decode_quant,
     "decode_daemon": phase_decode_daemon,

@@ -1,50 +1,33 @@
-"""StagedLane restage cost at the 1M-row BASELINE target (VERDICT r3 #6).
+"""StagedLane restage cost at scale (VERDICT r3 #6).
 
-Builds a real native store with N populated slots and a (N, 768) f32
-vector lane (~2.9 GB at N=1M), then measures:
-
-  - full_upload_s     first refresh: torn-safe lane copy + device_put
-                      + the one-time full norm pass
-  - refresh_clean_ms  refresh with ZERO dirty rows (the per-query cost
-                      a search session pays: one bulk epoch diff)
-  - refresh_k_ms      refresh after touching k rows (k = 128, 8192):
-                      must scale with k (gather + scatter of k rows),
-                      NOT with N — the O(dirty) property the engine's
-                      incremental staging is built on
-  - memory            lane bytes, store mapping bytes, process RSS
-
-Appends a `staged_lane_restage` record to bench_results.jsonl.
+Thin standalone wrapper over bench_series.phase_restage (the single
+implementation the unified tunnel series also runs): builds a real
+native store with N populated slots and a (N, dim) f32 vector lane,
+then measures full-upload vs O(dirty) refresh (clean / 128-dirty /
+8192-dirty) and appends a `staged_lane_restage` record to
+bench_results.jsonl.
 
 Backend: host CPU by DEFAULT (the O(dirty) property is host-side
-bookkeeping + transfer volume, so the CPU run is the scaling
-evidence).  RESTAGE_TPU=1 runs on the chip instead — that path takes
-the tunnel watcher's flock first, because the tunnel admits ONE
-client and a second concurrent client wedges the claim (bench.py's
-discipline).
+bookkeeping + transfer volume).  RESTAGE_TPU=1 runs on the chip
+instead — that path takes the tunnel watcher's flock first, because
+the tunnel admits ONE client (bench.py's discipline).
 
-MEMORY: nslots rounds N up to a power of two with 2x headroom, so
-N=1M maps a 2^21 x 768 f32 lane = ~6.4 GB of shm; peak process
-footprint is ~3x that (mmap lane + the torn-safe host copy + the
-device buffer) — budget ~20 GB for the default run.
+MEMORY at the 1M default: nslots rounds N up to a power of two with
+2x headroom, so N=1M maps a 2^21 x 768 f32 lane = ~6.4 GB of shm;
+peak process footprint is ~3-4x that (mmap lane + torn-safe host copy
++ device buffer + scatter transient) — budget ~25 GB.
 
-Env: RESTAGE_N (default 1,000,000), RESTAGE_DIM (768), RESTAGE_TPU=1.
+Env: RESTAGE_N (default 1,000,000 cpu / 131,072 tpu), RESTAGE_DIM
+(768), RESTAGE_TPU=1.
 """
 from __future__ import annotations
 
-import json
 import os
-import resource
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-N = int(os.environ.get("RESTAGE_N", "1000000"))
-DIM = int(os.environ.get("RESTAGE_DIM", "768"))
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+from bench_series import shim_main  # noqa: E402
 
 
 def _take_tunnel_lock():
@@ -53,115 +36,18 @@ def _take_tunnel_lock():
     import fcntl
     lk = open(os.environ.get("SPTPU_BENCH_LOCK",
                              "/tmp/tpu_bench_watch.lock"), "w")
-    log("[restage] waiting for the tunnel lock ...")
+    print("[restage] waiting for the tunnel lock ...", file=sys.stderr,
+          flush=True)
     fcntl.flock(lk, fcntl.LOCK_EX)
-    log("[restage] tunnel lock acquired")
+    print("[restage] tunnel lock acquired", file=sys.stderr, flush=True)
     return lk
 
 
-def main() -> int:
-    import numpy as np
-
-    _lock = None
-    if os.environ.get("RESTAGE_TPU") == "1":
-        _lock = _take_tunnel_lock()   # held until process exit
-    else:
-        from libsplinter_tpu.utils.jaxplatform import force_cpu
-        force_cpu()
-    from libsplinter_tpu.utils.jaxplatform import enable_compile_cache
-    enable_compile_cache()
-    import jax
-
-    from libsplinter_tpu import Store
-    from libsplinter_tpu.ops.staged_lane import StagedLane
-
-    backend = jax.default_backend()
-    name = f"/spt-restage-{os.getpid()}"
-    Store.unlink(name)
-    nslots = 1
-    while nslots < N * 2:            # headroom against probe clustering
-        nslots *= 2
-    log(f"backend={backend}; creating store nslots={nslots} "
-        f"dim={DIM} ({nslots * DIM * 4 / 1e9:.2f} GB lane) ...")
-    st = Store.create(name, nslots=nslots, max_val=64, vec_dim=DIM)
-    try:
-        t0 = time.perf_counter()
-        for i in range(N):
-            st.set(f"v/{i}", "x")
-        fill_keys_s = time.perf_counter() - t0
-        # lane content: written directly through the mmap view (bulk
-        # numpy assignment; epochs already even+stable from the sets)
-        t0 = time.perf_counter()
-        rng = np.random.default_rng(0)
-        view = st.vectors
-        chunk = 65536
-        for lo in range(0, nslots, chunk):
-            hi = min(lo + chunk, nslots)
-            view[lo:hi] = rng.standard_normal(
-                (hi - lo, DIM), dtype=np.float32)
-        fill_lane_s = time.perf_counter() - t0
-        log(f"populated {N} keys in {fill_keys_s:.1f}s, lane in "
-            f"{fill_lane_s:.1f}s")
-
-        lane = StagedLane(st)
-        t0 = time.perf_counter()
-        arr = lane.refresh()
-        jax.block_until_ready(arr)
-        full_upload_s = time.perf_counter() - t0
-        log(f"full upload: {full_upload_s:.2f}s "
-            f"({nslots * DIM * 4 / 1e6 / full_upload_s:,.0f} MB/s)")
-
-        def timed_refresh() -> float:
-            t0 = time.perf_counter()
-            jax.block_until_ready(lane.refresh())
-            return (time.perf_counter() - t0) * 1e3
-
-        timed_refresh()                       # warm the scatter program
-        clean_ms = min(timed_refresh() for _ in range(5))
-        log(f"clean refresh (0 dirty): {clean_ms:.1f} ms")
-
-        results = {}
-        for k in (128, 8192):
-            # round 1 compiles the scatter program for this pad
-            # bucket; round 2 is the steady state a live session pays
-            for round_i in (0, 1):
-                staged_before = lane.rows_staged
-                idx = rng.choice(N, size=k, replace=False)
-                for i in idx:
-                    st.set(f"v/{i}", "y")     # epoch bump -> dirty
-                ms = timed_refresh()
-                moved = lane.rows_staged - staged_before
-                assert moved == k, (moved, k)
-                results[k] = ms               # keep the warm round
-            log(f"refresh after {k} dirty rows: {results[k]:.1f} ms "
-                f"(warm; compile round excluded)")
-
-        rss_gb = resource.getrusage(
-            resource.RUSAGE_SELF).ru_maxrss / 1e6
-        rec = {
-            "metric": "staged_lane_restage",
-            "value": round(results[8192], 1),
-            "unit": "ms (8192 dirty of 1M)",
-            "vs_baseline": 0.0,
-            "detail": {
-                "backend": backend, "n_keys": N, "nslots": nslots,
-                "dim": DIM,
-                "lane_gb": round(nslots * DIM * 4 / 1e9, 2),
-                "full_upload_s": round(full_upload_s, 2),
-                "refresh_clean_ms": round(clean_ms, 1),
-                "refresh_128_dirty_ms": round(results[128], 1),
-                "refresh_8192_dirty_ms": round(results[8192], 1),
-                "max_rss_gb": round(rss_gb, 2),
-            },
-        }
-        print(json.dumps(rec), flush=True)
-        from bench_series import append_ledger
-        append_ledger(rec)
-    finally:
-        st.close()
-        Store.unlink(name)
-    return 0
-
-
 if __name__ == "__main__":
-    raise SystemExit(main())
+    if os.environ.get("RESTAGE_TPU") == "1":
+        _LOCK = _take_tunnel_lock()   # held until process exit
+    else:
+        # unconditional: an inherited BENCH_CPU=0 must not send the
+        # unlocked path to the single-client tunnel
+        os.environ["BENCH_CPU"] = "1"
+    raise SystemExit(shim_main("restage"))

@@ -36,9 +36,15 @@ Resilience by construction (VERDICT r2 #1, r3 #1):
     the parent reads on timeout, so any hang is attributable;
   - the bench store's shm name is parent-chosen and parent-unlinked on
     every failure path (a SIGKILLed child can't leak it);
-  - on final failure, a ps scan reports candidate tunnel holders and
-    the error JSON carries the most recent in-round real measurement
-    as detail.last_measured.
+  - on final failure, a ps scan reports candidate tunnel holders; if
+    the ledger already holds a real TPU measurement it is PROMOTED to
+    the top-level headline (detail.headline_from_ledger=true, full
+    provenance kept, series_complete=false so the watcher keeps
+    knocking) — a starved window must never report 0.0 over a real
+    number (VERDICT r4 #1a);
+  - a driver-invoked run touches <lock>.driver on entry; the watcher
+    yields between cycles while that flag exists, so a bounded driver
+    window always gets the lock (VERDICT r4 #1b).
 
 Env knobs: BENCH_TIMEOUT, BENCH_ATTEMPT_TIMEOUT, BENCH_PHASES
 (default: the full series), BENCH_CPU=1 (host CPU quick-tracking),
@@ -159,6 +165,21 @@ def _read_resultfile(path: str) -> dict | None:
         return None
 
 
+def _lock_path() -> str:
+    return os.environ.get("SPTPU_BENCH_LOCK", "/tmp/tpu_bench_watch.lock")
+
+
+def _driver_flag_path() -> str:
+    """Per-pid flag file the driver-invoked bench touches on entry so
+    the watcher yields between cycles (VERDICT r4 #1b: the r4 driver
+    window starved for 1,200 s behind a 3,300 s watcher cycle).  The
+    pid lives in the FILENAME so (a) the file identifies its writer
+    from the instant it exists — no empty-content race with the
+    watcher's staleness check — and (b) concurrent drivers each own a
+    distinct flag and can only remove their own."""
+    return f"{_lock_path()}.driver.{os.getpid()}"
+
+
 def _acquire_watch_lock(deadline: float):
     """Coordinate with scripts/tpu_bench_watch.sh: the tunnel admits ONE
     client, so a driver-invoked bench must not start a child while a
@@ -174,8 +195,7 @@ def _acquire_watch_lock(deadline: float):
     client (ADVICE r3 #4)."""
     if CPU_MODE or os.environ.get("BENCH_FROM_WATCHER") == "1":
         return None, True             # no tunnel involved / lock inherited
-    lock_path = os.environ.get("SPTPU_BENCH_LOCK",
-                               "/tmp/tpu_bench_watch.lock")
+    lock_path = _lock_path()
     try:
         import fcntl
         lk = open(lock_path, "w")
@@ -220,7 +240,25 @@ def _acquire_watch_lock(deadline: float):
 def main() -> int:
     if os.environ.get("SPTPU_BENCH_CHILD") == "1":
         return child()
+    if not CPU_MODE and os.environ.get("BENCH_FROM_WATCHER") != "1":
+        # driver-priority flag: the watcher yields between cycles while
+        # this exists, so a bounded driver window always gets the lock
+        try:
+            with open(_driver_flag_path(), "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
+        try:
+            return _driver_main()
+        finally:
+            try:
+                os.unlink(_driver_flag_path())   # ours alone (per-pid)
+            except OSError:
+                pass
+    return _driver_main()
 
+
+def _driver_main() -> int:
     t_start = time.monotonic()
     deadline = t_start + TIMEOUT_S
     _watch_lock, lock_ok = _acquire_watch_lock(deadline)  # held until exit
@@ -240,6 +278,7 @@ def main() -> int:
     attempts = 0
     probes_failed = 0
     last_err = ""
+    restricted_phases = None          # set after a begun-series failure
     while lock_ok:
         remaining = deadline - time.monotonic()
         if remaining < 30:
@@ -286,13 +325,18 @@ def main() -> int:
                 os.unlink(path)
             except OSError:
                 pass
-        # the child budgets its series phases inside the attempt window
-        env["SPTPU_BENCH_DEADLINE_EPOCH"] = str(
+        # per-attempt env copy: a retry restriction must not leak into
+        # later attempts or clobber a caller-supplied BENCH_PHASES
+        # (ADVICE r4)
+        attempt_env = dict(env)
+        attempt_env["SPTPU_BENCH_DEADLINE_EPOCH"] = str(
             time.time() + attempt_budget - 30)
+        if restricted_phases is not None:
+            attempt_env["BENCH_PHASES"] = restricted_phases
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                env=env, timeout=attempt_budget,
+                env=attempt_env, timeout=attempt_budget,
                 stdout=subprocess.PIPE, text=True)
         except subprocess.TimeoutExpired:
             stage = _last_stage(stagefile)
@@ -328,6 +372,17 @@ def main() -> int:
         if proc.returncode == 0 and line:
             # the child (bench_series) already appended every phase's
             # record to bench_results.jsonl itself
+            if restricted_phases is not None:
+                # a phases-restricted retry can never have completed the
+                # full series, whatever the child computed (ADVICE r4):
+                # the watcher must keep knocking for the missing phases
+                try:
+                    rec = json.loads(line)
+                    rec["series_complete"] = False
+                    rec["phases_restricted"] = restricted_phases
+                    line = json.dumps(rec)
+                except ValueError:
+                    pass
             print(line, flush=True)
             _cleanup_store(store_name)
             return 0
@@ -349,7 +404,7 @@ def main() -> int:
             # need the missing headline, not a duplicate full series
             log("[bench] series had begun; retries run the embed "
                 "phase only")
-            env["BENCH_PHASES"] = "embed"
+            restricted_phases = "embed"
         time.sleep(min(BACKOFF_S, max(0.0, deadline - time.monotonic())))
 
     if not lock_ok:
@@ -357,23 +412,90 @@ def main() -> int:
                     "refused to start a second concurrent tunnel client")
 
     _cleanup_store(store_name)
+    saved = _read_resultfile(resultfile)
+    if saved is not None:
+        # the LAST child of this window crashed after the embed phase
+        # landed (rc!=0 path) — that is a FRESH in-window measurement,
+        # already ledgered by the child; report it as an interrupted
+        # series, not as cross-window ledger provenance (the watcher
+        # escalates on fresh partials but naps on promoted ones)
+        saved["series_complete"] = False
+        saved["interrupted_at"] = _last_stage(stagefile)
+        log("[bench] window ended after a child crash, but the embed "
+            "headline landed in-window; reporting the recovered "
+            "(partial) measurement")
+        print(json.dumps(saved), flush=True)
+        return 0
     suspects = _tunnel_suspects()
     detail = {
         "timeout_s": TIMEOUT_S, "attempts": attempts,
         "probes_failed": probes_failed,
         "tunnel_suspects": suspects,
     }
+    window_err = (f"no successful measurement in {TIMEOUT_S:.0f}s window "
+                  f"({attempts} child attempts, {probes_failed} failed "
+                  f"probes); last: {last_err}")
     last = _latest_recorded()
-    if last is not None:
+    if CPU_MODE and last is not None:
+        # the promotion rationale (starved tunnel window) doesn't apply
+        # to CPU quick-tracking, which has no tunnel: a failed CPU run
+        # must not be masked by a chip number from another backend
         detail["last_measured"] = last
-    emit(0.0, 0.0, detail,
-         error=f"no successful measurement in {TIMEOUT_S:.0f}s window "
-               f"({attempts} child attempts, {probes_failed} failed probes); "
-               f"last: {last_err}"
-               + ("" if last is None else
-                  " — see detail.last_measured for the most recent "
-                  "in-round real measurement"))
+        emit(0.0, 0.0, detail, error=window_err)
+        return 0
+    age_h = _record_age_hours(last) if last is not None else None
+    max_age_h = float(os.environ.get("BENCH_PROMOTE_MAX_AGE_H", "36"))
+    if last is not None and (age_h is None or age_h > max_age_h):
+        # the ledger is a committed cross-round file; a measurement
+        # older than ~a round must not masquerade as this round's
+        # headline — report it as context only
+        detail["last_measured"] = last
+        if age_h is not None:
+            detail["last_measured_age_h"] = round(age_h, 1)
+        emit(0.0, 0.0, detail,
+             error=window_err + " — see detail.last_measured for the "
+                   "most recent (stale) real measurement")
+        return 0
+    if last is not None:
+        # VERDICT r4 #1a: a real chip measurement already in the ledger
+        # IS the round's headline — a starved window must not demote it
+        # to 0.0.  Provenance is preserved; series_complete=False keeps
+        # the watcher knocking for a fresh in-window claim.
+        detail["headline_from_ledger"] = True
+        detail["ledger_ts"] = last.get("ts")
+        detail["ledger_age_h"] = round(age_h, 1)
+        detail["ledger_detail"] = last.get("detail")
+        detail["window_error"] = window_err
+        rec = {
+            "metric": last.get("metric", "embeddings_per_sec_per_chip"),
+            "value": last.get("value", 0.0),
+            "unit": last.get("unit", "embeddings/s"),
+            "vs_baseline": last.get("vs_baseline", 0.0),
+            "series_complete": False,
+            "detail": detail,
+        }
+        log(f"[bench] window failed ({last_err}) — promoting the most "
+            f"recent ledgered TPU measurement ({rec['value']} emb/s, "
+            f"ts {detail['ledger_ts']}) to the headline")
+        print(json.dumps(rec), flush=True)
+        return 0
+    emit(0.0, 0.0, detail, error=window_err)
     return 0
+
+
+def _record_age_hours(rec: dict) -> float | None:
+    """Hours since the ledger record's timestamp; None if unparsable."""
+    ts = rec.get("ts")
+    if not ts:
+        return None
+    from datetime import datetime, timezone
+
+    from bench_series import TS_FMT
+    try:
+        then = datetime.strptime(ts, TS_FMT)
+    except ValueError:
+        return None
+    return (datetime.now(timezone.utc) - then).total_seconds() / 3600.0
 
 
 def _latest_recorded() -> dict | None:

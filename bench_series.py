@@ -50,6 +50,7 @@ the per-phase knobs documented on each phase function.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 import sys
@@ -62,6 +63,8 @@ sys.path.insert(0, REPO)
 RESULTS_LOG = os.environ.get(
     "SPTPU_BENCH_LEDGER", os.path.join(REPO, "bench_results.jsonl"))
 BASELINE_PER_CHIP = 12_500.0
+# ledger timestamp format — shared with bench.py's age check
+TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
 
 ALL_PHASES = ("embed", "embed_sweep", "profile", "kernels", "search",
               "restage", "decode", "decode_quant", "decode_daemon")
@@ -83,7 +86,7 @@ def append_ledger(rec: dict, *, stamp: bool = True) -> dict:
     Atomic single write + fsync: evidence must survive a later hang."""
     rec = dict(rec)
     if stamp:
-        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        rec["ts"] = time.strftime(TS_FMT)
     try:
         with open(RESULTS_LOG, "a") as f:
             f.write(json.dumps(rec) + "\n")
@@ -1188,8 +1191,13 @@ def run_series(phases: tuple[str, ...] | None = None,
     per-phase fencing.  Returns the ctx (ctx.headline = embed record)."""
     import faulthandler
 
-    # a hung phase must leave a stack before any external kill
-    faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+    # a hung phase must leave a stack before any external kill (skipped
+    # when stderr has no fileno, e.g. under pytest capture)
+    try:
+        faulthandler.dump_traceback_later(600, repeat=True,
+                                          file=sys.stderr)
+    except (ValueError, OSError, io.UnsupportedOperation):
+        pass
 
     if phases is None:
         env = os.environ.get("BENCH_PHASES", "")
@@ -1231,6 +1239,16 @@ def run_series(phases: tuple[str, ...] | None = None,
             ctx.phase_status[name] = "skipped"
             continue
         _stage(f"phase-{name}")
+        if os.environ.get("BENCH_TEST_CRASH_AT") == name:
+            # test hook: hard-crash mid-phase (at most once when
+            # BENCH_TEST_CRASH_ONCE names a flag file) so bench.py's
+            # restricted-retry path has automated coverage
+            flagp = os.environ.get("BENCH_TEST_CRASH_ONCE", "")
+            if not flagp or not os.path.exists(flagp):
+                if flagp:
+                    open(flagp, "w").close()
+                log(f"[series] TEST HOOK: crashing at {name}")
+                os._exit(3)
         t0 = time.perf_counter()
         try:
             PHASE_FNS[name](ctx)
@@ -1243,6 +1261,12 @@ def run_series(phases: tuple[str, ...] | None = None,
                 f"{time.perf_counter() - t0:.1f}s:\n"
                 f"{traceback.format_exc()}")
         _stage(f"phase-{name}-done")
+        if os.environ.get("BENCH_TEST_CRASH_AFTER") == name:
+            # test hook: hard-crash AFTER a phase ledgered, on every
+            # attempt — drives bench.py's end-of-window recovery of a
+            # fresh in-window headline from a crashed (rc!=0) child
+            log(f"[series] TEST HOOK: crashing after {name}")
+            os._exit(3)
         if os.environ.get("BENCH_TEST_SLEEP_AFTER") == name:
             # test hook: simulate the round-3 on-chip hang (a phase
             # that never returns) so bench.py's recovery path has
@@ -1259,9 +1283,12 @@ def main() -> int:
     if ctx.headline is not None:
         out = {k: v for k, v in ctx.headline.items() if k != "ts"}
         # the watcher keeps knocking on an incomplete series; the
-        # driver's scoring consumer ignores the extra keys
+        # driver's scoring consumer ignores the extra keys.  Complete
+        # means ALL_PHASES ran ok — a phase-restricted run (retry after
+        # a crash, user selection) must not masquerade as the full
+        # evidence set (ADVICE r4).
         out["series_complete"] = all(
-            s == "ok" for s in ctx.phase_status.values())
+            ctx.phase_status.get(p) == "ok" for p in ALL_PHASES)
         out["phase_status"] = ctx.phase_status
         print(json.dumps(out), flush=True)
         return 0

@@ -97,6 +97,31 @@ def test_headline_recovery_file(ledger, monkeypatch, tmp_path):
     assert led[0]["detail"]["p50_samples"] == 2
 
 
+def test_series_complete_requires_all_phases(ledger, monkeypatch, capsys):
+    """ADVICE r4 (medium): series_complete means ALL_PHASES ran ok — a
+    phase-restricted run must report false even when everything it was
+    asked to run succeeded."""
+    def embed_phase(ctx):
+        ctx.headline = ctx.record(
+            {"metric": "embeddings_per_sec_per_chip", "value": 5.0,
+             "unit": "u", "vs_baseline": 0.1})
+
+    monkeypatch.setitem(bench_series.PHASE_FNS, "embed", embed_phase)
+    monkeypatch.setenv("BENCH_PHASES", "embed")
+    assert bench_series.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["series_complete"] is False
+
+    for name in bench_series.ALL_PHASES:
+        if name != "embed":
+            monkeypatch.setitem(
+                bench_series.PHASE_FNS, name, lambda ctx: None)
+    monkeypatch.setenv("BENCH_PHASES", ",".join(bench_series.ALL_PHASES))
+    assert bench_series.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["series_complete"] is True
+
+
 def test_kernels_phase_real(ledger, monkeypatch):
     """The kernels phase end to end at tiny sizes: every kernel runs
     (interpret mode off-TPU), numerics checked vs the jnp oracle, and

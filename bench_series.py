@@ -67,13 +67,15 @@ BASELINE_PER_CHIP = 12_500.0
 TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
 
 ALL_PHASES = ("embed", "embed_sweep", "profile", "kernels", "search",
-              "restage", "decode", "decode_quant", "decode_daemon")
+              "restage", "decode", "decode_quant", "decode_daemon",
+              "store_ops")
 
 # conservative floor (seconds) a phase needs to be worth starting;
 # compile costs dominate these on a cold .xla_cache
 PHASE_MIN_S = {"embed": 0, "embed_sweep": 120, "profile": 90,
                "kernels": 120, "search": 150, "restage": 180,
-               "decode": 180, "decode_quant": 150, "decode_daemon": 120}
+               "decode": 180, "decode_quant": 150, "decode_daemon": 120,
+               "store_ops": 15}
 
 
 def log(*a):
@@ -1172,6 +1174,82 @@ def phase_decode_daemon(ctx: SeriesCtx) -> dict:
 # the series driver
 # ---------------------------------------------------------------------------
 
+def phase_store_ops(ctx: SeriesCtx) -> dict:
+    """Raw store throughput + cycles-per-op vs the reference's own
+    published numbers (VERDICT r4 #5): MRSW and 32-writer MRMW ops/s
+    from the native stress harnesses (spt_stress/spt_chi_sao --json)
+    and the clean single-thread write CPO, ledgered alongside the
+    reference contract (/root/reference/README.md:130-133: 3.2M MRSW,
+    15.6M MRMW ops/s, CPO~937; splinter.h:553-555).  Host-only — no
+    device is touched.  Env: STORE_OPS_MS (duration per tool, default
+    3000), STORE_OPS_WRITERS (default 32)."""
+    import subprocess
+
+    dur = os.environ.get("STORE_OPS_MS", "3000")
+    writers = os.environ.get("STORE_OPS_WRITERS", "32")
+    build = os.path.join(REPO, "native", "build")
+    # build/refresh the harnesses (make is a fast no-op when current) —
+    # native/build is gitignored, so a fresh host has no binaries and a
+    # stale pre---json binary would silently ignore the flag
+    mk = subprocess.run(["make", "tests"],
+                        cwd=os.path.join(REPO, "native"),
+                        capture_output=True, text=True, timeout=120)
+    if mk.returncode != 0:
+        raise RuntimeError(f"make tests failed: {mk.stderr[-400:]}")
+
+    def run_tool(args):
+        out = subprocess.run(args, capture_output=True, text=True,
+                             timeout=120, cwd=REPO)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"{args[0]} rc={out.returncode}: {out.stderr[-400:]}")
+        lines = [ln for ln in out.stdout.splitlines()
+                 if ln.startswith("{")]
+        if not lines:
+            raise RuntimeError(
+                f"{args[0]} emitted no JSON line — stale binary "
+                f"without --json support? (rebuild: make -C native "
+                f"tests)")
+        return json.loads(lines[-1])
+
+    mrsw_raw = run_tool([os.path.join(build, "spt_stress"),
+                         "--duration-ms", dur, "--raw", "--json"])
+    mrsw = run_tool([os.path.join(build, "spt_stress"),
+                     "--duration-ms", dur, "--json"])
+    mrmw = run_tool([os.path.join(build, "spt_chi_sao"),
+                     "--writers", writers, "--duration-ms", dur,
+                     "--json"])
+    if mrsw_raw["corrupt"] or mrsw["corrupt"] or mrmw["corrupt"]:
+        raise RuntimeError("integrity failure under stress")
+    ncpu = os.cpu_count() or 1
+    ref = {"mrsw_ops_per_sec": 3.2e6, "mrmw_ops_per_sec": 15.6e6,
+           "write_cpo": 937.0}
+    return ctx.record({
+        "metric": "store_ops_per_sec",
+        "value": round(mrsw_raw["ops_per_sec"], 0),
+        "unit": "ops/s (raw MRSW, 1w+7r)",
+        "vs_baseline": round(mrsw_raw["ops_per_sec"]
+                             / ref["mrsw_ops_per_sec"], 3),
+        "detail": {
+            "backend": "host",
+            "host_cores": ncpu,
+            "mrsw_raw": mrsw_raw,
+            "mrsw_structured": mrsw,
+            "mrmw": mrmw,
+            "write_cpo": mrsw_raw["write_cpo"],
+            "cpo_vs_reference": round(
+                mrsw_raw["write_cpo"] / ref["write_cpo"], 3),
+            "mrmw_vs_reference": round(
+                mrmw["ops_per_sec"] / ref["mrmw_ops_per_sec"], 3),
+            "reference": ref,
+            "note": ("reference numbers were published from a "
+                     "many-core box; this host has "
+                     f"{ncpu} core(s) — CPO is the core-count-"
+                     "independent comparison"),
+        },
+    })
+
+
 PHASE_FNS = {
     "embed": phase_embed,
     "embed_sweep": phase_embed_sweep,
@@ -1182,6 +1260,7 @@ PHASE_FNS = {
     "decode": phase_decode,
     "decode_quant": phase_decode_quant,
     "decode_daemon": phase_decode_daemon,
+    "store_ops": phase_store_ops,
 }
 
 

@@ -80,14 +80,18 @@ static void *reader(void *arg) {
 }
 
 int main(int argc, char **argv) {
-  int readers = 4, duration_ms = 5000, slots = 50000;
-  for (int i = 1; i < argc - 1; i++) {
-    if (!strcmp(argv[i], "--writers")) g_writers = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--readers")) readers = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--keys-per-lane"))
+  int readers = 4, duration_ms = 5000, slots = 50000, json_out = 0;
+  for (int i = 1; i < argc; i++) {
+    int has_val = i + 1 < argc;
+    if (!strcmp(argv[i], "--writers") && has_val) g_writers = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--readers") && has_val)
+      readers = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--keys-per-lane") && has_val)
       g_keys_per_lane = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--duration-ms")) duration_ms = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--slots")) slots = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--duration-ms") && has_val)
+      duration_ms = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--slots") && has_val) slots = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--json")) json_out = 1;
   }
   if (g_writers > 32) g_writers = 32;  /* the 32-writer design ceiling */
   char name[64];
@@ -115,6 +119,11 @@ int main(int argc, char **argv) {
   printf("  writes=%ld (%.2fM/s)  reads=%ld (%.2fM/s)  total=%.2fM ops/s\n",
          w, w / secs / 1e6, r, r / secs / 1e6, (w + r) / secs / 1e6);
   printf("  eagain=%ld  corrupt=%ld\n", e, c);
+  if (json_out)
+    printf("{\"tool\": \"mrmw\", \"writers\": %d, \"readers\": %d, "
+           "\"duration_s\": %.2f, \"writes\": %ld, \"reads\": %ld, "
+           "\"ops_per_sec\": %.0f, \"eagain\": %ld, \"corrupt\": %ld}\n",
+           g_writers, readers, secs, w, r, (w + r) / secs, e, c);
   spt_close(g_st);
   spt_unlink(name, 0);
   if (c) { fprintf(stderr, "INTEGRITY FAILURE\n"); return 1; }

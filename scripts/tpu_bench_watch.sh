@@ -21,9 +21,14 @@
 #
 # Driver priority (VERDICT r4 #1b): a driver-invoked bench.py touches
 # $LOCK.driver.<pid> on entry; while any live driver's flag exists this
-# watcher never starts a cycle, so a bounded driver window always gets
-# the lock.  A flag whose pid is dead (driver SIGKILLed, cleanup never
-# ran) is stale and removed — it must not disable the watcher.
+# watcher never STARTS a cycle, so against probe cycles (<=600 s) a
+# bounded driver window always gets the lock.  A driver arriving
+# mid-BANK-cycle can still wait up to WATCH_BANK seconds — preempting
+# a measuring child would kill a claim-holding client (the wedge
+# trigger) and lose the series; the driver's ledger-promotion fallback
+# reports the bank cycle's freshly ledgered headline in that case.
+# A flag whose pid is dead (driver SIGKILLed, cleanup never ran) is
+# stale and removed — it must not disable the watcher.
 #
 # On a granted claim the child runs the ENTIRE series (embed/profile/
 # kernels/search/restage/decode — bench_series.py) inside that one

@@ -1197,9 +1197,11 @@ def phase_store_ops(ctx: SeriesCtx) -> dict:
     if mk.returncode != 0:
         raise RuntimeError(f"make tests failed: {mk.stderr[-400:]}")
 
+    tool_timeout = max(120.0, int(dur) / 1000.0 + 60.0)
+
     def run_tool(args):
         out = subprocess.run(args, capture_output=True, text=True,
-                             timeout=120, cwd=REPO)
+                             timeout=tool_timeout, cwd=REPO)
         if out.returncode != 0:
             raise RuntimeError(
                 f"{args[0]} rc={out.returncode}: {out.stderr[-400:]}")

@@ -11,16 +11,22 @@ that store scripts actually use:
   exprs       full operator precedence (or/and, comparisons, .., + - * / //
               % ^, unary - not #), closures, varargs (...), method calls,
               table constructors
+  metatables  setmetatable/getmetatable (incl. __metatable protection),
+              __index/__newindex (table + function handlers, chained),
+              arithmetic (__add __sub __mul __div __idiv __mod __pow
+              __unm), __concat, __eq/__lt/__le, __len, __call,
+              __tostring — the full OO-style store-script surface
+              (reference embeds liblua 5.4, splinter_cli_cmd_lua.c:365-386)
   stdlib      print, type, tostring, tonumber, pairs, ipairs, select,
-              pcall, error, assert, rawget/rawset, unpack,
+              pcall, error, assert, rawget/rawset/rawequal/rawlen, unpack,
               string.(format sub len upper lower rep byte char find gsub),
               table.(insert remove concat unpack), math.(floor ceil abs min
               max sqrt huge pi fmod max min tointeger), os.(time clock),
               require (host-registered modules only)
 
 Deliberately out of scope (scripts needing these belong in Python):
-metatables, coroutines, goto, bitwise operators (use splinter.math — the
-store's atomic ops — instead), io/file access (the store IS the I/O).
+coroutines, goto, bitwise operators (use splinter.math — the store's
+atomic ops — instead), io/file access (the store IS the I/O).
 
 Lua semantics kept faithfully: 1-based arrays, # border rule, integer vs
 float arithmetic (/ is float, // is floor), .. coerces numbers, only nil
@@ -518,11 +524,14 @@ class _Parser:
 # =================================================================== runtime
 
 class LuaTable:
-    """A Lua table: unified hash with Lua's # border semantics."""
-    __slots__ = ("data",)
+    """A Lua table: unified hash with Lua's # border semantics and an
+    optional metatable (set via setmetatable; consulted by the runtime
+    for __index/__newindex/arith/compare/__call/__len/__tostring)."""
+    __slots__ = ("data", "metatable")
 
     def __init__(self, items: Optional[dict] = None):
         self.data: dict = dict(items) if items else {}
+        self.metatable: Optional["LuaTable"] = None
 
     def get(self, key):
         key = _normkey(key)
@@ -717,8 +726,88 @@ class LuaRuntime:
             return r.values
         return ()
 
+    # -- metatable machinery ---------------------------------------------
+    @staticmethod
+    def _getmeta(v, event: str):
+        """The handler for `event` from v's metatable, or None."""
+        if isinstance(v, LuaTable) and v.metatable is not None:
+            return v.metatable.get(event)
+        return None
+
+    def index_value(self, obj, key, line: int):
+        """Table/string read honoring __index chains (lua 5.4
+        semantics: raw hit wins; else a table handler is re-indexed, a
+        function handler is called with (t, key))."""
+        for _ in range(100):
+            if isinstance(obj, LuaTable):
+                raw = obj.get(key)
+                if raw is not None:
+                    return raw
+                h = self._getmeta(obj, "__index")
+                if h is None:
+                    return None
+                if isinstance(h, LuaTable):
+                    obj = h
+                    continue
+                res = self.call(h, (obj, key))
+                return res[0] if res else None
+            if isinstance(obj, str):
+                strlib = self.globals.get("string")
+                if isinstance(strlib, LuaTable):   # "x":upper() idiom
+                    return strlib.get(key)
+                return None
+            raise LuaError(f"line {line}: attempt to index a "
+                           f"{lua_typename(obj)} value")
+        raise LuaError(f"line {line}: '__index' chain too long; "
+                       f"possible loop")
+
+    def newindex_value(self, obj, key, value, line: int) -> None:
+        """Table write honoring __newindex (raw hit or no handler
+        writes raw; a table handler is re-assigned into, a function
+        handler is called with (t, key, value))."""
+        for _ in range(100):
+            if not isinstance(obj, LuaTable):
+                raise LuaError(f"line {line}: attempt to index a "
+                               f"{lua_typename(obj)} value")
+            h = self._getmeta(obj, "__newindex")
+            if h is None or obj.get(key) is not None:
+                obj.set(key, value)
+                return
+            if isinstance(h, LuaTable):
+                obj = h
+                continue
+            self.call(h, (obj, key, value))
+            return
+        raise LuaError(f"line {line}: '__newindex' chain too long; "
+                       f"possible loop")
+
+    def tostring(self, v) -> str:
+        """lua_tostring honoring __tostring."""
+        h = self._getmeta(v, "__tostring")
+        if h is not None:
+            res = self.call(h, (v,))
+            out = res[0] if res else None
+            if not isinstance(out, str):
+                raise LuaError("'__tostring' must return a string")
+            return out
+        return lua_tostring(v)
+
+    def _binmeta(self, event: str, lv, rv, line: int, errmsg: str):
+        """Dispatch a binary metamethod from either operand (left
+        first, per lua), or raise the original error message."""
+        h = self._getmeta(lv, event)
+        if h is None:
+            h = self._getmeta(rv, event)
+        if h is None:
+            raise LuaError(errmsg)
+        res = self.call(h, (lv, rv))
+        return res[0] if res else None
+
     def call(self, fn, args: tuple) -> tuple:
         """Call a Lua or host function with python args, tuple of results."""
+        h = self._getmeta(fn, "__call")
+        if h is not None:
+            return self.call(h, (fn,) + args)
         if isinstance(fn, LuaFunction):
             env = _Env({}, fn.env)
             for i, p in enumerate(fn.params):
@@ -728,6 +817,13 @@ class LuaRuntime:
                 self.exec_block(fn.body, env, varargs)
             except _Return as r:
                 return r.values
+            except RecursionError:
+                # translate HERE, the one chokepoint every lua-level
+                # call goes through (incl. metamethod dispatch, which
+                # never passes the eval_multi 'call' branch), so a
+                # runaway recursive script can never crash the host
+                # with a raw python RecursionError
+                raise LuaError("stack overflow") from None
             return ()
         if callable(fn):
             out = fn(*args)
@@ -855,10 +951,7 @@ class LuaRuntime:
         else:  # index
             obj = self.eval(tgt[1], env, varargs)
             key = self.eval(tgt[2], env, varargs)
-            if not isinstance(obj, LuaTable):
-                raise LuaError(f"line {tgt[3]}: attempt to index a "
-                               f"{lua_typename(obj)} value")
-            obj.set(key, value)
+            self.newindex_value(obj, key, value, tgt[3])
 
     # -- expression evaluation -------------------------------------------
     def eval_explist(self, exprs, env, varargs, want: int) -> list:
@@ -893,15 +986,9 @@ class LuaRuntime:
                 raise LuaError(f"line {e[3]}: stack overflow")
         if tag == "method":
             obj = self.eval(e[1], env, varargs)
-            if isinstance(obj, LuaTable):
-                fn = obj.get(e[2])
-            elif isinstance(obj, str):   # "x":upper() routes to string lib
-                strlib = self.globals.get("string")
-                fn = strlib.get(e[2]) if isinstance(strlib, LuaTable) \
-                    else None
-            else:
-                raise LuaError(f"line {e[4]}: attempt to index a "
-                               f"{lua_typename(obj)} value")
+            # __index-aware lookup: obj:method() on an instance whose
+            # class methods live behind setmetatable(obj, {__index=C})
+            fn = self.index_value(obj, e[2], e[4])
             if fn is None:
                 raise LuaError(f"line {e[4]}: attempt to call a nil value "
                                f"(method '{e[2]}')")
@@ -928,14 +1015,7 @@ class LuaRuntime:
         if tag == "index":
             obj = self.eval(e[1], env, varargs)
             key = self.eval(e[2], env, varargs)
-            if isinstance(obj, LuaTable):
-                return obj.get(key)
-            if isinstance(obj, str):
-                strlib = self.globals.get("string")
-                if isinstance(strlib, LuaTable):   # "x":upper() idiom
-                    return strlib.get(key)
-            raise LuaError(f"line {e[3]}: attempt to index a "
-                           f"{lua_typename(obj)} value")
+            return self.index_value(obj, key, e[3])
         if tag == "function":
             _, params, va, body, _line = e
             return LuaFunction(params, va, body, env)
@@ -958,12 +1038,23 @@ class LuaRuntime:
             _, op, oe, line = e
             v = self.eval(oe, env, varargs)
             if op == "-":
-                return -_arith_operand(v, "-", line)
+                try:
+                    return -_arith_operand(v, "-", line)
+                except LuaError as exc:
+                    h = self._getmeta(v, "__unm")
+                    if h is None:
+                        raise exc
+                    res = self.call(h, (v, v))
+                    return res[0] if res else None
             if op == "not":
                 return not _truthy(v)
             if op == "#":
                 if isinstance(v, str):
                     return len(v)
+                h = self._getmeta(v, "__len")
+                if h is not None:
+                    res = self.call(h, (v,))
+                    return res[0] if res else None
                 if isinstance(v, LuaTable):
                     return v.length()
                 raise LuaError(f"line {line}: attempt to get length of a "
@@ -984,14 +1075,21 @@ class LuaRuntime:
             for v in (lv, rv):
                 if not isinstance(v, (str, int, float)) or \
                         isinstance(v, bool):
-                    raise LuaError(
+                    return self._binmeta(
+                        "__concat", lv, rv, line,
                         f"line {line}: attempt to concatenate a "
                         f"{lua_typename(v)} value")
             return lua_tostring(lv) + lua_tostring(rv)
-        if op == "==":
-            return self._lua_eq(lv, rv)
-        if op == "~=":
-            return not self._lua_eq(lv, rv)
+        if op in ("==", "~="):
+            eq = self._lua_eq(lv, rv)
+            if not eq and isinstance(lv, LuaTable) \
+                    and isinstance(rv, LuaTable):
+                # __eq fires only for two tables that are not raw-equal
+                h = self._getmeta(lv, "__eq") or self._getmeta(rv, "__eq")
+                if h is not None:
+                    res = self.call(h, (lv, rv))
+                    eq = _truthy(res[0] if res else None)
+            return eq if op == "==" else not eq
         if op in ("<", "<=", ">", ">="):
             if isinstance(lv, str) and isinstance(rv, str):
                 pass
@@ -1000,13 +1098,22 @@ class LuaRuntime:
                     not isinstance(lv, bool) and not isinstance(rv, bool):
                 pass
             else:
-                raise LuaError(f"line {line}: attempt to compare "
-                               f"{lua_typename(lv)} with "
-                               f"{lua_typename(rv)}")
+                # a > b is b < a, a >= b is b <= a (lua 5.4 §3.4.4)
+                ev = "__lt" if op in ("<", ">") else "__le"
+                a, b = (lv, rv) if op in ("<", "<=") else (rv, lv)
+                err = (f"line {line}: attempt to compare "
+                       f"{lua_typename(lv)} with {lua_typename(rv)}")
+                return _truthy(self._binmeta(ev, a, b, line, err))
             return {"<": lv < rv, "<=": lv <= rv,
                     ">": lv > rv, ">=": lv >= rv}[op]
-        ln = _arith_operand(lv, op, line)
-        rn = _arith_operand(rv, op, line)
+        try:
+            ln = _arith_operand(lv, op, line)
+            rn = _arith_operand(rv, op, line)
+        except LuaError as exc:
+            events = {"+": "__add", "-": "__sub", "*": "__mul",
+                      "/": "__div", "//": "__idiv", "%": "__mod",
+                      "^": "__pow"}
+            return self._binmeta(events[op], lv, rv, line, str(exc))
         if op == "+":
             return ln + rn
         if op == "-":
@@ -1054,7 +1161,36 @@ class LuaRuntime:
         g = self.globals
 
         def _print(*args):
-            self.output("\t".join(lua_tostring(a) for a in args))
+            self.output("\t".join(self.tostring(a) for a in args))
+
+        def _setmetatable(t, mt=None):
+            if not isinstance(t, LuaTable):
+                raise LuaError("bad argument #1 to 'setmetatable' "
+                               "(table expected)")
+            if mt is not None and not isinstance(mt, LuaTable):
+                raise LuaError("bad argument #2 to 'setmetatable' "
+                               "(nil or table expected)")
+            if t.metatable is not None and \
+                    t.metatable.get("__metatable") is not None:
+                raise LuaError("cannot change a protected metatable")
+            t.metatable = mt
+            return t
+
+        def _getmetatable(t=None):
+            if not isinstance(t, LuaTable) or t.metatable is None:
+                return None
+            protected = t.metatable.get("__metatable")
+            return protected if protected is not None else t.metatable
+
+        def _rawequal(a=None, b=None):
+            return self._lua_eq(a, b)
+
+        def _rawlen(v=None):
+            if isinstance(v, str):
+                return len(v)
+            if isinstance(v, LuaTable):
+                return v.length()
+            raise LuaError("table or string expected")
 
         def _ipairs_iter(t, i):
             i = int(i) + 1
@@ -1087,6 +1223,10 @@ class LuaRuntime:
                 return (True,) + self.call(fn, args)
             except LuaError as exc:
                 return (False, str(exc))
+            except RecursionError:
+                # a host-function chain can still overflow outside
+                # call()'s chokepoint; lua 5.4 pcall returns this too
+                return (False, "stack overflow")
 
         def _error(msg, _level=None):
             raise LuaError(lua_tostring(msg))
@@ -1104,7 +1244,11 @@ class LuaRuntime:
         g.update({
             "print": _print,
             "type": lambda v=None: lua_typename(v),
-            "tostring": lambda v=None: lua_tostring(v),
+            "tostring": lambda v=None: self.tostring(v),
+            "setmetatable": _setmetatable,
+            "getmetatable": _getmetatable,
+            "rawequal": _rawequal,
+            "rawlen": _rawlen,
             "tonumber": _tonumber,
             "ipairs": lambda t: (_ipairs_iter, t, 0),
             "pairs": lambda t: (_pairs_iter, t, None),

@@ -12,7 +12,11 @@ interpreter covering the MVP core:
   parametric  drop, select
   variables   local.get/set/tee, global.get/set
   memory      all i32/i64/f32/f64 loads & stores (incl. 8/16/32 partial
-              widths), memory.size, memory.grow
+              widths), memory.size, memory.grow; bulk memory
+              (memory.copy/fill/init, data.drop, passive data segments
+              — modern clang --target=wasm32 emits these by default)
+  misc        the 0xFC saturating float->int truncation matrix
+              (i32/i64.trunc_sat_f32/f64_s/u)
   numeric     full i32/i64 ALU (clz..rotr), f32/f64 arithmetic & compares,
               the conversion/reinterpret matrix, sign-extension ops
   simd        the fixed-width SIMD proposal's v128 core (the reference
@@ -27,10 +31,11 @@ interpreter covering the MVP core:
               int<->float conversion matrix
 
 Out of scope (raise WasmError): threads, reference types, multi-value
-block signatures, bulk memory, and the SIMD tail that exists for codec
-inner loops (q15mulr, extadd_pairwise, extmul, relaxed-simd).  Scripts
-that heavy-compute belong in the JAX tier; wasm here is a portable
-*protocol* client, like the reference's.
+block signatures, the table.* bulk ops (table.init/copy/grow/fill),
+and the SIMD tail that exists for codec inner loops (q15mulr,
+extadd_pairwise, extmul, relaxed-simd).  Scripts that heavy-compute
+belong in the JAX tier; wasm here is a portable *protocol* client,
+like the reference's.
 
 Host functions are supplied as a dict {("module","name"): python_callable};
 callables receive (Instance, *args) so they can touch linear memory.
@@ -144,7 +149,11 @@ class Module:
     globals: list = field(default_factory=list)   # (valtype, mutable, init)
     exports: dict = field(default_factory=dict)   # name -> (kind, idx)
     start: Optional[int] = None
-    data: list = field(default_factory=list)      # (offset_expr, bytes)
+    data: list = field(default_factory=list)      # active: (offset, bytes)
+    # every data segment in index order, for memory.init/data.drop:
+    # ("active"|"passive", bytes) — active ones are implicitly dropped
+    # after instantiation (bulk-memory spec)
+    datasegs: list = field(default_factory=list)
 
 
 def _decode_valtype(r: _Reader) -> int:
@@ -309,6 +318,28 @@ def _decode_expr(r: _Reader) -> list:
             out.append((op, r.f64()))
         elif 0x45 <= op <= 0xC4:                # numeric ops, no immediates
             out.append((op,))
+        elif op == 0xFC:                        # misc prefix (bulk memory
+            sub = r.uleb()                      # + saturating truncation)
+            if sub <= 7:                        # ixx.trunc_sat_fyy_s/u
+                out.append((0xFC00 | sub,))
+            elif sub == 8:                      # memory.init dataidx mem
+                seg = r.uleb()
+                if r.u8() != 0:
+                    raise WasmError("memory.init: only memory 0")
+                out.append((0xFC08, seg))
+            elif sub == 9:                      # data.drop dataidx
+                out.append((0xFC09, r.uleb()))
+            elif sub == 10:                     # memory.copy mem mem
+                if r.u8() != 0 or r.u8() != 0:
+                    raise WasmError("memory.copy: only memory 0")
+                out.append((0xFC0A,))
+            elif sub == 11:                     # memory.fill mem
+                if r.u8() != 0:
+                    raise WasmError("memory.fill: only memory 0")
+                out.append((0xFC0B,))
+            else:
+                raise WasmError(f"unsupported 0xFC opcode {sub} "
+                                f"(table.* bulk ops are out of scope)")
         elif op == 0xFD:                        # SIMD prefix
             sub = r.uleb()
             # ops are re-keyed as 0xFD00|sub so the executor still
@@ -436,12 +467,22 @@ def decode_module(data: bytes) -> Module:
                 bodies.append((locals_, _decode_expr(fr)))
         elif sec == 11:                                  # data
             for _ in range(body.uleb()):
-                if body.uleb() != 0:
-                    raise WasmError("only active memory-0 data segments")
+                flags = body.uleb()
+                if flags == 1:                           # passive
+                    payload = body.bytes_(body.uleb())
+                    m.datasegs.append(("passive", payload))
+                    continue
+                if flags == 2 and body.uleb() != 0:      # explicit memidx
+                    raise WasmError("only memory-0 data segments")
+                elif flags not in (0, 2):
+                    raise WasmError(f"bad data segment flags {flags}")
                 off_expr = _decode_expr(body)
-                m.data.append((_const_expr_value(off_expr),
-                               body.bytes_(body.uleb())))
-        # custom (0) and unknown sections are skipped
+                payload = body.bytes_(body.uleb())
+                m.data.append((_const_expr_value(off_expr), payload))
+                m.datasegs.append(("active", payload))
+        # custom (0), datacount (12) and unknown sections are skipped
+        # (the decoder doesn't need the datacount hint: code is decoded
+        # after the full module is read)
 
     if len(func_type_idx) != len(bodies):
         raise WasmError("function/code section mismatch")
@@ -477,6 +518,24 @@ def _sign32(v: int) -> int:
 def _sign64(v: int) -> int:
     v &= 0xFFFFFFFFFFFFFFFF
     return v - (1 << 64) if v & (1 << 63) else v
+
+
+def _trunc_sat(sub: int, v: float) -> int:
+    """0xFC 0..7: saturating float->int truncation (NaN -> 0, out of
+    range clamps — never traps).  Result in canonical unsigned form."""
+    signed = (sub & 1) == 0      # 0,2,4,6 = _s; 1,3,5,7 = _u
+    bits = 64 if sub >= 4 else 32
+    if math.isnan(v):
+        return 0
+    lo, hi = ((-(1 << (bits - 1)), (1 << (bits - 1)) - 1) if signed
+              else (0, (1 << bits) - 1))
+    if v <= lo:
+        t = lo
+    elif v >= hi:
+        t = hi
+    else:
+        t = math.trunc(v)
+    return _wrap32(t) if bits == 32 else _wrap64(t)
 
 
 def _trunc(v: float, lo: int, hi: int, name: str) -> int:
@@ -532,6 +591,12 @@ class Instance:
             if end > len(self.mem):
                 raise WasmError("data segment out of bounds")
             self.mem[off:end] = payload
+        # runtime segment store for memory.init/data.drop: passive
+        # segments keep their bytes until dropped; active segments are
+        # implicitly dropped at instantiation (bulk-memory spec)
+        self.datasegs: list[Optional[bytes]] = [
+            payload if mode == "passive" else None
+            for mode, payload in module.datasegs]
         self.steps = 0
         if module.start is not None:
             self._call_function(module.start, [])
@@ -759,6 +824,33 @@ class Instance:
                         stack.append(old)
             elif op in (0x41, 0x42, 0x43, 0x44):  # consts
                 stack.append(ins[1])
+            elif 0xFC00 <= op <= 0xFC07:         # ixx.trunc_sat_fyy_s/u
+                stack.append(_trunc_sat(op & 7, stack.pop()))
+            elif op == 0xFC08:                   # memory.init
+                n = _wrap32(stack.pop())
+                s = _wrap32(stack.pop())
+                d = _wrap32(stack.pop())
+                seg = self.datasegs[ins[1]]
+                src = seg if seg is not None else b""   # dropped = empty
+                if s + n > len(src) or d + n > len(mem):
+                    raise Trap("out of bounds memory.init")
+                mem[d:d + n] = src[s:s + n]
+            elif op == 0xFC09:                   # data.drop
+                self.datasegs[ins[1]] = None
+            elif op == 0xFC0A:                   # memory.copy (memmove)
+                n = _wrap32(stack.pop())
+                s = _wrap32(stack.pop())
+                d = _wrap32(stack.pop())
+                if s + n > len(mem) or d + n > len(mem):
+                    raise Trap("out of bounds memory.copy")
+                mem[d:d + n] = bytes(mem[s:s + n])
+            elif op == 0xFC0B:                   # memory.fill
+                n = _wrap32(stack.pop())
+                v = _wrap32(stack.pop()) & 0xFF
+                d = _wrap32(stack.pop())
+                if d + n > len(mem):
+                    raise Trap("out of bounds memory.fill")
+                mem[d:d + n] = bytes([v]) * n
             elif op >= 0xFD00:                   # SIMD (pops/pushes itself)
                 self._simd(ins, stack)
             else:

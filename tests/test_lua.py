@@ -212,6 +212,182 @@ class TestInterpreter:
         assert out == ["nil\t16\t3.5\tnil"]
 
 
+class TestMetatables:
+    """Metatable semantics (reference: liblua 5.4 via
+    splinter_cli_cmd_lua.c:365-386) — the OO-style store-script
+    surface: class tables behind __index, operator overloads,
+    defaulting proxies, protected metatables."""
+
+    def test_class_pattern_with_methods(self):
+        src = """
+        local Account = {}
+        Account.__index = Account
+        function Account.new(owner, balance)
+          return setmetatable({owner = owner, balance = balance or 0},
+                              Account)
+        end
+        function Account:deposit(n) self.balance = self.balance + n end
+        function Account:get() return self.balance end
+        local a = Account.new("ada", 10)
+        a:deposit(32)
+        print(a:get(), a.owner)
+        """
+        assert run_lua(src)[0] == ["42\tada"]
+
+    def test_inheritance_chain(self):
+        src = """
+        local Base = {}
+        Base.__index = Base
+        function Base:kind() return "base" end
+        function Base:greet() return "hello from " .. self:kind() end
+        local Derived = setmetatable({}, {__index = Base})
+        Derived.__index = Derived
+        function Derived:kind() return "derived" end
+        local d = setmetatable({}, Derived)
+        print(d:greet())
+        local b = setmetatable({}, Base)
+        print(b:greet())
+        """
+        assert run_lua(src)[0] == ["hello from derived",
+                                   "hello from base"]
+
+    def test_index_function_handler(self):
+        src = """
+        local t = setmetatable({}, {__index = function(t, k)
+          return "<" .. k .. ">"
+        end})
+        t.real = 1
+        print(t.real, t.missing)
+        """
+        assert run_lua(src)[0] == ["1\t<missing>"]
+
+    def test_newindex_function_and_rawset(self):
+        src = """
+        local log = {}
+        local t = setmetatable({}, {__newindex = function(t, k, v)
+          table.insert(log, k .. "=" .. tostring(v))
+          rawset(t, k, v)
+        end})
+        t.a = 1
+        t.a = 2       -- raw hit now: __newindex must NOT fire again
+        print(table.concat(log, ","), t.a)
+        """
+        assert run_lua(src)[0] == ["a=1\t2"]
+
+    def test_newindex_table_handler_redirects(self):
+        src = """
+        local backing = {}
+        local t = setmetatable({}, {__newindex = backing})
+        t.x = 7
+        print(rawget(t, "x"), backing.x)
+        """
+        assert run_lua(src)[0] == ["nil\t7"]
+
+    def test_arith_metamethods_vector(self):
+        src = """
+        local V = {}
+        V.__index = V
+        V.__add = function(a, b) return V.new(a.x + b.x, a.y + b.y) end
+        V.__sub = function(a, b) return V.new(a.x - b.x, a.y - b.y) end
+        V.__mul = function(a, k) return V.new(a.x * k, a.y * k) end
+        V.__unm = function(a) return V.new(-a.x, -a.y) end
+        V.__eq = function(a, b) return a.x == b.x and a.y == b.y end
+        V.__tostring = function(a)
+          return "(" .. a.x .. "," .. a.y .. ")"
+        end
+        function V.new(x, y) return setmetatable({x = x, y = y}, V) end
+        local a, b = V.new(1, 2), V.new(3, 4)
+        print(tostring(a + b), tostring(b - a), tostring(a * 10),
+              tostring(-a))
+        print(a + b == V.new(4, 6), a == b)
+        """
+        assert run_lua(src)[0] == ["(4,6)\t(2,2)\t(10,20)\t(-1,-2)",
+                                   "true\tfalse"]
+
+    def test_comparison_and_len_and_concat(self):
+        src = """
+        local M = {}
+        M.__lt = function(a, b) return a.v < b.v end
+        M.__le = function(a, b) return a.v <= b.v end
+        M.__len = function(a) return a.v end
+        M.__concat = function(a, b)
+          local av = type(a) == "table" and a.v or a
+          local bv = type(b) == "table" and b.v or b
+          return av .. "|" .. bv
+        end
+        local function box(v) return setmetatable({v = v}, M) end
+        local s, t = box(3), box(5)
+        print(s < t, t < s, s <= s, t > s, #t)
+        print(s .. t, "x" .. t)
+        """
+        assert run_lua(src)[0] == ["true\tfalse\ttrue\ttrue\t5",
+                                   "3|5\tx|5"]
+
+    def test_call_metamethod(self):
+        src = """
+        local counter = setmetatable({n = 0}, {__call = function(self, k)
+          self.n = self.n + (k or 1)
+          return self.n
+        end})
+        counter(5)
+        print(counter(), counter.n)
+        """
+        assert run_lua(src)[0] == ["6\t6"]
+
+    def test_protected_metatable(self):
+        src = """
+        local t = setmetatable({}, {__metatable = "locked"})
+        print(getmetatable(t))
+        local ok, err = pcall(function() setmetatable(t, {}) end)
+        print(ok, err)
+        """
+        out, _ = run_lua(src)
+        assert out[0] == "locked"
+        assert out[1].startswith("false\t")
+        assert "protected metatable" in out[1]
+
+    def test_rawequal_rawlen_bypass(self):
+        src = """
+        local M = {__eq = function() return true end,
+                   __len = function() return 99 end}
+        local a = setmetatable({1, 2}, M)
+        local b = setmetatable({1, 2}, M)
+        print(a == b, rawequal(a, b), #a, rawlen(a))
+        """
+        assert run_lua(src)[0] == ["true\tfalse\t99\t2"]
+
+    def test_default_value_proxy_store_script(self):
+        """The canonical store-script idiom: a config table whose reads
+        fall back to defaults and whose writes are validated."""
+        src = """
+        local defaults = {ttl = 60, shards = 8}
+        local cfg = setmetatable({}, {
+          __index = defaults,
+          __newindex = function(t, k, v)
+            if defaults[k] == nil then
+              error("unknown config key: " .. k)
+            end
+            rawset(t, k, v)
+          end,
+        })
+        cfg.ttl = 120
+        print(cfg.ttl, cfg.shards)
+        local ok, err = pcall(function() cfg.bogus = 1 end)
+        print(ok, err)
+        """
+        out, _ = run_lua(src)
+        assert out[0] == "120\t8"
+        assert out[1].startswith("false\t") and "unknown config key" in out[1]
+
+    def test_getmetatable_plain(self):
+        out, _ = run_lua("""
+        local mt = {}
+        local t = setmetatable({}, mt)
+        print(getmetatable(t) == mt, getmetatable({}), getmetatable(1))
+        """)
+        assert out == ["true\tnil\tnil"]
+
+
 class TestStoreHost:
     @pytest.fixture
     def store(self):
@@ -306,3 +482,33 @@ class TestStoreHost:
         dispatch(ses, ["lua", str(script), "cli_key"])
         assert capsys.readouterr().out.strip() == "wrote cli_key"
         assert store.get("cli_key") == b"from cli lua"
+
+
+class TestRecursionSafety:
+    def test_recursive_metamethod_is_lua_error(self):
+        src = """
+        local M = {}
+        M.__add = function(a, b) return a + b end
+        local x = setmetatable({}, M)
+        local ok, err = pcall(function() return x + x end)
+        print(ok, err)
+        """
+        out, _ = run_lua(src)
+        assert out[0].startswith("false\t")
+        assert "stack overflow" in out[0]
+
+    def test_recursive_method_is_lua_error(self):
+        src = """
+        local A = {}
+        A.__index = A
+        function A:m() return self:m() end
+        local a = setmetatable({}, A)
+        local ok, err = pcall(function() return a:m() end)
+        print(ok, err)
+        """
+        out, _ = run_lua(src)
+        assert out[0].startswith("false\t") and "stack overflow" in out[0]
+
+    def test_uncaught_overflow_is_lua_error_not_python(self):
+        with pytest.raises(LuaError, match="stack overflow"):
+            run_lua("local function f() return f() end f()")

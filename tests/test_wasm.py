@@ -469,3 +469,120 @@ class TestSimd:
                 fd(13, ctl) + fd(22, b"\x00") + END)
         with pytest.raises(WasmError, match="shuffle lane"):
             instantiate(simple_module([], [I32], body))
+
+
+def f64c(v):
+    return b"\x44" + struct.pack("<d", v)
+
+
+def FC(sub, imm=b""):
+    return b"\xfc" + uleb(sub) + imm
+
+
+class TestBulkMemory:
+    """Bulk-memory proposal (memory.copy/fill/init, data.drop, passive
+    segments + DataCount section) — the encodings modern
+    `clang --target=wasm32` emits by default; the reference gets them
+    from WasmEdge (splinter_cli_cmd_wasm.c:85-143)."""
+
+    def bulk_module(self, body, *, passive=b"hello, bulk!", n_funcs=1):
+        return module([
+            section(1, vec([functype([], [])])),
+            section(3, vec([uleb(0)])),
+            section(5, vec([b"\x00" + uleb(1)])),          # 1 page
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(12, uleb(1)),                          # DataCount
+            section(10, vec([code_entry([], body)])),
+            section(11, vec([b"\x01" + uleb(len(passive)) + passive])),
+        ])
+
+    def test_init_copy_fill_roundtrip(self):
+        body = (
+            # memory.init: dst=16 src=0 n=12 from passive segment 0
+            i32c(16) + i32c(0) + i32c(12) + FC(8, uleb(0) + b"\x00") +
+            # memory.copy: dst=100 src=16 n=12
+            i32c(100) + i32c(16) + i32c(12) + FC(10, b"\x00\x00") +
+            # memory.fill: dst=200 val=0x2A n=4
+            i32c(200) + i32c(0x2A) + i32c(4) + FC(11, b"\x00") +
+            END)
+        inst = instantiate(self.bulk_module(body))
+        inst.invoke("run", [])
+        assert inst.mem_read(16, 12) == b"hello, bulk!"
+        assert inst.mem_read(100, 12) == b"hello, bulk!"
+        assert inst.mem_read(200, 4) == b"\x2a" * 4
+        assert inst.mem_read(204, 2) == b"\x00\x00"
+
+    def test_copy_overlapping_is_memmove(self):
+        m = module([
+            section(1, vec([functype([], [])])),
+            section(3, vec([uleb(0)])),
+            section(5, vec([b"\x00" + uleb(1)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry(
+                [], i32c(2) + i32c(0) + i32c(6) + FC(10, b"\x00\x00")
+                + END)])),
+            section(11, vec([b"\x00" + i32c(0) + END +
+                             uleb(8) + b"abcdefgh"])),
+        ])
+        inst = instantiate(m)
+        inst.invoke("run", [])
+        assert inst.mem_read(0, 8) == b"ababcdef"
+
+    def test_data_drop_then_init_traps(self):
+        drop_then_init = (
+            FC(9, uleb(0)) +                              # data.drop 0
+            i32c(0) + i32c(0) + i32c(1) +                 # n=1 must trap
+            FC(8, uleb(0) + b"\x00") + END)
+        inst = instantiate(self.bulk_module(drop_then_init))
+        with pytest.raises(Trap, match="memory.init"):
+            inst.invoke("run", [])
+
+    def test_data_drop_then_zero_init_ok(self):
+        body = (FC(9, uleb(0)) +
+                i32c(0) + i32c(0) + i32c(0) +             # n=0 is fine
+                FC(8, uleb(0) + b"\x00") + END)
+        inst = instantiate(self.bulk_module(body))
+        inst.invoke("run", [])
+
+    def test_init_source_oob_traps(self):
+        body = (i32c(0) + i32c(8) + i32c(8) +             # 8+8 > len(seg)
+                FC(8, uleb(0) + b"\x00") + END)
+        inst = instantiate(self.bulk_module(body))
+        with pytest.raises(Trap, match="memory.init"):
+            inst.invoke("run", [])
+
+    def test_fill_oob_traps(self):
+        body = (i32c(65530) + i32c(1) + i32c(100) +
+                FC(11, b"\x00") + END)
+        inst = instantiate(self.bulk_module(body))
+        with pytest.raises(Trap, match="memory.fill"):
+            inst.invoke("run", [])
+
+    def test_table_bulk_ops_rejected(self):
+        body = i32c(0) + i32c(0) + i32c(0) + FC(12, uleb(0) + b"\x00") \
+            + END
+        with pytest.raises(WasmError, match="table"):
+            instantiate(simple_module([], [], body))
+
+
+class TestTruncSat:
+    def run1(self, body, params=(), args=()):
+        inst = instantiate(simple_module(list(params), [I32], body))
+        return inst.invoke("run", list(args))[0]
+
+    def test_i32_trunc_sat_f64_s(self):
+        assert self.run1(f64c(3.9) + FC(2) + END) == 3
+        assert self.run1(f64c(-3.9) + FC(2) + END) == (1 << 32) - 3
+        assert self.run1(f64c(float("nan")) + FC(2) + END) == 0
+        assert self.run1(f64c(1e20) + FC(2) + END) == 0x7FFFFFFF
+        assert self.run1(f64c(-1e20) + FC(2) + END) == 0x80000000
+
+    def test_i32_trunc_sat_f64_u(self):
+        assert self.run1(f64c(3.9) + FC(3) + END) == 3
+        assert self.run1(f64c(-3.9) + FC(3) + END) == 0
+        assert self.run1(f64c(1e20) + FC(3) + END) == 0xFFFFFFFF
+
+    def test_i64_trunc_sat_f64(self):
+        body64 = f64c(-1e300) + b"\xfc\x06" + END   # i64.trunc_sat_f64_s
+        inst = instantiate(simple_module([], [0x7E], body64))
+        assert inst.invoke("run", []) == [1 << 63]   # saturated at min

@@ -1,0 +1,248 @@
+"""Generate docs/api/ — the per-function API reference for the sptpu.h
+C ABI (VERDICT r4 #9; reference ships ~60 per-function pages,
+/root/reference/docs/api/index.md).
+
+The header's comments ARE the documentation source; this script turns
+them into browsable markdown so they cannot drift apart:
+`tests/test_api_docs.py` regenerates into a temp dir and fails when the
+committed pages differ.
+
+Usage: python scripts/gen_api_docs.py [outdir]   (default docs/api)
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(REPO, "native", "include", "sptpu.h")
+
+_SECTION_RE = re.compile(r"^/\* -{3,}\s*(.+?)\s*-*\s*(?:\*/)?\s*$")
+_PROTO_START = re.compile(
+    r"^(?:const\s+)?(?:unsigned\s+)?[A-Za-z_][A-Za-z0-9_]*\s*\**\s*"
+    r"(spt_[A-Za-z0-9_]+)\s*\(")
+_DEFINE_RE = re.compile(r"^#define\s+(SPT_[A-Za-z0-9_]+)")
+
+
+def _clean_comment(lines: list[str]) -> str:
+    """Strip comment markers, preserve paragraph flow."""
+    out = []
+    for ln in lines:
+        ln = ln.strip()
+        ln = re.sub(r"^/\*+", "", ln)
+        ln = re.sub(r"\*+/$", "", ln)
+        ln = re.sub(r"^\*\s?", "", ln)
+        out.append(ln.rstrip())
+    text = "\n".join(out).strip("\n")
+    # collapse runs of blank lines
+    text = re.sub(r"\n{3,}", "\n\n", text)
+    return text.strip()
+
+
+def _slug(title: str) -> str:
+    s = title.lower()
+    s = re.sub(r"\(.*?\)", "", s)          # drop parentheticals
+    s = re.sub(r"[^a-z0-9]+", "-", s).strip("-")
+    return s
+
+
+class Section:
+    def __init__(self, title: str):
+        self.title = title
+        self.slug = _slug(title)
+        self.intro = ""
+        self.funcs: list[tuple[str, str, str]] = []   # (name, sig, doc)
+        self.defines: list[tuple[str, str, str]] = []  # (name, line, doc)
+        self.types: list[tuple[str, str, str]] = []   # (name, body, doc)
+
+
+def parse_header(path: str = HEADER):
+    with open(path) as f:
+        raw = f.read()
+    lines = raw.splitlines()
+
+    # the leading block comment is the ABI overview
+    m = re.match(r"/\*(.*?)\*/", raw, re.S)
+    preamble = _clean_comment(m.group(0).splitlines()) if m else ""
+
+    sections: list[Section] = []
+    cur = Section("core constants")   # pre-marker #defines land here
+    sections.append(cur)
+    pending: list[str] = []        # comment lines awaiting an owner
+    i = 0
+    n = len(lines)
+    # skip the preamble comment
+    while i < n and not lines[i].startswith("#ifndef"):
+        i += 1
+    while i < n:
+        ln = lines[i]
+        sm = _SECTION_RE.match(ln)
+        if sm:
+            cur = Section(sm.group(1))
+            sections.append(cur)
+            pending = []
+            # a section marker may open a multi-line comment whose body
+            # documents the whole section (e.g. the tokenizer block)
+            if "*/" not in ln:
+                # the marker opens a multi-line comment: its body is
+                # the section's own introduction
+                body = []
+                i += 1
+                while i < n and "*/" not in lines[i]:
+                    body.append(lines[i])
+                    i += 1
+                if i < n:
+                    body.append(lines[i])
+                cur.intro = _clean_comment(body)
+            i += 1
+            continue
+        stripped = ln.strip()
+        if stripped.startswith("/*"):
+            block = [ln]
+            while "*/" not in lines[i] and i + 1 < n:
+                i += 1
+                block.append(lines[i])
+            pending = block
+            i += 1
+            continue
+        dm = _DEFINE_RE.match(stripped)
+        if dm:
+            # a define takes only its INLINE comment; a block comment
+            # above it stays pending — in this header those blocks
+            # document the function that follows the define (e.g. the
+            # spt_vec_gather contract above SPT_GATHER_TORN)
+            inline = re.search(r"/\*(.*?)\*/", stripped)
+            doc = inline.group(1).strip() if inline else ""
+            cur.defines.append(
+                (dm.group(1), re.sub(r"\s*/\*.*?\*/", "", stripped), doc))
+            i += 1
+            continue
+        if stripped.startswith("typedef enum") or \
+                stripped.startswith("typedef struct {"):
+            block = [stripped]
+            while not re.search(r"}\s*\w+\s*;", block[-1]) and i + 1 < n:
+                i += 1
+                block.append(lines[i].strip())
+            tm = re.search(r"}\s*(\w+)\s*;", block[-1])
+            tname = tm.group(1) if tm else "?"
+            doc = _clean_comment(pending) if pending else ""
+            cur.types.append((tname, "\n".join(block), doc))
+            pending = []
+            i += 1
+            continue
+        pm = _PROTO_START.match(stripped)
+        if pm:
+            sig_lines = [stripped]
+
+            def _unclosed(txt: str) -> bool:
+                return txt.count("/*") > txt.count("*/")
+
+            # collect until the statement's ';' lands OUTSIDE a comment
+            # (trailing block comments can run past the prototype line)
+            while i + 1 < n:
+                joined = " ".join(sig_lines)
+                bare = re.sub(r"/\*.*?\*/", "", joined, flags=re.S)
+                if ";" in bare and not _unclosed(joined):
+                    break
+                i += 1
+                sig_lines.append(lines[i].strip())
+            sig = " ".join(sig_lines)
+            inline = re.findall(r"/\*(.*?)\*/", sig, re.S)
+            sig = re.sub(r"\s*/\*.*?\*/", "", sig, flags=re.S).rstrip()
+            sig = re.sub(r"\s+", " ", sig)
+            if ";" in sig:
+                sig = sig[:sig.index(";") + 1]
+            doc = _clean_comment(pending) if pending else ""
+            if inline:
+                extra = " ".join(
+                    re.sub(r"\s+", " ", t.strip()) for t in inline)
+                doc = (doc + "\n" + extra).strip()
+            cur.funcs.append((pm.group(1), sig, doc))
+            pending = []
+            i += 1
+            continue
+        if stripped == "":
+            pending = []           # a blank line orphans the comment
+        i += 1
+    return preamble, sections
+
+
+def render(outdir: str) -> list[str]:
+    preamble, sections = parse_header()
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+
+    idx = ["# sptpu.h — C ABI reference",
+           "",
+           "Generated from `native/include/sptpu.h` by "
+           "`scripts/gen_api_docs.py`; do not edit by hand "
+           "(`tests/test_api_docs.py` enforces sync).",
+           "",
+           "```",
+           preamble,
+           "```",
+           "",
+           "| Section | Functions |",
+           "|---|---|"]
+    for sec in sections:
+        if not sec.funcs and not sec.defines and not sec.types:
+            continue
+        names = ", ".join(f"`{nm}`" for nm, _, _ in sec.funcs) or "—"
+        idx.append(f"| [{sec.title}]({sec.slug}.md) | {names} |")
+    idx.append("")
+
+    for sec in sections:
+        if not sec.funcs and not sec.defines and not sec.types:
+            continue
+        page = [f"# {sec.title}",
+                "",
+                f"Part of the [sptpu.h C ABI](index.md); declarations "
+                f"in `native/include/sptpu.h`.",
+                ""]
+        if sec.intro:
+            page.append(sec.intro)
+            page.append("")
+        if sec.defines:
+            page.append("## Constants")
+            page.append("")
+            for name, line, doc in sec.defines:
+                page.append(f"- `{line}`" + (f" — {doc.splitlines()[0]}"
+                                             if doc else ""))
+            page.append("")
+        for name, body, doc in sec.types:
+            page.append(f"## `{name}`")
+            page.append("")
+            page.append("```c")
+            page.append(body)
+            page.append("```")
+            page.append("")
+            if doc:
+                page.append(doc)
+                page.append("")
+        for name, sig, doc in sec.funcs:
+            page.append(f"## `{name}`")
+            page.append("")
+            page.append("```c")
+            page.append(sig)
+            page.append("```")
+            page.append("")
+            if doc:
+                page.append(doc)
+                page.append("")
+        path = os.path.join(outdir, f"{sec.slug}.md")
+        with open(path, "w") as f:
+            f.write("\n".join(page))
+        written.append(path)
+
+    with open(os.path.join(outdir, "index.md"), "w") as f:
+        f.write("\n".join(idx))
+    written.append(os.path.join(outdir, "index.md"))
+    return written
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "docs", "api")
+    files = render(out)
+    print(f"wrote {len(files)} pages to {out}")

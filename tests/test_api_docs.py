@@ -1,0 +1,70 @@
+"""docs/api/ is generated from sptpu.h (scripts/gen_api_docs.py,
+VERDICT r4 #9) — these tests keep it complete and in sync."""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(ROOT, "native", "include", "sptpu.h")
+DOCS = os.path.join(ROOT, "docs", "api")
+
+
+def header_functions() -> set[str]:
+    """Every function declared in the public header."""
+    with open(HEADER) as f:
+        src = f.read()
+    src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)   # strip comments
+    names = set()
+    for m in re.finditer(
+            r"\b(spt_[A-Za-z0-9_]+)\s*\(", src):
+        # a '(' directly after the name inside a declaration line;
+        # exclude macro uses (none in the header) and the struct tag
+        names.add(m.group(1))
+    return names
+
+
+def test_every_header_function_documented():
+    funcs = header_functions()
+    assert len(funcs) >= 70, f"expected the ~70-symbol ABI, got {len(funcs)}"
+    documented = set()
+    for fn in os.listdir(DOCS):
+        if not fn.endswith(".md"):
+            continue
+        with open(os.path.join(DOCS, fn)) as f:
+            for m in re.finditer(r"^## `(spt_[A-Za-z0-9_]+)`", f.read(),
+                                 re.M):
+                documented.add(m.group(1))
+    missing = funcs - documented
+    assert not missing, f"undocumented ABI functions: {sorted(missing)}"
+
+
+def test_docs_in_sync_with_header(tmp_path):
+    """Regenerating must reproduce the committed pages byte-for-byte."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "gen_api_docs.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    gen = sorted(os.listdir(tmp_path))
+    committed = sorted(p for p in os.listdir(DOCS) if p.endswith(".md"))
+    assert gen == committed, (
+        f"page set drifted: generated {gen} vs committed {committed} "
+        f"— run scripts/gen_api_docs.py")
+    for name in gen:
+        with open(os.path.join(tmp_path, name)) as f:
+            want = f.read()
+        with open(os.path.join(DOCS, name)) as f:
+            have = f.read()
+        assert have == want, (
+            f"docs/api/{name} is stale — run scripts/gen_api_docs.py")
+
+
+def test_index_links_resolve():
+    with open(os.path.join(DOCS, "index.md")) as f:
+        idx = f.read()
+    for m in re.finditer(r"\]\(([a-z0-9-]+\.md)\)", idx):
+        assert os.path.exists(os.path.join(DOCS, m.group(1))), \
+            f"index links to missing page {m.group(1)}"

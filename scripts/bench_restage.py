@@ -14,8 +14,9 @@ the tunnel admits ONE client (bench.py's discipline).
 
 MEMORY at the 1M default: nslots rounds N up to a power of two with
 2x headroom, so N=1M maps a 2^21 x 768 f32 lane = ~6.4 GB of shm;
-peak process footprint is ~3-4x that (mmap lane + torn-safe host copy
-+ device buffer + scatter transient) — budget ~25 GB.
+the streaming upload (128 MB chunks + MADV_DONTNEED on staged slices)
+peaks at ~1.3x the lane — budget ~9 GB (measured 8.46 GB; before the
+round-5 diet the full-host-copy path needed ~25 GB).
 
 Env: RESTAGE_N (default 1,000,000 cpu / 131,072 tpu), RESTAGE_DIM
 (768), RESTAGE_TPU=1.

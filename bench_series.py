@@ -1104,21 +1104,47 @@ def phase_decode_daemon(ctx: SeriesCtx) -> dict:
     name = _bench_store_name("dec")
     Store.unlink(name)
     st = Store.create(name, nslots=256, max_val=4096, vec_dim=8)
+    hung = False
     try:
         comp = Completer(st, model=model, max_new_tokens=32,
                          flush_tokens=chunk, template="none")
         comp.attach()
         log("completer e2e ...")
         e2e = []
-        for i in range(3):
-            key = f"q/{i}"
-            t0 = time.perf_counter()
-            st.set(key, "Say something interesting about TPUs.")
-            st.label_or(key, P.LBL_INFER_REQ)
-            st.bump(key)
-            comp.run_once()
-            e2e.append((time.perf_counter() - t0) * 1000)
-            log(f"completer e2e request {i}: {e2e[-1]:.0f} ms")
+        probe_err: list[Exception] = []
+
+        def _probe():
+            try:
+                for i in range(3):
+                    key = f"q/{i}"
+                    t0 = time.perf_counter()
+                    st.set(key, "Say something interesting about TPUs.")
+                    st.label_or(key, P.LBL_INFER_REQ)
+                    st.bump(key)
+                    comp.run_once()
+                    e2e.append((time.perf_counter() - t0) * 1000)
+                    log(f"completer e2e request {i}: {e2e[-1]:.0f} ms")
+            except Exception as exc:       # surfaced on the main thread
+                probe_err.append(exc)
+
+        # bounded: the round-3 on-chip hang lived HERE (run_once blocked
+        # in a device sync).  A daemon thread + join(timeout) turns a
+        # repeat into a failed phase instead of a burned claim window —
+        # this is the LAST series phase, so aborting loses nothing else.
+        th = threading.Thread(target=_probe, daemon=True)
+        th.start()
+        th.join(timeout=float(os.environ.get("DECODE_E2E_TIMEOUT",
+                                             "300")))
+        if th.is_alive():
+            import faulthandler
+            hung = True                  # finally: must NOT unmap the
+            faulthandler.dump_traceback(file=sys.stderr)  # stuck stack
+            raise RuntimeError(
+                "completer e2e hung past DECODE_E2E_TIMEOUT (round-3 "
+                "on-chip mode); aborting the phase — all thread "
+                "stacks incl. the stuck one dumped above")
+        if probe_err:
+            raise probe_err[0]
         e2e_ms = float(np.median(e2e))
 
         comp2 = Completer(st, model=model, max_new_tokens=32,
@@ -1154,8 +1180,15 @@ def phase_decode_daemon(ctx: SeriesCtx) -> dict:
         log(f"continuous: {done}/12 ready in {cont_s:.2f}s, "
             f"{cont_tps:,.1f} aggregate tok/s")
     finally:
-        st.close()
-        Store.unlink(name)
+        if hung:
+            # the stuck thread still holds pointers into the mapping;
+            # closing would unmap under it (use-after-close segfault
+            # before the failed phase_status could be recorded).  Only
+            # remove the NAME — the mapping lives until process exit.
+            Store.unlink(name)
+        else:
+            st.close()
+            Store.unlink(name)
 
     return ctx.record({
         "metric": "completer_e2e_ms",

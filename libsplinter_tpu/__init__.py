@@ -20,7 +20,7 @@ from ._native import (
 )
 from .store import BidInfo, Eagain, HeaderInfo, SlotInfo, Store
 
-__version__ = "0.4.0"   # bump policy: changelogs/README.md
+__version__ = "0.5.0"   # bump policy: changelogs/README.md
 
 __all__ = [
     "Store", "SlotInfo", "HeaderInfo", "BidInfo", "Eagain", "native_abi",

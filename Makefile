@@ -7,7 +7,7 @@
 #   make check      the native check tier (TAP + MRSW stress + MRMW
 #                   chi-sao) + full pytest
 #   make memcheck   valgrind (if installed) or ASan/UBSan native tier
-#   make bench-cpu  quick host-CPU bench series (embed phase)
+#   make bench-cpu  quick host-CPU bench (embed + store_ops phases)
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -39,7 +39,8 @@ memcheck: native
 	$(MAKE) -C native memcheck
 
 bench-cpu:
-	BENCH_CPU=1 BENCH_TEXTS=256 BENCH_BATCH=64 $(PY) bench.py
+	BENCH_CPU=1 BENCH_TEXTS=256 BENCH_BATCH=64 \
+	    BENCH_PHASES=embed,store_ops $(PY) bench.py
 
 clean:
 	$(MAKE) -C native clean

@@ -158,6 +158,18 @@ def _last_stage(stagefile: str) -> str:
         return "(no stage file)"
 
 
+def _all_stages(stagefile: str) -> list[str]:
+    """Every stage marker the child recorded (e.g. 'phase-embed-done'),
+    without the trailing ' t=HH:MM:SS' timestamps."""
+    try:
+        with open(stagefile) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        return [ln.split(" ", 1)[1].split(" t=")[0] for ln in lines
+                if " " in ln]
+    except OSError:
+        return []
+
+
 def _read_resultfile(path: str) -> dict | None:
     """The child's headline recovery file (written the moment the embed
     phase lands, before the riskier series phases run)."""
@@ -426,10 +438,21 @@ def _driver_window() -> int:
         if "phase-" in stage:
             # the claim landed and the series began, so non-embed
             # phases may already have ledgered records — retries only
-            # need the missing headline, not a duplicate full series
-            log("[bench] series had begun; retries run the embed "
-                "phase only")
-            restricted_phases = "embed"
+            # need the missing headline, not a duplicate full series.
+            # Intersect with the caller's selection: a BENCH_PHASES
+            # without embed (e.g. make bench-cpu's embed,store_ops
+            # after embed already succeeded) must not be silently
+            # replaced by an embed-only retry that exits 0 with the
+            # requested phases unrun.
+            asked = [p.strip() for p in os.environ.get(
+                "BENCH_PHASES", "embed").split(",") if p.strip()]
+            done_ph = {s.split("-done")[0].removeprefix("phase-")
+                       for s in _all_stages(stagefile)
+                       if s.startswith("phase-") and s.endswith("-done")}
+            keep = [p for p in asked if p == "embed" or p not in done_ph]
+            restricted_phases = ",".join(keep) or "embed"
+            log(f"[bench] series had begun; retries run only: "
+                f"{restricted_phases}")
         time.sleep(min(BACKOFF_S, max(0.0, deadline - time.monotonic())))
 
     if not lock_ok:

@@ -182,9 +182,13 @@ def _causal_kernel(q_ref, k_ref, v_ref, pos_ref, start_ref, out_ref, *,
     reaches HBM.
 
     q_ref: (1, 1, BQ, D); k/v_ref: (1, 1, T, D); pos_ref: (1,) SMEM;
-    start_ref: (1,) SMEM (this batch row's left-pad offset);
+    start_ref: (B,) SMEM — the FULL left-pad vector (Mosaic requires
+    rank-1 SMEM blocks be whole-array or 128-multiples, so slicing one
+    row per program via a (1,) block does not lower); each program
+    reads its own row by program_id(0);
     out_ref: (1, 1, BQ, D).
     """
+    b = pl.program_id(0)
     i = pl.program_id(2)
     q = q_ref[0, 0]
     k = k_ref[0, 0]
@@ -192,7 +196,7 @@ def _causal_kernel(q_ref, k_ref, v_ref, pos_ref, start_ref, out_ref, *,
     BQ = q.shape[0]
     T = k.shape[0]
     pos = pos_ref[0]
-    start = start_ref[0]
+    start = start_ref[b]
     logits = jnp.dot(q, k.T,
                      preferred_element_type=jnp.float32) * scale
     qi = pos + i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, T), 0)
@@ -232,7 +236,7 @@ def _causal_flash_pallas(q, k, v, pos, start, *, block_q: int,
             kv_spec,
             pl.BlockSpec((1,), lambda b, h, i: (0,),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1,), lambda b, h, i: (b,),
+            pl.BlockSpec((B,), lambda b, h, i: (0,),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),

@@ -436,16 +436,22 @@ def _driver_window() -> int:
         log(f"[bench] {last_err}")
         _cleanup_store(store_name)
         if "phase-" in stage:
-            # the claim landed and the series began, so non-embed
-            # phases may already have ledgered records — retries only
-            # need the missing headline, not a duplicate full series.
-            # Intersect with the caller's selection: a BENCH_PHASES
-            # without embed (e.g. make bench-cpu's embed,store_ops
-            # after embed already succeeded) must not be silently
-            # replaced by an embed-only retry that exits 0 with the
-            # requested phases unrun.
-            asked = [p.strip() for p in os.environ.get(
-                "BENCH_PHASES", "embed").split(",") if p.strip()]
+            # the claim landed and the series began, so phases that
+            # SUCCEEDED (their "-done" marker is only written on
+            # success) already have ledgered records — retries only
+            # need the missing ones, not a duplicate full series.
+            # The request set must match the child's semantics: unset
+            # BENCH_PHASES means the full series on TPU and embed-only
+            # under BENCH_CPU=1 (bench_series.main), not "embed".
+            env_sel = os.environ.get("BENCH_PHASES", "").strip()
+            if env_sel:
+                asked = [p.strip() for p in env_sel.split(",")
+                         if p.strip()]
+            elif os.environ.get("BENCH_CPU") == "1":
+                asked = ["embed"]
+            else:
+                from bench_series import ALL_PHASES
+                asked = list(ALL_PHASES)
             done_ph = {s.split("-done")[0].removeprefix("phase-")
                        for s in _all_stages(stagefile)
                        if s.startswith("phase-") and s.endswith("-done")}

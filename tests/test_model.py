@@ -55,6 +55,33 @@ def test_bucket_for(model):
     assert model.bucket_for(999) == 128  # beyond window: clamps to it
 
 
+@pytest.mark.parametrize("wire", ["f16", "bf16"])
+def test_fetch_dtype_wire(model, wire):
+    """f16/bf16 wire fetch: caller still gets f32, values within the
+    wire format's quantization of the f32 reference (unit vectors, so
+    absolute tolerance ~= the format's eps)."""
+    cfg = EncoderConfig.tiny(out_dim=32)
+    m2 = EmbeddingModel(cfg, buckets=(16, 32, 64), fetch_dtype=wire)
+    ids = np.random.default_rng(3).integers(0, 1024, (4, 16)) \
+        .astype(np.int32)
+    lens = np.array([16, 10, 5, 1], np.int32)
+    ref = model.encode_ids(ids, lens)
+    got = m2.encode_ids(ids, lens)
+    assert got.dtype == np.float32
+    tol = 2e-3 if wire == "f16" else 1.6e-2   # 2^-10 / 2^-7 ulps in [-1,1]
+    np.testing.assert_allclose(got, ref, atol=tol)
+    # the pending result really is 2 bytes/component on the wire
+    pend = m2.encode_ids_async(ids, lens)
+    assert jnp.asarray(pend._out).dtype.itemsize == 2
+    assert pend.materialize().dtype == np.float32
+
+
+def test_fetch_dtype_rejects_unknown():
+    cfg = EncoderConfig.tiny(out_dim=32)
+    with pytest.raises(ValueError):
+        EmbeddingModel(cfg, buckets=(16,), fetch_dtype="f8")
+
+
 def test_bert_variant_runs():
     cfg = EncoderConfig.tiny(variant="bert", out_dim=16)
     m = EmbeddingModel(cfg, buckets=(16,))

@@ -443,7 +443,12 @@ def _driver_window() -> int:
             # The request set must match the child's semantics: unset
             # BENCH_PHASES means the full series on TPU and embed-only
             # under BENCH_CPU=1 (bench_series.main), not "embed".
-            env_sel = os.environ.get("BENCH_PHASES", "").strip()
+            # seed from the PREVIOUS restriction when one exists: the
+            # stagefile is wiped per attempt, so recomputing from the
+            # environment would re-add phases that succeeded in an
+            # earlier attempt of this same window
+            env_sel = (restricted_phases
+                       or os.environ.get("BENCH_PHASES", "")).strip()
             if env_sel:
                 asked = [p.strip() for p in env_sel.split(",")
                          if p.strip()]

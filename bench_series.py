@@ -407,8 +407,13 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
         return batch, depth, (parts[2] if len(parts) > 2
                               else default_fetch)
 
+    # default set (2026-07-31): the f16-vs-f32 wire A/B at the tuned
+    # batch_cap (same-window, so tunnel drift can't confound it), the
+    # 8192 scaling point, and a 2048 anchor comparable to the ledger's
+    # existing curve
     cfgs = [_parse(c) for c in os.environ.get(
-        "SWEEP_CONFIGS", "512x2,512x1,512x4,256x2,1024x2").split(",")]
+        "SWEEP_CONFIGS",
+        "4096x2xf32,4096x2xf16,8192x2xf16,2048x2xf16").split(",")]
     bucket = int(os.environ.get("BENCH_BUCKET", "64"))
     buckets = tuple(int(x) for x in os.environ.get(
         "BENCH_BUCKETS", f"16,32,{bucket}").split(","))
@@ -417,12 +422,17 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
     models: dict[str, EmbeddingModel] = {}
 
     def _model(fetch: str) -> EmbeddingModel:
-        if fetch not in models:
-            models[fetch] = EmbeddingModel(
+        key = "f32" if fetch in ("f32", "", "none") else fetch
+        if key not in models:
+            # share one param set across wire dtypes: only the jitted
+            # output cast differs, and a duplicate flax init would
+            # burn claim-window seconds and device memory for nothing
+            donor = next(iter(models.values()), None)
+            models[key] = EmbeddingModel(
                 cfg, buckets=buckets,
-                fetch_dtype=None if fetch in ("f32", "", "none")
-                else fetch)
-        return models[fetch]
+                params=None if donor is None else donor.params,
+                fetch_dtype=None if key == "f32" else key)
+        return models[key]
 
     tok = default_tokenizer(cfg.vocab_size)
     texts = make_texts(n_texts)
@@ -862,7 +872,12 @@ def phase_search(ctx: SeriesCtx) -> dict:
     lane = rng.normal(size=(n, d)).astype(np.float32)
     gen_s = time.perf_counter() - t0
     QB = 32
-    queries = rng.normal(size=(max(reps, QB), d)).astype(np.float32)
+    # the big batch exposes the device's aggregate rate through a
+    # high-RTT runtime: at ~70 ms/dispatch, single-query q/s measures
+    # the tunnel, QB amortizes it
+    QB2 = int(os.environ.get("SEARCH_QB2", "256"))
+    queries = rng.normal(size=(max(reps, QB, QB2), d)) \
+        .astype(np.float32)
 
     # probe the host->device bandwidth on a small slice first: over
     # the tunnel it is an unknown, and a 2.9 GB device_put that takes
@@ -927,6 +942,17 @@ def phase_search(ctx: SeriesCtx) -> dict:
     qps_batch = reps_b * QB / (time.perf_counter() - t0)
     log(f"batched: {qps_batch:.1f} q/s aggregate (QB={QB})")
 
+    qps_batch_big = 0.0
+    if QB2 > QB:
+        cosine_topk_batch(lane_dev, queries[:QB2], k,
+                          use_pallas=use_pallas, vnorm=vnorm_dev)
+        t0 = time.perf_counter()
+        for _ in range(2):
+            cosine_topk_batch(lane_dev, queries[:QB2], k,
+                              use_pallas=use_pallas, vnorm=vnorm_dev)
+        qps_batch_big = 2 * QB2 / (time.perf_counter() - t0)
+        log(f"batched: {qps_batch_big:.1f} q/s aggregate (QB={QB2})")
+
     # host numpy scan: vectorized stand-in for the reference's scalar C
     # scan (splinter_cli_cmd_search.c:374-412), i.e. a GENEROUS baseline
     nn = min(n, 100_000)
@@ -952,6 +978,8 @@ def phase_search(ctx: SeriesCtx) -> dict:
             "qps_f32": round(qps_f32, 1),
             "qps_bf16_fast": round(qps_bf16, 1),
             "qps_batch32_aggregate": round(qps_batch, 1),
+            "qb_big": QB2,
+            "qps_batch_big_aggregate": round(qps_batch_big, 1),
             "bf16_speedup": round(qps_bf16 / qps_f32, 2)
             if qps_f32 > 0 and qps_bf16 > 0 else None,
             "qps_numpy_hostscan": round(qps_np, 2),
@@ -1014,6 +1042,22 @@ def phase_restage(ctx: SeriesCtx) -> dict:
         log(f"[restage] full upload: {full_upload_s:.2f}s "
             f"({nslots * dim * 4 / 1e6 / full_upload_s:,.0f} MB/s)")
 
+        # f16-wire A/B in the SAME window (link conditions drift
+        # between claims): second full upload with half the bytes.
+        # TPU only — on the CPU backend the duplicate lane is host
+        # RSS and would corrupt this phase's max_rss memory-diet
+        # evidence (on TPU it is HBM, freed right after).
+        f16_upload_s = None
+        if on_tpu:
+            lane16 = StagedLane(st, wire="f16")
+            t0 = time.perf_counter()
+            jax.block_until_ready(lane16.refresh())
+            f16_upload_s = time.perf_counter() - t0
+            del lane16                    # free the duplicate HBM lane
+            log(f"[restage] f16-wire upload: {f16_upload_s:.2f}s "
+                f"({nslots * dim * 2 / 1e6 / f16_upload_s:,.0f} "
+                f"MB/s wire)")
+
         def timed_refresh() -> float:
             t0 = time.perf_counter()
             jax.block_until_ready(lane.refresh())
@@ -1053,6 +1097,10 @@ def phase_restage(ctx: SeriesCtx) -> dict:
             "full_upload_s": round(full_upload_s, 2),
             "upload_mb_s": round(nslots * dim * 4 / 1e6
                                  / full_upload_s, 1),
+            "f16_wire_upload_s": round(f16_upload_s, 2)
+            if f16_upload_s else None,
+            "f16_wire_speedup": round(full_upload_s / f16_upload_s, 2)
+            if f16_upload_s else None,
             "refresh_clean_ms": round(clean_ms, 1),
             "refresh_128_dirty_ms": round(results[128], 1),
             "refresh_8192_dirty_ms": round(results[8192], 1),

@@ -24,6 +24,7 @@ every reader of the store (sptpu.h EAGAIN contract).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -90,7 +91,11 @@ def _chunk_update_fn():
 
     @functools.partial(jax.jit, donate_argnums=0)
     def upd(arr, vals, start):
-        return jax.lax.dynamic_update_slice(arr, vals, (start, 0))
+        # vals may arrive in a narrower wire dtype (f16): the device
+        # lane stays f32, so the upcast happens on-device where it is
+        # free, not on the host where it would double the transfer
+        return jax.lax.dynamic_update_slice(
+            arr, vals.astype(arr.dtype), (start, 0))
 
     return upd
 
@@ -107,7 +112,7 @@ def _scatter_fn():
 
     @functools.partial(jax.jit, donate_argnums=0)
     def scatter(arr, rows, vals):
-        return arr.at[rows].set(vals)
+        return arr.at[rows].set(vals.astype(arr.dtype))
 
     return scatter
 
@@ -127,9 +132,22 @@ class StagedLane:
     before each read of .array — or just use topk(), which does both.
     """
 
-    def __init__(self, store: Store, *, device=None):
+    def __init__(self, store: Store, *, device=None, wire: str | None = None):
+        """wire: host->device transfer dtype for staging — "f32"
+        (default) ships the lane bit-exact; "f16" halves the staged
+        bytes (upcast to f32 on-device; ~1e-3 component quantization,
+        ranking-equivalent for cosine top-k).  f16 pays a host-side
+        astype per chunk, so it wins when link bandwidth is the
+        bottleneck (tunneled/remote runtimes, DCN-attached hosts) and
+        loses nothing but exactness on fast PCIe — hence opt-in.
+        Resolved from SPTPU_LANE_WIRE when not passed."""
         if store.vec_dim == 0:
             raise ValueError("store has no vector lane (vec_dim=0)")
+        wire = wire or os.environ.get("SPTPU_LANE_WIRE", "f32")
+        if wire not in ("f32", "f16"):
+            raise ValueError(f"wire {wire!r} not in ('f32', 'f16')")
+        self.wire = wire
+        self._wire_np = np.float16 if wire == "f16" else np.float32
         self._st = store
         self._device = device
         self._arr = None                 # jax.Array (nslots, dim) f32
@@ -165,8 +183,10 @@ class StagedLane:
         for lo in range(0, n, chunk):
             hi = min(lo + chunk, n)
             vals = np.ascontiguousarray(view[lo:hi], dtype=np.float32)
-            arr = upd(arr, vals, np.int32(lo))
+            # norms from the exact f32 data; the wire copy may be f16
             norms_host[lo:hi] = np.linalg.norm(vals, axis=1)
+            arr = upd(arr, vals.astype(self._wire_np, copy=False),
+                      np.int32(lo))
             _advise_dontneed(view[lo:hi])    # staged; drop our PTEs
         e2 = st.epochs()
         stable = (e1 == e2) & ((e1 & 1) == 0)
@@ -193,17 +213,20 @@ class StagedLane:
             if rows.size:
                 n = int(rows.size)
                 b = _bucket(n)
+                g = vecs[ok]              # one gather for vals + norms
                 # pad with a duplicate of row 0 — scatter-set with an
                 # identical (row, value) pair is idempotent
                 rows_p = np.empty(b, np.int32)
                 rows_p[:n] = rows
                 rows_p[n:] = rows[0]
-                vals_p = np.empty((b, vecs.shape[1]), np.float32)
-                vals_p[:n] = vecs[ok]
-                vals_p[n:] = vecs[ok][0]
+                vals_p = np.empty((b, vecs.shape[1]), self._wire_np)
+                vals_p[:n] = g
+                vals_p[n:] = g[0]
                 self._arr = _scatter_fn()(self._arr, rows_p, vals_p)
-                norms_p = np.linalg.norm(vals_p, axis=1) \
-                    .astype(np.float32)
+                # norms from the exact f32 gather (not the wire copy)
+                norms_p = np.empty(b, np.float32)
+                norms_p[:n] = np.linalg.norm(g, axis=1)
+                norms_p[n:] = norms_p[0]
                 self._norms = _scatter_fn()(self._norms, rows_p,
                                             norms_p)
                 self._staged[rows] = eps[ok]

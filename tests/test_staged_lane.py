@@ -162,3 +162,53 @@ class TestNorms:
         s, i = lane.topk(q, k=1)
         assert int(i[0]) == slot
         assert s[0] == pytest.approx(1.0, abs=1e-5)
+
+
+class TestWireDtype:
+    """f16 staging wire: half the staged bytes, device lane still f32,
+    quantization bounded and ranking-preserved; norms stay exact (they
+    come from the f32 data, not the wire copy)."""
+
+    def test_f16_upload_and_refresh(self, store):
+        dim = store.vec_dim
+        vecs = _fill(store, 20, dim)
+        lane = StagedLane(store, wire="f16")
+        arr = np.asarray(lane.refresh())
+        assert arr.dtype == np.float32        # device lane stays f32
+        for i in range(20):
+            np.testing.assert_allclose(
+                arr[store.find_index(f"doc/{i}")], vecs[i],
+                atol=2e-3, rtol=2e-3)         # f16 quantization bound
+        # incremental path quantizes the same way
+        new = np.full(dim, 0.123456, np.float32)
+        store.vec_set("doc/0", new)
+        arr = np.asarray(lane.refresh())
+        assert lane.full_uploads == 1 and lane.rows_staged == 1
+        np.testing.assert_allclose(
+            arr[store.find_index("doc/0")], new, atol=2e-3, rtol=2e-3)
+        # norms are computed from the exact f32 gather, not the wire
+        want = np.linalg.norm(np.array(store.vectors), axis=1)
+        np.testing.assert_allclose(np.asarray(lane.norms), want,
+                                   rtol=1e-6)
+
+    def test_f16_ranking_matches_f32(self, store):
+        dim = store.vec_dim
+        _fill(store, 32, dim, seed=5)
+        f32 = StagedLane(store)
+        f16 = StagedLane(store, wire="f16")
+        q = np.array(store.vectors)[store.find_index("doc/7")]
+        _, i32 = f32.topk(q, k=5)
+        _, i16 = f16.topk(q, k=5)
+        assert int(i16[0]) == int(i32[0]) == store.find_index("doc/7")
+        assert set(map(int, i16)) == set(map(int, i32))
+
+    def test_wire_rejects_unknown(self, store):
+        with pytest.raises(ValueError):
+            StagedLane(store, wire="int8")
+
+    def test_wire_env_default(self, store, monkeypatch):
+        monkeypatch.setenv("SPTPU_LANE_WIRE", "f16")
+        lane = StagedLane(store)
+        assert lane.wire == "f16"
+        monkeypatch.delenv("SPTPU_LANE_WIRE")
+        assert StagedLane(store).wire == "f32"

@@ -407,13 +407,12 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
         return batch, depth, (parts[2] if len(parts) > 2
                               else default_fetch)
 
-    # default set (2026-07-31): the f16-vs-f32 wire A/B at the tuned
-    # batch_cap (same-window, so tunnel drift can't confound it), the
-    # 8192 scaling point, and a 2048 anchor comparable to the ledger's
-    # existing curve
+    # default set (2026-07-31): the f32/f16/int8 wire A/B at the tuned
+    # batch_cap (same-window, so tunnel drift can't confound it) and
+    # the 8192 scaling point
     cfgs = [_parse(c) for c in os.environ.get(
         "SWEEP_CONFIGS",
-        "4096x2xf32,4096x2xf16,8192x2xf16,2048x2xf16").split(",")]
+        "4096x2xf32,4096x2xf16,4096x2xint8,8192x2xf16").split(",")]
     bucket = int(os.environ.get("BENCH_BUCKET", "64"))
     buckets = tuple(int(x) for x in os.environ.get(
         "BENCH_BUCKETS", f"16,32,{bucket}").split(","))

@@ -231,9 +231,14 @@ class PendingEmbeddings:
         self.n = n
 
     def materialize(self) -> np.ndarray:
-        # fetch in the model's wire dtype (f16/bf16 halves the
-        # device->host bytes on the commit path), hand f32 to callers
+        # fetch in the model's wire dtype (f16 halves, int8 quarters
+        # the device->host bytes on the commit path), hand f32 to
+        # callers.  int8 is fixed-scale x127: components of an
+        # L2-normalized embedding lie in [-1, 1], so no per-vector
+        # scale row is needed.
         out = np.asarray(self._out)[: self.n]
+        if out.dtype == np.int8:
+            return out.astype(np.float32) * np.float32(1.0 / 127.0)
         return out.astype(np.float32, copy=False)
 
 
@@ -266,15 +271,18 @@ class EmbeddingModel:
         output on-device and fetch 2 bytes/component — half the
         device->host transfer on the vector-commit path, which is the
         serving bottleneck when host link bandwidth (not the MXU) caps
-        throughput.  f16 is the better wire format here: components of
-        a unit vector lie in [-1, 1], where f16's 10 mantissa bits
-        beat bf16's 7 (no range to protect).  materialize() always
-        hands the caller f32."""
+        throughput.  f16 is the better 2-byte wire: components of a
+        unit vector lie in [-1, 1], where f16's 10 mantissa bits beat
+        bf16's 7 (no range to protect).  "int8" fetches 1
+        byte/component at a FIXED x127 scale (again: unit vectors need
+        no per-vector scale row) — quarter the bytes, ~4e-3 rounding
+        error, still ranking-equivalent for cosine retrieval.
+        materialize() always hands the caller f32."""
         self.cfg = cfg
         self.module = Encoder(cfg)
-        if fetch_dtype not in (None, "f16", "bf16"):
+        if fetch_dtype not in (None, "f16", "bf16", "int8"):
             raise ValueError(f"fetch_dtype {fetch_dtype!r} not in "
-                             f"(None, 'f16', 'bf16')")
+                             f"(None, 'f16', 'bf16', 'int8')")
         self.fetch_dtype = fetch_dtype
         # always include max_len itself: a long-context checkpoint whose
         # window exceeds the default bucket list must not have texts
@@ -297,13 +305,18 @@ class EmbeddingModel:
         self.params = params
 
         wire = {None: None, "f16": jnp.float16,
-                "bf16": jnp.bfloat16}[fetch_dtype]
+                "bf16": jnp.bfloat16, "int8": jnp.int8}[fetch_dtype]
 
         def fwd(params, token_ids, lengths):
             mask = jnp.arange(token_ids.shape[1])[None, :] < \
                 lengths[:, None]
             out = self.module.apply(params, token_ids, mask)
-            return out if wire is None else out.astype(wire)
+            if wire is None:
+                return out
+            if wire == jnp.int8:
+                return jnp.clip(jnp.round(out * 127.0),
+                                -127.0, 127.0).astype(jnp.int8)
+            return out.astype(wire)
 
         self._fn = jax.jit(fwd)
 

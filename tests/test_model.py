@@ -55,11 +55,15 @@ def test_bucket_for(model):
     assert model.bucket_for(999) == 128  # beyond window: clamps to it
 
 
-@pytest.mark.parametrize("wire", ["f16", "bf16"])
-def test_fetch_dtype_wire(model, wire):
-    """f16/bf16 wire fetch: caller still gets f32, values within the
+@pytest.mark.parametrize("wire,bytes_,tol", [
+    ("f16", 2, 2e-3),       # 2^-10 ulps in [-1, 1]
+    ("bf16", 2, 1.6e-2),    # 2^-7
+    ("int8", 1, 5e-3),      # half-step of the fixed x127 scale
+])
+def test_fetch_dtype_wire(model, wire, bytes_, tol):
+    """Narrow wire fetch: caller still gets f32, values within the
     wire format's quantization of the f32 reference (unit vectors, so
-    absolute tolerance ~= the format's eps)."""
+    absolute tolerance ~= the format's step)."""
     cfg = EncoderConfig.tiny(out_dim=32)
     m2 = EmbeddingModel(cfg, buckets=(16, 32, 64), fetch_dtype=wire)
     ids = np.random.default_rng(3).integers(0, 1024, (4, 16)) \
@@ -68,12 +72,15 @@ def test_fetch_dtype_wire(model, wire):
     ref = model.encode_ids(ids, lens)
     got = m2.encode_ids(ids, lens)
     assert got.dtype == np.float32
-    tol = 2e-3 if wire == "f16" else 1.6e-2   # 2^-10 / 2^-7 ulps in [-1,1]
     np.testing.assert_allclose(got, ref, atol=tol)
-    # the pending result really is 2 bytes/component on the wire
+    # the pending result really is this narrow on the wire
     pend = m2.encode_ids_async(ids, lens)
-    assert jnp.asarray(pend._out).dtype.itemsize == 2
+    assert jnp.asarray(pend._out).dtype.itemsize == bytes_
     assert pend.materialize().dtype == np.float32
+    # retrieval sanity: each row's nearest neighbour among the f32
+    # reference vectors is itself
+    sims = got @ ref.T
+    assert (np.argmax(sims, axis=1) == np.arange(4)).all()
 
 
 def test_fetch_dtype_rejects_unknown():

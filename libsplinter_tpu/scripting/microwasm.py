@@ -15,6 +15,12 @@ interpreter covering the MVP core:
               widths), memory.size, memory.grow; bulk memory
               (memory.copy/fill/init, data.drop, passive data segments
               — modern clang --target=wasm32 emits these by default)
+  tables      funcref table 0 end to end: the full elem-segment flag
+              matrix (active/passive/declared, index- or
+              expr-encoded), table.get/set, table.init/copy/grow/
+              size/fill + elem.drop, and the funcref ops
+              ref.null/ref.is_null/ref.func (null = -1 in the
+              unityped interpreter)
   misc        the 0xFC saturating float->int truncation matrix
               (i32/i64.trunc_sat_f32/f64_s/u)
   numeric     full i32/i64 ALU (clz..rotr), f32/f64 arithmetic & compares,
@@ -30,12 +36,11 @@ interpreter covering the MVP core:
               arith/sqrt/rounding/min/max/pmin/pmax, and the
               int<->float conversion matrix
 
-Out of scope (raise WasmError): threads, reference types, multi-value
-block signatures, the table.* bulk ops (table.init/copy/grow/fill),
-and the SIMD tail that exists for codec inner loops (q15mulr,
-extadd_pairwise, extmul, relaxed-simd).  Scripts that heavy-compute
-belong in the JAX tier; wasm here is a portable *protocol* client,
-like the reference's.
+Out of scope (raise WasmError): threads, externref / multiple tables,
+multi-value block signatures, and the SIMD tail that exists for codec
+inner loops (q15mulr, extadd_pairwise, extmul, relaxed-simd).
+Scripts that heavy-compute belong in the JAX tier; wasm here is a
+portable *protocol* client, like the reference's.
 
 Host functions are supplied as a dict {("module","name"): python_callable};
 callables receive (Instance, *args) so they can touch linear memory.
@@ -143,7 +148,14 @@ class Module:
     funcs: list = field(default_factory=list)     # local funcs
     n_imported_funcs: int = 0
     table_min: int = 0
-    elem: dict = field(default_factory=dict)      # table idx -> func idx
+    table_max: Optional[int] = None
+    # every elem segment in index order, for table.init/elem.drop:
+    # ("active", offset, [funcidx]) | ("passive", None, [funcidx]) |
+    # ("declared", None, [funcidx]) — active ones are applied to the
+    # table then implicitly dropped at instantiation, declared ones
+    # exist only to forward-declare ref.func targets and start dropped
+    # (bulk-memory/reference-types spec).  null refs are -1.
+    elemsegs: list = field(default_factory=list)
     mem_min: int = 0
     mem_max: Optional[int] = None
     globals: list = field(default_factory=list)   # (valtype, mutable, init)
@@ -301,6 +313,17 @@ def _decode_expr(r: _Reader) -> list:
             out.append((op,))
         elif op in (0x20, 0x21, 0x22, 0x23, 0x24):  # local/global access
             out.append((op, r.uleb()))
+        elif op in (0x25, 0x26):                # table.get/set (table 0)
+            if r.uleb() != 0:
+                raise WasmError("only table 0 supported")
+            out.append((op,))
+        elif op == 0xD0:                        # ref.null t -> -1
+            r.u8()
+            out.append((op,))
+        elif op == 0xD1:                        # ref.is_null
+            out.append((op,))
+        elif op == 0xD2:                        # ref.func f
+            out.append((op, r.uleb()))
         elif 0x28 <= op <= 0x3E:                # loads & stores
             align, offset = r.uleb(), r.uleb()
             out.append((op, align, offset))
@@ -337,9 +360,23 @@ def _decode_expr(r: _Reader) -> list:
                 if r.u8() != 0:
                     raise WasmError("memory.fill: only memory 0")
                 out.append((0xFC0B,))
+            elif sub == 12:                     # table.init elem table
+                seg = r.uleb()
+                if r.uleb() != 0:
+                    raise WasmError("table.init: only table 0")
+                out.append((0xFC0C, seg))
+            elif sub == 13:                     # elem.drop elemidx
+                out.append((0xFC0D, r.uleb()))
+            elif sub == 14:                     # table.copy table table
+                if r.uleb() != 0 or r.uleb() != 0:
+                    raise WasmError("table.copy: only table 0")
+                out.append((0xFC0E,))
+            elif sub in (15, 16, 17):           # table.grow/size/fill
+                if r.uleb() != 0:
+                    raise WasmError("table.*: only table 0")
+                out.append((0xFC00 | sub,))
             else:
-                raise WasmError(f"unsupported 0xFC opcode {sub} "
-                                f"(table.* bulk ops are out of scope)")
+                raise WasmError(f"unsupported 0xFC opcode {sub}")
         elif op == 0xFD:                        # SIMD prefix
             sub = r.uleb()
             # ops are re-keyed as 0xFD00|sub so the executor still
@@ -427,7 +464,7 @@ def decode_module(data: bytes) -> Module:
                 flags = body.u8()
                 m.table_min = body.uleb()
                 if flags & 1:
-                    body.uleb()
+                    m.table_max = body.uleb()
         elif sec == 5:                                   # memory
             for _ in range(body.uleb()):
                 flags = body.u8()
@@ -449,13 +486,40 @@ def decode_module(data: bytes) -> Module:
         elif sec == 8:                                   # start
             m.start = body.uleb()
         elif sec == 9:                                   # elem
+            # full flag matrix (spec 5.5.12): bit0 passive/declared,
+            # bit1 explicit-table-or-declared, bit2 expr-encoded refs
+            def _ref_expr(r: _Reader) -> int:
+                op = r.u8()
+                if op == 0xD2:                  # ref.func f
+                    v = r.uleb()
+                elif op == 0xD0:                # ref.null t
+                    r.u8()
+                    v = -1
+                else:
+                    raise WasmError(f"unsupported elem expr op {op:#x}")
+                if r.u8() != 0x0B:
+                    raise WasmError("elem expr: expected end")
+                return v
+
             for _ in range(body.uleb()):
-                if body.uleb() != 0:
-                    raise WasmError("only active table-0 elem segments")
-                off_expr = _decode_expr(body)
-                off = _const_expr_value(off_expr)
-                for i in range(body.uleb()):
-                    m.elem[off + i] = body.uleb()
+                flags = body.uleb()
+                if flags > 7:
+                    raise WasmError(f"bad elem segment flags {flags}")
+                off = None
+                if flags & 1 == 0:                       # active
+                    if flags & 2:                        # explicit table
+                        if body.uleb() != 0:
+                            raise WasmError("only table 0 supported")
+                    off = _const_expr_value(_decode_expr(body))
+                if flags & 3 != 0:
+                    # elemkind (0x00 = funcref) or reftype (0x70)
+                    if body.u8() not in (0x00, 0x70):
+                        raise WasmError("only funcref elem segments")
+                refs = [(_ref_expr(body) if flags & 4 else body.uleb())
+                        for _ in range(body.uleb())]
+                mode = ("active" if flags & 1 == 0
+                        else "declared" if flags & 3 == 3 else "passive")
+                m.elemsegs.append((mode, off, refs))
         elif sec == 10:                                  # code
             for _ in range(body.uleb()):
                 sz = body.uleb()
@@ -597,6 +661,20 @@ class Instance:
         self.datasegs: list[Optional[bytes]] = [
             payload if mode == "passive" else None
             for mode, payload in module.datasegs]
+        # runtime funcref table (-1 = null) + elem segment store with
+        # the same lifecycle as datasegs: active applied then dropped,
+        # declared born dropped, passive live until elem.drop
+        self.table: list[int] = [-1] * module.table_min
+        self.elemsegs: list[Optional[list[int]]] = []
+        for mode, off, refs in module.elemsegs:
+            if mode == "active":
+                if off + len(refs) > len(self.table):
+                    raise WasmError("elem segment out of bounds")
+                self.table[off: off + len(refs)] = refs
+                self.elemsegs.append(None)
+            else:
+                self.elemsegs.append(list(refs)
+                                     if mode == "passive" else None)
         self.steps = 0
         if module.start is not None:
             self._call_function(module.start, [])
@@ -769,8 +847,9 @@ class Instance:
             if op == 0x11:                       # call_indirect
                 ti = ins[1]
                 elem_i = stack.pop()
-                target = self.m.elem.get(elem_i)
-                if target is None:
+                target = self.table[elem_i] \
+                    if 0 <= elem_i < len(self.table) else -1
+                if target < 0:
                     raise Trap("undefined table element")
                 ft = self.m.types[ti]
                 argn = len(ft.params)
@@ -779,7 +858,24 @@ class Instance:
                 stack.extend(self._call_function(target, args))
                 pc += 1
                 continue
-            if op == 0x1A:                       # drop
+            if op == 0xD0:                       # ref.null -> -1
+                stack.append(-1)
+            elif op == 0xD1:                     # ref.is_null
+                stack.append(1 if stack.pop() < 0 else 0)
+            elif op == 0xD2:                     # ref.func f
+                stack.append(ins[1])
+            elif op == 0x25:                     # table.get
+                i = _wrap32(stack.pop())
+                if i >= len(self.table):
+                    raise Trap("out of bounds table.get")
+                stack.append(self.table[i])
+            elif op == 0x26:                     # table.set
+                v = stack.pop()
+                i = _wrap32(stack.pop())
+                if i >= len(self.table):
+                    raise Trap("out of bounds table.set")
+                self.table[i] = v
+            elif op == 0x1A:                     # drop
                 stack.pop()
             elif op == 0x1B:                     # select
                 c = stack.pop()
@@ -851,6 +947,53 @@ class Instance:
                 if d + n > len(mem):
                     raise Trap("out of bounds memory.fill")
                 mem[d:d + n] = bytes([v]) * n
+            elif op == 0xFC0C:                   # table.init
+                n = _wrap32(stack.pop())
+                s = _wrap32(stack.pop())
+                d = _wrap32(stack.pop())
+                seg = self.elemsegs[ins[1]] \
+                    if ins[1] < len(self.elemsegs) else None
+                src = seg if seg is not None else []
+                if s + n > len(src) or d + n > len(self.table):
+                    raise Trap("out of bounds table.init")
+                self.table[d:d + n] = src[s:s + n]
+            elif op == 0xFC0D:                   # elem.drop
+                if ins[1] < len(self.elemsegs):
+                    self.elemsegs[ins[1]] = None
+            elif op == 0xFC0E:                   # table.copy (memmove)
+                n = _wrap32(stack.pop())
+                s = _wrap32(stack.pop())
+                d = _wrap32(stack.pop())
+                if s + n > len(self.table) or d + n > len(self.table):
+                    raise Trap("out of bounds table.copy")
+                self.table[d:d + n] = self.table[s:s + n]
+            elif op == 0xFC0F:                   # table.grow
+                n = _wrap32(stack.pop())
+                v = stack.pop()
+                old = len(self.table)
+                # like memory.grow's 4 GiB page ceiling: an untrusted
+                # module must not be able to allocate unbounded host
+                # memory through a no-max table — failure is the
+                # spec's -1, never a host MemoryError
+                cap = self.m.table_max if self.m.table_max is not None \
+                    else 1 << 20
+                if old + n > cap:
+                    stack.append(_wrap32(-1))
+                else:
+                    try:
+                        self.table.extend([v] * n)
+                        stack.append(old)
+                    except MemoryError:
+                        stack.append(_wrap32(-1))
+            elif op == 0xFC10:                   # table.size
+                stack.append(len(self.table))
+            elif op == 0xFC11:                   # table.fill
+                n = _wrap32(stack.pop())
+                v = stack.pop()
+                i = _wrap32(stack.pop())
+                if i + n > len(self.table):
+                    raise Trap("out of bounds table.fill")
+                self.table[i:i + n] = [v] * n
             elif op >= 0xFD00:                   # SIMD (pops/pushes itself)
                 self._simd(ins, stack)
             else:

@@ -558,11 +558,156 @@ class TestBulkMemory:
         with pytest.raises(Trap, match="memory.fill"):
             inst.invoke("run", [])
 
-    def test_table_bulk_ops_rejected(self):
-        body = i32c(0) + i32c(0) + i32c(0) + FC(12, uleb(0) + b"\x00") \
-            + END
-        with pytest.raises(WasmError, match="table"):
-            instantiate(simple_module([], [], body))
+class TestTables:
+    """Funcref table tier: the elem-segment flag matrix, table.* bulk
+    ops, and the ref opcodes — matching what the reference gets from
+    WasmEdge's reference-types/bulk-memory support
+    (splinter_cli_cmd_wasm.c:85-143).  Funcs 0..2 return 10..12; null
+    refs are -1 in the unityped interpreter."""
+
+    CALL_IND = b"\x11" + uleb(0) + uleb(0)    # call_indirect type0 tbl0
+
+    def table_module(self, run_body, *, elem: bytes = b"",
+                     table=(8, None)):
+        tmin, tmax = table
+        tbl = b"\x70" + (b"\x00" + uleb(tmin) if tmax is None
+                         else b"\x01" + uleb(tmin) + uleb(tmax))
+        consts = [code_entry([], i32c(10 + i) + END) for i in range(3)]
+        secs = [
+            section(1, vec([functype([], [I32])])),
+            section(3, vec([uleb(0)] * 4)),
+            section(4, vec([tbl])),
+            section(7, vec([name("run") + b"\x00" + uleb(3)])),
+        ]
+        if elem:
+            secs.append(section(9, elem))
+        secs.append(section(10, vec(consts + [code_entry([], run_body)])))
+        return module(secs)
+
+    # elem segment encodings by flag
+    @staticmethod
+    def elem_active(off, funcs):
+        return uleb(0) + i32c(off) + END + vec([uleb(f) for f in funcs])
+
+    @staticmethod
+    def elem_passive(funcs):
+        return uleb(1) + b"\x00" + vec([uleb(f) for f in funcs])
+
+    @staticmethod
+    def elem_declared(funcs):
+        return uleb(3) + b"\x00" + vec([uleb(f) for f in funcs])
+
+    @staticmethod
+    def elem_passive_exprs(entries):
+        """entries: funcidx or None (ref.null)."""
+        return uleb(5) + b"\x70" + vec(
+            [(b"\xd0\x70" if f is None else b"\xd2" + uleb(f)) + END
+             for f in entries])
+
+    def test_active_elem_call_indirect(self):
+        m = self.table_module(i32c(1) + self.CALL_IND + END,
+                              elem=vec([self.elem_active(0, [0, 1, 2])]))
+        assert instantiate(m).invoke("run", []) == [11]
+
+    def test_table_init_from_passive(self):
+        body = (i32c(0) + i32c(0) + i32c(3)
+                + FC(12, uleb(0) + uleb(0))          # table.init seg0
+                + i32c(2) + self.CALL_IND + END)
+        m = self.table_module(body,
+                              elem=vec([self.elem_passive([0, 1, 2])]))
+        assert instantiate(m).invoke("run", []) == [12]
+
+    def test_elem_drop_then_init_traps(self):
+        body = (FC(13, uleb(0))                      # elem.drop 0
+                + i32c(0) + i32c(0) + i32c(1)
+                + FC(12, uleb(0) + uleb(0)) + END)
+        m = self.table_module(body,
+                              elem=vec([self.elem_passive([0])]))
+        with pytest.raises(Trap, match="table.init"):
+            instantiate(m).invoke("run", [])
+
+    def test_elem_drop_then_zero_init_ok(self):
+        body = (FC(13, uleb(0))
+                + i32c(0) + i32c(0) + i32c(0)        # n=0 is fine
+                + FC(12, uleb(0) + uleb(0)) + END)
+        m = self.table_module(body,
+                              elem=vec([self.elem_passive([0])]))
+        instantiate(m).invoke("run", [])
+
+    def test_table_copy_is_memmove(self):
+        # table [f0,f1,f2,...] --copy d=1 s=0 n=2--> [f0,f0,f1,...]
+        body = (i32c(1) + i32c(0) + i32c(2)
+                + FC(14, uleb(0) + uleb(0))          # table.copy
+                + i32c(2) + self.CALL_IND + END)
+        m = self.table_module(body,
+                              elem=vec([self.elem_active(0, [0, 1, 2])]))
+        assert instantiate(m).invoke("run", []) == [11]
+
+    def test_grow_size_and_max(self):
+        # size(8) + grow(null, 4) -> 8; size -> 12; grow past max -> -1
+        body = (FC(16, uleb(0))                      # table.size: 8
+                + b"\xd0\x70" + i32c(4) + FC(15, uleb(0))   # grow: 8
+                + b"\x6a"                            # 8 + 8 = 16
+                + FC(16, uleb(0)) + b"\x6a"          # +12 = 28
+                + b"\xd0\x70" + i32c(100) + FC(15, uleb(0)) # -> -1
+                + b"\x6a" + END)                     # 28 + -1 = 27
+        m = self.table_module(body, table=(8, 12))
+        assert instantiate(m).invoke("run", []) == [27]
+
+    def test_get_set_and_refs(self):
+        # table.set 5 = ref.func 2; call 5 -> 12; ref.is_null(get 0) -> 1
+        body = (i32c(5) + b"\xd2" + uleb(2) + b"\x26" + uleb(0)
+                + i32c(5) + self.CALL_IND
+                + i32c(0) + b"\x25" + uleb(0) + b"\xd1"
+                + b"\x6a" + END)                     # 12 + 1
+        m = self.table_module(body)
+        assert instantiate(m).invoke("run", []) == [13]
+
+    def test_table_fill_then_call(self):
+        body = (i32c(2) + b"\xd2" + uleb(0) + i32c(3)
+                + FC(17, uleb(0))                    # fill [2,5) = f0
+                + i32c(4) + self.CALL_IND + END)
+        m = self.table_module(body)
+        assert instantiate(m).invoke("run", []) == [10]
+
+    def test_expr_elems_and_null_trap(self):
+        init = (i32c(0) + i32c(0) + i32c(2)
+                + FC(12, uleb(0) + uleb(0)))
+        m_ok = self.table_module(
+            init + i32c(0) + self.CALL_IND + END,
+            elem=vec([self.elem_passive_exprs([2, None])]))
+        assert instantiate(m_ok).invoke("run", []) == [12]
+        m_null = self.table_module(
+            init + i32c(1) + self.CALL_IND + END,
+            elem=vec([self.elem_passive_exprs([2, None])]))
+        with pytest.raises(Trap, match="undefined table element"):
+            instantiate(m_null).invoke("run", [])
+
+    def test_declared_segment_starts_dropped(self):
+        body = (i32c(0) + i32c(0) + i32c(1)
+                + FC(12, uleb(0) + uleb(0)) + END)
+        m = self.table_module(body,
+                              elem=vec([self.elem_declared([1])]))
+        with pytest.raises(Trap, match="table.init"):
+            instantiate(m).invoke("run", [])
+
+    def test_grow_unbounded_table_is_capped(self):
+        # no-max table: a huge grow must answer -1, not allocate
+        body = (b"\xd0\x70" + i32c(0x10000000) + FC(15, uleb(0)) + END)
+        m = self.table_module(body)
+        assert instantiate(m).invoke("run", []) == [(1 << 32) - 1]
+
+    def test_call_null_slot_traps(self):
+        m = self.table_module(i32c(7) + self.CALL_IND + END)
+        with pytest.raises(Trap, match="undefined table element"):
+            instantiate(m).invoke("run", [])
+
+    def test_active_elem_oob_is_error(self):
+        m = self.table_module(i32c(0) + self.CALL_IND + END,
+                              elem=vec([self.elem_active(7, [0, 1])]),
+                              table=(8, None))
+        with pytest.raises(WasmError, match="elem segment"):
+            instantiate(m)
 
 
 class TestTruncSat:

@@ -19,7 +19,7 @@ from __future__ import annotations
 import time
 
 from .. import _native as N
-from .microlua import LuaRuntime, LuaTable
+from .microlua import LuaRuntime, LuaTable, _wrap_i64
 
 _IOPS = {
     "and": N.IOP_AND, "or": N.IOP_OR, "xor": N.IOP_XOR, "not": N.IOP_NOT,
@@ -124,6 +124,17 @@ def make_splinter_module(store) -> LuaTable:
         except (OSError, KeyError):
             return None
 
+    def _labels(key):
+        """Read a key's bloom label mask (nil on a missing key) — the
+        counterpart scripts need now that 5.4 bitwise operators make
+        mask tests (m & BIT ~= 0) expressible in-script.  Wrapped to
+        the interpreter's signed-i64 convention so a mask with bit 63
+        set compares equal to the in-script `1 << 63` constant."""
+        try:
+            return _wrap_i64(store.labels(str(key)))
+        except (OSError, KeyError):
+            return None
+
     def _bump(key):
         try:
             store.bump(str(key))
@@ -182,6 +193,7 @@ def make_splinter_module(store) -> LuaTable:
         "watch": _watch,
         "unwatch": _unwatch,
         "label": _label,
+        "labels": _labels,
         "bump": _bump,
         "sleep": _sleep,
         "get_embedding": _get_embedding,

@@ -9,12 +9,14 @@ that store scripts actually use:
               while, repeat/until, numeric & generic for, if/elseif/else,
               function (incl. methods, local function), return, break
   exprs       full operator precedence (or/and, comparisons, .., + - * / //
-              % ^, unary - not #), closures, varargs (...), method calls,
-              table constructors
+              % ^, bitwise & | ~ << >> with lua 5.4 64-bit wrap +
+              integer-representation rules, unary - not # ~), closures,
+              varargs (...), method calls, table constructors
   metatables  setmetatable/getmetatable (incl. __metatable protection),
               __index/__newindex (table + function handlers, chained),
               arithmetic (__add __sub __mul __div __idiv __mod __pow
-              __unm), __concat, __eq/__lt/__le, __len, __call,
+              __unm), bitwise (__band __bor __bxor __bnot __shl __shr),
+              __concat, __eq/__lt/__le, __len, __call,
               __tostring — the full OO-style store-script surface
               (reference embeds liblua 5.4, splinter_cli_cmd_lua.c:365-386)
   stdlib      print, type, tostring, tonumber, pairs, ipairs, select,
@@ -25,8 +27,7 @@ that store scripts actually use:
               require (host-registered modules only)
 
 Deliberately out of scope (scripts needing these belong in Python):
-coroutines, goto, bitwise operators (use splinter.math — the store's
-atomic ops — instead), io/file access (the store IS the I/O).
+coroutines, goto, io/file access (the store IS the I/O).
 
 Lua semantics kept faithfully: 1-based arrays, # border rule, integer vs
 float arithmetic (/ is float, // is floor), .. coerces numbers, only nil
@@ -54,8 +55,9 @@ _KEYWORDS = {
 
 # multi-char operators first so maximal munch wins
 _OPS = [
-    "...", "..", "==", "~=", "<=", ">=", "//",
+    "...", "..", "==", "~=", "<=", ">=", "//", "<<", ">>",
     "+", "-", "*", "/", "%", "^", "#", "<", ">", "=",
+    "&", "|", "~",
     "(", ")", "{", "}", "[", "]", ";", ":", ",", ".",
 ]
 
@@ -394,6 +396,8 @@ class _Parser:
         "or": (1, 1), "and": (2, 2),
         "<": (3, 3), ">": (3, 3), "<=": (3, 3), ">=": (3, 3),
         "~=": (3, 3), "==": (3, 3),
+        "|": (4, 4), "~": (5, 5), "&": (6, 6),      # lua 5.4 §3.4.8
+        "<<": (7, 7), ">>": (7, 7),
         "..": (9, 8),                       # right associative
         "+": (10, 10), "-": (10, 10),
         "*": (11, 11), "/": (11, 11), "//": (11, 11), "%": (11, 11),
@@ -403,7 +407,7 @@ class _Parser:
 
     def parse_exp(self, limit: int = 0):
         t = self.peek()
-        if (t.kind == "op" and t.value in ("-", "#")) or \
+        if (t.kind == "op" and t.value in ("-", "#", "~")) or \
                 (t.kind == "keyword" and t.value == "not"):
             self.next()
             operand = self.parse_exp(self._UNARY_PRI)
@@ -674,6 +678,34 @@ def _arith_operand(v, op, line):
         raise LuaError(f"line {line}: attempt to perform arithmetic ({op}) "
                        f"on a {lua_typename(v)} value")
     return n
+
+
+_I64 = 1 << 64
+
+
+def _wrap_i64(n: int) -> int:
+    """Lua integers are 64-bit two's complement; bitwise results wrap."""
+    return (n + (1 << 63)) % _I64 - (1 << 63)
+
+
+def _int_operand(v, op, line):
+    """Bitwise operand (lua 5.4 §3.4.2): integers and floats with an
+    exact IN-RANGE integer value; anything else errors (a metamethod
+    may still handle it).  Unlike arithmetic, 5.4 does NOT coerce
+    strings for bitwise ops (lstrlib installs only arithmetic
+    metamethods on strings), and an out-of-i64-range float is an
+    error, not a wrap — scripts validated here must behave the same
+    under the reference CLI's real liblua."""
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise LuaError(f"line {line}: attempt to perform bitwise "
+                       f"operation ({op}) on a {lua_typename(v)} value")
+    n = v
+    if isinstance(n, float):
+        if n != int(n) or not (-(1 << 63) <= n < (1 << 63)):
+            raise LuaError(f"line {line}: number has no integer "
+                           f"representation")
+        n = int(n)
+    return _wrap_i64(n)
 
 
 def lua_typename(v) -> str:
@@ -1046,6 +1078,15 @@ class LuaRuntime:
                         raise exc
                     res = self.call(h, (v, v))
                     return res[0] if res else None
+            if op == "~":                     # bitwise not
+                try:
+                    return _wrap_i64(~_int_operand(v, "~", line))
+                except LuaError as exc:
+                    h = self._getmeta(v, "__bnot")
+                    if h is None:
+                        raise exc
+                    res = self.call(h, (v, v))
+                    return res[0] if res else None
             if op == "not":
                 return not _truthy(v)
             if op == "#":
@@ -1106,6 +1147,30 @@ class LuaRuntime:
                 return _truthy(self._binmeta(ev, a, b, line, err))
             return {"<": lv < rv, "<=": lv <= rv,
                     ">": lv > rv, ">=": lv >= rv}[op]
+        if op in ("&", "|", "~", "<<", ">>"):
+            try:
+                ln = _int_operand(lv, op, line)
+                rn = _int_operand(rv, op, line)
+            except LuaError as exc:
+                events = {"&": "__band", "|": "__bor", "~": "__bxor",
+                          "<<": "__shl", ">>": "__shr"}
+                return self._binmeta(events[op], lv, rv, line, str(exc))
+            if op == "&":
+                return _wrap_i64(ln & rn)
+            if op == "|":
+                return _wrap_i64(ln | rn)
+            if op == "~":
+                return _wrap_i64(ln ^ rn)
+            # shifts are LOGICAL over the 64-bit pattern; counts are
+            # signed (negative shifts the other way) and |n| >= 64
+            # yields 0 (lua 5.4 §3.4.3)
+            if op == ">>":
+                rn = -rn
+            if rn <= -64 or rn >= 64:
+                return 0
+            u = ln & (_I64 - 1)
+            u = (u << rn) if rn >= 0 else (u >> -rn)
+            return _wrap_i64(u)
         try:
             ln = _arith_operand(lv, op, line)
             rn = _arith_operand(rv, op, line)

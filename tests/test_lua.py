@@ -421,6 +421,26 @@ class TestStoreHost:
         assert store.get("test_multi") == b"1, 2, 3, 4, 5"
         assert store.get_uint("test_integer") == 2
 
+    def test_labels_read_and_mask_test(self, store):
+        # the bitwise-tier idiom: set label bits, read the mask back,
+        # test + clear bits in-script
+        src = """
+        local bus = require("splinter")
+        bus.set("job", "pending")
+        local EMBED, DONE = 1 << 0, 1 << 5
+        bus.label("job", EMBED | DONE)
+        local m = bus.labels("job")
+        print(m, (m & EMBED) ~= 0, m & ~EMBED)
+        bus.label("job", EMBED, true)
+        print(bus.labels("job"), bus.labels("missing"))
+        bus.label("job", 1 << 63)
+        print(bus.labels("job") & (1 << 63) ~= 0,
+              bus.labels("job") < 0)
+        """
+        out = self.run_host(store, src)
+        # bit 63 reads back in the interpreter's signed-i64 convention
+        assert out == ["33\ttrue\t32", "32\tnil", "true\ttrue"]
+
     def test_tandem_roundtrip(self, store):
         src = """
         local bus = require("splinter")
@@ -512,3 +532,79 @@ class TestRecursionSafety:
     def test_uncaught_overflow_is_lua_error_not_python(self):
         with pytest.raises(LuaError, match="stack overflow"):
             run_lua("local function f() return f() end f()")
+
+
+class TestBitwise:
+    """Lua 5.4 bitwise tier (§3.4.2-3.4.3): 64-bit two's-complement
+    wrap, logical shifts with signed out-of-range counts, string/float
+    integer-representation coercion, and the six metamethods — the one
+    operator family real store scripts (bloom label masks) lean on."""
+
+    def test_and_or_xor_not(self):
+        out, _ = run_lua("print(0xF0 & 0x3C, 0xF0 | 0x0F, "
+                         "0xFF ~ 0x0F, ~0)")
+        assert out == ["48\t255\t240\t-1"]
+
+    def test_shifts_logical_and_signed_counts(self):
+        out, _ = run_lua(
+            "print(1 << 4, 0x100 >> 4, -1 >> 56, 1 << 64, "
+            "16 >> -2, -1 >> 0)")
+        # -1 >> 56 is LOGICAL: 0xFF; shift >= 64 -> 0; negative count
+        # reverses direction
+        assert out == ["16\t16\t255\t0\t64\t-1"]
+
+    def test_wrap_to_64_bits(self):
+        # bitwise results wrap to 64-bit two's complement (plain
+        # integer arithmetic deliberately stays python-bigint here)
+        out, _ = run_lua("print(1 << 63, -1 >> 1, ~(1 << 63))")
+        assert out == [f"{-(1 << 63)}\t{(1 << 63) - 1}\t{(1 << 63) - 1}"]
+
+    def test_precedence_between_or_and_concat(self):
+        # 5.4 §3.4.8: | is looser than .. and tighter than
+        # comparisons — a < b | c parses as a < (b | c), and
+        # tostring(1 | 2) .. "" concats the already-computed 3
+        out, _ = run_lua("print(1 < 2 | 4, tostring(1 | 2) .. '')")
+        assert out == ["true\t3"]
+
+    def test_float_coercion_and_5_4_errors(self):
+        out, _ = run_lua("print(3.0 & 7)")
+        assert out == ["3"]
+        with pytest.raises(LuaError, match="no integer representation"):
+            run_lua("return 3.5 & 1")
+        # out-of-i64-range float: error, not a silent wrap
+        with pytest.raises(LuaError, match="no integer representation"):
+            run_lua("return 2^63 & 1")
+        # 5.4 does NOT coerce strings for bitwise (unlike arithmetic)
+        with pytest.raises(LuaError, match="bitwise"):
+            run_lua("return '12' & 0xFF")
+        with pytest.raises(LuaError, match="bitwise"):
+            run_lua("return {} & 1")
+
+    def test_label_mask_pattern(self):
+        # the store-script idiom this exists for: build, test, clear
+        # label bits
+        out, _ = run_lua("""
+            local EMBED, WAIT = 1 << 0, 1 << 3
+            local mask = EMBED | WAIT
+            print(mask, mask & EMBED ~= 0, mask & ~EMBED)
+        """)
+        assert out == ["9\ttrue\t8"]
+
+    def test_bitwise_metamethods(self):
+        out, _ = run_lua("""
+            local mt = {
+                __band = function(a, b) return "band" end,
+                __bor  = function(a, b) return "bor" end,
+                __bxor = function(a, b) return "bxor" end,
+                __shl  = function(a, b) return "shl" end,
+                __shr  = function(a, b) return "shr" end,
+                __bnot = function(a) return "bnot" end,
+            }
+            local t = setmetatable({}, mt)
+            print(t & 1, 1 | t, t ~ t, t << 2, t >> 2, ~t)
+        """)
+        assert out == ["band\tbor\tbxor\tshl\tshr\tbnot"]
+
+    def test_unary_bnot_binds_tighter_than_binary(self):
+        out, _ = run_lua("print(~1 & 0xFF, 2 ~ ~0)")
+        assert out == ["254\t-3"]

@@ -213,7 +213,11 @@ def cosine_topk(vectors, query, k: int, mask=None, *,
     fn = _topk_fn(k, False, bool(use_pallas), bool(mxu_bf16),
                   int(block_n) if use_pallas else 1024)
     top_s, top_i = fn(vectors, query, mask, vnorm)
-    return np.asarray(top_s), np.asarray(top_i)
+    # one combined fetch: device_get starts both host copies async
+    # before blocking, so scores+indices cost ONE runtime round trip,
+    # not two sequential np.asarray fetches (the difference between
+    # 1x and 2x RTT per query on a remote runtime)
+    return tuple(jax.device_get((top_s, top_i)))
 
 
 def cosine_topk_batch(vectors, queries, k: int, mask=None, *,
@@ -228,4 +232,4 @@ def cosine_topk_batch(vectors, queries, k: int, mask=None, *,
     fn = _topk_fn(k, True, bool(use_pallas), bool(mxu_bf16),
                   int(block_n) if use_pallas else 1024)
     top_s, top_i = fn(vectors, queries, mask, vnorm)
-    return np.asarray(top_s), np.asarray(top_i)
+    return tuple(jax.device_get((top_s, top_i)))

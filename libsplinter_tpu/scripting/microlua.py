@@ -701,7 +701,10 @@ def _int_operand(v, op, line):
                        f"operation ({op}) on a {lua_typename(v)} value")
     n = v
     if isinstance(n, float):
-        if n != int(n) or not (-(1 << 63) <= n < (1 << 63)):
+        # isfinite first: int(inf)/int(nan) raise raw Python errors,
+        # which must never escape the LuaError contract
+        if not _pymath.isfinite(n) or n != int(n) \
+                or not (-(1 << 63) <= n < (1 << 63)):
             raise LuaError(f"line {line}: number has no integer "
                            f"representation")
         n = int(n)

@@ -579,6 +579,15 @@ class TestBitwise:
             run_lua("return '12' & 0xFF")
         with pytest.raises(LuaError, match="bitwise"):
             run_lua("return {} & 1")
+        # inf/nan must be a CATCHABLE lua error, never a raw Python
+        # OverflowError escaping the sandbox
+        for bad in ("math.huge & 1", "(1/0) & 1", "(0/0) | 2"):
+            with pytest.raises(LuaError,
+                               match="no integer representation"):
+                run_lua(f"return {bad}")
+        out, _ = run_lua(
+            "print(pcall(function() return math.huge & 1 end))")
+        assert out[0].startswith("false\t")
 
     def test_label_mask_pattern(self):
         # the store-script idiom this exists for: build, test, clear

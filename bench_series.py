@@ -50,6 +50,7 @@ the per-phase knobs documented on each phase function.
 """
 from __future__ import annotations
 
+import functools
 import io
 import json
 import os
@@ -717,35 +718,54 @@ def phase_kernels(ctx: SeriesCtx) -> dict:
     log(f"flash fwd S={S}: {flash_ms:.2f} ms, diff={fwd_diff:.2e}")
 
     # -- flash blockwise backward (grad check vs naive) ---------------------
-    def loss_flash(q_, k_, v_):
+    # Correctness and timing are SEPARATE arms.  At default precision
+    # Mosaic truncates f32 dot inputs to bf16 exactly like XLA does for
+    # the naive einsums, so kernel-vs-naive diffs there are dominated
+    # by the two paths' different rounding orders (~5e-3 relative,
+    # deterministic — measured on-chip 2026-08-02), not kernel bugs.
+    # The check therefore runs BOTH paths at Precision.HIGHEST, which
+    # isolates the algorithm; the timing runs the production default.
+    def loss_flash(q_, k_, v_, hi=False):
         return jnp.sum(flash_attention(q_, k_, v_, mask,
                                        interpret=interp,
-                                       force_pallas=True) * w)
+                                       force_pallas=True,
+                                       hi_prec=hi) * w)
 
     def loss_naive(q_, k_, v_):
         return jnp.sum(_mha_jnp(q_, k_, v_, mask) * w)
 
     grad_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-    grad_naive = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
-    (gq, gk, gv), bwd_ms = timed(grad_flash, q, k, v)
-    nq, nk, nv = grad_naive(q, k, v)
+    grad_flash_hi = jax.jit(jax.grad(
+        functools.partial(loss_flash, hi=True), argnums=(0, 1, 2)))
+    with jax.default_matmul_precision("highest"):
+        grad_naive = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
+        nq, nk, nv = grad_naive(q, k, v)
+    (dq, dk, dv), bwd_ms = timed(grad_flash, q, k, v)  # production arm
+    gq, gk, gv = grad_flash_hi(q, k, v)                # checked arm
     bwd_diff = float(max(jnp.max(jnp.abs(a - b))
                          for a, b in ((gq, nq), (gk, nk), (gv, nv))))
-    # scale-aware check: gradients of a sum-loss over ~1.5M terms have
-    # O(10^1..10^2) magnitudes, and on TPU both paths run their matmuls
-    # at MXU default precision — an absolute threshold that passes
-    # under CPU interpret then fails on hardware for precision, not
-    # correctness.  Relative to the naive grad's own magnitude is the
-    # kernel-correctness signal.
     grad_scale = float(max(jnp.max(jnp.abs(g)) for g in (nq, nk, nv)))
     bwd_rel = bwd_diff / (grad_scale + 1e-9)
+    # the production-precision gradients get their own (looser) sanity
+    # bound vs the f32 oracle so a default-arm-only regression (e.g. a
+    # demoted accumulator the HIGHEST decomposition would mask) still
+    # fails the phase; 5e-2 clears the measured ~5e-3 rounding-order
+    # noise with margin while catching order-of-magnitude breakage
+    def_diff = float(max(jnp.max(jnp.abs(a - b))
+                         for a, b in ((dq, nq), (dk, nk), (dv, nv))))
+    def_rel = def_diff / (grad_scale + 1e-9)
     detail["flash_bwd"] = {"ms": round(bwd_ms, 2),
                            "max_abs_diff": bwd_diff,
                            "grad_scale": round(grad_scale, 3),
                            "rel_diff": bwd_rel,
-                           "ok": bool(bwd_rel < 1e-3)}
+                           "checked_at": "highest-vs-highest",
+                           "default_rel_diff": def_rel,
+                           "ok": bool(bwd_rel < 1e-3
+                                      and def_rel < 5e-2)}
     log(f"flash bwd S={S}: {bwd_ms:.2f} ms, diff={bwd_diff:.2e} "
-        f"(rel {bwd_rel:.2e} of grad scale {grad_scale:.1f})")
+        f"(rel {bwd_rel:.2e} of grad scale {grad_scale:.1f}, "
+        f"checked at highest precision; default-arm rel "
+        f"{def_rel:.2e})")
 
     # -- causal prefill with GQA head routing -------------------------------
     Bp, Sp, T, Hq, KH = 2, max(S // 2, 64), S, 8, 2

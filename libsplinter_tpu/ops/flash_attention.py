@@ -42,7 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _mha_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float):
+def _mha_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float,
+                precision=None):
     """One (batch, head, q-block) program.
 
     q_ref:   (1, 1, BQ, D)   query block
@@ -54,27 +55,29 @@ def _mha_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float):
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
-    logits = jnp.dot(q, k.T,
+    logits = jnp.dot(q, k.T, precision=precision,
                      preferred_element_type=jnp.float32) * scale
     m = mask_ref[0]                               # (1, S) broadcasts
     logits = jnp.where(m > 0.0, logits, NEG_INF)  # (BQ, S)
     logits = logits - jnp.max(logits, axis=-1, keepdims=True)
     p = jnp.exp(logits)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
-    out_ref[0, 0] = jnp.dot(p.astype(v.dtype), v,
+    out_ref[0, 0] = jnp.dot(p.astype(v.dtype), v, precision=precision,
                             preferred_element_type=jnp.float32
                             ).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_q", "interpret"))
-def _flash_pallas(q, k, v, maskf, *, block_q: int, interpret: bool):
+                   static_argnames=("block_q", "interpret", "hi_prec"))
+def _flash_pallas(q, k, v, maskf, *, block_q: int, interpret: bool,
+                  hi_prec: bool = False):
     """q/k/v: (B, H, S, D); maskf: (B, 1, S) f32.  Returns (B, H, S, D)."""
     B, H, S, D = q.shape
     scale = 1.0 / np.sqrt(D)
     grid = (B, H, S // block_q)
+    prec = jax.lax.Precision.HIGHEST if hi_prec else None
     return pl.pallas_call(
-        functools.partial(_mha_kernel, scale=scale),
+        functools.partial(_mha_kernel, scale=scale, precision=prec),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
@@ -96,7 +99,7 @@ def _flash_pallas(q, k, v, maskf, *, block_q: int, interpret: bool):
 
 def _mha_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, mask_ref,
                     dq_ref, dk_ref, dv_ref, *, scale: float,
-                    block_q: int):
+                    block_q: int, precision=None):
     """Blockwise backward for one (batch, head): recomputes each
     (block_q, S) probability tile in VMEM (the standard flash-attention
     backward identity), accumulating dK/dV across query blocks and
@@ -120,7 +123,7 @@ def _mha_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, mask_ref,
         q = q_ref[0, 0, sl]                        # per-block rounding
         o = o_ref[0, 0, sl]
         do = do_ref[0, 0, sl]
-        logits = jnp.dot(q, k.T,
+        logits = jnp.dot(q, k.T, precision=precision,
                          preferred_element_type=jnp.float32) * scale
         logits = jnp.where(m > 0.0, logits, NEG_INF)
         logits = logits - jnp.max(logits, axis=-1, keepdims=True)
@@ -129,15 +132,15 @@ def _mha_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, mask_ref,
         dof = do.astype(jnp.float32)
         of = o.astype(jnp.float32)
         d_i = jnp.sum(dof * of, axis=-1, keepdims=True)  # (BQ, 1)
-        dp = jnp.dot(dof, v.astype(jnp.float32).T,
+        dp = jnp.dot(dof, v.astype(jnp.float32).T, precision=precision,
                      preferred_element_type=jnp.float32)
         ds = p * (dp - d_i) * scale                      # (BQ, S)
         dq_ref[0, 0, sl] = jnp.dot(
-            ds, k.astype(jnp.float32),
+            ds, k.astype(jnp.float32), precision=precision,
             preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-        dk_acc += jnp.dot(ds.T, q.astype(jnp.float32),
+        dk_acc += jnp.dot(ds.T, q.astype(jnp.float32), precision=precision,
                           preferred_element_type=jnp.float32)
-        dv_acc += jnp.dot(p.T, dof,
+        dv_acc += jnp.dot(p.T, dof, precision=precision,
                           preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
@@ -148,9 +151,10 @@ def _mha_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, mask_ref,
     dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "interpret", "hi_prec"))
 def _flash_bwd_pallas(q, k, v, o, do, maskf, *, block_q: int,
-                      interpret: bool):
+                      interpret: bool, hi_prec: bool = False):
     """q/k/v/o/do: (B, H, S, D); maskf: (B, 1, S).
     Returns (dq, dk, dv) each (B, H, S, D)."""
     B, H, S, D = q.shape
@@ -159,9 +163,10 @@ def _flash_bwd_pallas(q, k, v, o, do, maskf, *, block_q: int,
     full = pl.BlockSpec((1, 1, S, D), lambda b, h: (b, h, 0, 0),
                         memory_space=pltpu.VMEM)
     shape = jax.ShapeDtypeStruct((B, H, S, D), q.dtype)
+    prec = jax.lax.Precision.HIGHEST if hi_prec else None
     return pl.pallas_call(
         functools.partial(_mha_bwd_kernel, scale=scale,
-                          block_q=min(block_q, S)),
+                          block_q=min(block_q, S), precision=prec),
         grid=grid,
         in_specs=[full, full, full, full, full,
                   pl.BlockSpec((1, 1, S), lambda b, h: (b, 0, 0),
@@ -329,42 +334,44 @@ def _to_kernel_layout(tensors, mask, bq: int):
             mask.astype(jnp.float32)[:, None, :], pad)
 
 
-def _flash_fwd_only(q, k, v, mask, block_q: int, interpret: bool):
+def _flash_fwd_only(q, k, v, mask, block_q: int, interpret: bool,
+                    hi_prec: bool = False):
     """The Pallas forward: pad S to a block multiple, transpose to
     (B, H, S, D), run the kernel, undo."""
     S = q.shape[1]
     bq = min(block_q, S)
     (qt, kt, vt), maskf, pad = _to_kernel_layout([q, k, v], mask, bq)
     out = _flash_pallas(qt, kt, vt, maskf, block_q=bq,
-                        interpret=interpret)
+                        interpret=interpret, hi_prec=hi_prec)
     out = out.transpose(0, 2, 1, 3)
     return out[:, :S] if pad else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_diff(q, k, v, mask, block_q, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_diff(q, k, v, mask, block_q, interpret, hi_prec):
     """Differentiable wrapper: a raw pallas_call has no autodiff rule,
     and the encoder's TRAINING path hits this kernel whenever a long
     bucket trains (train.py over S >= flash_min_seq).  Forward runs
     the forward kernel; backward runs the blockwise backward kernel
     (_mha_bwd_kernel) — probability tiles are recomputed in VMEM per
     query block, so the TRAINING path is as HBM-linear as inference."""
-    return _flash_fwd_only(q, k, v, mask, block_q, interpret)
+    return _flash_fwd_only(q, k, v, mask, block_q, interpret, hi_prec)
 
 
-def _flash_diff_fwd(q, k, v, mask, block_q, interpret):
-    out = _flash_fwd_only(q, k, v, mask, block_q, interpret)
+def _flash_diff_fwd(q, k, v, mask, block_q, interpret, hi_prec):
+    out = _flash_fwd_only(q, k, v, mask, block_q, interpret, hi_prec)
     return out, (q, k, v, mask, out)
 
 
-def _flash_diff_bwd(block_q, interpret, res, g):
+def _flash_diff_bwd(block_q, interpret, hi_prec, res, g):
     q, k, v, mask, out = res
     S = q.shape[1]
     bq = min(block_q, S)
     (qt, kt, vt, ot, gt), maskf, pad = _to_kernel_layout(
         [q, k, v, out, g], mask, bq)
     dq, dk, dv = _flash_bwd_pallas(qt, kt, vt, ot, gt, maskf,
-                                   block_q=bq, interpret=interpret)
+                                   block_q=bq, interpret=interpret,
+                                   hi_prec=hi_prec)
 
     def unpadded(x):
         x = x.transpose(0, 2, 1, 3)
@@ -378,7 +385,8 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 def flash_attention(q, k, v, mask, *, block_q: int = 256,
                     interpret: bool = False,
-                    force_pallas: bool = False):
+                    force_pallas: bool = False,
+                    hi_prec: bool = False):
     """Bidirectional masked attention without HBM-quadratic logits.
 
     q/k/v: (B, S, H, D); mask: (B, S) bool key validity.
@@ -387,9 +395,18 @@ def flash_attention(q, k, v, mask, *, block_q: int = 256,
     identical jnp math.  Differentiable either way: the custom VJP
     runs the BLOCKWISE backward kernel (probability tiles recomputed
     in VMEM, dK/dV accumulated in f32), so training stays HBM-linear
-    like the forward."""
+    like the forward.
+
+    hi_prec=True runs every MXU dot at Precision.HIGHEST (the
+    multi-pass f32 decomposition) — the correctness-check arm: at
+    default precision Mosaic truncates f32 dot INPUTS to bf16 exactly
+    like XLA does for the naive einsums, so kernel-vs-naive diffs are
+    dominated by their different rounding orders (~5e-3 relative,
+    deterministic), not kernel bugs.  Matching HIGHEST on both sides
+    isolates the algorithm (agrees to ~1e-4); serving/training keep
+    the fast default."""
     use_pallas = (force_pallas or interpret
                   or jax.default_backend() == "tpu")
     if not use_pallas:
         return _mha_jnp(q, k, v, mask)
-    return _flash_diff(q, k, v, mask, block_q, interpret)
+    return _flash_diff(q, k, v, mask, block_q, interpret, hi_prec)

@@ -31,11 +31,13 @@ def cmd_lua(ses, args):
             raise CliError(f"no such script: {path}")
         src, chunk_name, script_args = (path.read_text(), str(path),
                                         list(args[1:]))
-    rt = make_runtime(ses.store)
-    try:
-        rt.run(src, script_args=script_args, chunk_name=chunk_name)
-    except LuaError as e:
-        raise CliError(f"lua: {e}") from None
+    # context manager: unwinds any coroutine the script left suspended
+    # so a REPL running many scripts can't accumulate parked threads
+    with make_runtime(ses.store) as rt:
+        try:
+            rt.run(src, script_args=script_args, chunk_name=chunk_name)
+        except LuaError as e:
+            raise CliError(f"lua: {e}") from None
 
 
 @command("wasm", "wasm MODULE.wasm [FUNC] [ARGS...]",

@@ -4,10 +4,12 @@ The reference embeds liblua 5.4 (splinter_cli_cmd_lua.c:365-386); this build
 image has no Lua, so the host is a from-scratch interpreter of the subset
 that store scripts actually use:
 
-  values      nil, boolean, integer, float, string, table, function
+  values      nil, boolean, integer, float, string, table, function,
+              thread (coroutine)
   statements  local (multi), assignment (multi-target), calls, do/end,
               while, repeat/until, numeric & generic for, if/elseif/else,
-              function (incl. methods, local function), return, break
+              function (incl. methods, local function), return, break,
+              goto / ::label:: (block-granular 5.4 visibility)
   exprs       full operator precedence (or/and, comparisons, .., + - * / //
               % ^, bitwise & | ~ << >> with lua 5.4 64-bit wrap +
               integer-representation rules, unary - not # ~), closures,
@@ -24,10 +26,13 @@ that store scripts actually use:
               string.(format sub len upper lower rep byte char find gsub),
               table.(insert remove concat unpack), math.(floor ceil abs min
               max sqrt huge pi fmod max min tointeger), os.(time clock),
-              require (host-registered modules only)
+              coroutine.(create resume yield status wrap close
+              isyieldable running) — one daemon thread per coroutine in
+              strict semaphore handoff, so yield crosses pcall and host
+              calls — require (host-registered modules only)
 
 Deliberately out of scope (scripts needing these belong in Python):
-coroutines, goto, io/file access (the store IS the I/O).
+io/file access (the store IS the I/O).
 
 Lua semantics kept faithfully: 1-based arrays, # border rule, integer vs
 float arithmetic (/ is float, // is floor), .. coerces numbers, only nil
@@ -36,7 +41,9 @@ and false are falsy, multiple return values with explist adjustment.
 from __future__ import annotations
 
 import math as _pymath
+import threading as _pythreading
 import time as _pytime
+import weakref as _pyweakref
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -49,13 +56,13 @@ class LuaError(Exception):
 
 _KEYWORDS = {
     "and", "break", "do", "else", "elseif", "end", "false", "for",
-    "function", "if", "in", "local", "nil", "not", "or", "repeat",
-    "return", "then", "true", "until", "while",
+    "function", "goto", "if", "in", "local", "nil", "not", "or",
+    "repeat", "return", "then", "true", "until", "while",
 }
 
 # multi-char operators first so maximal munch wins
 _OPS = [
-    "...", "..", "==", "~=", "<=", ">=", "//", "<<", ">>",
+    "...", "..", "==", "~=", "<=", ">=", "//", "<<", ">>", "::",
     "+", "-", "*", "/", "%", "^", "#", "<", ">", "=",
     "&", "|", "~",
     "(", ")", "{", "}", "[", "]", ";", ":", ",", ".",
@@ -234,6 +241,13 @@ class _Parser:
                 stmts.append(("return", exprs, t.line))
                 break
             stmts.append(self.parse_statement())
+        seen_labels = set()
+        for st in stmts:
+            if st[0] == "label":
+                if st[1] in seen_labels:
+                    raise LuaError(f"line {st[2]}: label '{st[1]}' "
+                                   "already defined")
+                seen_labels.add(st[1])
         return stmts
 
     def parse_statement(self):
@@ -268,6 +282,15 @@ class _Parser:
             if t.value == "break":
                 self.next()
                 return ("break", t.line)
+            if t.value == "goto":
+                self.next()
+                name = self.expect("name").value
+                return ("goto", name, t.line)
+        if t.kind == "op" and t.value == "::":
+            self.next()
+            name = self.expect("name").value
+            self.expect("op", "::")
+            return ("label", name, t.line)
         # expression statement: call or assignment
         exp = self.parse_suffixed()
         if self.check("op", "=") or self.check("op", ","):
@@ -584,6 +607,17 @@ def _denormkey(key):
     return key
 
 
+class _Goto(Exception):
+    """Control transfer to a ::label:: — caught by the nearest enclosing
+    block that declares the label (lua 5.4 visibility, block-granular:
+    the label must be in the same or an enclosing block; a goto can
+    never enter a block).  Escaping the function body is a lua error."""
+
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+
+
 class _Break(Exception):
     pass
 
@@ -722,7 +756,129 @@ def lua_typename(v) -> str:
         return "string"
     if isinstance(v, LuaTable):
         return "table"
+    if isinstance(v, LuaCoroutine):
+        return "thread"
     return "function"
+
+
+class _CoClosed(Exception):
+    """Unwinds a parked coroutine body when close() reclaims it."""
+
+
+class LuaCoroutine:
+    """A lua 5.4 coroutine: one daemon thread + two semaphores in strict
+    handoff — exactly one of {resumer, coroutine} ever runs, so the
+    interpreter state (steps budget, globals) needs no extra locking.
+
+    A thread per coroutine is the honest mapping for a tree-walking
+    interpreter (the python stack IS the coroutine's suspended state);
+    it also means yield works across pcall, metamethods and host calls
+    — fewer restrictions than C lua's unyieldable C boundary.
+
+    OS threads are a bounded host resource, so they are accounted:
+    at most max_coroutines live threads per runtime (the 257th create
+    that actually starts a thread is a catchable lua error, like
+    liblua's memory error on luaB_cocreate), coroutine.close() on a
+    suspended coroutine UNWINDS its parked body (the thread exits and
+    releases its slot, lua 5.4 close semantics), and a body thread
+    always releases its slot on exit."""
+
+    def __init__(self, fn, runtime: "LuaRuntime"):
+        self.fn = fn
+        self.rt = runtime
+        self.status = "suspended"      # suspended|running|normal|dead
+        self._thread: Optional[_pythreading.Thread] = None
+        self._resume_sem = _pythreading.Semaphore(0)
+        self._return_sem = _pythreading.Semaphore(0)
+        self._xfer: tuple = ()         # resume()'s args for the body
+        self._outcome = ("yield", ())  # ("yield"|"return"|"error", ...)
+        self._closed = False
+
+    def _body(self) -> None:
+        try:
+            vals = self.rt.call(self.fn, self._xfer)
+            self._outcome = ("return", vals)
+        except _CoClosed:
+            self.rt._co_live -= 1      # reclaimed; nobody is waiting
+            return
+        except LuaError as exc:
+            self._outcome = ("error", str(exc))
+        except RecursionError:
+            self._outcome = ("error", "stack overflow")
+        except BaseException as exc:   # host bug: surface, don't hang
+            self._outcome = ("error", f"{type(exc).__name__}: {exc}")
+        self.rt._co_live -= 1
+        self._return_sem.release()
+
+    def resume(self, args: tuple) -> tuple:
+        if self.status == "dead":
+            return (False, "cannot resume dead coroutine")
+        if self.status != "suspended":
+            return (False, "cannot resume non-suspended coroutine")
+        stack = self.rt._co_stack
+        caller = stack[-1] if stack else None
+        if caller is not None:
+            caller.status = "normal"
+        self.status = "running"
+        stack.append(self)
+        self._xfer = args
+        if self._thread is None:
+            try:
+                if self.rt._co_live >= self.rt.max_coroutines:
+                    raise RuntimeError(
+                        f"too many live coroutines "
+                        f"(max {self.rt.max_coroutines})")
+                self.rt._co_live += 1
+                self.rt._co_started.add(self)
+                self._thread = _pythreading.Thread(
+                    target=self._body, daemon=True,
+                    name="microlua-coroutine")
+                try:
+                    self._thread.start()
+                except BaseException:
+                    self.rt._co_live -= 1
+                    self._thread = None
+                    raise
+            except RuntimeError as exc:
+                stack.pop()            # undo the push: catchable error
+                if caller is not None:
+                    caller.status = "running"
+                self.status = "dead"
+                raise LuaError(
+                    f"cannot start coroutine: {exc}") from None
+        else:
+            self._resume_sem.release()
+        self._return_sem.acquire()     # strict handoff: body ran
+        stack.pop()
+        if caller is not None:
+            caller.status = "running"
+        kind, payload = self._outcome
+        if kind == "yield":
+            self.status = "suspended"
+            return (True,) + tuple(payload)
+        self.status = "dead"
+        if kind == "return":
+            return (True,) + tuple(payload)
+        return (False, payload)
+
+    def yield_(self, args: tuple) -> tuple:
+        self._outcome = ("yield", args)
+        self._return_sem.release()
+        self._resume_sem.acquire()     # parked until the next resume
+        if self._closed:
+            raise _CoClosed()
+        return self._xfer
+
+    def close(self) -> None:
+        """Reclaim a suspended coroutine's thread (lua 5.4 close):
+        the parked body unwinds via _CoClosed and exits.  Joined
+        (bounded) so the slot release is synchronous — a script that
+        closes then creates sees the freed slot."""
+        self.status = "dead"
+        if self._thread is not None and self._thread.is_alive():
+            self._closed = True
+            self._resume_sem.release()
+            self._thread.join(timeout=5.0)
 
 
 class LuaRuntime:
@@ -730,16 +886,39 @@ class LuaRuntime:
 
     MAX_STEPS_DEFAULT = 50_000_000
 
+    MAX_COROUTINES_DEFAULT = 256
+
     def __init__(self, output: Optional[Callable[[str], None]] = None,
-                 max_steps: int = MAX_STEPS_DEFAULT):
+                 max_steps: int = MAX_STEPS_DEFAULT,
+                 max_coroutines: int = MAX_COROUTINES_DEFAULT):
         self.globals: dict = {}
         self.modules: dict = {}
         self.output = output or (lambda s: print(s))
         self.max_steps = max_steps
         self.steps = 0
+        self.max_coroutines = max_coroutines
+        self._co_stack: list = []      # innermost running coroutine last
+        self._co_live = 0              # live body threads (bounded)
+        self._co_started: "_pyweakref.WeakSet" = _pyweakref.WeakSet()
         self._install_stdlib()
 
     # -- public API ------------------------------------------------------
+    def close(self) -> None:
+        """Unwind every still-suspended coroutine so its parked body
+        thread exits.  Hosts that run many scripts (one runtime each)
+        must call this — or use the runtime as a context manager — or
+        each abandoned generator leaks an OS thread that pins the whole
+        runtime object graph until process exit."""
+        for co in list(self._co_started):
+            if co.status == "suspended":
+                co.close()
+
+    def __enter__(self) -> "LuaRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def register_module(self, name: str, table: LuaTable) -> None:
         """Make `require(name)` (and the global `name`) resolve to table."""
         self.modules[name] = table
@@ -759,6 +938,11 @@ class LuaRuntime:
             self.exec_block(ast, env, ())
         except _Return as r:
             return r.values
+        except _Goto as g:
+            raise LuaError(f"line {g.line}: no visible label "
+                           f"'{g.name}' for goto") from None
+        except _Break:
+            raise LuaError("break outside a loop") from None
         return ()
 
     # -- metatable machinery ---------------------------------------------
@@ -852,6 +1036,11 @@ class LuaRuntime:
                 self.exec_block(fn.body, env, varargs)
             except _Return as r:
                 return r.values
+            except _Goto as g:
+                raise LuaError(f"line {g.line}: no visible label "
+                               f"'{g.name}' for goto") from None
+            except _Break:
+                raise LuaError("break outside a loop") from None
             except RecursionError:
                 # translate HERE, the one chokepoint every lua-level
                 # call goes through (incl. metamethod dispatch, which
@@ -877,8 +1066,48 @@ class LuaRuntime:
                            f"{self.max_steps} steps (runaway loop?)")
 
     def exec_block(self, stmts, env: _Env, varargs: tuple) -> None:
-        for st in stmts:
-            self.exec_stmt(st, env, varargs)
+        i, n = 0, len(stmts)
+        while i < n:
+            try:
+                self.exec_stmt(stmts[i], env, varargs)
+            except _Goto as g:
+                for j, st in enumerate(stmts):
+                    if st[0] == "label" and st[1] == g.name:
+                        # lua 5.4: a forward goto may not enter the
+                        # scope of a local declared between it and the
+                        # label — unless the label ends the block (the
+                        # ::continue:: carve-out).  Checked when the
+                        # jump executes, not at parse time.
+                        if (j > i
+                                and any(s[0] in ("local", "localfunc")
+                                        for s in stmts[i + 1:j])
+                                and any(s[0] != "label"
+                                        for s in stmts[j + 1:])):
+                            raise LuaError(
+                                f"line {g.line}: goto '{g.name}' jumps"
+                                " into the scope of a local") from None
+                        if j <= i:
+                            # a backward jump EXITS the scope of every
+                            # local declared at/after the label — drop
+                            # those bindings so lookups fall through
+                            # to outer scopes again (lua 5.4 scoping).
+                            # Known divergence: two same-name locals in
+                            # ONE block share a slot in this flat env,
+                            # so the pop exposes the OUTER binding, not
+                            # the earlier same-block one (real lua
+                            # alpha-renames; not worth a scope tree)
+                            for s in stmts[j:i + 1]:
+                                if s[0] == "local":
+                                    for nm in s[1]:
+                                        env.vars.pop(nm, None)
+                                elif s[0] == "localfunc":
+                                    env.vars.pop(s[1], None)
+                        i = j + 1          # backward gotos loop; ticked
+                        break              # per-statement like any loop
+                else:
+                    raise                  # label lives further out
+                continue
+            i += 1
 
     def exec_stmt(self, st, env: _Env, varargs: tuple) -> None:
         tag = st[0]
@@ -975,6 +1204,10 @@ class LuaRuntime:
             raise _Return(self.eval_explist_open(exprs, env, varargs))
         elif tag == "break":
             raise _Break()
+        elif tag == "label":
+            pass                           # jump target only
+        elif tag == "goto":
+            raise _Goto(st[1], st[2])
         else:                          # pragma: no cover
             raise LuaError(f"unknown statement {tag}")
 
@@ -1503,6 +1736,68 @@ class LuaRuntime:
         g["os"] = LuaTable({
             "time": lambda: int(_pytime.time()),
             "clock": lambda: _pytime.process_time(),
+        })
+
+        # coroutine ------------------------------------------------------
+        def _co_create(fn):
+            if not (isinstance(fn, LuaFunction) or callable(fn)):
+                raise LuaError("bad argument #1 to 'create' "
+                               f"(function expected, got "
+                               f"{lua_typename(fn)})")
+            return LuaCoroutine(fn, self)
+
+        def _co_resume(co, *args):
+            if not isinstance(co, LuaCoroutine):
+                raise LuaError("bad argument #1 to 'resume' "
+                               f"(coroutine expected, got "
+                               f"{lua_typename(co)})")
+            return co.resume(args)
+
+        def _co_yield(*args):
+            if not self._co_stack:
+                raise LuaError("attempt to yield from outside "
+                               "a coroutine")
+            return self._co_stack[-1].yield_(args)
+
+        def _co_status(co):
+            if not isinstance(co, LuaCoroutine):
+                raise LuaError("bad argument #1 to 'status' "
+                               f"(coroutine expected, got "
+                               f"{lua_typename(co)})")
+            return co.status
+
+        def _co_wrap(fn):
+            co = _co_create(fn)
+
+            def _wrapped(*args):
+                out = co.resume(args)
+                if not out[0]:
+                    raise LuaError(lua_tostring(out[1]))
+                return out[1:]
+            return _wrapped
+
+        def _co_close(co):
+            if not isinstance(co, LuaCoroutine):
+                raise LuaError("bad argument #1 to 'close' "
+                               f"(coroutine expected, got "
+                               f"{lua_typename(co)})")
+            if co.status in ("running", "normal"):
+                return (False, "cannot close a "
+                        f"{co.status} coroutine")
+            co.close()           # unwinds a parked body; thread exits
+            return True
+
+        g["coroutine"] = LuaTable({
+            "create": _co_create,
+            "resume": _co_resume,
+            "yield": _co_yield,
+            "status": _co_status,
+            "wrap": _co_wrap,
+            "close": _co_close,
+            "isyieldable": lambda: bool(self._co_stack),
+            "running": lambda: (
+                (self._co_stack[-1], False) if self._co_stack
+                else (None, True)),
         })
 
     def _require(self, name):

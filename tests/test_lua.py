@@ -617,3 +617,318 @@ class TestBitwise:
     def test_unary_bnot_binds_tighter_than_binary(self):
         out, _ = run_lua("print(~1 & 0xFF, 2 ~ ~0)")
         assert out == ["254\t-3"]
+
+
+class TestGoto:
+    """goto / ::label:: — lua 5.4 block-granular control transfer."""
+
+    def test_continue_idiom(self):
+        out, _ = run_lua("""
+            local s = 0
+            for i = 1, 10 do
+              if i % 2 == 0 then goto continue end
+              s = s + i
+              ::continue::
+            end
+            print(s)
+        """)
+        assert out == ["25"]
+
+    def test_backward_goto_loops(self):
+        out, _ = run_lua("""
+            local i = 0
+            ::top::
+            i = i + 1
+            if i < 5 then goto top end
+            print(i)
+        """)
+        assert out == ["5"]
+
+    def test_goto_out_of_nested_blocks(self):
+        out, _ = run_lua("""
+            local n = 0
+            do
+              do
+                n = 1
+                goto done
+              end
+            end
+            n = 99            -- skipped
+            ::done::
+            print(n)
+        """)
+        assert out == ["1"]
+
+    def test_goto_out_of_loop(self):
+        out, _ = run_lua("""
+            for i = 1, 100 do
+              if i == 3 then goto out end
+            end
+            ::out::
+            print("escaped")
+        """)
+        assert out == ["escaped"]
+
+    def test_invisible_label_is_catchable_error(self):
+        out, _ = run_lua("""
+            local ok, err = pcall(function() goto nowhere end)
+            print(ok, err)
+        """)
+        assert out[0].startswith("false\t")
+        assert "nowhere" in out[0]
+
+    def test_runaway_backward_goto_hits_step_budget(self):
+        lines = []
+        rt = LuaRuntime(output=lines.append, max_steps=10_000)
+        with pytest.raises(LuaError, match="exceeded"):
+            rt.run("::spin:: goto spin")
+
+
+class TestCoroutines:
+    """coroutine.* — one daemon thread per coroutine, strict handoff."""
+
+    def test_producer_consumer_round_trip(self):
+        out, _ = run_lua("""
+            local co = coroutine.create(function(a, b)
+              local c = coroutine.yield(a + b)
+              local d, e = coroutine.yield(c * 2)
+              return d + e, "done"
+            end)
+            print(coroutine.status(co))
+            print(coroutine.resume(co, 1, 2))
+            print(coroutine.resume(co, 10))
+            print(coroutine.resume(co, 3, 4))
+            print(coroutine.status(co))
+            print(coroutine.resume(co))
+        """)
+        assert out == [
+            "suspended",
+            "true\t3",
+            "true\t20",
+            "true\t7\tdone",
+            "dead",
+            "false\tcannot resume dead coroutine",
+        ]
+
+    def test_wrap_generator_idiom(self):
+        out, _ = run_lua("""
+            local gen = coroutine.wrap(function()
+              for i = 1, 3 do coroutine.yield(i * i) end
+            end)
+            print(gen(), gen(), gen())
+        """)
+        assert out == ["1\t4\t9"]
+
+    def test_wrap_in_generic_for(self):
+        out, _ = run_lua("""
+            local function range2(n)
+              return coroutine.wrap(function()
+                for i = 1, n do coroutine.yield(i) end
+              end)
+            end
+            local s = 0
+            for i in range2(4) do s = s + i end
+            print(s)
+        """)
+        assert out == ["10"]
+
+    def test_error_in_body_returns_false(self):
+        out, _ = run_lua("""
+            local co = coroutine.create(function() error("boom") end)
+            print(coroutine.resume(co))
+            print(coroutine.status(co))
+        """)
+        assert out[0].startswith("false\t")
+        assert "boom" in out[0]
+        assert out[1] == "dead"
+
+    def test_yield_crosses_pcall(self):
+        # thread-per-coroutine keeps the python stack alive across the
+        # suspension, so yield inside pcall works (liblua's unyieldable
+        # C-boundary restriction does not apply here)
+        out, _ = run_lua("""
+            local co = coroutine.create(function()
+              local ok = pcall(function() coroutine.yield("mid") end)
+              return ok
+            end)
+            print(coroutine.resume(co))
+            print(coroutine.resume(co))
+        """)
+        assert out == ["true\tmid", "true\ttrue"]
+
+    def test_yield_outside_coroutine_is_error(self):
+        out, _ = run_lua("print(pcall(coroutine.yield))")
+        assert out[0].startswith("false\t")
+        assert "outside" in out[0]
+
+    def test_introspection_and_close(self):
+        out, _ = run_lua("""
+            print(coroutine.isyieldable())
+            local co, main = coroutine.running()
+            print(co, main)
+            local c2 = coroutine.create(function() coroutine.yield() end)
+            coroutine.resume(c2)
+            print(coroutine.close(c2))
+            print(coroutine.status(c2))
+            print(type(c2))
+        """)
+        assert out == ["false", "nil\ttrue", "true", "dead", "thread"]
+
+    def test_nested_resume_marks_outer_normal(self):
+        out, _ = run_lua("""
+            local inner = coroutine.create(function()
+              coroutine.yield("i1")
+            end)
+            local outer = coroutine.create(function()
+              local _, v = coroutine.resume(inner)
+              coroutine.yield("o:" .. v)
+            end)
+            print(coroutine.resume(outer))
+            print(coroutine.status(inner))
+        """)
+        assert out == ["true\to:i1", "suspended"]
+
+    def test_self_resume_rejected(self):
+        out, _ = run_lua("""
+            local co
+            co = coroutine.create(function()
+              print(coroutine.resume(co))
+            end)
+            coroutine.resume(co)
+        """)
+        assert out == ["false\tcannot resume non-suspended coroutine"]
+
+    def test_step_budget_shared_with_coroutine(self):
+        lines = []
+        rt = LuaRuntime(output=lines.append, max_steps=10_000)
+        out = rt.run("""
+            local co = coroutine.create(function()
+              while true do end
+            end)
+            return coroutine.resume(co)
+        """)
+        assert out[0] is False
+        assert "exceeded" in out[1]
+
+    def test_break_outside_loop_is_catchable(self):
+        out, _ = run_lua("print(pcall(function() break end))")
+        assert out[0].startswith("false\t")
+        assert "break" in out[0]
+
+    def test_close_reclaims_parked_thread(self):
+        import time
+
+        lines = []
+        rt = LuaRuntime(output=lines.append)
+        rt.run("""
+            local co = coroutine.create(function() coroutine.yield() end)
+            coroutine.resume(co)
+            coroutine.close(co)
+        """)
+        for _ in range(100):           # parked body unwinds async
+            if rt._co_live == 0:
+                break
+            time.sleep(0.01)
+        assert rt._co_live == 0
+
+    def test_live_thread_cap_is_catchable(self):
+        lines = []
+        rt = LuaRuntime(output=lines.append, max_coroutines=4)
+        out = rt.run("""
+            held = {}              -- global: the follow-up run closes it
+            local ok, err
+            for i = 1, 8 do
+              local co = coroutine.create(function()
+                coroutine.yield()
+              end)
+              ok, err = pcall(coroutine.resume, co)
+              if not ok then break end
+              held[i] = co
+            end
+            return ok, err
+        """)
+        assert out[0] is False
+        assert "too many live coroutines" in out[1]
+        # closing a parked coroutine releases its slot synchronously
+        out2 = rt.run("""
+            coroutine.close(held[1])
+            local co = coroutine.create(function() return 1 end)
+            return coroutine.resume(co)
+        """)
+        assert out2[0] is True and out2[1] == 1
+
+
+class TestGotoScopeRule:
+    def test_forward_goto_into_local_scope_rejected(self):
+        out, _ = run_lua("""
+            print(pcall(function()
+              goto skip
+              local x = 5
+              ::skip::
+              return x
+            end))
+        """)
+        assert out[0].startswith("false\t")
+        assert "scope of a local" in out[0]
+
+    def test_continue_carveout_with_locals_allowed(self):
+        # label at end of block: jumping over a local is legal (the
+        # lua 5.4 ::continue:: carve-out)
+        out, _ = run_lua("""
+            local s = 0
+            for i = 1, 4 do
+              if i % 2 == 0 then goto continue end
+              local double = i * 2
+              s = s + double
+              ::continue::
+            end
+            print(s)
+        """)
+        assert out == ["8"]
+
+    def test_backward_goto_exits_local_scope(self):
+        # lua 5.4: a backward jump leaves the scope of locals declared
+        # after the label, so the outer binding is visible again
+        out, _ = run_lua("""
+            local v = "g"
+            do
+              local first = true
+              ::top::
+              print(v)
+              local v = "inner"
+              if first then
+                first = false
+                goto top
+              end
+            end
+        """)
+        assert out == ["g", "g"]
+
+    def test_duplicate_label_is_parse_error(self):
+        with pytest.raises(LuaError, match="already defined"):
+            run_lua("::a:: print(1) ::a:: print(2)")
+
+    def test_runtime_close_unwinds_suspended(self):
+        lines = []
+        rt = LuaRuntime(output=lines.append)
+        rt.run("""
+            gen = coroutine.create(function()
+              coroutine.yield(1)
+              coroutine.yield(2)
+            end)
+            coroutine.resume(gen)
+        """)
+        assert rt._co_live == 1
+        rt.close()
+        assert rt._co_live == 0
+
+    def test_runtime_context_manager(self):
+        lines = []
+        with LuaRuntime(output=lines.append) as rt:
+            rt.run("""
+                local co = coroutine.create(function()
+                  coroutine.yield()
+                end)
+                coroutine.resume(co)
+            """)
+        assert rt._co_live == 0

@@ -211,7 +211,7 @@ def phase_embed(ctx: SeriesCtx) -> dict:
         if os.environ.get("BENCH_BUCKETS") != "" else (bucket,)
     # f16 on the wire halves the vector-fetch bytes (the measured
     # bottleneck when link bandwidth caps the drain); "f32" opts out
-    fetch = os.environ.get("BENCH_FETCH", "f16")
+    fetch = os.environ.get("BENCH_FETCH", "int8")
     fetch_dtype = None if fetch in ("f32", "", "none") else fetch
 
     cfg = EncoderConfig(out_dim=768, max_len=2048)
@@ -400,7 +400,7 @@ def phase_embed_sweep(ctx: SeriesCtx) -> dict:
                                         default_tokenizer)
 
     n_texts = int(os.environ.get("SWEEP_TEXTS", "4096"))
-    default_fetch = os.environ.get("BENCH_FETCH", "f16")
+    default_fetch = os.environ.get("BENCH_FETCH", "int8")
 
     def _parse(c: str) -> tuple[int, int, str]:
         parts = c.split("x")

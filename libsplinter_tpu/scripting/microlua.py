@@ -900,6 +900,10 @@ class LuaRuntime:
         self._co_stack: list = []      # innermost running coroutine last
         self._co_live = 0              # live body threads (bounded)
         self._co_started: "_pyweakref.WeakSet" = _pyweakref.WeakSet()
+        # the main thread IS a coroutine value in lua 5.4 (running()
+        # returns it; status works on it); it has no body thread
+        self._main_co = LuaCoroutine(None, self)
+        self._main_co.status = "running"
         self._install_stdlib()
 
     # -- public API ------------------------------------------------------
@@ -1764,6 +1768,8 @@ class LuaRuntime:
                 raise LuaError("bad argument #1 to 'status' "
                                f"(coroutine expected, got "
                                f"{lua_typename(co)})")
+            if co is self._main_co:
+                return "normal" if self._co_stack else "running"
             return co.status
 
         def _co_wrap(fn):
@@ -1797,7 +1803,7 @@ class LuaRuntime:
             "isyieldable": lambda: bool(self._co_stack),
             "running": lambda: (
                 (self._co_stack[-1], False) if self._co_stack
-                else (None, True)),
+                else (self._main_co, True)),
         })
 
     def _require(self, name):

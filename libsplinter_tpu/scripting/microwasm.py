@@ -36,9 +36,13 @@ interpreter covering the MVP core:
               arith/sqrt/rounding/min/max/pmin/pmax, and the
               int<->float conversion matrix
 
+  multi-value  functions and block signatures returning/carrying
+              multiple values (type-index blocktypes; branches to a
+              loop carry its params back to the top)
+
 Out of scope (raise WasmError): threads, externref / multiple tables,
-multi-value block signatures, and the SIMD tail that exists for codec
-inner loops (q15mulr, extadd_pairwise, extmul, relaxed-simd).
+and the SIMD tail that exists for codec inner loops (q15mulr,
+extadd_pairwise, extmul, relaxed-simd).
 Scripts that heavy-compute belong in the JAX tier; wasm here is a
 portable *protocol* client, like the reference's.
 
@@ -175,16 +179,25 @@ def _decode_valtype(r: _Reader) -> int:
     return t
 
 
-def _decode_blocktype(r: _Reader) -> tuple:
-    """() or (valtype,) — MVP block signatures only."""
+def _decode_blocktype(r: _Reader, types=None) -> tuple:
+    """(params, results) valtype tuples.  Three encodings (wasm 1.1 /
+    multi-value): 0x40 = empty, a single valtype byte = one result, or
+    a non-negative s33 = index into the type section (full signature,
+    params enter the block on the stack)."""
     t = r.b[r.p]
     if t == 0x40:
         r.p += 1
-        return ()
+        return ((), ())
     if t in _VALNAMES:
         r.p += 1
-        return (t,)
-    raise WasmError("multi-value block signatures are not supported")
+        return ((), (t,))
+    if t >= 0x80 or t < 0x40:      # non-negative s33 -> type index
+        idx = r.uleb()
+        if types is None or idx >= len(types):
+            raise WasmError(f"blocktype type index {idx} out of range")
+        ft = types[idx]
+        return (ft.params, ft.results)
+    raise WasmError(f"bad blocktype 0x{t:02x}")
 
 
 # ------------------------------------------------------------- SIMD tables
@@ -279,14 +292,14 @@ _SIMD_SUPPORTED = (
 
 # opcode name tables keep the decoder readable; executor dispatches on int.
 
-def _decode_expr(r: _Reader) -> list:
+def _decode_expr(r: _Reader, types=None) -> list:
     """Decode instructions until the matching 0x0B end (depth balanced)."""
     out = []
     depth = 0
     while True:
         op = r.u8()
         if op in (0x02, 0x03, 0x04):            # block, loop, if
-            out.append((op, _decode_blocktype(r)))
+            out.append((op, _decode_blocktype(r, types)))
             depth += 1
         elif op == 0x05:                        # else
             out.append((op,))
@@ -435,8 +448,6 @@ def decode_module(data: bytes) -> Module:
                                for _ in range(body.uleb()))
                 results = tuple(_decode_valtype(body)
                                 for _ in range(body.uleb()))
-                if len(results) > 1:
-                    raise WasmError("multi-value returns not supported")
                 m.types.append(FuncType(params, results))
         elif sec == 2:                                   # import
             for _ in range(body.uleb()):
@@ -528,7 +539,7 @@ def decode_module(data: bytes) -> Module:
                 for _ in range(fr.uleb()):
                     count, vt = fr.uleb(), _decode_valtype(fr)
                     locals_.extend([vt] * count)
-                bodies.append((locals_, _decode_expr(fr)))
+                bodies.append((locals_, _decode_expr(fr, m.types)))
         elif sec == 11:                                  # data
             for _ in range(body.uleb()):
                 flags = body.uleb()
@@ -789,22 +800,30 @@ class Instance:
                 raise Trap("unreachable executed")
             if op == 0x02:                       # block
                 _else, end = find_matching(pc)
-                labels.append(_Label(len(ins[1]), len(stack), end + 1,
-                                     False))
+                bt_params, bt_results = ins[1]
+                labels.append(_Label(len(bt_results),
+                                     len(stack) - len(bt_params),
+                                     end + 1, False))
                 pc += 1
                 continue
             if op == 0x03:                       # loop
                 # cont = first instruction INSIDE: a br re-enters the body
                 # without re-executing the loop opcode (label is kept live
-                # by do_branch, so it is pushed exactly once)
-                labels.append(_Label(0, len(stack), pc + 1, True))
+                # by do_branch, so it is pushed exactly once).  A branch
+                # to a loop carries the loop's PARAMS back to the top.
+                bt_params, _bt_results = ins[1]
+                labels.append(_Label(len(bt_params),
+                                     len(stack) - len(bt_params),
+                                     pc + 1, True))
                 pc += 1
                 continue
             if op == 0x04:                       # if
                 else_pc, end = find_matching(pc)
                 cond = stack.pop()
-                labels.append(_Label(len(ins[1]), len(stack), end + 1,
-                                     False))
+                bt_params, bt_results = ins[1]
+                labels.append(_Label(len(bt_results),
+                                     len(stack) - len(bt_params),
+                                     end + 1, False))
                 if cond:
                     pc += 1
                 else:

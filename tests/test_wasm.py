@@ -731,3 +731,89 @@ class TestTruncSat:
         body64 = f64c(-1e300) + b"\xfc\x06" + END   # i64.trunc_sat_f64_s
         inst = instantiate(simple_module([], [0x7E], body64))
         assert inst.invoke("run", []) == [1 << 63]   # saturated at min
+
+
+class TestMultiValue:
+    """wasm multi-value: multi-result functions, type-index block
+    signatures (params enter on the stack), and branches to a loop
+    carrying its params back to the top."""
+
+    def test_two_result_function(self):
+        wasm = simple_module([], [0x7F, 0x7F], i32c(1) + i32c(2) + END)
+        inst = instantiate(wasm, {})
+        assert inst.invoke("run", []) == [1, 2]
+
+    def test_multi_result_call_site(self):
+        # f0: () -> (i32, i32); run: () -> i32 calls f0 and adds
+        wasm = module([
+            section(1, vec([functype([], [0x7F, 0x7F]),
+                            functype([], [0x7F])])),
+            section(3, vec([uleb(0), uleb(1)])),
+            section(7, vec([name("run") + b"\x00" + uleb(1)])),
+            section(10, vec([
+                code_entry([], i32c(20) + i32c(22) + END),
+                code_entry([], CALL(0) + b"\x6a" + END),   # i32.add
+            ])),
+        ])
+        inst = instantiate(wasm, {})
+        assert inst.invoke("run", []) == [42]
+
+    def test_block_with_params_via_type_index(self):
+        # type1: (i32, i32) -> (i32); block consumes the two pushed
+        # operands as params and yields their sum
+        wasm = module([
+            section(1, vec([functype([], [0x7F]),
+                            functype([0x7F, 0x7F], [0x7F])])),
+            section(3, vec([uleb(0)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry(
+                [],
+                i32c(3) + i32c(4)
+                + b"\x02" + uleb(1)          # block (type 1)
+                + b"\x6a"                    # i32.add
+                + END                        # end block
+                + END)])),
+        ])
+        inst = instantiate(wasm, {})
+        assert inst.invoke("run", []) == [7]
+
+    def test_loop_params_carried_by_branch(self):
+        # fib via a (i32,i32)->(i32,i32) loop: state (a, b) lives ON
+        # THE STACK; br 0 carries both values back to the loop top,
+        # br 2 exits through the enclosing block with both results.
+        #   locals: 0 = n (param), 1..2 = scratch
+        body = (
+            i32c(0) + i32c(1)                 # a=0 b=1
+            + b"\x02" + uleb(2)               # block (type 2: ()->(i32,i32))
+            + b"\x03" + uleb(1)               # loop  (type 1: (i32,i32)->same)
+            + LOCAL_SET(2) + LOCAL_SET(1)     # b->l2, a->l1
+            + LOCAL_GET(0) + b"\x45"          # i32.eqz
+            + b"\x04\x40"                     # if (empty)
+            + LOCAL_GET(1) + LOCAL_GET(2)
+            + b"\x0c" + uleb(2)               # br 2 -> block, carries (a,b)
+            + END                             # end if
+            + LOCAL_GET(0) + i32c(1) + b"\x6b" + LOCAL_SET(0)  # n--
+            + LOCAL_GET(2)                    # b
+            + LOCAL_GET(1) + LOCAL_GET(2) + b"\x6a"            # a+b
+            + b"\x0c" + uleb(0)               # br 0 -> loop top with (b,a+b)
+            + END                             # end loop
+            + END                             # end block
+            + b"\x1a"                         # drop b: leave a = fib(n)
+            + END)
+        wasm = module([
+            section(1, vec([functype([0x7F], [0x7F]),
+                            functype([0x7F, 0x7F], [0x7F, 0x7F]),
+                            functype([], [0x7F, 0x7F])])),
+            section(3, vec([uleb(0)])),
+            section(7, vec([name("run") + b"\x00" + uleb(0)])),
+            section(10, vec([code_entry([(2, 0x7F)], body)])),
+        ])
+        inst = instantiate(wasm, {})
+        assert inst.invoke("run", [10]) == [55]
+        assert inst.invoke("run", [0]) == [0]
+        assert inst.invoke("run", [1]) == [1]
+
+    def test_bad_blocktype_index_rejected(self):
+        wasm = simple_module([], [], b"\x02" + uleb(9) + END + END)
+        with pytest.raises(WasmError, match="out of range"):
+            instantiate(wasm, {})

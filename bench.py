@@ -53,7 +53,16 @@ Resilience by construction (VERDICT r2 #1, r3 #1):
 Env knobs: BENCH_TIMEOUT, BENCH_ATTEMPT_TIMEOUT, BENCH_PHASES
 (default: the full series), BENCH_CPU=1 (host CPU quick-tracking),
 BENCH_SKIP_PROBE=0 (re-enable the pre-flight probe), plus the
-per-phase knobs documented in bench_series.py.
+per-phase knobs documented in bench_series.py (RESTAGE_DIRTY for the
+staged-lane dirty-count sweep, BENCH_P50_PROBES for the wake path).
+
+The embed phase's p50_stage_means decomposes wake->commit against the
+engine/protocol.PIPELINE_STAGES contract: drain / tokenize / dispatch
+/ device_wait / commit, plus overlap_ratio (device in-flight time the
+host spent staging instead of blocking — the commit pipeline's whole
+point; see docs/performance.md "The commit pipeline").
+commit_incl_device_wait_ms remains as the sum for continuity with
+rounds <= r05, whose fused span buried the synchronous device wait.
 
 Tunnel semantics (learned rounds 1-3): the claim server admits ONE
 client; concurrent clients wedge the claim and recovery is a

@@ -321,16 +321,33 @@ def phase_embed(ctx: SeriesCtx) -> dict:
 
         e2e_mean = float(np.mean(lat)) if lat else 0.0
         drain_pr = per_req_ms("embed.drain")
+        # the commit pipeline split the old embed.commit span (which
+        # buried a synchronous device round-trip per batch — 62.2 of
+        # the 67.2 ms r05 p50) into device_wait (host truly blocked on
+        # a future) and commit (epoch-gated store write + protocol
+        # tail).  Device time the host overlapped with staging costs
+        # the wake path nothing and shows up only in overlap_ratio.
         stage_tbl = {
             "e2e_mean_ms": round(e2e_mean, 3),
             "requests": n_req,
             "drain_ms": drain_pr,
             "tokenize_ms": per_req_ms("embed.tokenize"),
             "dispatch_ms": per_req_ms("embed.dispatch"),
-            "commit_incl_device_wait_ms": per_req_ms("embed.commit"),
+            "device_wait_ms": per_req_ms("embed.device_wait"),
+            "commit_ms": per_req_ms("embed.commit"),
+            # continuity with pre-pipeline rounds (<= r05): the sum the
+            # old fused span used to measure
+            "commit_incl_device_wait_ms": round(
+                per_req_ms("embed.device_wait")
+                + per_req_ms("embed.commit"), 3),
             # wake = client set() -> daemon drain start (signal_wait
             # wake + thread handoff): everything e2e that is not drain
             "wake_ms": round(max(e2e_mean - drain_pr, 0.0), 3),
+            "overlap_ratio": round(emb.stats.overlap_ratio(), 4),
+            "probe_lane_hits": emb.stats.probe_lane_hits,
+            "blocking_waits": emb.stats.blocking_waits,
+            "ready_commits": emb.stats.ready_commits,
+            "inflight_peak": emb.stats.inflight_peak,
         }
         log(f"p50 set->vector (event-driven): {p50:.2f} ms  p95: "
             f"{p95:.2f} ms  timeouts={lat_timeouts}  spans={stage_tbl}")
@@ -1086,11 +1103,20 @@ def phase_restage(ctx: SeriesCtx) -> dict:
         clean_ms = min(timed_refresh() for _ in range(5))
 
         results = {}
-        for k in (128, 8192):
+        chunk_detail = {}
+        # tolerant parse: a trailing comma or stray token must not
+        # abort the phase, and counts past n are silently dropped
+        dirty_counts = tuple(
+            int(x.strip()) for x in os.environ.get(
+                "RESTAGE_DIRTY", "128,8192,40000").split(",")
+            if x.strip().isdigit() and int(x.strip()) <= n)
+        for k in dirty_counts:
             # round 1 compiles this pad bucket's scatter; round 2 is
             # the steady state a live session pays
             for _ in (0, 1):
                 staged_before = lane.rows_staged
+                chunks_before = lane.scatter_chunks
+                padded_before = lane.rows_padded
                 idx = rng.choice(n, size=k, replace=False)
                 for i in idx:
                     st.set(f"v/{i}", "y")
@@ -1098,16 +1124,24 @@ def phase_restage(ctx: SeriesCtx) -> dict:
                 moved = lane.rows_staged - staged_before
                 assert moved == k, (moved, k)
                 results[k] = ms
+                chunk_detail[k] = {
+                    "chunks": lane.scatter_chunks - chunks_before,
+                    "rows_padded": lane.rows_padded - padded_before,
+                }
             log(f"[restage] refresh after {k} dirty: "
-                f"{results[k]:.1f} ms (warm)")
+                f"{results[k]:.1f} ms (warm, "
+                f"{chunk_detail[k]['chunks']} chunks, "
+                f"{chunk_detail[k]['rows_padded']} rows padded)")
     finally:
         st.close()
         Store.unlink(name)
 
+    head = max(results) if results else None
     return ctx.record({
         "metric": "staged_lane_restage",
-        "value": round(results[8192], 1),
-        "unit": f"ms (8192 dirty of {n})",
+        "value": round(results[head], 1) if head is not None else 0.0,
+        "unit": (f"ms ({head} dirty of {n})" if head is not None
+                 else f"ms (no dirty counts <= {n} requested)"),
         "vs_baseline": 0.0,
         "detail": {
             "backend": ctx.backend, "n_keys": n, "nslots": nslots,
@@ -1121,8 +1155,12 @@ def phase_restage(ctx: SeriesCtx) -> dict:
             "f16_wire_speedup": round(full_upload_s / f16_upload_s, 2)
             if f16_upload_s else None,
             "refresh_clean_ms": round(clean_ms, 1),
-            "refresh_128_dirty_ms": round(results[128], 1),
-            "refresh_8192_dirty_ms": round(results[8192], 1),
+            **{f"refresh_{k}_dirty_ms": round(v, 1)
+               for k, v in sorted(results.items())},
+            # chunked-refresh accounting (the piecewise-linearity
+            # evidence: chunks x bucket size, padding waste <= 2x)
+            "refresh_chunks": {str(k): v for k, v
+                               in sorted(chunk_detail.items())},
             "max_rss_gb": round(resource.getrusage(
                 resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
         }})

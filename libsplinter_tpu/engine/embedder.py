@@ -10,6 +10,12 @@ on the CPU, this daemon:
   - snapshots (text, epoch) per candidate under the seqlock read protocol;
   - pads each gather into per-bucket batches and runs one jit-compiled TPU
     encoder call per bucket;
+  - pipelines the drain: encode futures are held, not forced — the host
+    tokenizes/buckets/pads batch N+1 while batch N computes on-device,
+    and the epoch-gated commit stage resolves futures in COMPLETION
+    order (CommitPipeline), so wake->commit never pays a synchronous
+    device round-trip it could have overlapped; tiny drains take a
+    short-circuit lane onto pre-compiled small-bucket programs;
   - commits the whole batch of vectors with a single epoch-gated native
     call (spt_vec_commit_batch) — rows whose slot changed mid-flight are
     dropped, mirroring the reference's post-decode epoch+2 verification
@@ -54,6 +60,111 @@ class EmbedderStats:
     skipped_write_once: int = 0
     ctx_exceeded: int = 0
     backfilled: int = 0
+    # -- commit-pipeline telemetry (the overlap is measured, not
+    # asserted: bench.py's p50 stage table reads these) --------------
+    futures_dispatched: int = 0
+    futures_resolved: int = 0
+    ready_commits: int = 0      # future already complete at commit time
+    blocking_waits: int = 0     # host had to block on a device future
+    inflight_peak: int = 0      # max dispatched-uncommitted depth seen
+    probe_lane_hits: int = 0    # drains through the small-batch lane
+    device_wait_ms: float = 0.0  # host wall time blocked in materialize
+    overlap_ms: float = 0.0      # device in-flight time host spent staging
+    commit_host_ms: float = 0.0  # epoch-gated commit + protocol tail
+
+    def overlap_ratio(self) -> float:
+        """Fraction of total device in-flight time the host spent doing
+        useful work instead of blocking (1.0 = the device never stalled
+        the host; 0.0 = every batch was a synchronous round-trip)."""
+        total = self.overlap_ms + self.device_wait_ms
+        return self.overlap_ms / total if total > 0 else 0.0
+
+
+class CommitPipeline:
+    """The drain stage of the embed->commit lane.
+
+    Dispatched encode futures (PendingEmbeddings) queue here instead of
+    being forced inline.  Commits resolve in COMPLETION order: any
+    future that has finished is committed immediately (zero wait) while
+    later batches are still being tokenized/dispatched, and the host
+    only blocks on the device when the in-flight bound is hit with
+    nothing ready — back-pressure, not a synchronous round-trip per
+    batch.  The old path forced each batch FIFO with a blocking
+    device_get inside the wake handler: wake->commit paid the full
+    device round-trip every time (BENCH_r05: 62.2 of the 67.2 ms p50).
+    """
+
+    def __init__(self, commit_fn, stats: EmbedderStats, depth: int):
+        self._commit = commit_fn      # (rows, epochs, f32 vecs) -> int
+        self._stats = stats
+        self.depth = max(1, depth)
+        # (rows, epochs, pending, t_dispatch, blocked_ms_at_dispatch)
+        self._q: deque = deque()
+        self._blocked_ms = 0.0        # cumulative materialize-block time
+        self.committed = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, rows, epochs, pending) -> None:
+        st = self._stats
+        self._q.append((rows, epochs, pending, time.perf_counter(),
+                        self._blocked_ms))
+        st.futures_dispatched += 1
+        st.inflight_peak = max(st.inflight_peak, len(self._q))
+        self.drain_ready()
+        while len(self._q) > self.depth:
+            self._resolve(self._q.popleft())
+
+    def drain_ready(self) -> int:
+        """Commit every future that has already completed (in queue
+        order among the ready ones); never blocks."""
+        done = 0
+        if self._q:
+            still: deque = deque()
+            for item in self._q:
+                if item[2].is_ready():
+                    self._resolve(item)
+                    done += 1
+                else:
+                    still.append(item)
+            self._q = still
+        return done
+
+    def flush(self) -> None:
+        """Drain everything: ready futures first, then block for the
+        rest in dispatch order (the unavoidable tail wait — by now it
+        overlapped the whole remaining host-side staging)."""
+        self.drain_ready()
+        while self._q:
+            self._resolve(self._q.popleft())
+
+    def _resolve(self, item) -> None:
+        rows, epochs, pending, t_dispatch, blocked_at_dispatch = item
+        st = self._stats
+        ready = pending.is_ready()
+        t0 = time.perf_counter()
+        # time the future flew while the host did USEFUL staging work:
+        # the raw dwell minus any interval the host spent blocked in
+        # OTHER futures' materialize (counting that too would let a
+        # fully-stalled pipeline still report ~50% overlap)
+        dwell_ms = (t0 - t_dispatch) * 1e3
+        st.overlap_ms += max(
+            dwell_ms - (self._blocked_ms - blocked_at_dispatch), 0.0)
+        with tracer.span("embed.device_wait"):
+            vecs = pending.materialize()
+        t1 = time.perf_counter()
+        wait_ms = (t1 - t0) * 1e3
+        st.device_wait_ms += wait_ms
+        self._blocked_ms += wait_ms
+        if ready:
+            st.ready_commits += 1
+        else:
+            st.blocking_waits += 1
+        with tracer.span("embed.commit"):
+            self.committed += self._commit(rows, epochs, vecs)
+        st.commit_host_ms += (time.perf_counter() - t1) * 1e3
+        st.futures_resolved += 1
 
 
 class Embedder:
@@ -67,13 +178,20 @@ class Embedder:
                  vector_training: bool = False,
                  group: int = P.GROUP_EMBED,
                  batch_cap: int = 256,
-                 inflight_depth: int | None = None):
+                 inflight_depth: int | None = None,
+                 probe_batch_max: int | None = None):
         self.store = store
         self.max_ctx = max_ctx
         self.vector_training = vector_training
         self.group = group
         self.batch_cap = batch_cap
         self._inflight_override = inflight_depth
+        # drains at or below this size take the latency short-circuit
+        # lane (no sort, no windowing — straight to the pre-compiled
+        # small-bucket programs)
+        self.probe_batch_max = (P.PROBE_BATCH_MAX_DEFAULT
+                                if probe_batch_max is None
+                                else probe_batch_max)
         self.stats = EmbedderStats()
         self._known_epochs: dict[int, int] = {}
         # rows believed to need embedding: fed by the dirty mask (hot
@@ -287,92 +405,112 @@ class Embedder:
         self._inflight_override = value
 
     def process_rows(self, rows: list[int]) -> int:
-        """Embed a set of candidate slot indices; returns committed count."""
+        """Embed a set of candidate slot indices; returns committed count.
+
+        The drain is a two-lane pipeline feeding a CommitPipeline:
+        tiny drains (<= probe_batch_max rows — latency probes, single
+        hot keys) short-circuit straight to tokenize->dispatch on the
+        pre-compiled small-bucket programs; everything bigger runs the
+        windowed big-batch lane, where the host stages window k+1
+        (tokenize/bucket/pad/gather) while window k's encode runs on
+        the device, and finished futures commit the moment they
+        complete — the wake handler never parks on a device round-trip
+        it could overlap."""
         st = self.store
         rows = self._candidates(rows)
         if not rows:
             return 0
         self._pending.update(rows)            # until each row resolves
         keep, texts, epochs = self._gather(rows)
+        if not keep:
+            return 0
 
+        t_start = Store.now()
+        pipe = CommitPipeline(
+            lambda r, e, v: self._commit_batch(r, e, v, t_start),
+            self.stats, self.inflight_depth)
+        if len(keep) <= self.probe_batch_max:
+            self.stats.probe_lane_hits += 1
+            out = self._guard_rows(keep, texts, epochs)
+            if out[0]:
+                self._dispatch_guarded(pipe, *out)
+        else:
+            self._drain_windowed(pipe, keep, texts, epochs)
+        pipe.flush()
+
+        self.stats.embedded += pipe.committed
+        if pipe.committed and P.KEY_DONE_LANE in st:
+            st.bump(P.KEY_DONE_LANE)
+        return pipe.committed
+
+    def _drain_windowed(self, pipe: CommitPipeline, keep, texts,
+                        epochs) -> None:
         # order the drain by text byte length (a cheap token-count
         # proxy): windows become nearly bucket-homogeneous, so the
         # bucket grouping fills whole batch_cap batches instead of
         # fragmenting every window into per-bucket stragglers
-        if len(keep) > 1:
-            order = sorted(range(len(keep)), key=lambda i: len(texts[i]))
-            keep = [keep[i] for i in order]
-            texts = [texts[i] for i in order]
-            epochs = [epochs[i] for i in order]
-
-        from ..models.encoder import PendingEmbeddings
-
-        committed_total = 0
-        t_start = Store.now()
-        inflight: deque = deque()             # (rows, epochs, pending)
-
-        def commit_oldest():
-            nonlocal committed_total
-            r, e, pend = inflight.popleft()
-            with tracer.span("embed.commit"):
-                committed_total += self._commit_batch(
-                    r, e, pend.materialize(), t_start)
-
-        def enqueue(rows_b, eps_b, pend):
-            inflight.append((rows_b, eps_b, pend))
-            while len(inflight) > self.inflight_depth:
-                commit_oldest()
+        order = sorted(range(len(keep)), key=lambda i: len(texts[i]))
+        keep = [keep[i] for i in order]
+        texts = [texts[i] for i in order]
+        epochs = [epochs[i] for i in order]
 
         # guard + tokenize run per window (a few batch_caps): the fused
         # tokenization materializes (window, max_len) ids, which must
         # stay bounded on huge drains (backfill sweeps), while giving
-        # the bucket grouping enough rows to fill homogeneous batches
+        # the bucket grouping enough rows to fill homogeneous batches.
+        # While this window's encodes fly, the next window tokenizes —
+        # and any future that lands mid-stage commits via drain_ready.
         window = max(self.batch_cap * 4, 512)
         for lo in range(0, len(keep), window):
             ch = slice(lo, lo + window)
-            ch_rows, ch_texts, ch_eps = keep[ch], texts[ch], epochs[ch]
+            out = self._guard_rows(keep[ch], texts[ch], epochs[ch])
+            if out[0]:
+                self._dispatch_guarded(pipe, *out)
+            pipe.drain_ready()
 
-            # context-window guard (reference: splinference.cpp:226-233)
-            with tracer.span("embed.tokenize"):
-                too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
-            ok_rows, ok_texts, ok_epochs, ok_i = [], [], [], []
-            for j, (idx, text, e) in enumerate(
-                    zip(ch_rows, ch_texts, ch_eps)):
-                if too_long[j]:
-                    self._mark_ctx_exceeded(idx)
-                else:
-                    ok_rows.append(idx)
-                    ok_texts.append(text)
-                    ok_epochs.append(e)
-                    ok_i.append(j)
-            if not ok_rows:
-                continue
-
-            if ids is not None:
-                # ids already tokenized by the guard pass: group by
-                # per-row bucket and dispatch without forcing (the
-                # span measures host-side dispatch; device time shows
-                # up in embed.commit's materialize wait)
-                rows_a = np.asarray(ok_rows)
-                eps_a = np.asarray(ok_epochs)
-                with tracer.span("embed.dispatch"):
-                    for ss, pend in self._dispatch_bucketed(
-                            ids[ok_i], lens[ok_i]):
-                        enqueue([int(x) for x in rows_a[ss]],
-                                [int(x) for x in eps_a[ss]], pend)
+    def _guard_rows(self, ch_rows, ch_texts, ch_eps):
+        """Context-window guard (reference: splinference.cpp:226-233)
+        over one gather window; violators are marked ctx-exceeded.
+        Returns (ok_rows, ok_texts, ok_epochs, ok_i, ids, lens) — ids
+        is None outside the fused model path."""
+        with tracer.span("embed.tokenize"):
+            too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
+        ok_rows, ok_texts, ok_epochs, ok_i = [], [], [], []
+        for j, (idx, text, e) in enumerate(
+                zip(ch_rows, ch_texts, ch_eps)):
+            if too_long[j]:
+                self._mark_ctx_exceeded(idx)
             else:
-                for slo in range(0, len(ok_rows), self.batch_cap):
-                    sl = slice(slo, slo + self.batch_cap)
-                    vecs = np.asarray(self.encoder_fn(ok_texts[sl]),
-                                      np.float32)
-                    enqueue(ok_rows[sl], ok_epochs[sl],
-                            PendingEmbeddings(vecs, len(vecs)))
-        while inflight:
-            commit_oldest()
-        self.stats.embedded += committed_total
-        if committed_total and P.KEY_DONE_LANE in st:
-            st.bump(P.KEY_DONE_LANE)
-        return committed_total
+                ok_rows.append(idx)
+                ok_texts.append(text)
+                ok_epochs.append(e)
+                ok_i.append(j)
+        return ok_rows, ok_texts, ok_epochs, ok_i, ids, lens
+
+    def _dispatch_guarded(self, pipe: CommitPipeline, ok_rows, ok_texts,
+                          ok_epochs, ok_i, ids, lens) -> None:
+        """Dispatch one guarded window into the pipeline WITHOUT forcing
+        any result (the span measures host-side dispatch; device time
+        surfaces as embed.device_wait only when the host truly blocks)."""
+        from ..models.encoder import PendingEmbeddings
+
+        if ids is not None:
+            # ids already tokenized by the guard pass: group by
+            # per-row bucket and dispatch async
+            rows_a = np.asarray(ok_rows)
+            eps_a = np.asarray(ok_epochs)
+            with tracer.span("embed.dispatch"):
+                for ss, pend in self._dispatch_bucketed(
+                        ids[ok_i], lens[ok_i]):
+                    pipe.push([int(x) for x in rows_a[ss]],
+                              [int(x) for x in eps_a[ss]], pend)
+        else:
+            for slo in range(0, len(ok_rows), self.batch_cap):
+                sl = slice(slo, slo + self.batch_cap)
+                vecs = np.asarray(self.encoder_fn(ok_texts[sl]),
+                                  np.float32)
+                pipe.push(ok_rows[sl], ok_epochs[sl],
+                          PendingEmbeddings(vecs, len(vecs)))
 
     def _commit_batch(self, ok_rows, ok_epochs, vecs: np.ndarray,
                       t_start: int) -> int:
@@ -458,7 +596,10 @@ class Embedder:
         reference's __debug channel; the sidecar's group-63 watch
         surfaces every update)."""
         payload = {**dataclasses.asdict(self.stats),
+                   "overlap_ratio": round(self.stats.overlap_ratio(), 4),
                    "pending": len(self._pending)}
+        for k in ("device_wait_ms", "overlap_ms", "commit_host_ms"):
+            payload[k] = round(payload[k], 3)
         if tracer.enabled:
             payload["spans"] = tracer.snapshot()
         P.publish_heartbeat(self.store, P.KEY_EMBED_STATS, payload)
@@ -594,7 +735,17 @@ def main(argv: list[str] | None = None) -> int:
     emb.attach()
     if args.warmup:
         t0 = time.monotonic()
-        emb._model.warmup(batch_sizes=(1, emb.batch_cap))
+        # probe-lane pad sizes (powers of two up to probe_batch_max)
+        # compile too, or the first latency probe of each size pays a
+        # fresh XLA compile on the wake path
+        probe_pads = []
+        b = 1
+        while b <= emb.probe_batch_max:
+            probe_pads.append(b)
+            b *= 2
+        emb._model.warmup(
+            batch_sizes=tuple(dict.fromkeys(probe_pads
+                                            + [emb.batch_cap])))
         log.info("warmup compiled in %.1fs", time.monotonic() - t0)
     if args.backfill_text_keys:
         n = emb.backfill()

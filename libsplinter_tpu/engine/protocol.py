@@ -53,16 +53,34 @@ SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
 # context guard: reject inputs >= this fraction of the model window
 CTX_GUARD_FRACTION = 0.9
 
+# --- commit-pipeline stage contract --------------------------------------
+# The wake->commit path decomposes into these stages; every stats
+# surface (the embedder heartbeat's "pipeline" section, bench's
+# p50_stage_means) uses these names so dashboards and before/after
+# comparisons line up.  device_wait is the time the host BLOCKED on a
+# device future; overlapped device time (future in flight while the
+# host staged the next batch) is reported separately as overlap_ms /
+# overlap_ratio, not as a stage — it costs no wake-path wall time.
+PIPELINE_STAGES = ("drain", "tokenize", "dispatch", "device_wait",
+                   "commit")
+
+# latency-probe short-circuit: drains at or below this many candidate
+# rows skip the windowed big-batch machinery and dispatch immediately
+# on the pre-compiled small-bucket programs (Embedder.probe_batch_max
+# overrides per instance)
+PROBE_BATCH_MAX_DEFAULT = 8
+
 
 def publish_heartbeat(store, key: str, payload: dict) -> None:
     """Write a timestamped JSON stats snapshot into a debug-labeled
     key.  Telemetry must never wedge serving: a concurrently deleted
     key (KeyError) or a failed store op (OSError) is swallowed — but a
-    snapshot too big for the store's max_val degrades to the core
-    counters (marking what was dropped) instead of silently removing
-    the heartbeat the moment tracing is enabled."""
+    snapshot too big for the store's max_val degrades SECTION BY
+    SECTION (largest optional dict/list dropped first, marked
+    truncated) so whatever telemetry fits still lands, instead of
+    all-or-nothing removal the moment tracing is enabled."""
     rec = {"ts": time.time(), **payload}
-    for attempt in (0, 1):
+    for _ in range(2 + len(payload)):
         try:
             store.set(key, json.dumps(rec))
             store.label_or(key, LBL_DEBUG)
@@ -70,10 +88,10 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
         except KeyError:
             return
         except OSError:
-            if attempt == 1:
+            sections = [k for k, v in rec.items()
+                        if isinstance(v, (dict, list))]
+            if not sections:
                 return
-            # drop the bulky optional sections and retry once
-            rec = {k: v for k, v in rec.items()
-                   if not isinstance(v, (dict, list))}
+            rec.pop(max(sections, key=lambda k: len(json.dumps(rec[k]))))
             rec["truncated"] = True
 CTX_EXCEEDED_DIAGNOSTIC = b"[context exceeded: input too long for model]"

@@ -178,7 +178,8 @@ class Encoder(nn.Module):
         if cfg.variant == "bert":
             pos = jnp.arange(token_ids.shape[1])[None, :]
             if cfg.ring_axis:   # local chunk -> global absolute positions
-                sp = jax.lax.axis_size(cfg.ring_axis)
+                from ..parallel.mesh import axis_size
+                sp = axis_size(cfg.ring_axis)
                 if sp * token_ids.shape[1] > cfg.max_len:
                     raise ValueError(
                         f"bert variant: global sequence {sp}x"
@@ -229,6 +230,22 @@ class PendingEmbeddings:
     def __init__(self, out, n: int):
         self._out = out
         self.n = n
+
+    def is_ready(self) -> bool:
+        """True when materialize() will not block: the device compute
+        (and any transfer) behind this future has completed, or the
+        result is already host memory.  The commit pipeline uses this
+        to resolve futures in COMPLETION order — commit whatever is
+        done, keep staging while the rest computes."""
+        out = self._out
+        if isinstance(out, np.ndarray):
+            return True
+        try:
+            return bool(out.is_ready())
+        except AttributeError:
+            # unknown future type: claim in-flight so callers account
+            # the materialize as a (possibly) blocking wait
+            return False
 
     def materialize(self) -> np.ndarray:
         # fetch in the model's wire dtype (f16 halves, int8 quarters

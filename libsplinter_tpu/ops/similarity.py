@@ -159,6 +159,30 @@ def cosine_scores(vectors, queries, mask=None, *, block_n: int = 1024,
     return out[:n, :q]
 
 
+@functools.lru_cache(maxsize=None)
+def _scatter_rows_norms_fn():
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def scatter(arr, norms, rows, vals, nvals):
+        # vals may arrive in a narrower wire dtype (f16): upcast
+        # on-device where it is free; norms are exact f32 from the host
+        arr = arr.at[rows].set(vals.astype(arr.dtype))
+        norms = norms.at[rows].set(nvals.astype(norms.dtype))
+        return arr, norms
+
+    return scatter
+
+
+def scatter_rows_with_norms(arr, norms, rows, vals, nvals):
+    """Fused in-place row update of a staged lane AND its row-norm
+    vector in ONE device dispatch (donated buffers — the old two-call
+    path paid two dispatches per refresh chunk and briefly held two
+    copies of the lane).  Shapes: arr (N, D), norms (N,), rows (B,)
+    int32, vals (B, D) any float dtype, nvals (B,) f32.  The (B, D)
+    shape must come from a fixed bucket set or every distinct dirty
+    count jit-compiles a fresh scatter."""
+    return _scatter_rows_norms_fn()(arr, norms, rows, vals, nvals)
+
+
 def euclidean_distances(vectors, queries, mask=None) -> jnp.ndarray:
     """(N, D) x (Q, D) -> (N, Q) euclidean distances (inf where masked).
     Computed from norms + dot so it reuses the same fused matmul shape."""

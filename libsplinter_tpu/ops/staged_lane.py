@@ -13,7 +13,13 @@ StagedLane makes the lane resident in HBM:
     acquire load per slot in C), diffs it against the epochs the rows
     were staged at, gathers ONLY the changed rows torn-safely
     (spt_vec_gather), and scatters them into the device array in place
-    (donated buffer, jit'd at a few padded update-size buckets);
+    (donated buffers, jit'd at a few padded update-size buckets);
+    large dirty sets are CHUNKED through the same fixed bucket set —
+    the gather of chunk i+1 overlaps the async device scatter of
+    chunk i, padding waste is bounded at 2x, and no dirty count ever
+    triggers a fresh jit compile (the r05 cliff: one 8,192-row refresh
+    padded to a single 32,768-row scatter and cost 53x the 128-row
+    path);
   - searches read the device array directly — zero host->device traffic
     for an unchanged lane, O(changed rows) otherwise.
 
@@ -106,22 +112,45 @@ def _get_jax():
     return jax
 
 
-@functools.lru_cache(maxsize=None)
-def _scatter_fn():
-    jax = _get_jax()
-
-    @functools.partial(jax.jit, donate_argnums=0)
-    def scatter(arr, rows, vals):
-        return arr.at[rows].set(vals.astype(arr.dtype))
-
-    return scatter
-
-
 def _bucket(n: int) -> int:
     for b in _UPDATE_BUCKETS:
         if n <= b:
             return b
     return -(-n // _UPDATE_BUCKETS[-1]) * _UPDATE_BUCKETS[-1]
+
+
+def _chunk_plan(n: int) -> list[int]:
+    """Decompose a dirty count into scatter chunk sizes, every one drawn
+    from the fixed _UPDATE_BUCKETS set (so no refresh size ever compiles
+    a fresh program) with padding waste bounded at 2x.
+
+    The old single-scatter path padded n up to one bucket: 8,192 dirty
+    rows became one 32,768-row scatter — a 4x transfer cliff that
+    measured 53x in wall time at scale (BENCH_r05: 46.7 ms at 128 dirty
+    -> 2,473 ms at 8,192).  Chunking keeps cost piecewise-linear: take
+    the largest bucket that fits while the remainder is big, stop as
+    soon as padding the tail wastes no more than 2x.
+
+      8,192  -> [4096, 4096]               (padded 8,192, exact)
+      40,000 -> [32768, 4096, 4096]        (padded 40,960, 1.02x)
+      128    -> [64, 64]                   (padded 128; old path: 512)
+    """
+    out: list[int] = []
+    smallest, largest = _UPDATE_BUCKETS[0], _UPDATE_BUCKETS[-1]
+    while n > 0:
+        if n >= largest:
+            out.append(largest)
+            n -= largest
+            continue
+        cover = _bucket(n)               # smallest bucket covering n
+        if cover <= 2 * n or cover == smallest:
+            out.append(cover)            # tail: padding waste <= 2x
+            break
+        # waste too big: peel off the largest bucket that fits
+        fit = max(b for b in _UPDATE_BUCKETS if b <= n)
+        out.append(fit)
+        n -= fit
+    return out
 
 
 class StagedLane:
@@ -156,7 +185,10 @@ class StagedLane:
         # transfer accounting (tests + perf docs read these)
         self.full_uploads = 0
         self.rows_staged = 0             # incremental rows transferred
+        self.rows_padded = 0             # incl. bucket padding (wire cost)
         self.refreshes = 0
+        self.scatter_chunks = 0          # device scatters dispatched
+        self.chunk_hist: dict[int, int] = {}   # bucket size -> count
 
     # -- staging -----------------------------------------------------------
 
@@ -190,7 +222,12 @@ class StagedLane:
             _advise_dontneed(view[lo:hi])    # staged; drop our PTEs
         e2 = st.epochs()
         stable = (e1 == e2) & ((e1 & 1) == 0)
-        self._arr = arr
+        # commit the lane to its device explicitly: the refresh scatter
+        # signature must match between (upload-produced arr, committed
+        # norms) and its own (committed, committed) outputs, or the
+        # first refresh of every bucket shape jit-compiles TWICE (the
+        # sharding-committedness is part of jax's cache key)
+        self._arr = jax.device_put(arr, dev)
         self._norms = jax.device_put(norms_host, dev)
         # rows that moved mid-copy get an odd sentinel so the next
         # refresh re-stages them (a stable epoch is always even)
@@ -203,36 +240,53 @@ class StagedLane:
         if self._arr is None:
             self._full_upload()
             return self._arr
-        st = self._st
-        e = st.epochs()
-        changed = np.nonzero(e != self._staged)[0]
+        changed = np.nonzero(self._st.epochs() != self._staged)[0]
         if changed.size:
-            vecs, eps = st.vec_gather(changed)
-            ok = eps != Store.GATHER_TORN
-            rows = changed[ok]
-            if rows.size:
-                n = int(rows.size)
-                b = _bucket(n)
-                g = vecs[ok]              # one gather for vals + norms
-                # pad with a duplicate of row 0 — scatter-set with an
-                # identical (row, value) pair is idempotent
-                rows_p = np.empty(b, np.int32)
-                rows_p[:n] = rows
-                rows_p[n:] = rows[0]
-                vals_p = np.empty((b, vecs.shape[1]), self._wire_np)
-                vals_p[:n] = g
-                vals_p[n:] = g[0]
-                self._arr = _scatter_fn()(self._arr, rows_p, vals_p)
-                # norms from the exact f32 gather (not the wire copy)
-                norms_p = np.empty(b, np.float32)
-                norms_p[:n] = np.linalg.norm(g, axis=1)
-                norms_p[n:] = norms_p[0]
-                self._norms = _scatter_fn()(self._norms, rows_p,
-                                            norms_p)
-                self._staged[rows] = eps[ok]
-                self.rows_staged += n
-            # torn rows: staged epoch untouched -> still dirty next pass
+            self._stage_rows(changed)
         return self._arr
+
+    def _stage_rows(self, changed: np.ndarray) -> None:
+        """Incremental re-stage of `changed` rows, chunked through the
+        fixed bucket set (_chunk_plan).  Each chunk's scatter is a
+        single fused vals+norms device dispatch on donated buffers
+        (ops.similarity.scatter_rows_with_norms) and jax dispatches it
+        asynchronously — so the host-side vec_gather of chunk i+1
+        overlaps the device scatter of chunk i, and no dirty count ever
+        pads to more than 2x its size or compiles a fresh program."""
+        from .similarity import scatter_rows_with_norms
+
+        st = self._st
+        plan = _chunk_plan(int(changed.size))
+        for off, vecs, eps in st.vec_gather_iter(changed, plan):
+            ok = eps != Store.GATHER_TORN
+            n = int(ok.sum())
+            if not n:
+                # torn rows: staged epoch untouched -> dirty next pass
+                continue
+            rows = changed[off: off + ok.size][ok]
+            g = vecs if n == ok.size else vecs[ok]
+            # the chunk length came from the plan, but torn-row drops
+            # may let the remainder fit a smaller precompiled bucket
+            b = _bucket(n)
+            # pad with a duplicate of row 0 — scatter-set with an
+            # identical (row, value) pair is idempotent
+            rows_p = np.empty(b, np.int32)
+            rows_p[:n] = rows
+            rows_p[n:] = rows[0]
+            vals_p = np.empty((b, g.shape[1]), self._wire_np)
+            vals_p[:n] = g
+            vals_p[n:] = g[0]
+            # norms from the exact f32 gather (not the wire copy)
+            norms_p = np.empty(b, np.float32)
+            norms_p[:n] = np.linalg.norm(g, axis=1)
+            norms_p[n:] = norms_p[0]
+            self._arr, self._norms = scatter_rows_with_norms(
+                self._arr, self._norms, rows_p, vals_p, norms_p)
+            self._staged[rows] = eps[ok]
+            self.rows_staged += n
+            self.rows_padded += b
+            self.scatter_chunks += 1
+            self.chunk_hist[b] = self.chunk_hist.get(b, 0) + 1
 
     @property
     def array(self):

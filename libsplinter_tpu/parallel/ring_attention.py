@@ -74,7 +74,11 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str,
               positions [i*S_local, (i+1)*S_local)).
     Returns   (B, S_local, H, D) in q.dtype.
     """
-    n = axis_size if axis_size is not None else lax.axis_size(axis_name)
+    if axis_size is not None:
+        n = axis_size
+    else:
+        from .mesh import axis_size as _axis_size
+        n = _axis_size(axis_name)
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)

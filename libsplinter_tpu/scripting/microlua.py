@@ -675,6 +675,8 @@ def lua_tostring(v) -> str:
         return v
     if isinstance(v, LuaTable):
         return f"table: 0x{id(v):012x}"
+    if isinstance(v, LuaCoroutine):   # thread values (incl. the main
+        return f"thread: 0x{id(v):012x}"   # thread) never leak a repr
     if isinstance(v, (LuaFunction,)) or callable(v):
         return f"function: 0x{id(v):012x}"
     return str(v)
@@ -869,16 +871,31 @@ class LuaCoroutine:
             raise _CoClosed()
         return self._xfer
 
-    def close(self) -> None:
+    # join budget for close(): module-level so hosts (and tests) can
+    # tighten it without touching every call site
+    CLOSE_JOIN_TIMEOUT_S = 5.0
+
+    def close(self) -> bool:
         """Reclaim a suspended coroutine's thread (lua 5.4 close):
         the parked body unwinds via _CoClosed and exits.  Joined
         (bounded) so the slot release is synchronous — a script that
-        closes then creates sees the freed slot."""
+        closes then creates sees the freed slot.
+
+        Returns False when the body thread did NOT exit within the
+        join budget (a host frame swallowed the _CoClosed unwind):
+        the _co_live slot is genuinely still occupied by a live
+        thread, so it is NOT released — silently pretending the slot
+        was freed would let unreclaimable threads accumulate past
+        max_coroutines unseen.  Callers surface the failure
+        (coroutine.close returns false + message, per 5.4)."""
         self.status = "dead"
         if self._thread is not None and self._thread.is_alive():
             self._closed = True
             self._resume_sem.release()
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=self.CLOSE_JOIN_TIMEOUT_S)
+            if self._thread.is_alive():
+                return False
+        return True
 
 
 class LuaRuntime:
@@ -1790,7 +1807,10 @@ class LuaRuntime:
             if co.status in ("running", "normal"):
                 return (False, "cannot close a "
                         f"{co.status} coroutine")
-            co.close()           # unwinds a parked body; thread exits
+            if not co.close():   # unwinds a parked body; thread exits
+                return (False, "cannot close coroutine: body thread "
+                        "did not exit (a host frame swallowed the "
+                        "close signal)")
             return True
 
         g["coroutine"] = LuaTable({

@@ -673,6 +673,25 @@ class Store:
             eps.ctypes.data_as(C.POINTER(C.c_uint64))))
         return vecs, eps
 
+    def vec_gather_iter(self, rows: np.ndarray, chunks: Sequence[int]
+                        ) -> Iterator[tuple[int, np.ndarray, np.ndarray]]:
+        """Chunked torn-safe gather: yields (offset, vecs, epochs) per
+        chunk, where `chunks` is a sequence of chunk lengths that
+        partitions `rows` (a short final chunk is clipped; lengths past
+        the end of `rows` yield nothing).  Bounds the host-side copy to
+        one chunk at a time and lets a consumer overlap the gather of
+        chunk i+1 with device work dispatched on chunk i — the
+        StagedLane refresh path's pipelining contract."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint32)
+        lo = 0
+        for length in chunks:
+            if lo >= rows.size:
+                return
+            sub = rows[lo: lo + int(length)]
+            vecs, eps = self.vec_gather(sub)
+            yield lo, vecs, eps
+            lo += sub.size
+
     def vec_commit_batch(self, rows: np.ndarray, epochs: np.ndarray,
                          vecs: np.ndarray, *,
                          write_once: bool = False) -> np.ndarray:

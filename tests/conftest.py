@@ -8,10 +8,24 @@ import os
 # initializes lazily, so jax.config still wins here.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The 8-device request must land before the CPU backend initializes.
+# jax >= 0.5 exposes it as a config option; older jax only reads the
+# XLA flag, which still works here because the backend is lazy.  Any
+# inherited count is REPLACED — the suite's sharding tests assume 8.
+import re as _re
+
+os.environ["XLA_FLAGS"] = (_re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""))
+    + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:      # jax < 0.5: the XLA flag above covers it
+    pass
 
 import uuid
 

@@ -772,7 +772,74 @@ class TestCoroutines:
             print(coroutine.status(c2))
             print(type(c2))
         """)
-        assert out == ["false", "nil\ttrue", "true", "dead", "thread"]
+        # lua 5.4: running() on the main thread returns the MAIN THREAD
+        # VALUE (a thread) plus true — not nil
+        assert out[0] == "false"
+        assert out[1].startswith("thread: 0x")
+        assert out[1].endswith("\ttrue")
+        assert out[2:] == ["true", "dead", "thread"]
+
+    def test_running_main_is_usable_thread_value(self):
+        # the main-thread value round-trips through type/status like
+        # any other thread
+        out, _ = run_lua("""
+            local main = coroutine.running()
+            print(type(main), coroutine.status(main))
+            local co = coroutine.create(function()
+              local inner, is_main = coroutine.running()
+              print(type(inner), is_main)
+            end)
+            coroutine.resume(co)
+        """)
+        assert out == ["thread\trunning", "thread\tfalse"]
+
+    def test_tostring_thread_values(self):
+        # thread values print as `thread: 0x...` (never the host
+        # object repr), via print AND tostring, for live and dead
+        out, _ = run_lua("""
+            local co = coroutine.create(function() end)
+            print(co)
+            print(tostring(co))
+            coroutine.resume(co)
+            print(tostring(co))
+        """)
+        assert len(out) == 3
+        for line in out:
+            assert line.startswith("thread: 0x"), line
+        assert "object at" not in "".join(out)   # the old repr leak
+
+    def test_close_reports_unreclaimable_thread(self, monkeypatch):
+        # a host frame that swallows the close unwind leaves the body
+        # thread alive: close() must report failure (false + message),
+        # not silently leak the slot accounting
+        from libsplinter_tpu.scripting.microlua import LuaCoroutine
+
+        monkeypatch.setattr(LuaCoroutine, "CLOSE_JOIN_TIMEOUT_S", 0.2)
+        import threading
+        release = threading.Event()
+
+        def swallow(y):
+            try:
+                y()                    # parks in coroutine.yield
+            except BaseException:
+                release.wait(30.0)     # close signal swallowed
+
+        lines = []
+        rt = LuaRuntime(output=lines.append)
+        rt.globals["swallow"] = swallow
+        out = rt.run("""
+            local co = coroutine.create(function()
+              swallow(coroutine.yield)
+            end)
+            coroutine.resume(co)
+            return coroutine.close(co)
+        """)
+        try:
+            assert out[0] is False
+            assert "did not exit" in out[1]
+            assert rt._co_live == 1    # honest accounting: still live
+        finally:
+            release.set()              # let the parked thread finish
 
     def test_nested_resume_marks_outer_normal(self):
         out, _ = run_lua("""

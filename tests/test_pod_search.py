@@ -118,11 +118,22 @@ class TestSingleProcess:
 
 
 WORKER = r"""
-import json, os, sys
+import json, os, re, sys
+# 2 devices per host -> 4 global; older jax lacks the config option and
+# reads the XLA flag instead (must land before backend init).  REPLACE
+# any inherited count (pytest's conftest exports =8) — merely skipping
+# when present would hand each worker 8 devices
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=2").strip()
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)   # 2 devices per host -> 4 global
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass
 import jax.distributed
 pid = int(sys.argv[1]); coord = sys.argv[2]; out_path = sys.argv[3]
 jax.distributed.initialize(coordinator_address=coord, num_processes=2,

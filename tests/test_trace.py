@@ -58,7 +58,9 @@ def test_embedder_heartbeat_carries_spans(tmp_path, monkeypatch):
     emod.tracer.reset()
     name = f"/spt-trace-{tmp_path.name}"
     Store.unlink(name)
-    st = Store.create(name, nslots=64, max_val=512, vec_dim=8)
+    # max_val must hold the full heartbeat: counters (incl. the commit
+    # pipeline's) + the span table this test is about
+    st = Store.create(name, nslots=64, max_val=1536, vec_dim=8)
     try:
         emb = emod.Embedder(st, encoder_fn=lambda ts: np.zeros(
             (len(ts), 8), np.float32), max_ctx=64)

@@ -8,6 +8,9 @@
 #                   chi-sao) + full pytest
 #   make memcheck   valgrind (if installed) or ASan/UBSan native tier
 #   make bench-cpu  quick host-CPU bench (embed + store_ops phases)
+#   make obs-check  observability tier: tracing-overhead budget
+#                   (scripts/obs_overhead_check.py, <3% vs disabled)
+#                   + the `-m obs` pytest group
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -33,7 +36,12 @@ quick: native
 
 check: native
 	$(MAKE) -C native check
+	$(PY) scripts/obs_overhead_check.py
 	$(PY) -m pytest tests/ -q
+
+obs-check: native
+	$(PY) scripts/obs_overhead_check.py
+	$(PY) -m pytest tests/ -q -m obs
 
 memcheck: native
 	$(MAKE) -C native memcheck
@@ -45,4 +53,4 @@ bench-cpu:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native quick check memcheck bench-cpu clean
+.PHONY: all native quick check obs-check memcheck bench-cpu clean

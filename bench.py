@@ -56,13 +56,17 @@ BENCH_SKIP_PROBE=0 (re-enable the pre-flight probe), plus the
 per-phase knobs documented in bench_series.py (RESTAGE_DIRTY for the
 staged-lane dirty-count sweep, BENCH_P50_PROBES for the wake path).
 
-The embed phase's p50_stage_means decomposes wake->commit against the
-engine/protocol.PIPELINE_STAGES contract: drain / tokenize / dispatch
-/ device_wait / commit, plus overlap_ratio (device in-flight time the
-host spent staging instead of blocking — the commit pipeline's whole
-point; see docs/performance.md "The commit pipeline").
-commit_incl_device_wait_ms remains as the sum for continuity with
-rounds <= r05, whose fused span buried the synchronous device wait.
+The embed phase's detail.stage_quantiles decomposes wake->commit
+against the engine/protocol.PIPELINE_STAGES contract: drain / tokenize
+/ dispatch / device_wait / commit, each as TRUE histogram-sourced
+p50/p95/p99 (obs/hist.py log-bucketed histograms riding the
+__embedder_stats heartbeat — rounds <= r06 reported stage MEANS under
+a "p50" name; that field is gone).  detail.pipeline_counters carries
+overlap_ratio (device in-flight time the host spent staging instead
+of blocking — the commit pipeline's whole point; see
+docs/performance.md "The commit pipeline") and the lane-routing
+counters; detail.slow_log carries the flight recorder's promoted
+slow requests.
 
 Tunnel semantics (learned rounds 1-3): the claim server admits ONE
 client; concurrent clients wedge the claim and recovery is a

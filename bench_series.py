@@ -12,7 +12,7 @@ each record to bench_results.jsonl the moment it completes, so a
 single claim window produces the complete evidence set:
 
   embed          e2e embedding throughput + event-driven p50
-                 set->vector with per-stage span decomposition
+                 set->vector with per-stage histogram quantiles
                  (the headline metric; written to a recovery file
                  the parent can read even if a later phase hangs)
   embed_sweep    e2e throughput across (batch_cap, inflight_depth)
@@ -176,8 +176,10 @@ def _bench_store_name(suffix: str) -> str:
 
 def phase_embed(ctx: SeriesCtx) -> dict:
     """End-to-end embedding throughput per chip + p50 set->vector on
-    the event-driven wake path, with the per-stage span table VERDICT
-    r3 #3 asks for (wake / drain / tokenize / dispatch / commit).
+    the event-driven wake path, with per-stage p50/p95/p99 sourced
+    from the span histograms riding the __embedder_stats heartbeat
+    (PIPELINE_STAGES: drain / tokenize / dispatch / device_wait /
+    commit).
 
     Env: BENCH_TEXTS (16384), BENCH_BATCH (4096), BENCH_BUCKET (64),
     BENCH_BUCKETS (16,32,BUCKET), BENCH_P50_PROBES (30).
@@ -232,7 +234,9 @@ def phase_embed(ctx: SeriesCtx) -> dict:
     _stage("stage-store")
     name = _bench_store_name("series")
     Store.unlink(name)
-    st = Store.create(name, nslots=max(8192, n_texts * 2), max_val=2048,
+    # max_val 4096: the traced heartbeat (counters + spans + stage
+    # quantiles + slow log) must land un-degraded for the stage table
+    st = Store.create(name, nslots=max(8192, n_texts * 2), max_val=4096,
                       vec_dim=768)
     runner = None
     try:
@@ -262,9 +266,10 @@ def phase_embed(ctx: SeriesCtx) -> dict:
             f"{eps:,.0f} emb/s/chip")
 
         # p50 set->vector on the EVENT-DRIVEN wake path, with spans
-        # enabled so the latency decomposes into stages: wake (e2e
-        # minus drain), gather+tokenize, host dispatch, commit (which
-        # contains the device wait — materialize blocks there).
+        # enabled so the latency decomposes into per-stage HISTOGRAM
+        # QUANTILES (obs/hist.py via utils/trace.py) riding the
+        # __embedder_stats heartbeat — true p50/p95/p99 per stage,
+        # never means dressed as percentiles.
         # The daemon thread MUST be stopped on every exit path: later
         # phases share this process, and a still-running daemon would
         # use the store after the finally below closes/unlinks it.
@@ -304,45 +309,39 @@ def phase_embed(ctx: SeriesCtx) -> dict:
         finally:
             emb.stop()
             runner.join(timeout=5.0)
-            spans = tracer.snapshot()
+            # the stage quantiles ride the heartbeat (the contract the
+            # obs layer pins: bench consumes what any watcher could)
+            emb.publish_stats()
+            hb = {}
+            try:
+                hb = json.loads(st.get(P.KEY_EMBED_STATS)
+                                .rstrip(b"\0"))
+            except (KeyError, OSError, ValueError):
+                pass
+            stage_q = hb.get("quantiles") or tracer.quantiles("embed.")
+            slow_log = hb.get("slow_log") or []
             tracer.enabled = was_enabled
         p50 = float(np.percentile(lat, 50)) if lat else -1.0
         p95 = float(np.percentile(lat, 95)) if lat else -1.0
+        p99 = float(np.percentile(lat, 99)) if lat else -1.0
 
-        # per-stage means over the p50 loop's requests.  The drain span
-        # fires on EVERY wake including empty idle-timeout sweeps, so
-        # per-request means divide each span's TOTAL by the number of
-        # real requests (the commit count) — not by the span's own n.
-        n_req = max(spans.get("embed.commit", {}).get("n", 0), 1)
+        # per-stage p50/p95/p99 from the span histograms, keyed by the
+        # PIPELINE_STAGES contract.  The old table reported arithmetic
+        # means over drains under a "p50" name; these are true
+        # percentiles of per-drain stage wall (the p50 loop drains one
+        # request at a time, so per-drain ~= per-request here).
+        # device_wait is host-BLOCKED time only; overlapped device
+        # time shows up in overlap_ratio, not as a stage.
+        def _q(stage: str) -> dict:
+            a = stage_q.get(stage) or {}
+            return {k: a.get(k, 0.0)
+                    for k in ("p50_ms", "p95_ms", "p99_ms",
+                              "max_ms", "n")}
 
-        def per_req_ms(span: str) -> float:
-            a = spans.get(span)
-            return round(a["total_ms"] / n_req, 3) if a else 0.0
-
-        e2e_mean = float(np.mean(lat)) if lat else 0.0
-        drain_pr = per_req_ms("embed.drain")
-        # the commit pipeline split the old embed.commit span (which
-        # buried a synchronous device round-trip per batch — 62.2 of
-        # the 67.2 ms r05 p50) into device_wait (host truly blocked on
-        # a future) and commit (epoch-gated store write + protocol
-        # tail).  Device time the host overlapped with staging costs
-        # the wake path nothing and shows up only in overlap_ratio.
-        stage_tbl = {
-            "e2e_mean_ms": round(e2e_mean, 3),
+        stage_tbl = {s: _q(s) for s in P.PIPELINE_STAGES}
+        n_req = int(stage_tbl["commit"]["n"]) or 1
+        pipeline_counters = {
             "requests": n_req,
-            "drain_ms": drain_pr,
-            "tokenize_ms": per_req_ms("embed.tokenize"),
-            "dispatch_ms": per_req_ms("embed.dispatch"),
-            "device_wait_ms": per_req_ms("embed.device_wait"),
-            "commit_ms": per_req_ms("embed.commit"),
-            # continuity with pre-pipeline rounds (<= r05): the sum the
-            # old fused span used to measure
-            "commit_incl_device_wait_ms": round(
-                per_req_ms("embed.device_wait")
-                + per_req_ms("embed.commit"), 3),
-            # wake = client set() -> daemon drain start (signal_wait
-            # wake + thread handoff): everything e2e that is not drain
-            "wake_ms": round(max(e2e_mean - drain_pr, 0.0), 3),
             "overlap_ratio": round(emb.stats.overlap_ratio(), 4),
             "probe_lane_hits": emb.stats.probe_lane_hits,
             "blocking_waits": emb.stats.blocking_waits,
@@ -350,7 +349,9 @@ def phase_embed(ctx: SeriesCtx) -> dict:
             "inflight_peak": emb.stats.inflight_peak,
         }
         log(f"p50 set->vector (event-driven): {p50:.2f} ms  p95: "
-            f"{p95:.2f} ms  timeouts={lat_timeouts}  spans={stage_tbl}")
+            f"{p95:.2f} ms  p99: {p99:.2f} ms  "
+            f"timeouts={lat_timeouts}  stage_quantiles={stage_tbl}  "
+            f"counters={pipeline_counters}")
     finally:
         if runner is not None and runner.is_alive():
             # a wedged daemon thread still holds the mapping: closing
@@ -376,8 +377,11 @@ def phase_embed(ctx: SeriesCtx) -> dict:
             "compile_s": round(compile_s, 1),
             "p50_set_to_vector_ms": round(p50, 2),
             "p95_set_to_vector_ms": round(p95, 2),
+            "p99_set_to_vector_ms": round(p99, 2),
             "p50_samples": len(lat), "p50_timeouts": lat_timeouts,
-            "p50_stage_means": stage_tbl,
+            "stage_quantiles": stage_tbl,
+            "pipeline_counters": pipeline_counters,
+            "slow_log": slow_log[-4:],
         }})
     ctx.headline = rec
 

@@ -619,10 +619,12 @@ def cmd_help(ses, args):
             print(f"  {usage:<{width}}  {help_}")
 
 
-# search / ingest / export / scripting hosts live in their own modules
+# search / ingest / export / scripting / obs hosts live in their own
+# modules
 from .search import cmd_search  # noqa: E402  (registers itself)
 from .ingest import cmd_ingest, cmd_export  # noqa: E402
 from .script import cmd_lua, cmd_wasm  # noqa: E402
+from .metrics import cmd_metrics, cmd_trace  # noqa: E402
 
 
 # ------------------------------------------------------------------- REPL

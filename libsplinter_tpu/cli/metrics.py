@@ -1,0 +1,135 @@
+"""`spt metrics` + `spt trace` — the operator-facing obs surface.
+
+`metrics` renders everything observable from OUTSIDE the daemons as
+Prometheus text exposition (obs/prom.py): store header diagnostics
+(used slots, global epoch, parse_failures), daemon heartbeat counters
+(__embedder_stats / __completer_stats scalars), heartbeat ages, the
+histogram-sourced per-stage quantile summaries the daemons publish
+under SPTPU_TRACE=1, and flight-recorder accounting.  Pipe it to a
+node_exporter textfile collector or curl-style scrape wrapper and the
+SLO dashboards come for free.
+
+`trace tail [N]` dumps the daemons' flight-recorder rings
+(__embedder_trace / __completer_trace): one line per traced request —
+trace id, key, wall ms, and the ordered stage event sequence
+(PIPELINE_STAGES / INFER_STAGES names) — reconstructing any single
+wake->commit journey cross-process.  Clients opt a request in with
+engine/protocol.stamp_trace(store, key) — after set+label, before
+the bump, so a racing daemon can't service the row stampless.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from ..engine import protocol as P
+from ..obs.prom import PromWriter
+from .main import CliError, command
+
+_HEARTBEATS = (("embedder", P.KEY_EMBED_STATS),
+               ("completer", P.KEY_COMPLETE_STATS))
+_TRACE_KEYS = (("embedder", P.KEY_EMBED_TRACE),
+               ("completer", P.KEY_COMPLETE_TRACE))
+
+
+def _read_json(store, key: str) -> dict | None:
+    try:
+        raw = store.get(key)
+    except (KeyError, OSError):
+        return None
+    try:
+        snap = json.loads(raw.rstrip(b"\0"))
+    except ValueError:
+        return None
+    return snap if isinstance(snap, dict) else None
+
+
+@command("metrics", "metrics",
+         "Prometheus text exposition of store + daemon telemetry")
+def cmd_metrics(ses, args):
+    st = ses.store
+    w = PromWriter()
+
+    h = st.header()
+    w.metric("sptpu_store_used_slots", h.used_slots,
+             help_="live keys at snapshot time")
+    w.metric("sptpu_store_nslots", h.nslots)
+    w.metric("sptpu_store_max_val_bytes", h.max_val)
+    w.metric("sptpu_store_global_epoch", h.global_epoch,
+             mtype="counter")
+    w.metric("sptpu_store_parse_failures", h.parse_failures,
+             mtype="counter",
+             help_="client-reported value parse failures "
+                   "(spt_report_parse_failure)")
+    w.metric("sptpu_store_last_failure_epoch", h.last_failure_epoch)
+
+    now = time.time()
+    for daemon, key in _HEARTBEATS:
+        snap = _read_json(st, key)
+        if snap is None:
+            continue
+        lab = {"daemon": daemon}
+        ts = snap.pop("ts", None)
+        if ts:
+            w.metric("sptpu_heartbeat_age_seconds", now - ts, lab,
+                     help_="seconds since the daemon's last heartbeat")
+        quantiles = snap.pop("quantiles", None) or {}
+        recorder = snap.pop("recorder", None) or {}
+        slow = snap.pop("slow_log", None) or []
+        snap.pop("spans", None)       # superseded by the quantiles
+        for field, v in snap.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            w.metric(f"sptpu_{daemon}_{field}", v)
+        for stage, q in quantiles.items():
+            if isinstance(q, dict):
+                w.summary("sptpu_stage_ms", q,
+                          {"daemon": daemon, "stage": stage},
+                          help_="per-stage wall time quantiles "
+                                "(histogram-sourced, ms)")
+        for field, v in recorder.items():
+            w.metric(f"sptpu_{daemon}_trace_{field}", v, mtype=(
+                "gauge" if field.endswith("_ms") else "counter"))
+        w.metric(f"sptpu_{daemon}_slow_log_entries", len(slow))
+
+    lane = ses._lane                  # only if a search staged one
+    if lane is not None:
+        w.scalars("sptpu_staged_lane", lane.counters())
+
+    sys.stdout.write(w.render())
+
+
+@command("trace", "trace tail [N]",
+         "dump the daemons' flight recorders (last N traced requests)")
+def cmd_trace(ses, args):
+    if not args or args[0] != "tail":
+        raise CliError("usage: trace tail [N]")
+    try:
+        n = int(args[1]) if len(args) > 1 else 16
+    except ValueError:
+        raise CliError("usage: trace tail [N] (N must be an integer)")
+    st = ses.store
+    shown = 0
+    for daemon, key in _TRACE_KEYS:
+        snap = _read_json(st, key)
+        recs = (snap or {}).get("trace") or []
+        age = time.time() - snap["ts"] if snap and "ts" in snap else 0
+        if recs and age > 30:
+            # a ring the daemon could not refresh (daemon stopped, or
+            # the payload outgrew max_val) must not read as current
+            print(f"[{daemon}] ring published {age:.0f}s ago — "
+                  f"records below may be stale")
+        for rec in (recs[-n:] if n > 0 else []):
+            events = " ".join(
+                f"{name}={ms:.3f}ms" for name, ms in
+                rec.get("events", []))
+            tid = rec.get("id", 0)
+            print(f"[{daemon}] id={tid:#x} pid={tid >> 24} "
+                  f"key={rec.get('key')!r} wall={rec.get('wall_ms')}ms "
+                  f"{events}")
+            shown += 1
+    if not shown:
+        print("no traced requests recorded (daemons publish their "
+              "rings under SPTPU_TRACE=1; clients opt requests in "
+              "via protocol.stamp_trace)")

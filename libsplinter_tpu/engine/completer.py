@@ -38,7 +38,9 @@ import time
 from typing import Callable, Iterator
 
 from .. import _native as N
+from ..obs.recorder import FlightRecorder
 from ..store import Store
+from ..utils.trace import tracer
 from . import protocol as P
 
 log = logging.getLogger("libsplinter_tpu.completer")
@@ -140,6 +142,12 @@ class Completer:
         self.template = template
         self.group = group
         self.stats = CompleterStats()
+        # flight recorder for the serial (process_key) path: clients
+        # stamp infer requests exactly like embed ones
+        # (protocol.stamp_trace); batched/continuous paths aggregate
+        # through the span histograms only
+        self.recorder = FlightRecorder()
+        self._trace_published = 0      # ring state last published
         self._bid = -1
         self._running = False
 
@@ -280,13 +288,27 @@ class Completer:
         _read_rendered plus the claim side effects — WAITING→SERVICING
         flip, slot overwrite with the rendered prompt.  A caller that
         already peeked passes its (key, rendered) to avoid re-reading.
-        Returns (key, rendered, t0) or None."""
+        Returns (key, rendered, t0, stamp) or None; stamp is the
+        request's consumed trace stamp (serial path records it, the
+        batched/continuous paths aggregate via spans only — consuming
+        HERE means no path can leave a stale stamp to corrupt a later
+        request's flight record)."""
         st = self.store
         if peek is None:
             peek = self._read_rendered(idx)
         if peek is None:
             return None
         key, rendered = peek
+
+        stamp = None
+        if st.labels_at(idx) & P.LBL_TRACED:
+            # consumed even with tracing OFF (an instrumented client's
+            # stamps must not leak keys/labels against an untraced
+            # daemon) — only recorded when tracing is on
+            stamp = P.consume_trace_stamp(st, idx,
+                                          epoch=st.epoch_at(idx))
+            if not tracer.enabled:
+                stamp = None
 
         # WAITING → SERVICING, visible to watchers immediately
         st.label_clear(key, P.LBL_INFER_REQ | P.LBL_WAITING)
@@ -300,7 +322,7 @@ class Completer:
             st.set(key, data)
         except OSError:               # rendered prompt alone overflows —
             st.set(key, data[: st.max_val - 1])   # slice BYTES, not chars
-        return key, rendered, t0
+        return key, rendered, t0, stamp
 
     def _finalize(self, key: str, t0: int, n_tok: int,
                   truncated: bool, vanished: bool = False) -> None:
@@ -341,11 +363,19 @@ class Completer:
                 pass
 
     def process_key(self, idx: int) -> bool:
-        """Run one completion for slot idx.  Returns True if serviced."""
+        """Run one completion for slot idx.  Returns True if serviced.
+
+        With SPTPU_TRACE=1 the request decomposes into the
+        protocol.INFER_STAGES histogram spans, and a client-stamped
+        request (protocol.stamp_trace) gets a flight-recorder entry
+        with the stage event sequence + client-measured wall time."""
+        traced = tracer.enabled
+        tr0 = time.perf_counter()
         prep = self._prepare(idx)
         if prep is None:
             return False
-        key, rendered, t0 = prep
+        tr1 = time.perf_counter()
+        key, rendered, t0, stamp = prep
         n_tok, pending = 0, b""
         truncated = vanished = False
         try:
@@ -368,7 +398,23 @@ class Completer:
                 vanished = r == "gone"
         except Exception as ex:       # model failure must not wedge WAITING
             self._debug(f"generation failed for {key!r}: {ex}")
+        tr2 = time.perf_counter()
         self._finalize(key, t0, n_tok, truncated, vanished)
+        if traced:
+            tr3 = time.perf_counter()
+            stages = ((tr1 - tr0) * 1e3, (tr2 - tr1) * 1e3,
+                      (tr3 - tr2) * 1e3)
+            for name, ms in zip(P.INFER_STAGES, stages):
+                tracer.record(f"infer.{name}", ms)
+            tracer.record("infer.e2e", (tr3 - tr0) * 1e3)
+            if stamp is not None:
+                tid, ts = stamp
+                wall = ((time.time() - ts) * 1e3 if ts > 0
+                        else (tr3 - tr0) * 1e3)
+                self.recorder.record(
+                    tid, key, wall,
+                    [[n, round(ms, 3)]
+                     for n, ms in zip(P.INFER_STAGES, stages)])
         return True
 
     def process_batch(self, idxs: list[int]) -> int:
@@ -391,7 +437,7 @@ class Completer:
             prep = self._prepare(idx)
             if prep is None:
                 continue
-            key, rendered, t0 = prep
+            key, rendered, t0, _stamp = prep   # consumed by _prepare
             ids = self._clip_context(tok.encode(rendered), bucketed=True)
             if not len(ids):
                 # an empty prompt must fail alone, not poison the whole
@@ -557,7 +603,7 @@ class Completer:
                 prep = self._prepare(idx, peek=peek)
                 if prep is None:
                     continue
-                key, rendered, t0 = prep
+                key, rendered, t0, _stamp = prep   # consumed
                 if ids is None:
                     ids = self._clip_context(tok_izer.encode(rendered),
                                              bucketed=True)
@@ -755,9 +801,17 @@ class Completer:
         """Heartbeat: JSON stats snapshot into the debug-labeled
         __completer_stats key (the structured counterpart of the
         reference's __debug chatter; sidecar group-63 watch surfaces
-        it)."""
-        P.publish_heartbeat(self.store, P.KEY_COMPLETE_STATS,
-                            dataclasses.asdict(self.stats))
+        it).  SPTPU_TRACE=1 adds histogram-sourced INFER_STAGES
+        quantiles, recorder accounting, and the slow log."""
+        payload = dataclasses.asdict(self.stats)
+        if tracer.enabled:
+            P.attach_trace_sections(payload, tracer, self.recorder,
+                                    "infer.")
+        P.publish_heartbeat(self.store, P.KEY_COMPLETE_STATS, payload)
+        if tracer.enabled:
+            self._trace_published = P.maybe_publish_trace_ring(
+                self.store, P.KEY_COMPLETE_TRACE, self.recorder,
+                self._trace_published)
 
     def run(self, *, idle_timeout_ms: int = 100,
             stop_after: float | None = None) -> None:

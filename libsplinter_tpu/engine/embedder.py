@@ -39,6 +39,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from .. import _native as N
+from ..obs.recorder import FlightRecorder
 from ..store import Store
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
@@ -94,9 +95,14 @@ class CommitPipeline:
     device round-trip every time (BENCH_r05: 62.2 of the 67.2 ms p50).
     """
 
-    def __init__(self, commit_fn, stats: EmbedderStats, depth: int):
+    def __init__(self, commit_fn, stats: EmbedderStats, depth: int,
+                 *, stage_acc: dict | None = None):
         self._commit = commit_fn      # (rows, epochs, f32 vecs) -> int
         self._stats = stats
+        # per-drain PIPELINE_STAGES accumulator (tracing only): the
+        # resolve path adds its device_wait/commit wall here so traced
+        # requests get real stage events, not re-measured estimates
+        self._stage_acc = stage_acc
         self.depth = max(1, depth)
         # (rows, epochs, pending, t_dispatch, blocked_ms_at_dispatch)
         self._q: deque = deque()
@@ -151,8 +157,7 @@ class CommitPipeline:
         dwell_ms = (t0 - t_dispatch) * 1e3
         st.overlap_ms += max(
             dwell_ms - (self._blocked_ms - blocked_at_dispatch), 0.0)
-        with tracer.span("embed.device_wait"):
-            vecs = pending.materialize()
+        vecs = pending.materialize()
         t1 = time.perf_counter()
         wait_ms = (t1 - t0) * 1e3
         st.device_wait_ms += wait_ms
@@ -161,10 +166,19 @@ class CommitPipeline:
             st.ready_commits += 1
         else:
             st.blocking_waits += 1
-        with tracer.span("embed.commit"):
-            self.committed += self._commit(rows, epochs, vecs)
-        st.commit_host_ms += (time.perf_counter() - t1) * 1e3
+        self.committed += self._commit(rows, epochs, vecs)
+        commit_ms = (time.perf_counter() - t1) * 1e3
+        st.commit_host_ms += commit_ms
         st.futures_resolved += 1
+        if tracer.enabled:
+            # histogram records from the timings above — no extra
+            # span machinery in the per-batch resolve path
+            tracer.record("embed.device_wait", wait_ms)
+            tracer.record("embed.commit", commit_ms)
+            acc = self._stage_acc
+            if acc is not None:
+                acc["device_wait"] += wait_ms
+                acc["commit"] += commit_ms
 
 
 class Embedder:
@@ -193,6 +207,14 @@ class Embedder:
                                 if probe_batch_max is None
                                 else probe_batch_max)
         self.stats = EmbedderStats()
+        # flight recorder: per-request wake->commit traces for rows
+        # whose client stamped a trace id (protocol.stamp_trace);
+        # published next to the heartbeat (KEY_EMBED_TRACE)
+        self.recorder = FlightRecorder()
+        self._trace_published = 0             # ring state last published
+        self._stage_acc: dict | None = None   # live drain's stage sums
+        self._traced_hits: list | None = None  # LBL_TRACED rows seen
+        self._drain_t0: float | None = None
         self._known_epochs: dict[int, int] = {}
         # rows believed to need embedding: fed by the dirty mask (hot
         # path) and by label sweeps (cold start + periodic reconcile).
@@ -313,10 +335,16 @@ class Embedder:
     def _candidates(self, indices: Sequence[int]) -> list[int]:
         st = self.store
         out = []
+        traced = self._traced_hits
         for idx in indices:
             labels = st.labels_at(idx)
             if not labels & P.LBL_EMBED_REQ:
                 self._pending.discard(idx)    # done or never requested
+                if labels & (P.LBL_TRACED | P.LBL_DEBUG):
+                    # a stamp that landed after its request was
+                    # serviced surfaces here (its own write dirtied
+                    # the stamp slot) — shed it or it leaks forever
+                    P.shed_orphan_stamp(st, idx, labels)
                 continue
             e = st.epoch_at(idx)
             if e & 1:
@@ -325,6 +353,8 @@ class Embedder:
             if self._known_epochs.get(idx, -1) >= e:
                 self._pending.discard(idx)    # already embedded this epoch
                 continue
+            if labels & P.LBL_TRACED and traced is not None:
+                traced.append(idx)   # stamp read deferred to _begin_trace
             out.append(idx)
         return out
 
@@ -417,18 +447,27 @@ class Embedder:
         complete — the wake handler never parks on a device round-trip
         it could overlap."""
         st = self.store
+        # armed BEFORE the candidate filter: it discovers traced rows
+        # from the label word it reads anyway (zero extra store ops).
+        # Always armed — an untraced daemon must still SHED stamps an
+        # instrumented client leaves, or every stamped request leaks a
+        # __tr_<idx> key + a permanent LBL_TRACED bit
+        self._traced_hits = []
         rows = self._candidates(rows)
         if not rows:
+            self._traced_hits = None
             return 0
         self._pending.update(rows)            # until each row resolves
         keep, texts, epochs = self._gather(rows)
         if not keep:
             return 0
+        traced = self._begin_trace(keep, epochs)
 
         t_start = Store.now()
         pipe = CommitPipeline(
             lambda r, e, v: self._commit_batch(r, e, v, t_start),
-            self.stats, self.inflight_depth)
+            self.stats, self.inflight_depth,
+            stage_acc=self._stage_acc)
         if len(keep) <= self.probe_batch_max:
             self.stats.probe_lane_hits += 1
             out = self._guard_rows(keep, texts, epochs)
@@ -437,11 +476,87 @@ class Embedder:
         else:
             self._drain_windowed(pipe, keep, texts, epochs)
         pipe.flush()
+        self._end_trace(traced)
 
         self.stats.embedded += pipe.committed
         if pipe.committed and P.KEY_DONE_LANE in st:
             st.bump(P.KEY_DONE_LANE)
         return pipe.committed
+
+    # -- flight recording --------------------------------------------------
+
+    def _begin_trace(self, keep: list[int],
+                     epochs: list[int]) -> list | None:
+        """Arm the drain's PIPELINE_STAGES accumulator and read the
+        trace stamps of LBL_TRACED rows the candidate filter flagged.
+        Disabled tracing costs one attribute check; enabled tracing
+        with no traced rows costs no store lookups at all.  Stamps are
+        epoch-checked against the gathered request: a stale stamp (a
+        request serviced before its stamp landed) is consumed, never
+        attributed to this drain."""
+        hits, self._traced_hits = self._traced_hits, None
+        if not tracer.enabled:
+            self._stage_acc = None
+            # shed stamps an instrumented client left for an untraced
+            # daemon — they would otherwise accumulate forever
+            for idx in (hits or ()):
+                P.consume_trace_stamp(self.store, idx)
+            return None
+        acc = dict.fromkeys(P.PIPELINE_STAGES, 0.0)
+        # the drain stage: signal drain + candidate filter + seqlock
+        # gather — everything between the wake and the first tokenize
+        # (disjoint from the other stages; the WHOLE drain's wall,
+        # stages nested, is the embed.drain_cycle span)
+        if self._drain_t0 is not None:
+            acc["drain"] = (time.perf_counter() - self._drain_t0) * 1e3
+            self._drain_t0 = None
+            tracer.record("embed.drain", acc["drain"])
+        self._stage_acc = acc
+        traced = []
+        if hits:
+            kept = {idx: e for idx, e in zip(keep, epochs)}
+            for idx in hits:
+                if idx not in kept:
+                    continue          # torn/raced: retried next drain
+                # consume HERE, while the slot is still this
+                # request's: by drain end the client may have unset
+                # the key and a NEW request (with its own fresh
+                # stamp) may occupy the slot — mutating then would
+                # destroy the newcomer's stamp.  A stale/missing
+                # stamp sheds the phantom label the same way.
+                stamp = P.consume_trace_stamp(self.store, idx,
+                                              epoch=kept[idx])
+                if stamp is not None:
+                    try:
+                        key = self.store.key_at(idx)
+                    except (KeyError, OSError):
+                        key = None
+                    traced.append((key, stamp[0], stamp[1]))
+        return traced
+
+    def _end_trace(self, traced: list | None) -> None:
+        """Emit one flight-recorder record per traced request: the
+        drain's stage sums as an ordered wake->commit event sequence,
+        wall time measured from the client's stamp timestamp.  Pure
+        recording — every store mutation happened at _begin_trace,
+        when the slot still belonged to the traced request."""
+        acc, self._stage_acc = self._stage_acc, None
+        if acc is None:
+            return
+        # e2e records for EVERY traced drain (not just stamped ones):
+        # the heartbeat's e2e quantiles must sample the same
+        # population as the per-stage quantiles, or comparing them is
+        # comparing different workloads
+        stage_sum = sum(acc.values())
+        tracer.record("embed.e2e", stage_sum)
+        if not traced:
+            return
+        now_wall = time.time()
+        events = [[s, round(acc[s], 3)] for s in P.PIPELINE_STAGES]
+        for key, tid, ts in traced:
+            wall = (now_wall - ts) * 1e3 if ts > 0 else stage_sum
+            self.recorder.record(tid, key, wall,
+                                 [list(e) for e in events])
 
     def _drain_windowed(self, pipe: CommitPipeline, keep, texts,
                         epochs) -> None:
@@ -473,8 +588,13 @@ class Embedder:
         over one gather window; violators are marked ctx-exceeded.
         Returns (ok_rows, ok_texts, ok_epochs, ok_i, ids, lens) — ids
         is None outside the fused model path."""
-        with tracer.span("embed.tokenize"):
-            too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
+        t0 = time.perf_counter()
+        too_long, ids, lens = self._ctx_flags_and_ids(ch_texts)
+        if tracer.enabled:
+            dt = (time.perf_counter() - t0) * 1e3
+            tracer.record("embed.tokenize", dt)
+            if self._stage_acc is not None:
+                self._stage_acc["tokenize"] += dt
         ok_rows, ok_texts, ok_epochs, ok_i = [], [], [], []
         for j, (idx, text, e) in enumerate(
                 zip(ch_rows, ch_texts, ch_eps)):
@@ -494,16 +614,23 @@ class Embedder:
         surfaces as embed.device_wait only when the host truly blocks)."""
         from ..models.encoder import PendingEmbeddings
 
+        acc = self._stage_acc
+        # pipe.push may commit ready futures inline (drain_ready):
+        # that wall belongs to device_wait/commit, which _resolve
+        # accrues itself — subtract it so the stage values stay
+        # disjoint (the drain stages must sum to the drain, not above)
+        nested0 = (acc["commit"] + acc["device_wait"]) \
+            if acc is not None else 0.0
+        t0 = time.perf_counter()
         if ids is not None:
             # ids already tokenized by the guard pass: group by
             # per-row bucket and dispatch async
             rows_a = np.asarray(ok_rows)
             eps_a = np.asarray(ok_epochs)
-            with tracer.span("embed.dispatch"):
-                for ss, pend in self._dispatch_bucketed(
-                        ids[ok_i], lens[ok_i]):
-                    pipe.push([int(x) for x in rows_a[ss]],
-                              [int(x) for x in eps_a[ss]], pend)
+            for ss, pend in self._dispatch_bucketed(
+                    ids[ok_i], lens[ok_i]):
+                pipe.push([int(x) for x in rows_a[ss]],
+                          [int(x) for x in eps_a[ss]], pend)
         else:
             for slo in range(0, len(ok_rows), self.batch_cap):
                 sl = slice(slo, slo + self.batch_cap)
@@ -511,6 +638,13 @@ class Embedder:
                                   np.float32)
                 pipe.push(ok_rows[sl], ok_epochs[sl],
                           PendingEmbeddings(vecs, len(vecs)))
+        if tracer.enabled:
+            nested = (acc["commit"] + acc["device_wait"] - nested0) \
+                if acc is not None else 0.0
+            dt = max((time.perf_counter() - t0) * 1e3 - nested, 0.0)
+            tracer.record("embed.dispatch", dt)
+            if acc is not None:
+                acc["dispatch"] += dt
 
     def _commit_batch(self, ok_rows, ok_epochs, vecs: np.ndarray,
                       t_start: int) -> int:
@@ -566,7 +700,14 @@ class Embedder:
         periodic reconciliation that catches labels whose dirty bits a
         crashed consumer drained and lost)."""
         st = self.store
-        with tracer.span("embed.drain"):
+        # trace anchor: _begin_trace turns this into the per-request
+        # "drain" stage (wake -> first tokenize).  The WHOLE drain's
+        # wall — stages nested, empty idle sweeps included — records
+        # separately as drain_cycle, so the PIPELINE_STAGES "drain"
+        # histogram and the flight-recorder "drain" event measure the
+        # same disjoint slice
+        self._drain_t0 = time.perf_counter() if tracer.enabled else None
+        with tracer.span("embed.drain_cycle"):
             bits = st.drain_dirty()
             rows = set(st.dirty_to_indices(bits))
             rows.update(self._pending)
@@ -598,11 +739,24 @@ class Embedder:
         payload = {**dataclasses.asdict(self.stats),
                    "overlap_ratio": round(self.stats.overlap_ratio(), 4),
                    "pending": len(self._pending)}
+        model = getattr(self, "_model", None)
+        if model is not None and hasattr(model, "compile_count"):
+            payload["compile_count"] = model.compile_count()
         for k in ("device_wait_ms", "overlap_ms", "commit_host_ms"):
             payload[k] = round(payload[k], 3)
         if tracer.enabled:
-            payload["spans"] = tracer.snapshot()
+            # histogram-sourced per-stage quantiles under the
+            # PIPELINE_STAGES names — what bench.py's stage table and
+            # `spt metrics` consume (true percentiles, never means)
+            P.attach_trace_sections(payload, tracer, self.recorder,
+                                    "embed.")
         P.publish_heartbeat(self.store, P.KEY_EMBED_STATS, payload)
+        if tracer.enabled:
+            # the flight-recorder ring rides its own key so `spt trace
+            # tail` reconstructs individual requests cross-process
+            self._trace_published = P.maybe_publish_trace_ring(
+                self.store, P.KEY_EMBED_TRACE, self.recorder,
+                self._trace_published)
 
     def run(self, *, idle_timeout_ms: int = 100,
             stop_after: float | None = None,

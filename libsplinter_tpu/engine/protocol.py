@@ -5,7 +5,9 @@ Mirrors the reference's label state machine and well-known keys
 splainference.cpp:51-109; SURVEY.md §2.2) so a client written against the
 reference's conventions finds identical behavior here.
 """
+import itertools
 import json
+import os
 import time
 
 # --- bloom labels (bit masks) -------------------------------------------
@@ -14,6 +16,7 @@ LBL_WAITING = 0x40             # client is blocked on this key
 LBL_CTX_EXCEEDED = 0x80        # input exceeded the model context window
 LBL_CHUNK = 0x200              # ingest: document chunk
 LBL_META = 0x400               # ingest: metadata slot
+LBL_TRACED = 0x1 << 58         # request carries a trace stamp (obs)
 LBL_DEBUG = 0x1 << 59          # debug channel (sidecar watches this)
 LBL_INFER_REQ = 0x1 << 60      # "complete me" — wakes the completion daemon
 LBL_SERVICING = 0x1 << 61      # completion in progress
@@ -49,26 +52,143 @@ KEY_SYSTEM_PROMPT = "__system_prompt"
 KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
 SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
+# flight-recorder dumps (obs/recorder.py): each daemon publishes its
+# ring of per-request wake->commit traces here alongside its stats
+# heartbeat; `spt trace tail` reads them cross-process
+KEY_EMBED_TRACE = "__embedder_trace"
+KEY_COMPLETE_TRACE = "__completer_trace"
 
 # context guard: reject inputs >= this fraction of the model window
 CTX_GUARD_FRACTION = 0.9
 
 # --- commit-pipeline stage contract --------------------------------------
 # The wake->commit path decomposes into these stages; every stats
-# surface (the embedder heartbeat's "pipeline" section, bench's
-# p50_stage_means) uses these names so dashboards and before/after
-# comparisons line up.  device_wait is the time the host BLOCKED on a
+# surface (the embedder heartbeat's quantiles section, bench's
+# stage_quantiles, flight-recorder event sequences) uses these names
+# so dashboards and before/after comparisons line up.  device_wait is
+# the time the host BLOCKED on a
 # device future; overlapped device time (future in flight while the
 # host staged the next batch) is reported separately as overlap_ms /
 # overlap_ratio, not as a stage — it costs no wake-path wall time.
 PIPELINE_STAGES = ("drain", "tokenize", "dispatch", "device_wait",
                    "commit")
 
+# the completion daemon's per-request decomposition (serial path):
+# render = guarded prompt read + system-prompt fetch + template +
+# WAITING->SERVICING claim; generate = the token loop incl. streaming
+# appends; commit = oom bookkeeping + ctime backfill + READY flip
+INFER_STAGES = ("render", "generate", "commit")
+
 # latency-probe short-circuit: drains at or below this many candidate
 # rows skip the windowed big-batch machinery and dispatch immediately
 # on the pre-compiled small-bucket programs (Embedder.probe_batch_max
 # overrides per instance)
 PROBE_BATCH_MAX_DEFAULT = 8
+
+# --- request trace ids ----------------------------------------------------
+# A client that wants its request's wake->commit journey reconstructed
+# stamps a trace id NEXT TO the request label: after set + label_or
+# (LBL_EMBED_REQ / LBL_INFER_REQ), ideally before the bump, it writes
+# "<trace_id>:<wall_ts>:<slot_epoch>" into the slot-indexed companion
+# key trace_stamp_key(idx).  The epoch field makes stamps
+# self-invalidating (a daemon discards a stamp whose epoch doesn't
+# match the request it gathered) — clients implementing the
+# convention by hand must include it or forfeit that protection.  The
+# servicing daemon (SPTPU_TRACE=1) consumes the stamp when it drains
+# the row, appends the request's stage events to its flight recorder
+# under the PIPELINE_STAGES names, and publishes the ring — so any
+# single request is reconstructable cross-process via `spt trace
+# tail`.  Ids are (pid << 24 | counter): unique across concurrent
+# clients without coordination, and the originating pid is
+# recoverable (id >> 24).
+TRACE_STAMP_PREFIX = "__tr_"
+
+
+def trace_stamp_key(idx: int) -> str:
+    return f"{TRACE_STAMP_PREFIX}{idx}"
+
+
+_trace_counter = itertools.count(1)
+
+
+def next_trace_id() -> int:
+    return (os.getpid() << 24) | (next(_trace_counter) & 0xFFFFFF)
+
+
+def stamp_trace(store, key: str) -> int | None:
+    """Client-side: mark the pending request on `key` for flight
+    recording (best after set+label, before the bump — a daemon
+    racing the stamp then can't service the row stampless).  Returns
+    the trace id, or None when the stamp could not land (tracing must
+    never fail a request).
+
+    LBL_TRACED on the request key is the cheap discovery signal: the
+    daemon's candidate filter already reads every row's label word, so
+    untraced rows cost one bit-test — never a stamp-key lookup.  The
+    stamp embeds the row's CURRENT epoch: a daemon finding a stamp
+    whose epoch doesn't match the request it gathered discards it as
+    stale (a leftover from a request serviced before the stamp
+    landed, or from a pre-tracing daemon run) instead of attributing
+    it — and its seconds-old wall clock — to the wrong request."""
+    try:
+        idx = store.find_index(key)
+        tid = next_trace_id()
+        sk = trace_stamp_key(idx)
+        store.set(sk, f"{tid}:{time.time():.6f}:{store.epoch_at(idx)}")
+        store.label_or(sk, LBL_DEBUG)
+        store.label_or(key, LBL_TRACED)
+        return tid
+    except (KeyError, OSError):
+        return None
+
+
+def read_trace_stamp(store, idx: int,
+                     epoch: int | None = None) -> tuple[int, float] | None:
+    """Daemon-side: (trace_id, client_wall_ts) for slot idx, or None.
+    With `epoch` given (the gathered request's epoch), a stamp from a
+    DIFFERENT epoch is stale: it is consumed (cleared) and None is
+    returned, so it can never corrupt a later request's record."""
+    try:
+        raw = store.get(trace_stamp_key(idx)).rstrip(b"\0").decode()
+        parts = raw.split(":")
+        tid = int(parts[0])
+        ts = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
+        e_stamp = int(parts[2]) if len(parts) > 2 and parts[2] else None
+    except (KeyError, OSError, ValueError, IndexError):
+        return None
+    if epoch is not None and e_stamp is not None and e_stamp != epoch:
+        clear_trace_stamp(store, idx)         # stale: consume, never
+        return None                           # attribute to this row
+    return tid, ts
+
+
+def clear_trace_stamp(store, idx: int) -> None:
+    try:
+        store.unset(trace_stamp_key(idx))
+    except (KeyError, OSError):
+        pass
+
+
+def consume_trace_stamp(store, idx: int,
+                        epoch: int | None = None
+                        ) -> tuple[int, float] | None:
+    """Read AND retire slot idx's trace stamp (companion key +
+    LBL_TRACED on the slot's key) — the one consume sequence both
+    daemons share, run while the slot still belongs to the gathered
+    request (by drain end it may hold a NEW request's fresh stamp).
+    Returns (trace_id, client_wall_ts) when the stamp matches `epoch`
+    (or no epoch given), else None.  Never raises: tracing must never
+    fail a request — a contended slot (Eagain) keeps its stamp one
+    more drain."""
+    stamp = read_trace_stamp(store, idx, epoch=epoch)
+    try:
+        clear_trace_stamp(store, idx)
+        key = store.key_at(idx)
+        if key is not None:
+            store.label_clear(key, LBL_TRACED)
+    except (KeyError, OSError):
+        pass
+    return stamp
 
 
 def publish_heartbeat(store, key: str, payload: dict) -> None:
@@ -94,4 +214,94 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
                 return
             rec.pop(max(sections, key=lambda k: len(json.dumps(rec[k]))))
             rec["truncated"] = True
+
+
+# labels that mean "a daemon will still service (and consume the
+# stamp of) this row" — a TRACED row carrying none of them is an
+# orphan whose stamp landed after its request was serviced
+_REQ_LABELS = LBL_EMBED_REQ | LBL_INFER_REQ | LBL_SERVICING
+
+
+def shed_orphan_stamp(store, idx: int, labels: int) -> bool:
+    """Retire a trace stamp whose request is no longer pending, so a
+    stamp that landed AFTER its request was serviced — with no
+    follow-up request ever arriving — cannot leak its __tr_<idx> slot
+    and LBL_TRACED forever.  Daemons call this from their discard
+    path for rows that carry TRACED or DEBUG labels; handles both the
+    stamped row itself and a freshly-written stamp slot (__tr_<n>)
+    surfacing through the dirty mask.  Returns True if something was
+    shed."""
+    if labels & LBL_TRACED and not labels & _REQ_LABELS:
+        consume_trace_stamp(store, idx)
+        return True
+    if labels & LBL_DEBUG:
+        try:
+            key = store.key_at(idx)
+        except (KeyError, OSError):
+            return False
+        if key and key.startswith(TRACE_STAMP_PREFIX):
+            try:
+                tgt = int(key[len(TRACE_STAMP_PREFIX):])
+                tl = store.labels_at(tgt)
+            except (ValueError, KeyError, OSError):
+                return False
+            if tl & LBL_TRACED and not tl & _REQ_LABELS:
+                consume_trace_stamp(store, tgt)
+                return True
+    return False
+
+
+def attach_trace_sections(payload: dict, tracer, recorder,
+                          prefix: str) -> None:
+    """Assemble the tracing heartbeat sections in place — ONE
+    definition both daemons share, so the section contract (legacy-
+    shaped spans, stage quantiles under `prefix`, recorder
+    accounting, slow log) cannot diverge between them."""
+    # one snapshot feeds both sections: spans keeps the LEGACY
+    # aggregate shape only, quantiles carries the full histogram
+    # summaries under the pinned stage names — both full would double
+    # the payload for zero extra information (publish_heartbeat
+    # degrades by size when max_val bites)
+    snap = tracer.snapshot()
+    payload["spans"] = {
+        k: {f: v[f] for f in ("n", "total_ms", "max_ms") if f in v}
+        for k, v in snap.items()}
+    payload["quantiles"] = {k[len(prefix):]: v
+                            for k, v in snap.items()
+                            if k.startswith(prefix)}
+    payload["recorder"] = recorder.counters()
+    slow = recorder.slow_log()
+    if slow:
+        payload["slow_log"] = slow
+
+
+def maybe_publish_trace_ring(store, key: str, recorder,
+                             last_published: int) -> int:
+    """Publish the flight-recorder ring iff new records arrived since
+    `last_published` (an identical ring per heartbeat would be pure
+    serialization waste).  Returns the new published count."""
+    if recorder.recorded != last_published:
+        publish_trace_ring(store, key, recorder)
+    return recorder.recorded
+
+
+def publish_trace_ring(store, key: str, recorder, n: int = 32) -> None:
+    """Publish a flight recorder's tail into a debug-labeled key.
+    Unlike publish_heartbeat's section-by-section degradation — which
+    would drop this payload's ONLY section and leave `spt trace tail`
+    empty exactly when there is data — an oversized ring halves its
+    tail count until it fits: fewer reconstructable requests beat
+    none."""
+    while n >= 1:
+        rec = {"ts": time.time(), "trace": recorder.tail(n)}
+        try:
+            store.set(key, json.dumps(rec))
+            store.label_or(key, LBL_DEBUG)
+            return
+        except KeyError:
+            return
+        except OSError:
+            n //= 2
+
+
 CTX_EXCEEDED_DIAGNOSTIC = b"[context exceeded: input too long for model]"

@@ -337,6 +337,17 @@ class EmbeddingModel:
 
         self._fn = jax.jit(fwd)
 
+    def compile_count(self) -> int:
+        """Distinct XLA programs compiled for the encode fn (one per
+        (batch, bucket) shape).  Obs surface: this riding the
+        heartbeat makes a shape leak visible — a count still growing
+        after warmup means some drain geometry escapes the bucket
+        set and is paying jit compiles on the wake path."""
+        try:
+            return int(self._fn._cache_size())
+        except Exception:      # private jax API: absence is not an error
+            return -1
+
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
             if length <= b:

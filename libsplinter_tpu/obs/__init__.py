@@ -1,0 +1,23 @@
+"""Observability: histogram metrics, flight recorder, Prometheus
+exposition.
+
+The reference's only runtime telemetry is the raw `__debug` append
+channel (SURVEY.md §5); this package is the structured counterpart the
+TPU port adds on top of the heartbeat keys:
+
+  hist      log-bucketed latency histograms (fixed edges, mergeable,
+            ~1 us record path) — p50/p90/p99/max per span name
+  recorder  bounded ring of per-request wake->commit traces + a
+            persistent slow log (SPTPU_TRACE_SLOW_MS or 5x live p50)
+  prom      Prometheus text exposition for all of the above plus
+            daemon counters, StagedLane chunk accounting, and store
+            header diagnostics (`spt metrics`)
+
+Everything here is host-side Python with no jax dependency, safe to
+import from daemons, the CLI, and tests alike.
+"""
+from .hist import LogHistogram
+from .prom import PromWriter
+from .recorder import FlightRecorder
+
+__all__ = ["LogHistogram", "FlightRecorder", "PromWriter"]

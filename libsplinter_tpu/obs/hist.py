@@ -1,0 +1,154 @@
+"""Log-bucketed latency histograms (HDR-style, fixed boundaries).
+
+The old Tracer kept [count, total, max] per span — enough for a mean,
+useless for the SLO question ("what does the p99 request pay?").  A
+LogHistogram answers percentile queries at a record cost comparable to
+the old three-float update:
+
+  - FIXED bucket boundaries, geometric with _PER_OCTAVE buckets per
+    power of two from _MIN_MS (1 us) to ~67 s.  Every histogram in
+    every process shares the same edges, so histograms MERGE by adding
+    bucket counts — cross-daemon and cross-drain aggregation is exact;
+  - the record path is arithmetic only (one log2 + one list increment,
+    ~1 us, no allocation) — safe inside the wake handler;
+  - quantiles interpolate inside the owning bucket (geometric
+    midpoint), so resolution is the bucket width: ~19% relative error
+    worst-case at 4 buckets/octave, plenty to tell a 2 ms p50 from a
+    67 ms one and to rank stages against each other.
+
+Single-writer by design (the Tracer serializes recording under its own
+lock; per-daemon recorders are single-threaded) — the read side
+(snapshot/quantile) tolerates a racing record at worst one sample off.
+"""
+from __future__ import annotations
+
+from math import log2, sqrt
+
+# 1 us floor; 4 buckets per octave; 26 octaves reach ~67 s.  Changing
+# any of these breaks cross-process mergeability — bump _HIST_VERSION
+# alongside so stale heartbeat consumers can tell.
+_MIN_MS = 1e-3
+_PER_OCTAVE = 4
+_OCTAVES = 26
+_NBUCKETS = _OCTAVES * _PER_OCTAVE + 2      # +underflow +overflow
+_HIST_VERSION = 1
+
+_INV_MIN = 1.0 / _MIN_MS
+
+
+def bucket_index(ms: float) -> int:
+    """Bucket owning a millisecond value (0 = underflow)."""
+    if ms < _MIN_MS:
+        return 0
+    i = int(log2(ms * _INV_MIN) * _PER_OCTAVE) + 1
+    return i if i < _NBUCKETS else _NBUCKETS - 1
+
+
+def bucket_upper_ms(i: int) -> float:
+    """Inclusive upper edge of bucket i (ms); +inf for the overflow."""
+    if i >= _NBUCKETS - 1:
+        return float("inf")
+    return _MIN_MS * 2.0 ** (i / _PER_OCTAVE)
+
+
+def _bucket_mid_ms(i: int) -> float:
+    """Representative value inside bucket i: geometric midpoint."""
+    if i == 0:
+        return _MIN_MS / 2.0
+    lo = _MIN_MS * 2.0 ** ((i - 1) / _PER_OCTAVE)
+    hi = _MIN_MS * 2.0 ** (i / _PER_OCTAVE)
+    return sqrt(lo * hi)
+
+
+class LogHistogram:
+    """One span name's latency distribution."""
+
+    __slots__ = ("counts", "n", "total_ms", "max_ms", "min_ms")
+
+    def __init__(self):
+        self.counts = [0] * _NBUCKETS
+        self.n = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self.min_ms = float("inf")
+
+    # -- write side --------------------------------------------------------
+
+    def record(self, ms: float) -> None:
+        """The hot path: arithmetic + increments, no allocation."""
+        self.counts[bucket_index(ms)] += 1
+        self.n += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+        if ms < self.min_ms:
+            self.min_ms = ms
+
+    def merge(self, other: "LogHistogram") -> None:
+        """Add another histogram's samples (same fixed edges)."""
+        c, oc = self.counts, other.counts
+        for i in range(_NBUCKETS):
+            c[i] += oc[i]
+        self.n += other.n
+        self.total_ms += other.total_ms
+        if other.max_ms > self.max_ms:
+            self.max_ms = other.max_ms
+        if other.min_ms < self.min_ms:
+            self.min_ms = other.min_ms
+
+    # -- read side ---------------------------------------------------------
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile q in ms (0 when empty).  Clamped to the
+        observed [min, max] so tiny samples never report a bucket edge
+        outside what was actually seen."""
+        if self.n == 0:
+            return 0.0
+        rank = q * (self.n - 1)
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            seen += c
+            if seen > rank:
+                v = _bucket_mid_ms(i)
+                return min(max(v, self.min_ms), self.max_ms)
+        return self.max_ms
+
+    def snapshot(self) -> dict:
+        """Heartbeat-ready summary: counts + the SLO quantiles."""
+        if self.n == 0:
+            return {"n": 0, "total_ms": 0.0, "max_ms": 0.0}
+        return {
+            "n": self.n,
+            "total_ms": round(self.total_ms, 3),
+            "max_ms": round(self.max_ms, 3),
+            "p50_ms": round(self.quantile(0.50), 4),
+            "p90_ms": round(self.quantile(0.90), 4),
+            "p95_ms": round(self.quantile(0.95), 4),
+            "p99_ms": round(self.quantile(0.99), 4),
+        }
+
+    def state(self) -> dict:
+        """Mergeable wire form (sparse counts keyed by bucket index)."""
+        return {"v": _HIST_VERSION,
+                "counts": {str(i): c for i, c in enumerate(self.counts)
+                           if c},
+                "n": self.n, "total_ms": round(self.total_ms, 3),
+                "max_ms": round(self.max_ms, 4),
+                "min_ms": (round(self.min_ms, 6)
+                           if self.n else None)}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "LogHistogram":
+        h = cls()
+        if state.get("v") != _HIST_VERSION:
+            return h                   # incompatible edges: empty
+        for i, c in state.get("counts", {}).items():
+            h.counts[int(i)] = int(c)
+        h.n = int(state.get("n", 0))
+        h.total_ms = float(state.get("total_ms", 0.0))
+        h.max_ms = float(state.get("max_ms", 0.0))
+        mn = state.get("min_ms")
+        h.min_ms = float(mn) if mn is not None else float("inf")
+        return h

@@ -1,0 +1,143 @@
+"""Prometheus text exposition (version 0.0.4) for the obs surface.
+
+One writer serializes everything the stack measures — Tracer span
+histograms, daemon heartbeat counters, flight-recorder accounting,
+StagedLane chunk accounting, store header diagnostics — so `spt
+metrics` and Tracer.render_prom() emit one consistent dialect:
+
+  - histograms render as native prometheus histograms (cumulative
+    `le` buckets) straight from LogHistogram's fixed edges — a scrape
+    can compute any quantile server-side;
+  - heartbeat quantile SNAPSHOTS (the compact form that rides
+    publish_heartbeat) render as summaries (`quantile=` labels):
+    the bucket counts were already reduced on the daemon side, so a
+    summary is the honest representation;
+  - scalar counters/gauges render with a metric-per-key prefix
+    convention (`sptpu_<subsystem>_<field>`).
+
+Latency metrics keep their native milliseconds and say so in the
+metric name (`*_ms`); nothing silently rescales to seconds.
+"""
+from __future__ import annotations
+
+import re
+
+from .hist import LogHistogram, bucket_upper_ms
+
+_NAME_RX = re.compile(r"[^a-zA-Z0-9_:]")
+_QUANTS = (("p50_ms", "0.5"), ("p90_ms", "0.9"), ("p95_ms", "0.95"),
+           ("p99_ms", "0.99"))
+
+
+def _name(s: str) -> str:
+    n = _NAME_RX.sub("_", str(s))
+    return n if not n[:1].isdigit() else "_" + n
+
+
+def _labels(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{_name(k)}="{_escape(v)}"'
+                    for k, v in labels.items())
+    return "{" + body + "}"
+
+
+def _escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _num(v) -> str:
+    if v is None:
+        return "0"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v != v:          # NaN
+        return "NaN"
+    return repr(float(v)) if isinstance(v, float) else str(int(v))
+
+
+class PromWriter:
+    """Accumulates exposition lines grouped BY METRIC FAMILY: the
+    0.0.4 text format requires every line of one family contiguous
+    under a single TYPE header, even when callers interleave families
+    (e.g. per-daemon loops each emitting the shared stage summary).
+    TYPE/HELP are emitted once per name, on first sight; family order
+    is first-seen."""
+
+    def __init__(self):
+        self._fams: dict[str, list[str]] = {}
+
+    def _fam(self, name: str, mtype: str,
+             help_: str | None) -> list[str]:
+        fam = self._fams.get(name)
+        if fam is None:
+            fam = self._fams[name] = []
+            if help_:
+                fam.append(f"# HELP {name} {_escape(help_)}")
+            fam.append(f"# TYPE {name} {mtype}")
+        return fam
+
+    def metric(self, name: str, value, labels: dict | None = None, *,
+               mtype: str = "gauge", help_: str | None = None) -> None:
+        name = _name(name)
+        if not isinstance(value, (int, float)):
+            return                   # non-numeric payloads don't expose
+        self._fam(name, mtype, help_).append(
+            f"{name}{_labels(labels)} {_num(value)}")
+
+    def scalars(self, prefix: str, mapping: dict,
+                labels: dict | None = None, *,
+                mtype: str = "gauge") -> None:
+        """One metric per numeric key of `mapping`."""
+        for k, v in mapping.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self.metric(f"{prefix}_{k}", v, labels, mtype=mtype)
+
+    def histogram(self, name: str, hist: LogHistogram,
+                  labels: dict | None = None, *,
+                  help_: str | None = None) -> None:
+        """Native histogram from the fixed log-bucket edges (only
+        occupied buckets emit a line; `le` edges are milliseconds)."""
+        name = _name(name)
+        fam = self._fam(name, "histogram", help_)
+        lab = dict(labels or {})
+        cum = 0
+        last = len(hist.counts) - 1         # the +Inf overflow bucket
+        for i, c in enumerate(hist.counts[:last]):
+            if not c:
+                continue
+            cum += c
+            lab["le"] = f"{bucket_upper_ms(i):.6g}"
+            fam.append(f"{name}_bucket{_labels(lab)} {cum}")
+        lab["le"] = "+Inf"                  # required terminal bucket
+        fam.append(f"{name}_bucket{_labels(lab)} {hist.n}")
+        lab.pop("le")
+        fam.append(
+            f"{name}_sum{_labels(lab)} {_num(float(hist.total_ms))}")
+        fam.append(f"{name}_count{_labels(lab)} {hist.n}")
+
+    def summary(self, name: str, snap: dict,
+                labels: dict | None = None, *,
+                help_: str | None = None) -> None:
+        """Summary from a LogHistogram.snapshot()-shaped dict (the
+        compact quantiles form heartbeats carry)."""
+        name = _name(name)
+        if not snap:
+            return
+        fam = self._fam(name, "summary", help_)
+        lab = dict(labels or {})
+        for key, q in _QUANTS:
+            if key in snap:
+                lab["quantile"] = q
+                fam.append(
+                    f"{name}{_labels(lab)} {_num(float(snap[key]))}")
+        lab.pop("quantile", None)
+        fam.append(f"{name}_sum{_labels(lab)} "
+                   f"{_num(float(snap.get('total_ms', 0.0)))}")
+        fam.append(f"{name}_count{_labels(lab)} {int(snap.get('n', 0))}")
+
+    def render(self) -> str:
+        lines = [ln for fam in self._fams.values() for ln in fam]
+        return "\n".join(lines) + ("\n" if lines else "")

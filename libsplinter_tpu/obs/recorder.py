@@ -1,0 +1,131 @@
+"""Flight recorder: a bounded ring of per-request wake->commit traces.
+
+Histograms (obs/hist.py) say WHAT the p99 is; the recorder says WHICH
+requests paid it and WHERE.  Each record is one traced request's
+journey through a daemon — an ordered event sequence under the
+engine/protocol stage-name contract (PIPELINE_STAGES for the
+embedder) plus the end-to-end wall time measured from the client's
+trace stamp (protocol.stamp_trace) when one exists.
+
+Two retention tiers:
+
+  - the RING: the last `capacity` traced requests, overwritten in
+    arrival order (post-hoc "show me what just happened");
+  - the SLOW LOG: requests whose wall time exceeded the slow
+    threshold are copied to a separate bounded deque that survives
+    ring wrap — one pathological request per thousand fast ones stays
+    visible.  The threshold is SPTPU_TRACE_SLOW_MS when set, else
+    5x the recorder's own live e2e p50 (self-calibrating: "slow"
+    means slow relative to what this daemon is currently serving),
+    armed only once enough samples exist for a stable p50.
+
+Ring slots are pre-allocated dicts reused in place, so steady-state
+recording allocates only the per-record events list the caller built.
+Single-writer (the owning daemon thread); readers (heartbeat publish,
+`spt trace tail` via the published ring key) see at worst a record
+mid-overwrite, which JSON serialization tolerates.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+from .hist import LogHistogram
+
+# samples before the 5x-p50 auto threshold arms (a cold daemon's first
+# requests include compiles and must not all land in the slow log)
+_AUTO_ARM_N = 20
+_SLOW_FACTOR = 5.0
+
+
+class FlightRecorder:
+    """Bounded per-request trace ring + persistent slow log."""
+
+    def __init__(self, capacity: int = 256, slow_capacity: int = 32,
+                 slow_ms: float | None = None):
+        cap = max(1, capacity)
+        self._ring: list[dict | None] = [None] * cap
+        self._head = 0                  # next slot to write
+        self.recorded = 0               # lifetime count
+        self.dropped = 0                # ring overwrites
+        self._slow: deque = deque(maxlen=max(1, slow_capacity))
+        self.slow_promoted = 0
+        if slow_ms is not None:
+            self.slow_ms = slow_ms
+        else:
+            env = os.environ.get("SPTPU_TRACE_SLOW_MS")
+            try:
+                self.slow_ms = float(env) if env else None
+            except ValueError:
+                # telemetry must never wedge serving: a typo'd env
+                # falls back to the auto threshold
+                self.slow_ms = None
+        self.e2e = LogHistogram()       # wall_ms distribution
+
+    def __len__(self) -> int:
+        return min(self.recorded, len(self._ring))
+
+    # -- write side --------------------------------------------------------
+
+    def slow_threshold_ms(self) -> float | None:
+        """The live promotion threshold (None = not armed yet)."""
+        if self.slow_ms is not None:
+            return self.slow_ms
+        if self.e2e.n < _AUTO_ARM_N:
+            return None
+        return _SLOW_FACTOR * self.e2e.quantile(0.5)
+
+    def record(self, trace_id: int, key: str | None, wall_ms: float,
+               events: list) -> dict:
+        """Append one traced request.  `events` is the ordered
+        [[stage, ms], ...] journey (stage names pinned by the calling
+        daemon's protocol contract); ownership transfers to the
+        recorder."""
+        thr = self.slow_threshold_ms()   # BEFORE this sample moves p50
+        self.e2e.record(wall_ms)
+        slot = self._ring[self._head]
+        if slot is None:
+            slot = {}
+            self._ring[self._head] = slot
+        elif slot.get("id") is not None:
+            self.dropped += 1
+        slot["id"] = trace_id
+        slot["key"] = key
+        slot["wall_ms"] = round(wall_ms, 3)
+        slot["ts"] = round(time.time(), 3)
+        slot["events"] = events
+        self._head = (self._head + 1) % len(self._ring)
+        self.recorded += 1
+        if thr is not None and wall_ms > thr:
+            self.slow_promoted += 1
+            rec = dict(slot)
+            rec["slow_threshold_ms"] = round(thr, 6)
+            self._slow.append(rec)
+        return slot
+
+    # -- read side ---------------------------------------------------------
+
+    def tail(self, n: int | None = None) -> list[dict]:
+        """Last n records, oldest first (copies — safe to serialize)."""
+        live = len(self)
+        n = live if n is None else min(max(n, 0), live)
+        cap = len(self._ring)
+        out = []
+        for k in range(live - n, live):
+            i = (self._head - live + k) % cap
+            rec = self._ring[i]
+            if rec is not None and rec.get("id") is not None:
+                out.append(dict(rec))
+        return out
+
+    def slow_log(self) -> list[dict]:
+        """Promoted slow requests, oldest first (bounded, wrap-proof)."""
+        return [dict(r) for r in self._slow]
+
+    def counters(self) -> dict:
+        """Exposition-ready scalar accounting."""
+        thr = self.slow_threshold_ms()
+        return {"recorded": self.recorded, "dropped": self.dropped,
+                "slow_promoted": self.slow_promoted,
+                "slow_threshold_ms": round(thr, 6) if thr else 0.0}

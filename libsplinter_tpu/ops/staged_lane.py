@@ -288,6 +288,19 @@ class StagedLane:
             self.scatter_chunks += 1
             self.chunk_hist[b] = self.chunk_hist.get(b, 0) + 1
 
+    def counters(self) -> dict:
+        """Transfer/chunk accounting as flat numerics — the shape
+        `spt metrics` and Tracer.render_prom() expose (chunk_hist
+        flattens to one field per bucket size)."""
+        out = {"full_uploads": self.full_uploads,
+               "refreshes": self.refreshes,
+               "rows_staged": self.rows_staged,
+               "rows_padded": self.rows_padded,
+               "scatter_chunks": self.scatter_chunks}
+        for b, n in sorted(self.chunk_hist.items()):
+            out[f"chunks_bucket_{b}"] = n
+        return out
+
     @property
     def array(self):
         """The device lane WITHOUT refreshing (last staged state)."""

@@ -48,8 +48,22 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 
+_UNSET = object()
+
+
 class LuaError(Exception):
-    """Raised for lex/parse/runtime errors, carrying a lua-style message."""
+    """Raised for lex/parse/runtime errors, carrying a lua-style message.
+
+    `.value` is the original Lua error VALUE: `error(tbl)` must
+    propagate tbl verbatim through pcall and coroutine boundaries
+    (Lua 5.4 §2.3 — error objects are values, not strings), so the
+    value rides the exception while the exception text stays the
+    tostring coercion.  Interpreter-raised errors (syntax, arithmetic
+    on nil, ...) have string values, matching liblua."""
+
+    def __init__(self, message, value=_UNSET):
+        super().__init__(message)
+        self.value = message if value is _UNSET else value
 
 
 # ===================================================================== lexer
@@ -804,7 +818,7 @@ class LuaCoroutine:
             self.rt._co_live -= 1      # reclaimed; nobody is waiting
             return
         except LuaError as exc:
-            self._outcome = ("error", str(exc))
+            self._outcome = ("error", exc.value)   # value, not coerced
         except RecursionError:
             self._outcome = ("error", "stack overflow")
         except BaseException as exc:   # host bug: surface, don't hang
@@ -1544,19 +1558,22 @@ class LuaRuntime:
             try:
                 return (True,) + self.call(fn, args)
             except LuaError as exc:
-                return (False, str(exc))
+                return (False, exc.value)   # the error VALUE, verbatim
             except RecursionError:
                 # a host-function chain can still overflow outside
                 # call()'s chokepoint; lua 5.4 pcall returns this too
                 return (False, "stack overflow")
 
         def _error(msg, _level=None):
-            raise LuaError(lua_tostring(msg))
+            # the message coerces for uncaught display; the VALUE
+            # (table, number, ...) rides .value for pcall to return
+            raise LuaError(lua_tostring(msg), value=msg)
 
         def _assert(v, msg=None, *rest):
             if not _truthy(v):
-                raise LuaError(lua_tostring(msg) if msg is not None
-                               else "assertion failed!")
+                if msg is None:
+                    raise LuaError("assertion failed!")
+                raise LuaError(lua_tostring(msg), value=msg)
             return (v, msg) + rest
 
         def _unpack(t, i=1, j=None):
@@ -1795,7 +1812,10 @@ class LuaRuntime:
             def _wrapped(*args):
                 out = co.resume(args)
                 if not out[0]:
-                    raise LuaError(lua_tostring(out[1]))
+                    # re-raise with the ORIGINAL error value: an outer
+                    # pcall around a wrapped coroutine must return the
+                    # body's error(tbl) table verbatim, not a string
+                    raise LuaError(lua_tostring(out[1]), value=out[1])
                 return out[1:]
             return _wrapped
 

@@ -9,13 +9,18 @@ daemons get nestable wall-clock spans with near-zero disabled cost:
     with tracer.span("drain"):
         ...
 
-Aggregates (count / total_ms / max_ms per span name) ride the stats
-heartbeat (engine/protocol.publish_heartbeat) so `spt head
-__embedder_stats` — or the sidecar's debug watch — shows where wall
-time goes without attaching anything.
+Each span name aggregates into a log-bucketed histogram
+(obs/hist.LogHistogram, fixed mergeable edges, ~1 us record path), so
+the stats heartbeat (engine/protocol.publish_heartbeat) carries true
+p50/p90/p99/max per stage — not means dressed up as percentiles.
+`spt head __embedder_stats` — or the sidecar's debug watch — shows
+where wall time goes without attaching anything, and
+Tracer.render_prom() serializes the same histograms in Prometheus
+text exposition for `spt metrics`.
 
 Enabled with SPTPU_TRACE=1 (default off: span() returns a shared
-no-op).  SPTPU_JAX_PROFILE=<dir> additionally wraps whole drains in
+no-op, and the disabled hot path pays one dict lookup and nothing
+else).  SPTPU_JAX_PROFILE=<dir> additionally wraps whole drains in
 jax.profiler traces for device-level timelines (TensorBoard-loadable);
 that one is for deliberate profiling sessions, not production.
 """
@@ -25,6 +30,8 @@ import contextlib
 import os
 import threading
 import time
+
+from ..obs.hist import LogHistogram
 
 
 class Tracer:
@@ -36,36 +43,86 @@ class Tracer:
         self.enabled = (os.environ.get("SPTPU_TRACE") == "1"
                         if enabled is None else enabled)
         self._lock = threading.Lock()
-        self._agg: dict[str, list[float]] = {}   # name -> [n, total, max]
+        self._agg: dict[str, LogHistogram] = {}
 
-    @contextlib.contextmanager
-    def _timed(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = (time.perf_counter() - t0) * 1e3
-            with self._lock:
-                a = self._agg.setdefault(name, [0, 0.0, 0.0])
-                a[0] += 1
-                a[1] += dt
-                a[2] = max(a[2], dt)
+    def record(self, name: str, dt_ms: float) -> None:
+        """Record one measured duration under a span name (for call
+        sites that already hold the timing — e.g. the commit pipeline's
+        device-wait accounting — this skips the span object)."""
+        with self._lock:
+            h = self._agg.get(name)
+            if h is None:
+                h = self._agg[name] = LogHistogram()
+            h.record(dt_ms)
 
     _NOOP = contextlib.nullcontext()
 
     def span(self, name: str):
-        return self._timed(name) if self.enabled else self._NOOP
+        return _Span(self, name) if self.enabled else self._NOOP
 
     def snapshot(self) -> dict:
-        """{name: {n, total_ms, max_ms}} — merged into heartbeats."""
+        """{name: {n, total_ms, max_ms, p50_ms, p90_ms, p95_ms,
+        p99_ms}} — merged into heartbeats.  The n/total_ms/max_ms keys
+        predate the histograms and stay for consumers of the old
+        aggregate shape."""
         with self._lock:
-            return {k: {"n": int(v[0]), "total_ms": round(v[1], 2),
-                        "max_ms": round(v[2], 2)}
-                    for k, v in self._agg.items()}
+            return {k: h.snapshot() for k, h in self._agg.items()}
+
+    def quantiles(self, prefix: str | None = None) -> dict:
+        """Per-span quantile summaries, optionally filtered to names
+        under `prefix` ("embed." -> {"drain": {...}, ...} with the
+        prefix stripped) — the heartbeat `quantiles` section."""
+        with self._lock:
+            items = list(self._agg.items())
+        out = {}
+        for name, h in items:
+            if prefix is not None:
+                if not name.startswith(prefix):
+                    continue
+                name = name[len(prefix):]
+            out[name] = h.snapshot()
+        return out
+
+    def render_prom(self, counters: dict | None = None, *,
+                    prefix: str = "sptpu") -> str:
+        """Prometheus text exposition of every span histogram, plus
+        optional scalar counter groups: {group: {field: number}}
+        renders as <prefix>_<group>_<field>."""
+        from ..obs.prom import PromWriter
+
+        w = PromWriter()
+        with self._lock:
+            items = list(self._agg.items())
+        for name, h in items:
+            w.histogram(f"{prefix}_span_ms", h, {"span": name},
+                        help_="tracer span wall time (ms)")
+        for group, mapping in (counters or {}).items():
+            w.scalars(f"{prefix}_{group}", mapping)
+        return w.render()
 
     def reset(self) -> None:
         with self._lock:
             self._agg.clear()
+
+
+class _Span:
+    """Enabled-path span context: one slotted object per span (half
+    the cost of a generator-based contextmanager on the wake path)."""
+
+    __slots__ = ("_tracer", "_name", "_t0")
+
+    def __init__(self, tracer_: Tracer, name: str):
+        self._tracer = tracer_
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.record(
+            self._name, (time.perf_counter() - self._t0) * 1e3)
+        return False
 
 
 tracer = Tracer()                     # process-wide default

@@ -57,6 +57,99 @@ class Section:
         self.types: list[tuple[str, str, str]] = []   # (name, body, doc)
 
 
+# Hand-maintained appendices merged into generated pages (slug -> md).
+# The header documents the C ABI; these document Python-layer surfaces
+# that extend a section — kept HERE so the docs stay regenerable and
+# tests/test_api_docs.py's sync check covers them too.
+_APPENDICES = {
+    "diagnostics": """
+## Observability surface (`libsplinter_tpu/obs/`)
+
+The Python layer above the C ABI: log-bucketed latency histograms,
+per-request flight recording, and a Prometheus text exposition.  The
+reference's only runtime telemetry is the `__debug` append channel;
+this is the structured counterpart the TPU port adds.
+
+### Env vars
+
+| var | effect |
+|---|---|
+| `SPTPU_TRACE=1` | enable span histograms + flight recording in the daemons (off: the hot path pays one dict lookup) |
+| `SPTPU_TRACE_SLOW_MS=<ms>` | explicit slow-log promotion threshold; unset → 5× the recorder's live e2e p50 (arms after 20 samples) |
+| `SPTPU_JAX_PROFILE=<dir>` | additionally capture jax.profiler device timelines per drain |
+
+### Trace-id convention (`engine/protocol.py`)
+
+A client that wants one request's wake→commit journey reconstructed
+stamps it **next to the request label** — after `set` + `label_or`,
+ideally before the `bump` (a daemon racing the stamp then can't
+service the row stampless):
+
+```python
+tid = protocol.stamp_trace(store, key)   # returns the trace id
+```
+
+The stamp is `"<trace_id>:<wall_ts>:<slot_epoch>"` in the
+slot-indexed companion key `__tr_<idx>` (`trace_stamp_key`), plus
+`LBL_TRACED` (bit 58) on the request key itself — the daemons'
+candidate filters already read every row's label word, so untraced
+rows never pay a stamp lookup.  The embedded epoch makes stamps
+self-invalidating: a daemon finding a stamp whose epoch doesn't
+match the request it gathered consumes it as stale instead of
+attributing it (and its seconds-old wall clock) to the wrong
+request.  Ids are `(pid << 24) | counter`: unique across concurrent
+clients without coordination, originating pid recoverable as
+`id >> 24`.  The
+servicing daemon consumes the stamp (clears key + label), appends the
+request's stage events to its flight recorder under the pinned stage
+names (`PIPELINE_STAGES` for the embedder: drain / tokenize /
+dispatch / device_wait / commit; `INFER_STAGES` for the completer:
+render / generate / commit), and publishes its ring to
+`__embedder_trace` / `__completer_trace` alongside the heartbeat.
+
+```
+$ SPTPU_TRACE=1 ... ; spt trace tail 4
+[embedder] id=0x6804000001 pid=26628 key='k' wall=1493.817ms \\
+  drain=0.269ms tokenize=0.053ms dispatch=0.087ms \\
+  device_wait=0.052ms commit=0.363ms
+```
+
+### Heartbeat sections (`publish_heartbeat`)
+
+With tracing on, `__embedder_stats` / `__completer_stats` gain:
+
+- `spans` — per span name `{n, total_ms, max_ms}` (the legacy
+  aggregate shape, kept for old consumers);
+- `quantiles` — histogram-sourced `{n, total_ms, max_ms, p50_ms,
+  p90_ms, p95_ms, p99_ms}` keyed by the pinned stage names (prefix
+  stripped) — what `bench.py`'s stage table and `spt metrics`
+  consume;
+- `recorder` — `{recorded, dropped, slow_promoted,
+  slow_threshold_ms}`;
+- `slow_log` — promoted slow requests, each
+  `{id, key, wall_ms, ts, slow_threshold_ms,
+  events: [[stage, ms], ...]}` (bounded deque; survives ring wrap).
+
+Oversized heartbeats degrade section by section (largest first,
+`truncated: true`): the slow log goes before the quantiles, and the
+scalar counters always land.
+
+### Prometheus exposition
+
+`spt metrics` renders exposition-format text: store header gauges
+(`sptpu_store_used_slots`, `sptpu_store_parse_failures`, ...),
+heartbeat scalars (`sptpu_embedder_*` / `sptpu_completer_*`),
+heartbeat ages, per-stage quantile summaries
+(`sptpu_stage_ms{daemon=...,stage=...,quantile=...}`), recorder
+counters, and StagedLane chunk accounting when a lane is staged.
+In-process, `Tracer.render_prom()` serializes the live histograms as
+native prometheus histograms (cumulative `le` buckets, edges in ms)
+plus any counter groups passed in.  `make obs-check` pins the enabled
+record path's overhead < 3% vs disabled.
+""",
+}
+
+
 def parse_header(path: str = HEADER):
     with open(path) as f:
         raw = f.read()
@@ -230,6 +323,10 @@ def render(outdir: str) -> list[str]:
             if doc:
                 page.append(doc)
                 page.append("")
+        extra = _APPENDICES.get(sec.slug)
+        if extra:
+            page.append(extra.strip())
+            page.append("")
         path = os.path.join(outdir, f"{sec.slug}.md")
         with open(path, "w") as f:
             f.write("\n".join(page))

@@ -1,0 +1,162 @@
+"""obs-check: the enabled record path must stay ~free.
+
+Runs the embedder micro-bench (stub encoder, event-driven drains — the
+shape of tests/test_embedder_pipeline.py's waves) twice in one
+process: SPTPU_TRACE disabled, then enabled (histogram spans + stage
+accumulation + flight-recorder stamps on every request), and asserts
+the enabled path costs < 3% extra wall time.
+
+Methodology: interleaved arms (off, on, off, on, ...) compared at
+their MINIMUM over many reps, best of up to 3 rounds.  The record
+path's cost is deterministic; host noise (noisy neighbors on shared
+infra, thermal, allocator state) is additive and can only INFLATE a
+min-based overhead reading — it cannot make the enabled arm look
+cheaper than it is — so "any round under budget" is a sound
+upper-bound assertion while being robust to the multi-ms noise bursts
+this box exhibits.  GC is disabled during timing so a collection
+pause can't land in one arm.  A NULL CONTROL (the disabled samples
+split even/odd — identical code, so their spread is pure noise)
+guards the verdict: when the apparent overhead exceeds the budget but
+the null spread rivals it, the box cannot resolve the budget and the
+check reports inconclusive instead of failing CI on noise.  `make
+obs-check` runs this plus `pytest -m obs`.
+
+Exit 0 and a JSON line on success; exit 1 with the measured overhead
+when the budget is blown.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from libsplinter_tpu import Store, T_VARTEXT  # noqa: E402
+from libsplinter_tpu.engine import protocol as P  # noqa: E402
+from libsplinter_tpu.engine.embedder import Embedder  # noqa: E402
+from libsplinter_tpu.utils.trace import tracer  # noqa: E402
+
+KEYS = int(os.environ.get("OBS_CHECK_KEYS", "128"))
+REPS = int(os.environ.get("OBS_CHECK_REPS", "120"))
+ROUNDS = int(os.environ.get("OBS_CHECK_ROUNDS", "3"))
+BUDGET = float(os.environ.get("OBS_CHECK_BUDGET_PCT", "3.0"))
+
+
+def encoder(texts):
+    return np.zeros((len(texts), 8), np.float32)
+
+
+def drain_once(st, emb, stamp: bool) -> float:
+    for i in range(KEYS):
+        key = f"k/{i}"
+        st.set(key, f"obs check text number {i}")
+        st.set_type(key, T_VARTEXT)
+        st.label_or(key, P.LBL_EMBED_REQ)
+        st.bump(key)
+    if stamp:
+        P.stamp_trace(st, "k/0")     # one traced request per wave
+    t0 = time.perf_counter()
+    n = emb.drain()
+    dt = (time.perf_counter() - t0) * 1e3
+    assert n == KEYS, (n, KEYS)
+    return dt
+
+
+def main() -> int:
+    name = f"/spt-obscheck-{os.getpid()}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=max(256, KEYS * 4), max_val=1024,
+                      vec_dim=8)
+    try:
+        # daemon-default batch_cap: the per-batch record cost is
+        # amortized exactly as production amortizes it
+        emb = Embedder(st, encoder_fn=encoder, max_ctx=512)
+        emb.attach()
+        # alternate the arms drain by drain so host drift (thermal,
+        # noisy neighbors, allocator state) hits both equally, then
+        # compare best-of: min is the robust estimator of what each
+        # code path itself costs
+        import gc
+
+        for arm in (False, True):    # warm both paths untimed
+            tracer.enabled = arm
+            drain_once(st, emb, arm)
+
+        def round_() -> tuple[float, float, float]:
+            """(min_off, min_on, null_pct): the null control splits
+            the DISABLED samples into even/odd halves — two identical
+            code paths — so their min-vs-min ratio measures the pure
+            noise floor of this box right now."""
+            offs, ons = [], []
+            gc.collect()
+            gc.disable()   # a GC pause landing in one arm would
+            try:           # swamp the ~tens-of-us effect measured
+                for _ in range(REPS):
+                    tracer.enabled = False
+                    offs.append(drain_once(st, emb, False))
+                    tracer.enabled = True
+                    ons.append(drain_once(st, emb, True))
+            finally:
+                gc.enable()
+            tracer.reset()
+            null = (abs(min(offs[0::2]) / min(offs[1::2]) - 1.0) * 100.0
+                    if len(offs) >= 2 else 0.0)
+            return min(offs), min(ons), null
+
+        off, on, null_pct = round_()
+        rounds_run = 1
+        while on / off - 1.0 >= BUDGET / 100.0 \
+                and rounds_run < ROUNDS:
+            o, n, nl = round_()
+            if n / o < on / off:
+                off, on = o, n
+            null_pct = max(null_pct, nl)   # worst observed noise
+            rounds_run += 1
+    finally:
+        tracer.enabled = os.environ.get("SPTPU_TRACE") == "1"
+        st.close()
+        Store.unlink(name)
+    overhead_pct = (on / off - 1.0) * 100.0
+    # the verdict discounts the worst same-code noise spread seen:
+    # the budget applies to (overhead - noise floor), so a quiet box
+    # asserts the strict 3% while a noisy one cannot go red on bursts
+    # it demonstrably produces with NO code difference.  A real
+    # regression clears the floor by construction (its cost is
+    # deterministic; noise is not).
+    inconclusive = (overhead_pct >= BUDGET
+                    and overhead_pct - null_pct < BUDGET)
+    rec = {"metric": "obs_record_overhead_pct",
+           "value": round(overhead_pct, 2),
+           "budget_pct": BUDGET,
+           "noise_floor_pct": round(null_pct, 2),
+           "disabled_ms": round(off, 3), "enabled_ms": round(on, 3),
+           "keys_per_drain": KEYS, "reps": REPS,
+           "rounds_run": rounds_run,
+           "ok": overhead_pct < BUDGET or inconclusive}
+    if inconclusive:
+        rec["inconclusive"] = True
+    print(json.dumps(rec), flush=True)
+    if inconclusive:
+        print(f"obs-check INCONCLUSIVE: apparent overhead "
+              f"{overhead_pct:.2f}% but same-code noise floor "
+              f"{null_pct:.2f}% — box too noisy to resolve the "
+              f"{BUDGET}% budget; not failing on noise",
+              file=sys.stderr)
+        return 0
+    if not rec["ok"]:
+        print(f"obs-check FAILED: tracing overhead "
+              f"{overhead_pct:.2f}% >= {BUDGET}% budget "
+              f"(noise floor {null_pct:.2f}%)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,3 +54,6 @@ def store_novec():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: longer-running stress tiers")
+    config.addinivalue_line(
+        "markers", "obs: observability tier (histograms, flight "
+        "recorder, exposition) — `make obs-check` runs these")

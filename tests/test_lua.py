@@ -999,3 +999,58 @@ class TestGotoScopeRule:
                 coroutine.resume(co)
             """)
         assert rt._co_live == 0
+
+
+class TestErrorValues:
+    """error() objects are VALUES (Lua 5.4 §2.3): a table thrown by
+    error() must come back VERBATIM from pcall — including across a
+    coroutine.wrap boundary, where the re-raise used to coerce it to
+    a string (the last open ADVICE item)."""
+
+    def test_pcall_returns_table_error_value(self):
+        out, _ = run_lua("""
+            local ok, err = pcall(function()
+              error({code = 42, msg = "structured"})
+            end)
+            print(ok, type(err), err.code, err.msg)
+        """)
+        assert out == ["false\ttable\t42\tstructured"]
+
+    def test_pcall_returns_number_error_value(self):
+        out, _ = run_lua("print(pcall(function() error(777) end))")
+        assert out == ["false\t777"]
+
+    def test_coroutine_resume_propagates_error_value(self):
+        out, _ = run_lua("""
+            local co = coroutine.create(function()
+              error({tag = "t"})
+            end)
+            local ok, err = coroutine.resume(co)
+            print(ok, type(err), err.tag)
+        """)
+        assert out == ["false\ttable\tt"]
+
+    def test_wrap_rethrows_original_value_through_pcall(self):
+        out, _ = run_lua("""
+            local f = coroutine.wrap(function()
+              coroutine.yield(1)
+              error({why = "wrapped"})
+            end)
+            print(f())
+            local ok, err = pcall(f)
+            print(ok, type(err), err.why)
+        """)
+        assert out == ["1", "false\ttable\twrapped"]
+
+    def test_assert_message_value_verbatim(self):
+        out, _ = run_lua("""
+            local ok, err = pcall(function() assert(false, {m = 1}) end)
+            print(ok, type(err), err.m)
+        """)
+        assert out == ["false\ttable\t1"]
+
+    def test_uncaught_error_carries_value_to_host(self):
+        with pytest.raises(LuaError) as ei:
+            run_lua('error({boom = true})')
+        assert isinstance(ei.value.value, LuaTable)
+        assert ei.value.value.get("boom") is True

@@ -8,6 +8,7 @@ from __future__ import annotations
 import json
 
 import numpy as np
+import pytest
 
 from libsplinter_tpu import Store
 from libsplinter_tpu.engine import protocol as P
@@ -55,6 +56,93 @@ def test_heartbeat_degrades_on_overflow(tmp_path):
         assert snap["completions"] == 7
         assert snap.get("truncated") is True
         assert "spans" not in snap
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+class _SetSpy:
+    """Store facade recording every publish attempt's section set —
+    the degradation ORDER is observable, not just the survivors."""
+
+    def __init__(self, st):
+        self._st = st
+        self.attempts: list[list[str]] = []
+
+    def set(self, key, val):
+        self.attempts.append(sorted(json.loads(val).keys()))
+        self._st.set(key, val)
+
+    def label_or(self, key, mask):
+        self._st.label_or(key, mask)
+
+
+def _traced_payload():
+    """A realistic SPTPU_TRACE=1 embedder heartbeat: scalar counters +
+    a slow log (largest), a quantiles section (medium), and recorder
+    accounting (small)."""
+    slow = [{"id": (1 << 24) | i, "key": f"bench/{i}",
+             "wall_ms": 123.456, "ts": 1e9,
+             "slow_threshold_ms": 10.0,
+             "events": [[s, 1.234] for s in P.PIPELINE_STAGES]}
+            for i in range(12)]
+    quantiles = {s: {"n": 30, "total_ms": 99.9, "max_ms": 9.9,
+                     "p50_ms": 1.11, "p90_ms": 2.22, "p95_ms": 2.88,
+                     "p99_ms": 3.33} for s in P.PIPELINE_STAGES}
+    return {"wakes": 9, "embedded": 8, "pending": 0,
+            "overlap_ratio": 0.5,
+            "recorder": {"recorded": 12, "dropped": 0,
+                         "slow_promoted": 12},
+            "quantiles": quantiles, "slow_log": slow}
+
+
+@pytest.mark.obs
+def test_heartbeat_drop_order_slow_log_then_quantiles(tmp_path):
+    """Section-by-section degradation drops the LARGEST section first:
+    for the traced heartbeat that is the slow log, then quantiles —
+    and the scalar core counters always land last-resort."""
+    # max_val sized so BOTH optional sections must go (core counters
+    # + recorder accounting still fit)
+    name = f"/spt-stats-order-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=320, vec_dim=8)
+    try:
+        spy = _SetSpy(st)
+        P.publish_heartbeat(spy, "__hb", _traced_payload())
+        # attempt 0 carried everything; slow_log (largest) went first;
+        # quantiles only after it; core counters never dropped
+        assert "slow_log" in spy.attempts[0]
+        assert "quantiles" in spy.attempts[0]
+        dropped_slow = next(i for i, a in enumerate(spy.attempts)
+                            if "slow_log" not in a)
+        dropped_q = next(i for i, a in enumerate(spy.attempts)
+                         if "quantiles" not in a)
+        assert dropped_slow < dropped_q, spy.attempts
+        assert all("embedded" in a and "wakes" in a
+                   for a in spy.attempts)
+        snap = json.loads(st.get("__hb").rstrip(b"\0"))
+        assert snap.get("truncated") is True
+        assert "slow_log" not in snap
+        assert snap["embedded"] == 8
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.obs
+def test_heartbeat_quantiles_survive_slow_log_drop(tmp_path):
+    """With room for everything but the slow log, quantiles stay: the
+    bench's stage table degrades LAST among the optional sections."""
+    name = f"/spt-stats-q-{tmp_path.name}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=64, max_val=2048, vec_dim=8)
+    try:
+        P.publish_heartbeat(st, "__hb", _traced_payload())
+        snap = json.loads(st.get("__hb").rstrip(b"\0"))
+        assert snap.get("truncated") is True
+        assert "slow_log" not in snap
+        assert set(P.PIPELINE_STAGES) <= set(snap["quantiles"])
+        assert snap["embedded"] == 8
     finally:
         st.close()
         Store.unlink(name)

@@ -59,8 +59,8 @@ def test_embedder_heartbeat_carries_spans(tmp_path, monkeypatch):
     name = f"/spt-trace-{tmp_path.name}"
     Store.unlink(name)
     # max_val must hold the full heartbeat: counters (incl. the commit
-    # pipeline's) + the span table this test is about
-    st = Store.create(name, nslots=64, max_val=1536, vec_dim=8)
+    # pipeline's) + the span table + the quantiles section
+    st = Store.create(name, nslots=64, max_val=4096, vec_dim=8)
     try:
         emb = emod.Embedder(st, encoder_fn=lambda ts: np.zeros(
             (len(ts), 8), np.float32), max_ctx=64)
@@ -74,6 +74,12 @@ def test_embedder_heartbeat_carries_spans(tmp_path, monkeypatch):
         assert "spans" in snap
         assert snap["spans"]["embed.drain"]["n"] >= 1
         assert snap["spans"]["embed.commit"]["n"] >= 1
+        # histogram-sourced quantiles ride the same heartbeat under
+        # the PIPELINE_STAGES names (prefix stripped)
+        assert "quantiles" in snap
+        assert snap["quantiles"]["commit"]["n"] >= 1
+        for k in ("p50_ms", "p90_ms", "p99_ms", "max_ms"):
+            assert k in snap["quantiles"]["commit"], k
     finally:
         st.close()
         Store.unlink(name)

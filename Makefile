@@ -11,6 +11,9 @@
 #   make obs-check  observability tier: tracing-overhead budget
 #                   (scripts/obs_overhead_check.py, <3% vs disabled)
 #                   + the `-m obs` pytest group
+#   make search-check  fused top-k tier: interpret-mode kernel parity
+#                   vs the lax.top_k reference + the search daemon's
+#                   coalescing smoke (N clients « N dispatches)
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -34,6 +37,9 @@ quick: native
 	$(PY) -m pytest tests/test_store.py tests/test_embedder.py \
 		tests/test_cli.py -q
 
+# the full pytest sweep below already collects the search tier
+# (test_fused_topk.py + test_searcher.py); search-check stays a
+# standalone fast gate, same pattern as obs-check's `-m obs` group
 check: native
 	$(MAKE) -C native check
 	$(PY) scripts/obs_overhead_check.py
@@ -42,6 +48,9 @@ check: native
 obs-check: native
 	$(PY) scripts/obs_overhead_check.py
 	$(PY) -m pytest tests/ -q -m obs
+
+search-check: native
+	$(PY) -m pytest tests/test_fused_topk.py tests/test_searcher.py -q
 
 memcheck: native
 	$(MAKE) -C native memcheck
@@ -53,4 +62,5 @@ bench-cpu:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native quick check obs-check memcheck bench-cpu clean
+.PHONY: all native quick check obs-check search-check memcheck \
+	bench-cpu clean

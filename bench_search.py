@@ -6,12 +6,16 @@ row: "Cosine top-k over 1M-vector arena — Pallas kernel (beat the
 reference's O(N*768) scalar scan, splinter_cli_cmd_search.c:374-412)".
 
 Prints ONE JSON line {"metric": "search_queries_per_sec", ...};
-vs_baseline = kernel qps / numpy host-scan qps.  Appends to
+vs_baseline = kernel qps / numpy host-scan qps.  The detail section
+carries fused-vs-unfused q/s, the fused QB sweep {1, 32, 256}, and
+the search daemon's coalescing stats + heartbeat-sourced stage
+quantiles (bench_series.phase_search).  Appends to
 bench_results.jsonl.
 
 Run strictly alone: the tunneled TPU admits one client.  Env:
 BENCH_CPU=1, SEARCH_N (default 1,000,000 on TPU / 100,000 on CPU),
-SEARCH_D (768), SEARCH_K (10), SEARCH_REPS (20).
+SEARCH_D (768), SEARCH_K (10), SEARCH_REPS (20), SEARCHD_N (8192),
+SEARCHD_WAVES (8).
 """
 from __future__ import annotations
 

@@ -886,11 +886,18 @@ def phase_kernels(ctx: SeriesCtx) -> dict:
 def phase_search(ctx: SeriesCtx) -> dict:
     """BASELINE.md: cosine top-k over a 1M-vector arena.  Stages the
     lane (staging time is itself reported — it is the StagedLane
-    restage cost at full-lane granularity), then measures single-query
-    and 32-query-batch q/s with the f32 kernel and the bf16 MXU path.
+    restage cost at full-lane granularity), then measures:
+
+      - legacy (unfused) single-query / QB=32 / QB=256 q/s — the rows
+        comparable with BENCH_r05's 12.1 q/s single-query cliff;
+      - the FUSED streaming kernel (score+select in VMEM, O(k*Q)
+        off-chip) single-query and a QB sweep {1, 32, 256};
+      - the coalescing search daemon end to end, with stage quantiles
+        sourced from its own heartbeat (SEARCH_STAGES histograms).
 
     Env: SEARCH_N (1,000,000 on TPU / 100,000 on CPU), SEARCH_D (768),
-    SEARCH_K (10), SEARCH_REPS (20)."""
+    SEARCH_K (10), SEARCH_REPS (20), SEARCHD_N (8192), SEARCHD_WAVES
+    (8)."""
     import numpy as np
 
     import jax
@@ -958,40 +965,63 @@ def phase_search(ctx: SeriesCtx) -> dict:
     log(f"lane host-gen {gen_s:.1f}s, staged to device in {stage_s:.1f}s"
         f" ({lane.nbytes / 1e6 / max(stage_s, 1e-9):,.0f} MB/s)")
 
-    def bench_kernel(mxu_bf16: bool) -> float:
+    def bench_kernel(mxu_bf16: bool, fused: bool | None = False) -> float:
         cosine_topk(lane_dev, queries[0], k, use_pallas=use_pallas,
-                    mxu_bf16=mxu_bf16, vnorm=vnorm_dev)
+                    mxu_bf16=mxu_bf16, vnorm=vnorm_dev, fused=fused)
         t0 = time.perf_counter()
         for i in range(reps):
             cosine_topk(lane_dev, queries[i], k, use_pallas=use_pallas,
-                        mxu_bf16=mxu_bf16, vnorm=vnorm_dev)
+                        mxu_bf16=mxu_bf16, vnorm=vnorm_dev, fused=fused)
         return reps / (time.perf_counter() - t0)
 
+    def bench_batch(qb: int, fused: bool | None) -> float:
+        qs_in = queries[:qb]
+        qb = len(qs_in)          # queries may be shorter than the ask:
+        # the rate must count the rows actually scored, not the target
+        cosine_topk_batch(lane_dev, qs_in, k, use_pallas=use_pallas,
+                          vnorm=vnorm_dev, fused=fused)
+        reps_b = max(2, reps // qb)
+        t0 = time.perf_counter()
+        for _ in range(reps_b):
+            cosine_topk_batch(lane_dev, qs_in, k, use_pallas=use_pallas,
+                              vnorm=vnorm_dev, fused=fused)
+        return reps_b * qb / (time.perf_counter() - t0)
+
+    # legacy (unfused) rows stay fused=False so they remain comparable
+    # with BENCH_r05's 12.1 q/s single / 2262.8 q/s QB=256 cliff
     qps_f32 = bench_kernel(False)
     qps_bf16 = bench_kernel(True) if on_tpu else 0.0
-    log(f"kernel: {qps_f32:.1f} q/s f32"
+    log(f"kernel: {qps_f32:.1f} q/s f32 (unfused)"
         + (f", {qps_bf16:.1f} q/s bf16" if qps_bf16 else ""))
 
-    cosine_topk_batch(lane_dev, queries[:QB], k, use_pallas=use_pallas,
-                      vnorm=vnorm_dev)
-    t0 = time.perf_counter()
-    reps_b = max(2, reps // QB)
-    for _ in range(reps_b):
-        cosine_topk_batch(lane_dev, queries[:QB], k,
-                          use_pallas=use_pallas, vnorm=vnorm_dev)
-    qps_batch = reps_b * QB / (time.perf_counter() - t0)
-    log(f"batched: {qps_batch:.1f} q/s aggregate (QB={QB})")
+    qps_batch = bench_batch(QB, False)
+    log(f"batched: {qps_batch:.1f} q/s aggregate (QB={QB}, unfused)")
+    qps_batch_big = bench_batch(QB2, False) if QB2 > QB else 0.0
+    if qps_batch_big:
+        log(f"batched: {qps_batch_big:.1f} q/s aggregate (QB={QB2}, "
+            f"unfused)")
 
-    qps_batch_big = 0.0
-    if QB2 > QB:
-        cosine_topk_batch(lane_dev, queries[:QB2], k,
-                          use_pallas=use_pallas, vnorm=vnorm_dev)
-        t0 = time.perf_counter()
-        for _ in range(2):
-            cosine_topk_batch(lane_dev, queries[:QB2], k,
-                              use_pallas=use_pallas, vnorm=vnorm_dev)
-        qps_batch_big = 2 * QB2 / (time.perf_counter() - t0)
-        log(f"batched: {qps_batch_big:.1f} q/s aggregate (QB={QB2})")
+    # fused streaming kernel (score + select in VMEM, O(k*Q) off-chip):
+    # the QB sweep is the daemon's coalescing schedule.  On CPU the
+    # fused selector falls back to the jnp score-matrix path, so the
+    # sweep only measures something new on the pallas backend.
+    fused_sweep = {}
+    qps_fused_single = 0.0
+    if on_tpu:
+        # fenced per measurement: a Mosaic lowering failure on one
+        # toolchain must cost that row, not the daemon section below
+        try:
+            qps_fused_single = bench_kernel(False, fused=True)
+            log(f"fused kernel: {qps_fused_single:.1f} q/s single")
+        except Exception as e:
+            log(f"[search] fused single failed: {e}")
+        for qb in (1, 32, 256):
+            try:
+                fused_sweep[str(qb)] = round(bench_batch(qb, True), 1)
+                log(f"fused batched: {fused_sweep[str(qb)]} q/s "
+                    f"aggregate (QB={qb})")
+            except Exception as e:
+                fused_sweep[str(qb)] = f"failed: {e}"[:120]
 
     # host numpy scan: vectorized stand-in for the reference's scalar C
     # scan (splinter_cli_cmd_search.c:374-412), i.e. a GENEROUS baseline
@@ -1007,25 +1037,108 @@ def phase_search(ctx: SeriesCtx) -> dict:
     qps_np = reps_np / (time.perf_counter() - t0) * (nn / n)
     log(f"numpy scan (scaled to {n} rows): {qps_np:.2f} q/s")
 
-    best = max(qps_f32, qps_bf16)
+    # search-daemon micro-bench: concurrent requests coalesce into
+    # batched dispatches, stage quantiles come from the daemon's OWN
+    # heartbeat (the histogram surface operators see), never re-timed
+    # ad hoc here.  Fenced: a daemon failure costs this section only.
+    daemon_detail = None
+    try:
+        daemon_detail = _search_daemon_bench(lane, queries, d, k)
+    except Exception:
+        log("[search] daemon micro-bench failed:")
+        log(traceback.format_exc())
+
+    best = max(qps_f32, qps_bf16, qps_fused_single)
+    detail = {
+        "backend": ctx.backend, "n": n, "d": d, "k": k,
+        "qps_f32": round(qps_f32, 1),
+        "qps_bf16_fast": round(qps_bf16, 1),
+        "qps_batch32_aggregate": round(qps_batch, 1),
+        "qb_big": QB2,
+        "qps_batch_big_aggregate": round(qps_batch_big, 1),
+        "bf16_speedup": round(qps_bf16 / qps_f32, 2)
+        if qps_f32 > 0 and qps_bf16 > 0 else None,
+        "qps_fused_single": round(qps_fused_single, 1),
+        "qps_fused_qb_sweep": fused_sweep or None,
+        "fused_vs_unfused_single": round(qps_fused_single / qps_f32, 2)
+        if qps_fused_single > 0 and qps_f32 > 0 else None,
+        "qps_numpy_hostscan": round(qps_np, 2),
+        "lane_stage_s": round(stage_s, 2),
+        "lane_mb": round(lane.nbytes / 1e6, 1),
+    }
+    if daemon_detail is not None:
+        detail["daemon"] = daemon_detail
     return ctx.record({
         "metric": "search_queries_per_sec",
         "value": round(best, 1),
         "unit": "queries/s",
         "vs_baseline": round(best / qps_np, 2) if qps_np > 0 else 0.0,
-        "detail": {
-            "backend": ctx.backend, "n": n, "d": d, "k": k,
-            "qps_f32": round(qps_f32, 1),
-            "qps_bf16_fast": round(qps_bf16, 1),
-            "qps_batch32_aggregate": round(qps_batch, 1),
-            "qb_big": QB2,
-            "qps_batch_big_aggregate": round(qps_batch_big, 1),
-            "bf16_speedup": round(qps_bf16 / qps_f32, 2)
-            if qps_f32 > 0 and qps_bf16 > 0 else None,
-            "qps_numpy_hostscan": round(qps_np, 2),
-            "lane_stage_s": round(stage_s, 2),
-            "lane_mb": round(lane.nbytes / 1e6, 1),
-        }})
+        "detail": detail})
+
+
+def _search_daemon_bench(lane, queries, d: int, k: int) -> dict:
+    """Coalescing search daemon against a real store: waves of 32
+    concurrent requests per drain, fused top-k dispatches, heartbeat-
+    sourced SEARCH_STAGES quantiles.  Env: SEARCHD_N (store slots,
+    default 8192), SEARCHD_WAVES (default 8)."""
+    import json as _json
+
+    from libsplinter_tpu import Store as _Store
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.searcher import Searcher
+    from libsplinter_tpu.utils.trace import tracer
+
+    nslots = int(os.environ.get("SEARCHD_N", "8192"))
+    waves = int(os.environ.get("SEARCHD_WAVES", "8"))
+    per_wave = 32
+    name = _bench_store_name("srchd")
+    _Store.unlink(name)
+    st = _Store.create(name, nslots=nslots, max_val=4096, vec_dim=d)
+    prev_traced = tracer.enabled
+    tracer.enabled = True
+    try:
+        rows = min(nslots // 2, len(lane))
+        for i in range(rows):
+            st.set(f"doc/{i}", "x")
+            st.vec_set(f"doc/{i}", lane[i])
+        sr = Searcher(st)
+        sr.attach()
+        t0 = time.perf_counter()
+        for w in range(waves):
+            for j in range(per_wave):
+                key = f"__sqtmp_bench{j}"
+                st.set(key, _json.dumps({"k": k}))
+                st.vec_set(key, queries[(w * per_wave + j)
+                                        % len(queries)])
+                st.label_or(key, P.LBL_SEARCH_REQ)
+                st.bump(key)
+            served = sr.run_once()
+            assert served == per_wave, (served, per_wave)
+        el = time.perf_counter() - t0
+        sr.publish_stats()
+        snap = _json.loads(st.get(P.KEY_SEARCH_STATS).rstrip(b"\0"))
+        quant = {
+            stage: {f: round(v[f], 3) for f in
+                    ("p50_ms", "p95_ms", "p99_ms") if f in v}
+            for stage, v in (snap.get("quantiles") or {}).items()}
+        out = {
+            "nslots": nslots, "rows": rows,
+            "requests": sr.stats.requests,
+            "served": sr.stats.served,
+            "dispatches": sr.stats.dispatches,
+            "coalesce_ratio": round(sr.stats.coalesce_ratio(), 2),
+            "daemon_qps": round(waves * per_wave / el, 1),
+            "stage_quantiles": quant,
+        }
+        log(f"[search] daemon: {out['served']} reqs in "
+            f"{out['dispatches']} dispatches "
+            f"({out['coalesce_ratio']}x coalesced), "
+            f"{out['daemon_qps']} q/s e2e")
+        return out
+    finally:
+        tracer.enabled = prev_traced
+        st.close()
+        _Store.unlink(name)
 
 
 # ---------------------------------------------------------------------------

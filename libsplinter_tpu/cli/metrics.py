@@ -3,14 +3,17 @@
 `metrics` renders everything observable from OUTSIDE the daemons as
 Prometheus text exposition (obs/prom.py): store header diagnostics
 (used slots, global epoch, parse_failures), daemon heartbeat counters
-(__embedder_stats / __completer_stats scalars), heartbeat ages, the
-histogram-sourced per-stage quantile summaries the daemons publish
-under SPTPU_TRACE=1, and flight-recorder accounting.  Pipe it to a
+(__embedder_stats / __completer_stats / __searcher_stats scalars),
+heartbeat ages, the histogram-sourced per-stage quantile summaries the
+daemons publish under SPTPU_TRACE=1 (PIPELINE_STAGES, INFER_STAGES,
+and the search daemon's SEARCH_STAGES), and flight-recorder
+accounting.  Pipe it to a
 node_exporter textfile collector or curl-style scrape wrapper and the
 SLO dashboards come for free.
 
 `trace tail [N]` dumps the daemons' flight-recorder rings
-(__embedder_trace / __completer_trace): one line per traced request —
+(__embedder_trace / __completer_trace / __searcher_trace): one line
+per traced request —
 trace id, key, wall ms, and the ordered stage event sequence
 (PIPELINE_STAGES / INFER_STAGES names) — reconstructing any single
 wake->commit journey cross-process.  Clients opt a request in with
@@ -28,9 +31,11 @@ from ..obs.prom import PromWriter
 from .main import CliError, command
 
 _HEARTBEATS = (("embedder", P.KEY_EMBED_STATS),
-               ("completer", P.KEY_COMPLETE_STATS))
+               ("completer", P.KEY_COMPLETE_STATS),
+               ("searcher", P.KEY_SEARCH_STATS))
 _TRACE_KEYS = (("embedder", P.KEY_EMBED_TRACE),
-               ("completer", P.KEY_COMPLETE_TRACE))
+               ("completer", P.KEY_COMPLETE_TRACE),
+               ("searcher", P.KEY_SEARCH_TRACE))
 
 
 def _read_json(store, key: str) -> dict | None:
@@ -78,6 +83,9 @@ def cmd_metrics(ses, args):
         recorder = snap.pop("recorder", None) or {}
         slow = snap.pop("slow_log", None) or []
         snap.pop("spans", None)       # superseded by the quantiles
+        lane = snap.pop("lane", None)  # searcher: StagedLane counters
+        if isinstance(lane, dict):
+            w.scalars(f"sptpu_{daemon}_lane", lane)
         for field, v in snap.items():
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue

@@ -22,13 +22,16 @@ from .main import CliError, command
 
 @command("search", "search [--json] [--limit N] [--similarity S] "
          "[--distance D] [--bloom MASK] [--regex RX] [--timeout MS] "
-         "[--cpu] [--sharded] [--fast] QUERY...",
+         "[--cpu] [--sharded] [--fast] [--local] QUERY...",
          "semantic vector search (TPU top-k; --fast = bf16 MXU scoring, "
-         "2x kernel throughput, ~2e-2 score precision)")
+         "2x kernel throughput, ~2e-2 score precision; a live search "
+         "daemon is used automatically — --local forces client-side "
+         "scoring)")
 def cmd_search(ses, args):
     opts = {"json": False, "limit": 10, "similarity": None,
             "distance": None, "bloom": 0, "regex": None, "timeout": 2000,
-            "cpu": False, "sharded": False, "fast": False}
+            "cpu": False, "sharded": False, "fast": False,
+            "local": False}
     query_words = []
     it = iter(args)
 
@@ -46,6 +49,8 @@ def cmd_search(ses, args):
                 opts["cpu"] = True
             elif a == "--sharded":
                 opts["sharded"] = True
+            elif a == "--local":
+                opts["local"] = True
             elif a == "--fast":
                 # bf16 MXU scoring (pallas path only): 2x matmul
                 # throughput, scores good to ~2e-2 absolute — fine for
@@ -101,15 +106,11 @@ def cmd_search(ses, args):
     # enumeration) — never a per-slot FFI loop.  Keys are fetched lazily
     # for the ranked head only, so regex/scratch filtering costs
     # O(results inspected), not O(nslots).
-    n = st.nslots
     rx = re.compile(opts["regex"]) if opts["regex"] else None
-    if opts["bloom"]:
-        mask = np.zeros(n, np.float32)
-        mask[st.enumerate_indices(opts["bloom"])] = 1.0
-    else:
-        eps = st.epochs()
-        mask = ((eps != 0) & ((eps & np.uint64(1)) == 0)
-                ).astype(np.float32)
+    # THE candidate-mask definition, shared with the search daemon
+    # (engine/protocol.candidate_mask) so client-side and server-side
+    # candidate sets cannot diverge
+    mask = P.candidate_mask(st, opts["bloom"])
 
     def key_ok(k: str | None) -> bool:
         if k is None or k.startswith(P.SEARCH_SCRATCH_PREFIX):
@@ -172,34 +173,19 @@ def cmd_search(ses, args):
                 break                         # done, or candidates exhausted
             fetch_k *= 8                      # stays on the bucket schedule
     elif qvec is not None and mask.any():
-        from ..ops.similarity import (cosine_scores, euclidean_distances)
-        from .main import cli_jax
-        jax = cli_jax()
-        use_pallas = (not opts["cpu"]) and jax.default_backend() == "tpu"
-        # device-resident lane cache: full upload on the session's first
-        # search, O(dirty rows) re-staging afterwards (VERDICT r1 item 2)
-        lane = ses.lane.refresh()
-        scores = np.asarray(cosine_scores(
-            lane, qvec, mask, use_pallas=use_pallas,
-            mxu_bf16=opts["fast"], vnorm=ses.lane.norms))[:, 0]
-        dists = np.asarray(euclidean_distances(lane, qvec, mask))[:, 0]
-        order = np.argsort(-scores)
-        for i in order:
-            i = int(i)
-            sim, dist = float(scores[i]), float(dists[i])
-            if sim <= -1e29:
-                break                         # sorted: only filler left
-            if opts["similarity"] is not None and sim < opts["similarity"]:
-                break                         # sorted desc: all below now
-            if opts["distance"] is not None and dist > opts["distance"]:
-                continue
-            k = st.key_at(i)
-            if not key_ok(k):
-                continue
-            rows.append({"key": k, "similarity": round(sim, 6),
-                         "distance": round(dist, 6)})
-            if len(rows) >= opts["limit"]:
-                break
+        served = None
+        if not opts["cpu"] and not opts["local"]:
+            # a live search daemon coalesces concurrent queries into
+            # QB-bucketed fused-kernel batches server-side: dispatch
+            # there instead of paying a private device round trip.
+            # Timeout / error falls back to client-side scoring.
+            from ..engine.searcher import daemon_live
+            if daemon_live(st):
+                served = _daemon_search(st, scratch, qvec, opts, key_ok)
+        if served is not None:
+            rows = served
+        else:
+            rows = _local_search(ses, st, qvec, mask, opts, key_ok)
     else:
         # degraded path (no embedding answered): list the CANDIDATES —
         # the mask already encodes the bloom prefilter
@@ -208,7 +194,12 @@ def cmd_search(ses, args):
         rows = [{"key": k, "similarity": None, "distance": None}
                 for k in keys[: opts["limit"]]]
 
-    # 4. cleanup + output
+    # 4. cleanup + output (the daemon result row rides the scratch
+    # slot's index — retire it with the scratch key)
+    try:
+        st.unset(P.search_result_key(st.find_index(scratch)))
+    except (KeyError, OSError):
+        pass
     try:
         st.unset(scratch)
     except KeyError:
@@ -221,9 +212,97 @@ def cmd_search(ses, args):
         for r in rows:
             if r["similarity"] is None:
                 print(r["key"])
-            elif r["distance"] is None:         # sharded hit: host-tagged
+            elif "host" in r:                   # sharded hit: host-tagged
                 print(f"{r['similarity']:+.4f}  h{r['host']}/"
                       f"{r['slot']:<6d}  {r['key']}")
-            else:
+            else:                               # local AND daemon rows
                 print(f"{r['similarity']:+.4f}  {r['distance']:8.4f}  "
                       f"{r['key']}")
+
+
+def _daemon_search(st, scratch, qvec, opts, key_ok) -> list[dict] | None:
+    """Route the query through the search daemon (engine/searcher.py):
+    the scratch key already holds the embedded query vector, so the
+    request is a value rewrite + relabel on the same slot.  Returns
+    result rows, or None when the daemon times out / errors (the
+    caller falls back to client-side scoring).
+
+    Over-fetch and GROW like the sharded path: the daemon drops
+    system/scratch rows server-side, but regex misses and similarity
+    cutoffs are client-side concerns, and the growth stays on the
+    daemon's bucketed fetch-k schedule."""
+    from ..engine.searcher import consume_result, submit_search
+    from ..parallel.sharded_search import _bucket
+
+    fetch_k = _bucket(opts["limit"] + (8 if opts["regex"] else 4))
+    rows: list[dict] = []
+    while True:
+        rec = submit_search(st, scratch, fetch_k, bloom=opts["bloom"],
+                            fast=opts["fast"],
+                            timeout_ms=opts["timeout"])
+        consume_result(st, scratch)
+        if rec is None or rec.get("err"):
+            return None
+        rows.clear()
+        satisfied = False
+        for key, sim, idx in zip(rec["keys"], rec["s"], rec["i"]):
+            if not key_ok(key):
+                continue
+            sim = round(sim, 6)
+            if opts["similarity"] is not None and \
+                    sim < opts["similarity"]:
+                satisfied = True              # sorted desc: all below now
+                break
+            # exact distance for the ranked head only: O(k) row
+            # fetches, never an O(nslots) second score pass — computed
+            # unconditionally so the row shape matches the local path
+            # regardless of which side scored (daemon liveness must
+            # never change the output contract)
+            dist = float(np.linalg.norm(st.vec_get_at(int(idx))
+                                        - qvec))
+            if opts["distance"] is not None and dist > opts["distance"]:
+                continue
+            rows.append({"key": key, "similarity": sim,
+                         "distance": round(dist, 6)})
+            if len(rows) >= opts["limit"]:
+                satisfied = True
+                break
+        if satisfied or rec["n"] < rec["fetched"] \
+                or fetch_k >= st.nslots:      # lane exhausted: no growth
+            return rows
+        fetch_k *= 8                          # stays on the bucket schedule
+
+
+def _local_search(ses, st, qvec, mask, opts, key_ok) -> list[dict]:
+    """Client-side scoring over the session's device-resident lane
+    (the pre-daemon path, kept for --local, --cpu, and fallback)."""
+    from ..ops.similarity import cosine_scores, euclidean_distances
+    from .main import cli_jax
+    jax = cli_jax()
+    use_pallas = (not opts["cpu"]) and jax.default_backend() == "tpu"
+    # device-resident lane cache: full upload on the session's first
+    # search, O(dirty rows) re-staging afterwards (VERDICT r1 item 2)
+    lane = ses.lane.refresh()
+    scores = np.asarray(cosine_scores(
+        lane, qvec, mask, use_pallas=use_pallas,
+        mxu_bf16=opts["fast"], vnorm=ses.lane.norms))[:, 0]
+    dists = np.asarray(euclidean_distances(lane, qvec, mask))[:, 0]
+    order = np.argsort(-scores)
+    rows: list[dict] = []
+    for i in order:
+        i = int(i)
+        sim, dist = float(scores[i]), float(dists[i])
+        if sim <= -1e29:
+            break                             # sorted: only filler left
+        if opts["similarity"] is not None and sim < opts["similarity"]:
+            break                             # sorted desc: all below now
+        if opts["distance"] is not None and dist > opts["distance"]:
+            continue
+        k = st.key_at(i)
+        if not key_ok(k):
+            continue
+        rows.append({"key": k, "similarity": round(sim, 6),
+                     "distance": round(dist, 6)})
+        if len(rows) >= opts["limit"]:
+            break
+    return rows

@@ -1,0 +1,19 @@
+"""Serving daemons over the store's event bus: the embedding daemon
+(embedder.py), the completion daemon (completer.py), and the
+query-coalescing search daemon (searcher.py), sharing one coordination
+contract (protocol.py)."""
+from . import protocol
+
+__all__ = ["protocol", "Searcher", "daemon_live", "submit_search"]
+
+_SEARCHER_API = ("Searcher", "daemon_live", "submit_search")
+
+
+def __getattr__(name):
+    # lazy: `python -m libsplinter_tpu.engine.searcher` must not find
+    # the module pre-imported by its own package (runpy warns), and
+    # protocol-only importers skip the daemon modules entirely
+    if name in _SEARCHER_API:
+        from . import searcher
+        return getattr(searcher, name)
+    raise AttributeError(name)

@@ -16,6 +16,7 @@ LBL_WAITING = 0x40             # client is blocked on this key
 LBL_CTX_EXCEEDED = 0x80        # input exceeded the model context window
 LBL_CHUNK = 0x200              # ingest: document chunk
 LBL_META = 0x400               # ingest: metadata slot
+LBL_SEARCH_REQ = 0x1 << 57     # "search me" — wakes the search daemon
 LBL_TRACED = 0x1 << 58         # request carries a trace stamp (obs)
 LBL_DEBUG = 0x1 << 59          # debug channel (sidecar watches this)
 LBL_INFER_REQ = 0x1 << 60      # "complete me" — wakes the completion daemon
@@ -26,20 +27,24 @@ LBL_READY = 0x1 << 62          # completion finished
 BIT_EMBED_REQ = 0
 BIT_WAITING = 6
 BIT_CTX_EXCEEDED = 7
+BIT_SEARCH_REQ = 57
 BIT_DEBUG = 59
 BIT_INFER_REQ = 60
 
 # --- signal groups -------------------------------------------------------
 GROUP_EMBED = 2                # embedding daemon wake group
 GROUP_INFER = 3                # completion daemon wake group
+GROUP_SEARCH = 4               # search daemon wake group
 GROUP_DEBUG = 63               # sidecar debug group
 
 # --- shard ids / priorities (cooperative advisement) --------------------
 SHARD_EMBED = 0x5F10
 SHARD_COMPLETE = 0x5F1A
+SHARD_SEARCH = 0x5F1B
 PRIO_EMBED_LIVE = 40
 PRIO_EMBED_BACKFILL = 20
 PRIO_COMPLETE = 200
+PRIO_SEARCH = 150
 
 # --- well-known keys -----------------------------------------------------
 KEY_DONE_LANE = "__lane_dw_2"  # pulsed after each committed embedding
@@ -51,12 +56,18 @@ KEY_SYSTEM_PROMPT = "__system_prompt"
 # structured counterpart)
 KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
+KEY_SEARCH_STATS = "__searcher_stats"
 SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
+# search-daemon results: one JSON row per serviced request, keyed by
+# the REQUEST's slot index (__sr_<idx>) — the client polls its request
+# key and reads the companion once LBL_SEARCH_REQ clears
+SEARCH_RESULT_PREFIX = "__sr_"
 # flight-recorder dumps (obs/recorder.py): each daemon publishes its
 # ring of per-request wake->commit traces here alongside its stats
 # heartbeat; `spt trace tail` reads them cross-process
 KEY_EMBED_TRACE = "__embedder_trace"
 KEY_COMPLETE_TRACE = "__completer_trace"
+KEY_SEARCH_TRACE = "__searcher_trace"
 
 # context guard: reject inputs >= this fraction of the model window
 CTX_GUARD_FRACTION = 0.9
@@ -78,6 +89,35 @@ PIPELINE_STAGES = ("drain", "tokenize", "dispatch", "device_wait",
 # WAITING->SERVICING claim; generate = the token loop incl. streaming
 # appends; commit = oom bookkeeping + ctime backfill + READY flip
 INFER_STAGES = ("render", "generate", "commit")
+
+# the search daemon's per-drain decomposition: wake = signal to drain
+# entry (the coalescing window's scheduling cost); drain = request
+# discovery + param parse + torn-safe query-vector gather; score =
+# lane refresh + async device dispatch of the fused top-k programs
+# (host-side, the device computes in flight); select = the blocking
+# device fetch of the O(k*Q) candidate rows; commit = per-request
+# filtering + __sr_<idx> result writes + label clears + bumps
+SEARCH_STAGES = ("wake", "drain", "score", "select", "commit")
+
+
+def search_result_key(idx: int) -> str:
+    return f"{SEARCH_RESULT_PREFIX}{idx}"
+
+
+def candidate_mask(store, bloom: int = 0):
+    """THE search candidate mask — one definition the CLI's client-side
+    scoring and the search daemon share, so their candidate sets
+    cannot diverge: a bloom prefilter enumerates labelled rows; the
+    default is every live row (written at least once, not mid-write —
+    even nonzero epoch)."""
+    import numpy as np
+
+    if bloom:
+        mask = np.zeros(store.nslots, np.float32)
+        mask[store.enumerate_indices(bloom)] = 1.0
+        return mask
+    eps = store.epochs()
+    return ((eps != 0) & ((eps & np.uint64(1)) == 0)).astype(np.float32)
 
 # latency-probe short-circuit: drains at or below this many candidate
 # rows skip the windowed big-batch machinery and dispatch immediately
@@ -219,7 +259,8 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
 # labels that mean "a daemon will still service (and consume the
 # stamp of) this row" — a TRACED row carrying none of them is an
 # orphan whose stamp landed after its request was serviced
-_REQ_LABELS = LBL_EMBED_REQ | LBL_INFER_REQ | LBL_SERVICING
+_REQ_LABELS = (LBL_EMBED_REQ | LBL_INFER_REQ | LBL_SERVICING
+               | LBL_SEARCH_REQ)
 
 
 def shed_orphan_stamp(store, idx: int, labels: int) -> bool:

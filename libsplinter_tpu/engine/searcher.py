@@ -1,0 +1,652 @@
+"""Event-driven query-coalescing search daemon.
+
+BENCH_r05 measured the search cliff: single-query kernel dispatch runs
+at ~12 q/s through the tunneled runtime while a QB=256 batch sustains
+~2262 q/s — the per-dispatch round trip, not the kernel, bounds
+single-client throughput.  The CLI's client-side scoring cannot close
+that gap: every client pays its own dispatch.
+
+This daemon moves scoring server-side, mirroring the embedder's
+drain/wake structure (engine/embedder.py):
+
+  - blocks on the store's signal group (LBL_SEARCH_REQ label watch);
+  - drains ALL pending search requests per wake and COALESCES them
+    into QB-bucketed batches against pre-compiled fused top-k
+    programs (ops/similarity.topk_program — the streaming Pallas
+    kernel: block-local select + merge in VMEM, O(k*Q) off-chip);
+  - scores against its own StagedLane (full upload once, O(dirty)
+    refresh per drain);
+  - commits per-request results back as __sr_<idx> rows and clears
+    the request label — N concurrent clients cost ceil(N / QB)
+    device dispatches, not N.
+
+Request contract (one slot per request):
+  value       JSON {"k": int, "bloom": int?} — the search params
+  vector lane the query vector in the SAME slot (the embedding daemon
+              puts it there when the client labels its scratch key
+              LBL_EMBED_REQ first — the classic CLI flow — or the
+              client writes it directly with vec_set)
+  labels      LBL_SEARCH_REQ (+ LBL_WAITING), then bump.
+
+Result contract: JSON in search_result_key(request_slot_index) —
+{"s": scores, "i": slot indices, "keys": resolved keys, "fetched": K,
+"n": valid candidate count} — sorted by similarity desc, system keys
+("__" prefix: scratch rows, heartbeats, other requests' slots)
+already dropped.  The daemon clears LBL_SEARCH_REQ + LBL_WAITING and
+bumps the request key; clients poll their own request key.  A request
+whose slot changed mid-service (epoch mismatch) is NOT committed and
+is retried next drain — the embedder's race discipline.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Sequence
+
+import numpy as np
+
+from .. import _native as N
+from ..obs.recorder import FlightRecorder
+from ..store import Store
+from ..utils.trace import device_profile, tracer
+from . import protocol as P
+
+log = logging.getLogger("libsplinter_tpu.searcher")
+
+# query-count pad buckets: a drain's requests batch into the smallest
+# bucket that holds them (chunked through the largest otherwise), so
+# the daemon compiles a handful of programs, not one per concurrency
+# level.  The floor of 8 matches the kernel's lane-width query pad —
+# a single query already computes 8 columns, so coalescing up to 8 is
+# literally free.
+QB_BUCKETS = (8, 32, 256)
+
+# fetch-k pad buckets (candidates pulled per query).  Bounded by
+# ops.similarity.FUSED_K_MAX — the cushion above the request's k
+# absorbs post-select drops (system keys, the requester's own row).
+K_BUCKETS = (16, 32, 64, 128)
+K_CUSHION = 4
+
+
+def _k_bucket(k: int) -> int:
+    for b in K_BUCKETS:
+        if k <= b:
+            return b
+    return k                     # beyond the schedule: exact (legacy path)
+
+
+def _qb_chunks(nq: int) -> list[int]:
+    """Decompose a drain's query count into QB bucket sizes with
+    padding waste bounded at 2x (the StagedLane _chunk_plan
+    discipline): 40 queries batch as [32, 8], never one 256-query
+    dispatch scoring 216 zero rows."""
+    out: list[int] = []
+    smallest, largest = QB_BUCKETS[0], QB_BUCKETS[-1]
+    while nq > 0:
+        if nq >= largest:
+            out.append(largest)
+            nq -= largest
+            continue
+        cover = next(b for b in QB_BUCKETS if nq <= b)
+        if cover <= 2 * nq or cover == smallest:
+            out.append(cover)                 # tail: waste <= 2x
+            break
+        out.append(max(b for b in QB_BUCKETS if b <= nq))
+        nq -= out[-1]
+    return out
+
+
+@dataclasses.dataclass
+class SearcherStats:
+    wakes: int = 0
+    drains: int = 0
+    requests: int = 0            # requests gathered (incl. retried)
+    served: int = 0              # results committed
+    dispatches: int = 0          # device top-k program calls
+    coalesced_max: int = 0       # most requests in one dispatch
+    parse_errors: int = 0        # malformed / vectorless requests
+    raced: int = 0               # slot changed mid-service; retried
+    full_refreshes: int = 0      # lane full uploads
+
+    def coalesce_ratio(self) -> float:
+        """Requests served per device dispatch (1.0 = no batching win;
+        the whole point of the daemon is pushing this toward QB)."""
+        return self.served / self.dispatches if self.dispatches else 0.0
+
+
+class _Request:
+    __slots__ = ("idx", "epoch", "k", "bloom", "fast", "qvec", "stamp")
+
+    def __init__(self, idx, epoch, k, bloom, fast, qvec, stamp):
+        self.idx = idx
+        self.epoch = epoch
+        self.k = k
+        self.bloom = bloom
+        self.fast = fast         # bf16 MXU scoring requested
+        self.qvec = qvec
+        self.stamp = stamp       # (trace_id, client_wall_ts) | None
+
+
+class Searcher:
+    """The daemon object.  Drive it with run() (blocking loop) or
+    run_once() (single drain — tests and --oneshot)."""
+
+    def __init__(self, store: Store, *, lane=None,
+                 group: int = P.GROUP_SEARCH,
+                 use_pallas: bool | None = None,
+                 mxu_bf16: bool = False,
+                 fused: bool | None = None,
+                 interpret: bool = False,
+                 block_n: int = 1024,
+                 coalesce_window_ms: float = 0.0):
+        from ..ops import StagedLane
+
+        self.store = store
+        self.group = group
+        self.use_pallas = use_pallas
+        self.mxu_bf16 = mxu_bf16
+        self.fused = fused
+        self.interpret = interpret
+        self.block_n = block_n
+        # >0: sleep this long after a wake before draining, widening
+        # the coalescing window at the cost of per-request latency.
+        # 0 (default): the natural window — requests landing while a
+        # drain's device work flies batch into the next drain.
+        self.coalesce_window_ms = coalesce_window_ms
+        self.lane = lane or StagedLane(store)
+        self.stats = SearcherStats()
+        self.recorder = FlightRecorder()
+        self._trace_published = 0
+        self._stage_acc: dict | None = None
+        self._bid = -1
+        self._running = False
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self) -> None:
+        """Claim the shard, bind the wake label, arm/join the event
+        bus — the embedder's attach sequence under the search ids."""
+        st = self.store
+        try:
+            self._bid = st.shard_claim(P.SHARD_SEARCH, N.ADV_WILLNEED,
+                                       P.PRIO_SEARCH, 30_000_000)
+        except OSError:
+            self._bid = -1
+        st.watch_label_register(P.BIT_SEARCH_REQ, self.group)
+        if st.header().bus_pid == 0:
+            st.bus_init()
+        else:
+            st.bus_open()
+
+    def warmup(self, ks: Sequence[int] = (10, 64)) -> None:
+        """Pre-compile the QB-bucketed top-k programs against the live
+        lane so the first coalesced drain of each shape doesn't pay an
+        XLA compile on the wake path (.xla_cache persists them).  `ks`
+        are REQUEST k values: they map through the same cushion +
+        bucket + lane clamp as a real drain's, and the probe mask is
+        an ndarray like every real drain's — a different transform (or
+        mask=None's different jit pytree) would compile programs no
+        serving request ever hits.  The defaults cover the CLI's
+        limit-10 fetch (bucket 64 -> k_fetch 128) and direct k<=12
+        API requests (k_fetch 16)."""
+        arr = self.lane.refresh()
+        d = self.store.vec_dim
+        mask = np.ones(self.store.nslots, np.float32)
+        for k in ks:
+            k_fetch = min(_k_bucket(k + K_CUSHION), self.store.nslots)
+            # both precision variants: a --fast client's first request
+            # must not stall a whole coalesced drain on a fresh compile
+            for fast in (False, True):
+                fn = self._program(k_fetch, mxu_bf16=fast)
+                for qb in QB_BUCKETS:
+                    fn(arr, np.zeros((qb, d), np.float32), mask,
+                       self.lane.norms)
+
+    def _program(self, k_fetch: int, mxu_bf16: bool = False):
+        from ..ops.similarity import topk_program
+
+        return topk_program(
+            k_fetch, batched=True, use_pallas=self.use_pallas,
+            mxu_bf16=self.mxu_bf16 or mxu_bf16, block_n=self.block_n,
+            fused=self.fused, interpret=self.interpret)
+
+    # -- request gathering -------------------------------------------------
+
+    def _gather_requests(self) -> list[_Request]:
+        """Drain stage: discover labelled rows, parse params, gather
+        query vectors torn-safely.  Rows mid-write stay labelled and
+        retry next drain; rows with malformed params or no query
+        vector get an error result immediately (they can never
+        succeed, so retrying would spin)."""
+        st = self.store
+        rows = st.enumerate_indices(P.LBL_SEARCH_REQ)
+        if not rows:
+            return []
+        out: list[_Request] = []
+        rows_a = np.asarray(rows, np.uint32)
+        vecs, eps = st.vec_gather(rows_a)
+        for j, idx in enumerate(rows):
+            idx = int(idx)
+            e = int(eps[j])
+            if eps[j] == Store.GATHER_TORN:
+                continue                      # writer active: next drain
+            labels = st.labels_at(idx)
+            if not labels & P.LBL_SEARCH_REQ:
+                continue                      # serviced by a peer drain
+            stamp = None
+            if labels & P.LBL_TRACED:
+                stamp = P.consume_trace_stamp(st, idx, epoch=e)
+            try:
+                raw = st.get_at(idx)
+            except (KeyError, OSError):
+                continue
+            if st.epoch_at(idx) != e:
+                continue                      # torn: retried next wake
+            self.stats.requests += 1
+            try:
+                req = json.loads(raw.rstrip(b"\0"))
+                k = int(req["k"])
+                if k <= 0:
+                    raise ValueError("k must be positive")
+                bloom = int(req.get("bloom", 0))
+                fast = bool(req.get("fast", False))
+            except (ValueError, KeyError, TypeError):
+                self._fail(idx, e, "bad request params")
+                continue
+            qvec = vecs[j]
+            if not np.abs(qvec).max() > 0:
+                self._fail(idx, e, "no query vector in request slot")
+                continue
+            out.append(_Request(idx, e, k, bloom, fast, qvec, stamp))
+        return out
+
+    def _fail(self, idx: int, epoch: int, err: str) -> None:
+        self.stats.parse_errors += 1
+        self._commit_result(idx, epoch, {"err": err})
+
+    # -- masks -------------------------------------------------------------
+
+    def _mask_for(self, bloom: int, req_rows: np.ndarray) -> np.ndarray:
+        """Candidate mask for one bloom group (the shared
+        protocol.candidate_mask definition); every CURRENT request row
+        is masked out of every group (request slots hold query vectors
+        — without this, concurrent similar queries would surface each
+        other's scratch rows at the top)."""
+        mask = P.candidate_mask(self.store, bloom)
+        mask[req_rows] = 0.0
+        return mask
+
+    # -- the drain ---------------------------------------------------------
+
+    def drain(self, *, wake_ms: float = 0.0) -> int:
+        """One drain cycle: gather -> coalesce -> dispatch -> commit.
+        Returns the number of requests served."""
+        st = self.store
+        self.stats.drains += 1
+        acc = (dict.fromkeys(P.SEARCH_STAGES, 0.0)
+               if tracer.enabled else None)
+        self._stage_acc = acc
+        if acc is not None:
+            acc["wake"] = wake_ms
+        with tracer.span("search.drain_cycle"):
+            t0 = time.perf_counter()
+            reqs = self._gather_requests()
+            if acc is not None:
+                acc["drain"] = (time.perf_counter() - t0) * 1e3
+            if not reqs:
+                # idle drains stay out of the stage histograms —
+                # quantiles must describe serviced requests, not
+                # reconciliation sweeps (drain_cycle still counts all)
+                self._stage_acc = None
+                return 0
+            if acc is not None:
+                tracer.record("search.wake", wake_ms)
+                tracer.record("search.drain", acc["drain"])
+            if self._bid >= 0:
+                try:
+                    st.shard_rebid(self._bid)
+                except OSError:
+                    pass
+            with device_profile("search"):
+                served = self._service(reqs)
+        self._end_trace(reqs)
+        self.stats.served += served
+        return served
+
+    def _service(self, reqs: list[_Request]) -> int:
+        """Score stage (lane refresh + async batched dispatch), select
+        stage (the one blocking device fetch), commit stage (result
+        rows + label clears)."""
+        acc = self._stage_acc
+        t0 = time.perf_counter()
+        full0 = self.lane.full_uploads
+        arr = self.lane.refresh()
+        self.stats.full_refreshes += self.lane.full_uploads - full0
+        req_rows = np.asarray([r.idx for r in reqs], np.int64)
+
+        # group by (bloom prefilter, bf16 flag) — the kernel mask and
+        # the matmul precision are shared across a batch — bucket each
+        # group's queries, dispatch ALL batches before fetching any:
+        # jax's async dispatch queues them on the device back to back
+        batches = []           # (requests, k_fetch, pending (s, i))
+        groups: dict[tuple, list[_Request]] = {}
+        for r in reqs:
+            groups.setdefault((r.bloom, r.fast), []).append(r)
+        # one mask per BLOOM value: the fast/exact split shares it, and
+        # the default mask's O(nslots) epochs() snapshot runs once per
+        # drain, not once per precision group
+        masks = {bloom: self._mask_for(bloom, req_rows)
+                 for bloom in {b for b, _ in groups}}
+        for (bloom, fast), group in groups.items():
+            mask = masks[bloom]
+            lo = 0
+            for qb in _qb_chunks(len(group)):
+                chunk = group[lo: lo + qb]
+                lo += len(chunk)
+                # clamped to the lane: an oversized client k (or the
+                # CLI's x8 growth crossing nslots) must cost a smaller
+                # fetch, never a top_k(k > rows) trace error that
+                # poison-pills the drain
+                k_fetch = min(
+                    _k_bucket(max(r.k for r in chunk) + K_CUSHION),
+                    self.store.nslots)
+                q = np.zeros((qb, self.store.vec_dim), np.float32)
+                for i, r in enumerate(chunk):
+                    q[i] = r.qvec
+                fn = self._program(k_fetch, mxu_bf16=fast)
+                pend = fn(arr, q, mask, self.lane.norms)
+                self.stats.dispatches += 1
+                self.stats.coalesced_max = max(
+                    self.stats.coalesced_max, len(chunk))
+                batches.append((chunk, k_fetch, pend))
+        t1 = time.perf_counter()
+        if acc is not None:
+            acc["score"] = (t1 - t0) * 1e3
+            tracer.record("search.score", acc["score"])
+
+        # select: ONE combined fetch for every batch's (scores, idx)
+        import jax
+        fetched = jax.device_get([p for _, _, p in batches])
+        t2 = time.perf_counter()
+        if acc is not None:
+            acc["select"] = (t2 - t1) * 1e3
+            tracer.record("search.select", acc["select"])
+
+        served = 0
+        for (chunk, k_fetch, _), (s_all, i_all) in zip(batches, fetched):
+            for i, r in enumerate(chunk):
+                served += self._commit_hits(
+                    r, np.asarray(s_all[i]), np.asarray(i_all[i]),
+                    k_fetch)
+        t3 = time.perf_counter()
+        if acc is not None:
+            acc["commit"] = (t3 - t2) * 1e3
+            tracer.record("search.commit", acc["commit"])
+        return served
+
+    # -- commit ------------------------------------------------------------
+
+    def _commit_hits(self, r: _Request, scores: np.ndarray,
+                     idxs: np.ndarray, k_fetch: int) -> int:
+        """Filter one request's fetched candidates (valid score, live
+        key, not a system/scratch row) down to its k and commit."""
+        st = self.store
+        n_valid = 0
+        out_s, out_i, out_k = [], [], []
+        for score, idx in zip(scores, idxs):
+            if score <= -1e29 or idx < 0:
+                break                         # sorted desc: filler next
+            n_valid += 1
+            if len(out_s) >= r.k:
+                continue                      # n_valid still counts
+            key = st.key_at(int(idx))
+            if key is None or key.startswith("__"):
+                continue                      # system/scratch rows
+            out_s.append(round(float(score), 6))
+            out_i.append(int(idx))
+            out_k.append(key)
+        rec = {"s": out_s, "i": out_i, "keys": out_k,
+               "fetched": int(min(k_fetch, st.nslots)), "n": n_valid}
+        return self._commit_result(r.idx, r.epoch, rec)
+
+    def _commit_result(self, idx: int, epoch: int, rec: dict) -> int:
+        """Epoch-gated result commit: write __sr_<idx>, clear the
+        request labels, bump — but ONLY if the request slot is
+        unchanged since the gather (a client racing a rewrite must
+        get the NEW query serviced, not the old result)."""
+        st = self.store
+        if st.epoch_at(idx) != epoch:
+            self.stats.raced += 1
+            return 0
+        key = st.key_at(idx)
+        if key is None:
+            return 0
+        rec = dict(rec)
+        rkey = P.search_result_key(idx)
+        # an oversized result halves its hit list until it fits —
+        # fewer candidates beat a request wedged forever
+        # (publish_trace_ring's degradation discipline)
+        while True:
+            try:
+                st.set(rkey, json.dumps(rec))
+                break
+            except OSError:
+                if not rec.get("s"):
+                    rec = {"err": "result too large for store max_val"}
+                    try:
+                        st.set(rkey, json.dumps(rec))
+                    except OSError:
+                        return 0
+                    break
+                half = max(len(rec["s"]) // 2, 0)
+                rec["s"] = rec["s"][:half]
+                rec["i"] = rec["i"][:half]
+                rec["keys"] = rec["keys"][:half]
+                rec["truncated"] = True
+            except KeyError:
+                return 0
+        # recheck the epoch right before the label flip: the result
+        # write above took real time (size-degradation retries), and a
+        # client rewriting its slot in that window must get its NEW
+        # request serviced next drain — clearing the label here would
+        # hand it the OLD query's answer.  (The label stays set, so
+        # submit_search never reads the stale __sr_ row, and the next
+        # service overwrites it.)
+        if st.epoch_at(idx) != epoch:
+            self.stats.raced += 1
+            return 0
+        try:
+            st.label_or(rkey, P.LBL_READY)
+            st.label_clear(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+            st.bump(key)
+        except (KeyError, OSError):
+            return 0
+        return 1
+
+    # -- flight recording --------------------------------------------------
+
+    def _end_trace(self, reqs: list[_Request]) -> None:
+        acc, self._stage_acc = self._stage_acc, None
+        if acc is None:
+            return
+        stage_sum = sum(acc.values())
+        tracer.record("search.e2e", stage_sum)
+        now_wall = time.time()
+        events = [[s, round(acc[s], 3)] for s in P.SEARCH_STAGES]
+        for r in reqs:
+            if r.stamp is None:
+                continue
+            tid, ts = r.stamp
+            try:
+                key = self.store.key_at(r.idx)
+            except (KeyError, OSError):
+                key = None
+            wall = (now_wall - ts) * 1e3 if ts > 0 else stage_sum
+            self.recorder.record(tid, key, wall,
+                                 [list(e) for e in events])
+
+    # -- daemon loop -------------------------------------------------------
+
+    def run_once(self) -> int:
+        """One full drain (tests, --oneshot)."""
+        return self.drain()
+
+    def publish_stats(self) -> None:
+        """Heartbeat: JSON stats snapshot into __searcher_stats (the
+        CLI's daemon-liveness probe reads its ts; `spt metrics`
+        renders the rest).  With tracing on, the SEARCH_STAGES
+        quantiles and the flight-recorder ring ride along — same
+        section contract as the other daemons."""
+        payload = {**dataclasses.asdict(self.stats),
+                   "coalesce_ratio": round(
+                       self.stats.coalesce_ratio(), 4),
+                   "lane": self.lane.counters()}
+        if tracer.enabled:
+            P.attach_trace_sections(payload, tracer, self.recorder,
+                                    "search.")
+        P.publish_heartbeat(self.store, P.KEY_SEARCH_STATS, payload)
+        if tracer.enabled:
+            self._trace_published = P.maybe_publish_trace_ring(
+                self.store, P.KEY_SEARCH_TRACE, self.recorder,
+                self._trace_published)
+
+    def run(self, *, idle_timeout_ms: int = 100,
+            stop_after: float | None = None,
+            heartbeat_interval_s: float = 5.0) -> None:
+        """The daemon loop: block on the signal group, drain, repeat.
+        The heartbeat doubles as the liveness signal the CLI's
+        dispatch check reads, so it publishes on an interval even
+        when idle."""
+        self._running = True
+        st = self.store
+        last = st.signal_count(self.group)
+        deadline = (time.monotonic() + stop_after) if stop_after else None
+        next_beat = 0.0                       # publish immediately
+        while self._running:
+            got = st.signal_wait(self.group, last,
+                                 timeout_ms=idle_timeout_ms)
+            t_wake = time.perf_counter()
+            if got is not None:
+                last = got
+                self.stats.wakes += 1
+                if self.coalesce_window_ms > 0:
+                    time.sleep(self.coalesce_window_ms / 1e3)
+                self.drain(
+                    wake_ms=(time.perf_counter() - t_wake) * 1e3)
+            now = time.monotonic()
+            if now >= next_beat:
+                if got is None:
+                    # reconciliation on the heartbeat cadence, never
+                    # per idle timeout: a request whose pulse raced a
+                    # prior drain (or a torn row left pending) retries
+                    # here without an O(nslots) label scan every idle
+                    # wakeup
+                    self.drain()
+                self.publish_stats()
+                next_beat = now + heartbeat_interval_s
+            if deadline and now > deadline:
+                break
+
+    def stop(self) -> None:
+        self._running = False
+
+
+# -- client side -----------------------------------------------------------
+
+def daemon_live(store: Store, *, max_age_s: float = 15.0) -> bool:
+    """True when a search daemon's heartbeat is fresh enough to route
+    a query through — the CLI's dispatch probe."""
+    try:
+        raw = store.get(P.KEY_SEARCH_STATS)
+        ts = json.loads(raw.rstrip(b"\0")).get("ts", 0.0)
+    except (KeyError, OSError, ValueError, AttributeError):
+        return False
+    return (time.time() - float(ts)) < max_age_s
+
+
+def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
+                  fast: bool = False,
+                  timeout_ms: int = 2000) -> dict | None:
+    """Client side: turn `key` (whose vector lane already holds the
+    embedded query) into a search request and wait for the daemon's
+    result.  fast requests bf16 MXU scoring server-side (the CLI's
+    --fast).  Returns the result record, or None on timeout (callers
+    fall back to client-side scoring)."""
+    idx = store.find_index(key)
+    store.set(key, json.dumps({"k": int(k), "bloom": int(bloom),
+                               "fast": bool(fast)}))
+    store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+    store.bump(key)
+    deadline = time.monotonic() + timeout_ms / 1e3
+    while True:
+        if not store.labels(key) & P.LBL_SEARCH_REQ:
+            try:
+                raw = store.get(P.search_result_key(idx))
+                return json.loads(raw.rstrip(b"\0"))
+            except (KeyError, OSError, ValueError):
+                return None
+        left_ms = int((deadline - time.monotonic()) * 1e3)
+        if left_ms <= 0:
+            return None
+        store.poll(key, timeout_ms=min(left_ms, 50))
+
+
+def consume_result(store: Store, key: str) -> None:
+    """Retire a serviced request: drop the result row (the request key
+    itself is the caller's to keep or unset)."""
+    try:
+        store.unset(P.search_result_key(store.find_index(key)))
+    except (KeyError, OSError):
+        pass
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI entry: python -m libsplinter_tpu.engine.searcher --store NAME"""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="splinter-tpu search daemon (query-coalescing fused "
+                    "top-k over the store's vector lane)")
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--persistent", action="store_true")
+    ap.add_argument("--oneshot", action="store_true")
+    ap.add_argument("--fast", action="store_true",
+                    help="bf16 MXU scoring (2x kernel throughput, "
+                         "~2e-2 score precision)")
+    ap.add_argument("--coalesce-window-ms", type=float, default=0.0)
+    ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile the QB-bucketed top-k programs "
+                         "before serving")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if os.environ.get("SPTPU_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from ..utils.jaxplatform import enable_compile_cache
+    enable_compile_cache()
+    store = Store.open(args.store, persistent=args.persistent)
+    sr = Searcher(store, mxu_bf16=args.fast,
+                  coalesce_window_ms=args.coalesce_window_ms)
+    sr.attach()
+    if args.warmup:
+        t0 = time.monotonic()
+        sr.warmup()
+        log.info("warmup compiled in %.1fs", time.monotonic() - t0)
+    if args.oneshot:
+        n = sr.run_once()
+        log.info("oneshot served %d searches", n)
+        return 0
+    try:
+        sr.run(idle_timeout_ms=args.idle_timeout_ms)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

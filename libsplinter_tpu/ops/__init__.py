@@ -1,6 +1,8 @@
-from .similarity import (cosine_scores, cosine_topk, cosine_topk_batch,
-                         euclidean_distances)
+from .similarity import (FUSED_K_MAX, cosine_scores, cosine_topk,
+                         cosine_topk_batch, euclidean_distances,
+                         topk_program)
 from .staged_lane import StagedLane
 
-__all__ = ["cosine_scores", "cosine_topk", "cosine_topk_batch",
-           "euclidean_distances", "StagedLane"]
+__all__ = ["FUSED_K_MAX", "cosine_scores", "cosine_topk",
+           "cosine_topk_batch", "euclidean_distances", "topk_program",
+           "StagedLane"]

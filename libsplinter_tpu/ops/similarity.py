@@ -222,20 +222,214 @@ def _topk_fn(k: int, batch: bool, use_pallas: bool, mxu_bf16: bool,
     return jax.jit(run)
 
 
-def cosine_topk(vectors, query, k: int, mask=None, *,
-                use_pallas: bool | None = None, mxu_bf16: bool = False,
-                vnorm=None, block_n: int = 1024
-                ) -> tuple[np.ndarray, np.ndarray]:
-    """Top-k most-similar rows for one query.  Returns (scores, indices),
-    scores NEG_INF-padded when fewer than k candidates exist.
-    block_n: pallas N-tile (rows of the lane resident in VMEM per grid
-    step); the default suits the 1M x 768 target, kernels-phase sweeps
-    measure alternatives."""
+# ---------------------------------------------------------------------------
+# fused streaming top-k: score + select in ONE kernel, O(k*Q) output
+# ---------------------------------------------------------------------------
+
+# Above this k the iterative in-kernel selection (k VPU passes per
+# N-tile) stops paying for the saved HBM traffic; larger k falls back
+# to the score-matrix + lax.top_k path.  The CLI's fetch-k growth
+# schedule (8, 64, 512) crosses this at its third step.
+FUSED_K_MAX = 128
+
+
+def _fused_topk_kernel(vec_ref, q_ref, qnorm_ref, mask_ref,
+                       out_s_ref, out_i_ref, *, k_pad: int,
+                       block_n: int, mxu_bf16: bool):
+    """One N-tile of the streaming top-k.
+
+    vec_ref:  (TN, D) f32 vectors tile
+    q_ref:    (Q, D)  f32 queries (replicated per block)
+    qnorm_ref:(1, Q)  f32 query L2 norms
+    mask_ref: (TN, 1) f32 1.0 = candidate, 0.0 = filtered out
+    out_s_ref:(K, Q)  f32 running top-k scores, sorted desc per query
+    out_i_ref:(K, Q)  i32 matching GLOBAL row indices (-1 = filler)
+
+    The output blocks map every grid step to block (0, 0), so they
+    stay resident in VMEM across the sequential N-tiles and act as the
+    running accumulator: each tile computes its fused cosine scores,
+    concatenates them under the accumulator, and re-selects the top
+    k_pad by k_pad max/mask passes — pure VPU reductions, no sort, no
+    (N, Q) score matrix ever leaving the chip.  Ties resolve to the
+    smallest global row index (accumulator rows come from earlier
+    tiles and precede tile rows in scan order), matching lax.top_k's
+    stable tie-break, so the fused path is rank-identical to the
+    reference score-matrix path."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_s_ref[:] = jnp.full(out_s_ref.shape, NEG_INF, jnp.float32)
+        out_i_ref[:] = jnp.full(out_i_ref.shape, -1, jnp.int32)
+
+    v = vec_ref[:]
+    if mxu_bf16:
+        dots = jnp.dot(v.astype(jnp.bfloat16),
+                       q_ref[:].astype(jnp.bfloat16).T,
+                       preferred_element_type=jnp.float32)
+    else:
+        dots = jnp.dot(v, q_ref[:].T, preferred_element_type=jnp.float32)
+    vnorm = jnp.sqrt(jnp.sum(v * v, axis=1, keepdims=True))      # (TN,1)
+    denom = jnp.maximum(vnorm * qnorm_ref[:], 1e-12)
+    cos = dots / denom
+    keep = (mask_ref[:] > 0.0) & (vnorm > 0.0)
+    scores = jnp.where(keep, cos, NEG_INF)                       # (TN,Q)
+
+    rows = (jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            + i * block_n)
+    comb_s = jnp.concatenate([out_s_ref[:], scores], axis=0)
+    comb_i = jnp.concatenate([out_i_ref[:], rows], axis=0)
+    pos = jax.lax.broadcasted_iota(jnp.int32, comb_s.shape, 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, out_s_ref.shape, 0)
+    past_end = comb_s.shape[0]
+
+    def select(j, carry):
+        # one selection pass: global max per query, first (smallest
+        # pos) occurrence wins — float-equality against the max is
+        # exact, and "first pos" is what makes ties index-stable
+        cs, acc_s, acc_i = carry
+        m = jnp.max(cs, axis=0)                                  # (Q,)
+        first = jnp.min(jnp.where(cs == m[None, :], pos, past_end),
+                        axis=0)                                  # (Q,)
+        sel = pos == first[None, :]
+        picked = jnp.sum(jnp.where(sel, comb_i, 0), axis=0)      # (Q,)
+        # candidates exhausted: the max is the NEG_INF filler — mark
+        # the index -1 (a consumed slot's stale index lives at pos 0)
+        picked = jnp.where(m > NEG_INF, picked, -1)
+        put = kpos == j
+        acc_s = jnp.where(put, m[None, :], acc_s)
+        acc_i = jnp.where(put, picked[None, :], acc_i)
+        return jnp.where(sel, NEG_INF, cs), acc_s, acc_i
+
+    _, acc_s, acc_i = jax.lax.fori_loop(
+        0, k_pad, select,
+        (comb_s,
+         jnp.full(out_s_ref.shape, NEG_INF, jnp.float32),
+         jnp.full(out_i_ref.shape, -1, jnp.int32)))
+    out_s_ref[:] = acc_s
+    out_i_ref[:] = acc_i
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_topk_fn(k: int, block_n: int, mxu_bf16: bool,
+                   interpret: bool):
+    """Compiled fused score+select program, cached per static config
+    (query count and lane shape retrace under the same jit).  Returns
+    run(vectors, queries, mask, vnorm) -> ((Q, k) scores, (Q, k)
+    GLOBAL indices), filler entries (fewer than k candidates) carry
+    score NEG_INF and index -1.  vnorm is accepted for signature
+    parity with _topk_fn and ignored — the kernel gets row norms for
+    free from the VMEM tile."""
+    k_pad = max(8, -(-k // 8) * 8)
+
+    def run(vectors, queries, mask, vnorm):
+        del vnorm
+        vectors = jnp.asarray(vectors, jnp.float32)
+        queries = jnp.asarray(queries, jnp.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        n, d = vectors.shape
+        q = queries.shape[0]
+        if mask is None:
+            mask_col = jnp.ones((n, 1), jnp.float32)
+        else:
+            mask_col = jnp.asarray(mask, jnp.float32).reshape(n, 1)
+        n_pad = -(-n // block_n) * block_n
+        q_pad = max(8, -(-q // 8) * 8)
+        d_pad = -(-d // 128) * 128
+        v = _pad_to(_pad_to(vectors, n_pad, 0), d_pad, 1)
+        qs = _pad_to(_pad_to(queries, q_pad, 0), d_pad, 1)
+        m = _pad_to(mask_col, n_pad, 0)
+        qnorm = jnp.linalg.norm(qs, axis=-1, keepdims=True).T    # (1,Qp)
+        block = min(block_n, n_pad)
+        grid = (n_pad // block,)
+        out_s, out_i = pl.pallas_call(
+            functools.partial(_fused_topk_kernel, k_pad=k_pad,
+                              block_n=block, mxu_bf16=mxu_bf16),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block, d_pad), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((q_pad, d_pad), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, q_pad), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((k_pad, q_pad), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((k_pad, q_pad), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((k_pad, q_pad), jnp.float32),
+                jax.ShapeDtypeStruct((k_pad, q_pad), jnp.int32),
+            ],
+            interpret=interpret,
+        )(v, qs, qnorm, m)
+        return out_s[:k, :q].T, out_i[:k, :q].T
+
+    return jax.jit(run)
+
+
+def topk_program(k: int, *, batched: bool = True,
+                 use_pallas: bool | None = None, mxu_bf16: bool = False,
+                 block_n: int = 1024, fused: bool | None = None,
+                 interpret: bool = False):
+    """The compiled (vectors, queries, mask, vnorm) -> (scores, indices)
+    top-k program — the surface the search daemon pre-compiles its
+    QB-bucketed batch programs from.
+
+    fused=None auto-selects: the streaming Pallas kernel whenever the
+    pallas path is on and k <= FUSED_K_MAX — the (N, Q) score matrix
+    then never exists in HBM and only O(k*Q) leaves the chip; larger k
+    (or the jnp backend) takes the score-matrix + lax.top_k path.
+    batched=False returns (k,)-shaped results for one query (legacy
+    cosine_topk contract); the fused program is always batched and the
+    wrapper slices.  interpret runs the kernel in Pallas interpret
+    mode (CPU tier-1 parity tests)."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
+    if fused is None:
+        fused = (use_pallas or interpret) and k <= FUSED_K_MAX
+    if not fused:
+        if interpret:
+            # interpret is the fused kernel's CPU test mode; the
+            # legacy fallback's CPU oracle is the jnp math
+            use_pallas = False
+        return _topk_fn(k, batched, bool(use_pallas), bool(mxu_bf16),
+                        int(block_n) if use_pallas else 1024)
+    fn = _fused_topk_fn(int(k), int(block_n), bool(mxu_bf16),
+                        bool(interpret))
+    if batched:
+        return fn
+
+    def single(vectors, queries, mask, vnorm):
+        s, i = fn(vectors, queries, mask, vnorm)
+        return s[0], i[0]
+
+    return single
+
+
+def cosine_topk(vectors, query, k: int, mask=None, *,
+                use_pallas: bool | None = None, mxu_bf16: bool = False,
+                vnorm=None, block_n: int = 1024,
+                fused: bool | None = None, interpret: bool = False
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k most-similar rows for one query.  Returns (scores, indices),
+    scores NEG_INF-padded when fewer than k candidates exist (the fused
+    path marks filler indices -1; the legacy path leaves them
+    arbitrary — filter on score, not index).
+    block_n: pallas N-tile (rows of the lane resident in VMEM per grid
+    step); the default suits the 1M x 768 target, kernels-phase sweeps
+    measure alternatives.  fused=None auto-selects the streaming
+    score+select kernel on the pallas path for k <= FUSED_K_MAX."""
     k = min(k, int(np.asarray(vectors.shape[0])))
-    fn = _topk_fn(k, False, bool(use_pallas), bool(mxu_bf16),
-                  int(block_n) if use_pallas else 1024)
+    fn = topk_program(k, batched=False, use_pallas=use_pallas,
+                      mxu_bf16=mxu_bf16, block_n=block_n, fused=fused,
+                      interpret=interpret)
     top_s, top_i = fn(vectors, query, mask, vnorm)
     # one combined fetch: device_get starts both host copies async
     # before blocking, so scores+indices cost ONE runtime round trip,
@@ -247,13 +441,13 @@ def cosine_topk(vectors, query, k: int, mask=None, *,
 def cosine_topk_batch(vectors, queries, k: int, mask=None, *,
                       use_pallas: bool | None = None,
                       mxu_bf16: bool = False, vnorm=None,
-                      block_n: int = 1024
+                      block_n: int = 1024, fused: bool | None = None,
+                      interpret: bool = False
                       ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k per query.  Returns (Q, k) scores and indices."""
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
     k = min(k, int(np.asarray(vectors.shape[0])))
-    fn = _topk_fn(k, True, bool(use_pallas), bool(mxu_bf16),
-                  int(block_n) if use_pallas else 1024)
+    fn = topk_program(k, batched=True, use_pallas=use_pallas,
+                      mxu_bf16=mxu_bf16, block_n=block_n, fused=fused,
+                      interpret=interpret)
     top_s, top_i = fn(vectors, queries, mask, vnorm)
     return tuple(jax.device_get((top_s, top_i)))

@@ -13,26 +13,40 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.similarity import cosine_scores
+from ..ops.similarity import FUSED_K_MAX, _fused_topk_fn, cosine_scores
 from .mesh import shard_map
 
 
 @functools.lru_cache(maxsize=64)
 def _topk_program(mesh: Mesh, axis: str, local_n: int, d: int, nq: int,
                   k_local: int, k_final: int, use_pallas: bool,
-                  mxu_bf16: bool = False):
+                  mxu_bf16: bool = False, interpret: bool = False):
     """Compiled sharded top-k, cached per (mesh, shapes, k) so repeated
     queries from a live session don't re-trace/re-compile."""
+    # pallas path: the local pass runs the STREAMING fused kernel —
+    # each shard's (local_n, Q) score matrix never exists in HBM, and
+    # only k_local candidate (score, index) pairs per shard feed the
+    # ICI merge.  The jnp fallback (CPU tests) keeps the score-matrix
+    # + lax.top_k shape, where XLA fuses it anyway.
+    fused = (use_pallas or interpret) and k_local <= FUSED_K_MAX
 
     def local_then_merge(v_local, q, m_local):
-        # local fused scores + top-k on this shard
-        scores = cosine_scores(v_local, q, m_local,
-                               use_pallas=use_pallas,
-                               mxu_bf16=mxu_bf16)
-        s, i = jax.lax.top_k(scores[:, 0], k_local)
-        # globalize indices by shard offset
+        if fused:
+            ls, li = _fused_topk_fn(k_local, 1024, mxu_bf16,
+                                    interpret)(v_local, q, m_local,
+                                               None)
+            s, i = ls[0], li[0]
+        else:
+            # local fused scores + top-k on this shard
+            scores = cosine_scores(v_local, q, m_local,
+                                   use_pallas=use_pallas,
+                                   mxu_bf16=mxu_bf16)
+            s, i = jax.lax.top_k(scores[:, 0], k_local)
+        # globalize indices by shard offset (fused-path filler rows,
+        # index -1 at score NEG_INF, stay below every real candidate
+        # in the merge and are dropped by callers' score filter)
         shard = jax.lax.axis_index(axis)
-        gi = i + shard * local_n
+        gi = jnp.where(i >= 0, i + shard * local_n, -1)
         # all-gather candidates over ICI, merge, re-top-k
         all_s = jax.lax.all_gather(s, axis)      # (m, k_local)
         all_i = jax.lax.all_gather(gi, axis)     # (m, k_local)
@@ -50,7 +64,7 @@ def _topk_program(mesh: Mesh, axis: str, local_n: int, d: int, nq: int,
 
 def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
                  axis: str = "dp", use_pallas: bool | None = None,
-                 mxu_bf16: bool = False
+                 mxu_bf16: bool = False, interpret: bool = False
                  ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k over row-sharded vectors.
 
@@ -75,7 +89,7 @@ def sharded_topk(mesh: Mesh, vectors, query, k: int, mask=None,
         query = query[None, :]
     fn = _topk_program(mesh, axis, local_n, d, query.shape[0],
                        k_local, k_final, bool(use_pallas),
-                       bool(mxu_bf16))
+                       bool(mxu_bf16), bool(interpret))
     s, i = fn(jnp.asarray(vectors, jnp.float32), query,
               jnp.asarray(mask, jnp.float32))
     return np.asarray(s), np.asarray(i)

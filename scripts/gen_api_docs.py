@@ -62,6 +62,48 @@ class Section:
 # that extend a section — kept HERE so the docs stay regenerable and
 # tests/test_api_docs.py's sync check covers them too.
 _APPENDICES = {
+    "embedding-vector-lane": """
+## Search daemon (`libsplinter_tpu/engine/searcher.py`)
+
+The query-coalescing counterpart of the embedding daemon: scoring
+moves server-side so N concurrent clients cost ceil(N / QB) fused
+top-k dispatches over the daemon's device-resident lane, not N
+private round trips.
+
+### Request contract (one slot per request)
+
+| surface | contents |
+|---|---|
+| value | JSON `{"k": int, "bloom": int?}` — result count + optional label prefilter |
+| vector lane | the query vector in the SAME slot (the embed daemon puts it there in the classic CLI flow, or write it with `spt_vec_set`) |
+| labels | `LBL_SEARCH_REQ` (bit 57) + optionally `LBL_WAITING`, then bump |
+
+The daemon drains every pending request per wake
+(signal group 4), groups by bloom mask, coalesces each group into
+QB-bucketed batches {8, 32, 256} against pre-compiled programs of the
+**fused streaming top-k kernel** (`ops/similarity.topk_program`:
+block-local select + merge in VMEM, O(k*Q) off-chip, k <=
+`FUSED_K_MAX` = 128), and commits per-request results to the
+slot-indexed companion key `__sr_<idx>`:
+
+```json
+{"s": [scores...], "i": [slot indices...], "keys": [resolved keys...],
+ "fetched": K, "n": valid_candidates}
+```
+
+sorted by similarity desc, system keys (`__` prefix — scratch rows,
+heartbeats, other requests' slots) already dropped.  The commit is
+epoch-gated: a slot rewritten mid-service is retried, never answered
+stale.  Clients poll their own request key and read the companion
+once `LBL_SEARCH_REQ` clears (`engine.searcher.submit_search` wraps
+the dance; `daemon_live` probes the `__searcher_stats` heartbeat).
+
+The CLI `search` command dispatches to a live daemon automatically
+(`--local` opts out) and falls back to client-side scoring on
+timeout.  Stage quantiles publish under the `SEARCH_STAGES` names
+(wake / drain / score / select / commit) in the heartbeat, `spt
+metrics`, and `spt trace tail` — see the diagnostics appendix.
+""",
     "diagnostics": """
 ## Observability surface (`libsplinter_tpu/obs/`)
 
@@ -104,8 +146,10 @@ servicing daemon consumes the stamp (clears key + label), appends the
 request's stage events to its flight recorder under the pinned stage
 names (`PIPELINE_STAGES` for the embedder: drain / tokenize /
 dispatch / device_wait / commit; `INFER_STAGES` for the completer:
-render / generate / commit), and publishes its ring to
-`__embedder_trace` / `__completer_trace` alongside the heartbeat.
+render / generate / commit; `SEARCH_STAGES` for the search daemon:
+wake / drain / score / select / commit), and publishes its ring to
+`__embedder_trace` / `__completer_trace` / `__searcher_trace`
+alongside the heartbeat.
 
 ```
 $ SPTPU_TRACE=1 ... ; spt trace tail 4

@@ -14,6 +14,11 @@
 #   make search-check  fused top-k tier: interpret-mode kernel parity
 #                   vs the lax.top_k reference + the search daemon's
 #                   coalescing smoke (N clients « N dispatches)
+#   make chaos-check   fault-injection tier: SPTPU_FAULT unit tests,
+#                   supervisor backoff/breaker, and the CPU-only
+#                   crash-at-every-stage recovery matrix (child
+#                   daemons crashed mid-drain via crash@k, restarted,
+#                   convergence asserted; `pytest -m chaos`)
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -37,13 +42,15 @@ quick: native
 	$(PY) -m pytest tests/test_store.py tests/test_embedder.py \
 		tests/test_cli.py -q
 
-# the full pytest sweep below already collects the search tier
-# (test_fused_topk.py + test_searcher.py); search-check stays a
-# standalone fast gate, same pattern as obs-check's `-m obs` group
+# the full sweep excludes the chaos tier, which runs once on its own
+# line (it needs JAX_PLATFORMS=cpu for the crash-matrix children and
+# would otherwise run twice); search-check/chaos-check stay standalone
+# fast gates, same pattern as obs-check's `-m obs` group
 check: native
 	$(MAKE) -C native check
 	$(PY) scripts/obs_overhead_check.py
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m "not chaos"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
 obs-check: native
 	$(PY) scripts/obs_overhead_check.py
@@ -51,6 +58,9 @@ obs-check: native
 
 search-check: native
 	$(PY) -m pytest tests/test_fused_topk.py tests/test_searcher.py -q
+
+chaos-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
 memcheck: native
 	$(MAKE) -C native memcheck
@@ -62,5 +72,5 @@ bench-cpu:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native quick check obs-check search-check memcheck \
-	bench-cpu clean
+.PHONY: all native quick check obs-check search-check chaos-check \
+	memcheck bench-cpu clean

@@ -87,10 +87,21 @@ def log(*a):
 def append_ledger(rec: dict, *, stamp: bool = True) -> dict:
     """THE ledger append (every bench entry point routes here so the
     path, timestamp format, and durability stay in one place).
-    Atomic single write + fsync: evidence must survive a later hang."""
+    Atomic single write + fsync: evidence must survive a later hang.
+
+    A run with SPTPU_FAULT armed is a chaos drill, not a performance
+    claim: the record is labeled so a before/after comparison can
+    never mistake fault-degraded numbers for a regression."""
     rec = dict(rec)
     if stamp:
         rec["ts"] = time.strftime(TS_FMT)
+    try:
+        from libsplinter_tpu.utils import faults
+        if faults.armed():
+            rec["faults_armed"] = sorted(
+                p["spec"] for p in faults.stats().values())
+    except Exception:
+        pass
     try:
         with open(RESULTS_LOG, "a") as f:
             f.write(json.dumps(rec) + "\n")

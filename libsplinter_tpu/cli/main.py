@@ -389,17 +389,32 @@ def cmd_watch(ses, args):
                 got_event = True
                 return True
 
+            vanished_at = None            # when the key went missing
             while True:
                 if not oneshot and abort_requested():
                     break
                 if report():
+                    vanished_at = None
                     if oneshot:
                         break
                     continue
                 try:
                     changed = ses.store.poll(key, bounded)
+                    vanished_at = None
                 except KeyError:
-                    break                     # key unset mid-watch
+                    # key unset mid-watch — but unset + re-create is a
+                    # legitimate transition (the new slot may be
+                    # elsewhere; report() re-resolves), and a poll
+                    # racing that tiny gap must not silently end a
+                    # continuous watch.  Linger one grace interval;
+                    # only a key that STAYS gone ends the loop.
+                    now = time.monotonic()
+                    if vanished_at is None:
+                        vanished_at = now
+                    if now - vanished_at > 0.25:
+                        break             # really deleted: watch over
+                    time.sleep(0.01)
+                    continue
                 if not changed and oneshot:
                     # a write in the window between report()'s epoch
                     # read and poll()'s baseline snapshot would be
@@ -486,7 +501,9 @@ def cmd_health(ses, args):
     # heartbeat keys are daemon-owned well-known names: NOT namespaced
     # (the daemons write the literal protocol constants)
     for label, key in (("embedder", P.KEY_EMBED_STATS),
-                       ("completer", P.KEY_COMPLETE_STATS)):
+                       ("completer", P.KEY_COMPLETE_STATS),
+                       ("searcher", P.KEY_SEARCH_STATS),
+                       ("supervisor", P.KEY_SUPERVISOR_STATS)):
         try:
             raw = st.get(key)
         except KeyError:
@@ -498,14 +515,28 @@ def cmd_health(ses, args):
         try:
             snap = json.loads(raw.rstrip(b"\0"))
             age = time.time() - snap.pop("ts", 0)
+            pid = snap.pop("pid", None)
+            dead = (isinstance(pid, int)
+                    and not P.pid_alive(pid))
             spans = snap.pop("spans", None)
-            vitals = ", ".join(f"{k}={v}" for k, v in snap.items())
-            stale = "  [STALE]" if age > 30 else ""
+            lanes = snap.pop("lanes", None)   # supervisor sections
+            vitals = ", ".join(
+                f"{k}={v}" for k, v in snap.items()
+                if not isinstance(v, (dict, list)))
+            stale = ("  [DEAD pid]" if dead
+                     else "  [STALE]" if age > 30 else "")
             print(f"{label:<14} {age:5.1f}s ago{stale}  {vitals}")
             if spans:
                 for name, s in spans.items():
                     print(f"    {name:<18} n={s['n']} "
                           f"total={s['total_ms']}ms max={s['max_ms']}ms")
+            if lanes:
+                for name, ln in lanes.items():
+                    print(f"    {name:<11} {ln.get('state', '?'):<9}"
+                          f" pid={ln.get('pid')} "
+                          f"gen={ln.get('generation')} "
+                          f"restarts={ln.get('restarts')} "
+                          f"breaker_opens={ln.get('breaker_opens')}")
         except (ValueError, AttributeError, TypeError, KeyError):
             print(f"{label:<14} unparseable heartbeat")
     live_bids = [b for b in st.bid_table() if b.pid and b.live]
@@ -625,6 +656,7 @@ from .search import cmd_search  # noqa: E402  (registers itself)
 from .ingest import cmd_ingest, cmd_export  # noqa: E402
 from .script import cmd_lua, cmd_wasm  # noqa: E402
 from .metrics import cmd_metrics, cmd_trace  # noqa: E402
+from .supervise import cmd_supervise  # noqa: E402
 
 
 # ------------------------------------------------------------------- REPL

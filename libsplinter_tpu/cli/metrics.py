@@ -86,6 +86,18 @@ def cmd_metrics(ses, args):
         lane = snap.pop("lane", None)  # searcher: StagedLane counters
         if isinstance(lane, dict):
             w.scalars(f"sptpu_{daemon}_lane", lane)
+        flt = snap.pop("faults", None)  # armed SPTPU_FAULT accounting
+        if isinstance(flt, dict):
+            for site, counts in flt.items():
+                if not isinstance(counts, dict):
+                    continue
+                for field in ("hits", "fired"):
+                    w.metric(f"sptpu_fault_{field}",
+                             counts.get(field, 0),
+                             {"daemon": daemon, "site": site},
+                             mtype="counter",
+                             help_="fault-injection site accounting "
+                                   "(SPTPU_FAULT armed)")
         for field, v in snap.items():
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
@@ -100,6 +112,37 @@ def cmd_metrics(ses, args):
             w.metric(f"sptpu_{daemon}_trace_{field}", v, mtype=(
                 "gauge" if field.endswith("_ms") else "counter"))
         w.metric(f"sptpu_{daemon}_slow_log_entries", len(slow))
+
+    # supervisor heartbeat: per-lane process state (engine/supervisor)
+    snap = _read_json(st, P.KEY_SUPERVISOR_STATS)
+    if snap is not None:
+        ts = snap.get("ts")
+        if ts:
+            w.metric("sptpu_heartbeat_age_seconds", now - ts,
+                     {"daemon": "supervisor"})
+        w.metric("sptpu_supervisor_polls", snap.get("polls", 0),
+                 mtype="counter")
+        for lane_name, ln in (snap.get("lanes") or {}).items():
+            if not isinstance(ln, dict):
+                continue
+            lab = {"lane": lane_name}
+            w.metric("sptpu_supervisor_lane_up",
+                     1 if ln.get("state") == "running" else 0, lab,
+                     help_="1 when the supervised lane is running "
+                           "with a fresh heartbeat")
+            w.metric("sptpu_supervisor_lane_down",
+                     1 if ln.get("state") == "down" else 0, lab,
+                     help_="1 when the lane's circuit breaker is "
+                           "open (clients skip dispatch)")
+            for field in ("generation", "restarts",
+                          "consecutive_crashes", "breaker_opens",
+                          "hung_kills"):
+                w.metric(f"sptpu_supervisor_lane_{field}",
+                         ln.get(field, 0), lab, mtype=(
+                             "gauge" if field == "consecutive_crashes"
+                             else "counter"))
+            w.metric("sptpu_supervisor_lane_backoff_ms",
+                     ln.get("backoff_ms", 0), lab)
 
     lane = ses._lane                  # only if a search staged one
     if lane is not None:

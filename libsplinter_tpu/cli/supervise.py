@@ -1,0 +1,93 @@
+"""CLI `supervise` — run the daemon lanes under the supervisor.
+
+`spt supervise` is the one-command serving bring-up: each requested
+lane (embedder / completer / searcher) starts as a child process and
+stays up — crashes restart with jittered exponential backoff, crash
+loops trip a circuit breaker that marks the lane down in the
+supervisor heartbeat (so `search` clients fall back to client-side
+scoring instantly instead of burning their timeout), and everything
+is observable via `spt metrics` / `spt health`.
+
+See docs/operations.md for the runbook (fault-point catalog, breaker
+semantics, what a crashed lane looks like in the metrics).
+"""
+from __future__ import annotations
+
+from .main import CliError, command
+
+
+@command("supervise",
+         "supervise [--lanes L1,L2] [--breaker-threshold N] "
+         "[--breaker-window-s S] [--breaker-cooldown-s S] "
+         "[--backoff-base-ms MS] [--heartbeat-timeout-s S] "
+         "[--poll-interval-s S] [--stop-after S] [--keep-faults] "
+         "[--lane-args LANE:ARGS...]",
+         "supervise the daemon lanes as child processes (restart on "
+         "crash with backoff; circuit breaker marks crash-looping "
+         "lanes down)")
+def cmd_supervise(ses, args):
+    import shlex
+
+    from ..engine.supervisor import LANES, Supervisor
+
+    lanes_csv = "embedder,completer,searcher"
+    # only user-set options are forwarded: Supervisor.__init__ (and
+    # Supervisor.run) stay the single source of truth for defaults
+    sup_kw: dict = {}
+    run_kw: dict = {}
+    lane_args: dict[str, list[str]] = {}
+    it = iter(args)
+
+    def arg_of(flag):
+        try:
+            return next(it)
+        except StopIteration:
+            raise CliError(f"{flag} requires a value") from None
+
+    sup_flags = {"--backoff-base-ms": ("backoff_base_ms", float),
+                 "--backoff-max-ms": ("backoff_max_ms", float),
+                 "--breaker-threshold": ("breaker_threshold", int),
+                 "--breaker-window-s": ("breaker_window_s", float),
+                 "--breaker-cooldown-s": ("breaker_cooldown_s", float),
+                 "--heartbeat-timeout-s": ("heartbeat_timeout_s",
+                                           float),
+                 "--startup-grace-s": ("startup_grace_s", float)}
+    for a in it:
+        if a == "--lanes":
+            lanes_csv = arg_of(a)
+        elif a in sup_flags:
+            name, conv = sup_flags[a]
+            sup_kw[name] = conv(arg_of(a))
+        elif a == "--poll-interval-s":
+            run_kw["poll_interval_s"] = float(arg_of(a))
+        elif a == "--stop-after":
+            run_kw["stop_after"] = float(arg_of(a))
+        elif a == "--keep-faults":
+            sup_kw["keep_faults"] = True
+        elif a == "--lane-args":
+            spec = arg_of(a)
+            lane, sep, rest = spec.partition(":")
+            if not sep or lane not in LANES:
+                raise CliError(
+                    f"--lane-args wants LANE:ARGS with LANE one of "
+                    f"{sorted(LANES)}, got {spec!r}")
+            lane_args[lane] = shlex.split(rest)
+        else:
+            raise CliError(f"unknown flag {a!r} (see `help supervise`)")
+
+    lanes = tuple(ln.strip() for ln in lanes_csv.split(",")
+                  if ln.strip())
+    bad = [ln for ln in lanes if ln not in LANES]
+    if bad:
+        raise CliError(f"unknown lanes {bad} "
+                       f"(supervisable: {sorted(LANES)})")
+    ses.store                 # fail fast if the store doesn't exist
+    sup = Supervisor(
+        ses.store_name, lanes=lanes, persistent=ses.persistent,
+        lane_args=lane_args, **sup_kw)
+    print(f"supervising {', '.join(lanes)} over {ses.store_name} "
+          "(ctrl-c stops children cleanly)")
+    try:
+        sup.run(**run_kw)
+    except KeyboardInterrupt:
+        sup.shutdown()

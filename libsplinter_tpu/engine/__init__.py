@@ -1,10 +1,12 @@
 """Serving daemons over the store's event bus: the embedding daemon
 (embedder.py), the completion daemon (completer.py), and the
 query-coalescing search daemon (searcher.py), sharing one coordination
-contract (protocol.py)."""
+contract (protocol.py) and supervised as child processes by
+supervisor.py (crash restart + circuit breaker)."""
 from . import protocol
 
-__all__ = ["protocol", "Searcher", "daemon_live", "submit_search"]
+__all__ = ["protocol", "Searcher", "daemon_live", "submit_search",
+           "Supervisor"]
 
 _SEARCHER_API = ("Searcher", "daemon_live", "submit_search")
 
@@ -16,4 +18,7 @@ def __getattr__(name):
     if name in _SEARCHER_API:
         from . import searcher
         return getattr(searcher, name)
+    if name == "Supervisor":
+        from . import supervisor
+        return supervisor.Supervisor
     raise AttributeError(name)

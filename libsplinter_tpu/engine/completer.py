@@ -40,6 +40,8 @@ from typing import Callable, Iterator
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
 from ..store import Store
+from ..utils import faults
+from ..utils.faults import fault
 from ..utils.trace import tracer
 from . import protocol as P
 
@@ -113,6 +115,8 @@ class CompleterStats:
     truncated: int = 0
     raced: int = 0
     vanished: int = 0                 # keys deleted mid-request
+    faults: int = 0                   # per-key failures the firewall ate
+    reclaimed: int = 0                # stranded SERVICING rows re-queued
 
 
 class Completer:
@@ -148,6 +152,7 @@ class Completer:
         # through the span histograms only
         self.recorder = FlightRecorder()
         self._trace_published = 0      # ring state last published
+        self.generation = 0            # bumped at attach (restart marker)
         self._bid = -1
         self._running = False
 
@@ -181,6 +186,62 @@ class Completer:
             st.bus_init()
         else:
             st.bus_open()
+        self.generation = P.bump_generation(st, P.KEY_COMPLETE_STATS)
+        self._reclaim_stranded()
+
+    def _reclaim_stranded(self) -> int:
+        """Crash recovery: a daemon that died mid-completion leaves
+        its key in SERVICING — no label watch fires for it again, so
+        without this it is wedged forever.  The completion lane has
+        one owner (the supervisor's invariant), so at attach every
+        SERVICING row is a previous generation's stranded request:
+        flip it back to WAITING and let the cold-start drain re-serve
+        it (the client sees a restarted stream, same as the
+        reference's crash story)."""
+        st = self.store
+        n = 0
+        for idx in st.enumerate_indices(P.LBL_SERVICING):
+            key = st.key_at(idx)
+            if key is None:
+                continue
+            try:
+                st.label_clear(key, P.LBL_SERVICING)
+                st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+                n += 1
+            except (KeyError, OSError):
+                continue
+        if n:
+            self.stats.reclaimed += n
+            self._debug(f"reclaimed {n} stranded SERVICING requests")
+        return n
+
+    def _requeue_failed(self, idxs: list[int]) -> int:
+        """Firewall tail for run_once: an exception escaping
+        process_key/process_batch after _prepare flipped rows to
+        SERVICING leaves them label-invisible — the sweep enumerates
+        LBL_INFER_REQ and, with the daemon still alive, the attach()
+        reclaim never runs.  Flip the failed batch's SERVICING rows
+        back to WAITING so the next sweep re-serves them instead of
+        wedging their clients until timeout."""
+        st = self.store
+        n = 0
+        for idx in idxs:
+            try:
+                if not (st.labels_at(idx) & P.LBL_SERVICING):
+                    continue
+                key = st.key_at(idx)
+                if key is None:
+                    continue
+                st.label_clear(key, P.LBL_SERVICING)
+                st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+                n += 1
+            except (KeyError, OSError):
+                continue
+        if n:
+            self.stats.reclaimed += n
+            self._debug(f"re-queued {n} SERVICING rows after a drain "
+                        "fault")
+        return n
 
     def _debug(self, msg: str) -> None:
         """Append to the shared debug log key
@@ -293,6 +354,7 @@ class Completer:
         batched/continuous paths aggregate via spans only — consuming
         HERE means no path can leave a stale stamp to corrupt a later
         request's flight record)."""
+        fault("completer.render")
         st = self.store
         if peek is None:
             peek = self._read_rendered(idx)
@@ -332,6 +394,7 @@ class Completer:
         alone — in a batch, a raising tail would strand the SIBLING
         rows in SERVICING forever — and is counted as vanished, not as
         a completion or a max_val truncation."""
+        fault("completer.commit")
         st = self.store
         if vanished:
             self.stats.vanished += 1
@@ -379,6 +442,7 @@ class Completer:
         n_tok, pending = 0, b""
         truncated = vanished = False
         try:
+            fault("completer.generate")
             for piece in self.generate_fn(rendered):
                 pending += piece
                 n_tok += 1
@@ -457,6 +521,7 @@ class Completer:
         vanished = [False] * B
         total = 0
         try:
+            fault("completer.generate")
             gen = m.generate_batch([p[2] for p in prepped], self.max_new,
                                    chunk=max(1, self.flush_tokens))
             for col in gen:           # (B,) token column per step
@@ -787,14 +852,31 @@ class Completer:
             and self.batch_cap > 1 \
             and hasattr(self._model, "prefill_batch") \
             and self._batched_budget() is not None
+        # per-key/per-batch exception firewall: generation failures are
+        # already contained inside process_key/process_batch, so
+        # anything raising through is a protocol/store-level surprise —
+        # it must cost ITS keys (any left SERVICING are flipped back to
+        # WAITING for the next sweep), never the drain's siblings or
+        # the run loop itself
         if batched:
             for lo in range(0, len(idxs), self.batch_cap):
-                n += self.process_batch(idxs[lo: lo + self.batch_cap])
+                batch = idxs[lo: lo + self.batch_cap]
+                try:
+                    n += self.process_batch(batch)
+                except Exception as ex:
+                    self.stats.faults += 1
+                    self._debug(f"batch drain failed: {ex}")
+                    self._requeue_failed(batch)
         else:
             for idx in idxs:
                 self._rebid()
-                if self.process_key(idx):
-                    n += 1
+                try:
+                    if self.process_key(idx):
+                        n += 1
+                except Exception as ex:
+                    self.stats.faults += 1
+                    self._debug(f"request at slot {idx} failed: {ex}")
+                    self._requeue_failed([idx])
         return n
 
     def publish_stats(self) -> None:
@@ -804,6 +886,9 @@ class Completer:
         it).  SPTPU_TRACE=1 adds histogram-sourced INFER_STAGES
         quantiles, recorder accounting, and the slow log."""
         payload = dataclasses.asdict(self.stats)
+        payload["generation"] = self.generation
+        if faults.armed():
+            payload["faults"] = faults.stats()
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "infer.")
@@ -829,14 +914,21 @@ class Completer:
             do_sweep = now >= next_sweep
             if do_sweep:
                 next_sweep = now + 2.0
-            if got is not None:
-                last = got
-                self.stats.wakes += 1
-                self.run_once()
-            elif do_sweep:
-                self.run_once()
-            if do_sweep:
-                self.publish_stats()
+            # loop-level firewall (run_once already contains per-key
+            # failures; this catches gather/store-level surprises)
+            try:
+                if got is not None:
+                    last = got
+                    self.stats.wakes += 1
+                    self.run_once()
+                elif do_sweep:
+                    self.run_once()
+                if do_sweep:
+                    self.publish_stats()
+            except Exception as ex:
+                self.stats.faults += 1
+                log.exception("run loop cycle failed; continuing")
+                self._debug(f"run loop cycle failed: {ex}")
             if deadline and now > deadline:
                 break
 

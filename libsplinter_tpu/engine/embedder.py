@@ -41,10 +41,17 @@ import numpy as np
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
 from ..store import Store
+from ..utils import faults
+from ..utils.faults import fault
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
 
 log = logging.getLogger("libsplinter_tpu.embedder")
+
+# a row whose encode/commit batch failed this many times is failed
+# terminally (labels cleared, client unblocked) instead of wedging the
+# degradation ladder forever
+ROW_STRIKE_LIMIT = 3
 
 # An encoder takes a list of texts and returns (B, dim) float32 vectors.
 EncoderFn = Callable[[Sequence[str]], np.ndarray]
@@ -61,6 +68,10 @@ class EmbedderStats:
     skipped_write_once: int = 0
     ctx_exceeded: int = 0
     backfilled: int = 0
+    # -- failure-domain accounting (the per-batch firewall) ----------
+    batch_faults: int = 0       # encode/commit batches that failed
+    embed_failed: int = 0       # rows failed terminally after strikes
+    drain_faults: int = 0       # run-loop cycles the firewall absorbed
     # -- commit-pipeline telemetry (the overlap is measured, not
     # asserted: bench.py's p50 stage table reads these) --------------
     futures_dispatched: int = 0
@@ -96,9 +107,15 @@ class CommitPipeline:
     """
 
     def __init__(self, commit_fn, stats: EmbedderStats, depth: int,
-                 *, stage_acc: dict | None = None):
+                 *, stage_acc: dict | None = None, on_error=None):
         self._commit = commit_fn      # (rows, epochs, f32 vecs) -> int
         self._stats = stats
+        # per-batch failure domain: (rows, epochs, exc) -> None.  With
+        # a handler armed, a batch whose materialize or commit raises
+        # fails ALONE (the handler re-queues or fails its rows) and
+        # the pipeline keeps resolving siblings; without one, the old
+        # raise-through behavior stands.
+        self._on_error = on_error
         # per-drain PIPELINE_STAGES accumulator (tracing only): the
         # resolve path adds its device_wait/commit wall here so traced
         # requests get real stage events, not re-measured estimates
@@ -157,7 +174,15 @@ class CommitPipeline:
         dwell_ms = (t0 - t_dispatch) * 1e3
         st.overlap_ms += max(
             dwell_ms - (self._blocked_ms - blocked_at_dispatch), 0.0)
-        vecs = pending.materialize()
+        try:
+            fault("embedder.encode")
+            vecs = pending.materialize()
+        except Exception as ex:
+            self._blocked_ms += (time.perf_counter() - t0) * 1e3
+            if self._on_error is None:
+                raise
+            self._on_error(rows, epochs, ex)
+            return
         t1 = time.perf_counter()
         wait_ms = (t1 - t0) * 1e3
         st.device_wait_ms += wait_ms
@@ -166,7 +191,13 @@ class CommitPipeline:
             st.ready_commits += 1
         else:
             st.blocking_waits += 1
-        self.committed += self._commit(rows, epochs, vecs)
+        try:
+            self.committed += self._commit(rows, epochs, vecs)
+        except Exception as ex:
+            if self._on_error is None:
+                raise
+            self._on_error(rows, epochs, ex)
+            return
         commit_ms = (time.perf_counter() - t1) * 1e3
         st.commit_host_ms += commit_ms
         st.futures_resolved += 1
@@ -221,6 +252,15 @@ class Embedder:
         # Raced/torn rows stay here and retry next drain — so the hot
         # path never needs the O(nslots) label scan (VERDICT r1 item 6).
         self._pending: set[int] = set()
+        # failure-domain state: a failed encode/commit batch halves
+        # the effective batch cap (the bucket) for subsequent drains —
+        # a poison batch is bisected until the bad rows stand alone —
+        # and per-row strike counts fail repeat offenders terminally
+        # (keyed by slot, scoped to the request epoch: a rewrite must
+        # not inherit the old text's strikes)
+        self._cap_degraded: int | None = None
+        self._strikes: dict[int, tuple[int, int]] = {}
+        self.generation = 0          # bumped at attach (restart marker)
         self._bid = -1
         self._running = False
 
@@ -255,6 +295,7 @@ class Embedder:
             st.bus_init()
         else:
             st.bus_open()
+        self.generation = P.bump_generation(st, P.KEY_EMBED_STATS)
         self._baseline_existing()
         # cold start: pre-existing requests enter the pending set once
         # (reference drains pre-existing WAITING keys on startup,
@@ -307,11 +348,12 @@ class Embedder:
         (serial llama.cpp decode); a naive batch pays every text the
         LONGEST text's bucket.  Grouping keeps short texts on narrow
         programs — most of the padding FLOPs come back."""
+        cap = self.effective_batch_cap
         bkts = self._model.buckets_for(np.asarray(lens))
         for b in np.unique(bkts):
             sel = np.nonzero(bkts == b)[0]
-            for lo in range(0, len(sel), self.batch_cap):
-                ss = sel[lo: lo + self.batch_cap]
+            for lo in range(0, len(sel), cap):
+                ss = sel[lo: lo + cap]
                 yield ss, self._model.encode_ids_async(
                     np.ascontiguousarray(ids[ss, : int(b)]),
                     np.minimum(lens[ss], b).astype(np.int32))
@@ -434,6 +476,64 @@ class Embedder:
     def inflight_depth(self, value: int) -> None:
         self._inflight_override = value
 
+    @property
+    def effective_batch_cap(self) -> int:
+        """batch_cap, halved per failed batch while the degradation
+        ladder is active (restored multiplicatively after clean
+        drains) — the poison-batch bisection bound."""
+        if self._cap_degraded is None:
+            return self.batch_cap
+        return min(self._cap_degraded, self.batch_cap)
+
+    # -- failure domains ---------------------------------------------------
+
+    def _on_batch_error(self, rows, epochs, ex: Exception) -> None:
+        """One encode/commit batch failed (XLA RESOURCE_EXHAUSTED, a
+        store commit surprise, an injected fault): halve the bucket so
+        the retry bisects toward the poison row, strike each row, and
+        fail rows past the strike limit terminally.  Surviving rows
+        stay in the pending set — the next drain retries them at the
+        degraded cap; the run loop itself never sees the exception."""
+        self.stats.batch_faults += 1
+        cap = self._cap_degraded or min(self.batch_cap, len(rows))
+        self._cap_degraded = max(1, cap // 2)
+        log.warning("encode batch of %d failed (%s); batch cap "
+                    "degraded to %d", len(rows), ex,
+                    self._cap_degraded)
+        for idx, epoch in zip(rows, epochs):
+            idx, epoch = int(idx), int(epoch)
+            prev_epoch, n = self._strikes.get(idx, (epoch, 0))
+            if prev_epoch != epoch:
+                n = 0                 # rewritten since: clean slate
+            self._strikes[idx] = (epoch, n + 1)
+            if n + 1 >= ROW_STRIKE_LIMIT:
+                self._mark_embed_failed(idx, epoch)
+
+    def _mark_embed_failed(self, idx: int, epoch: int) -> None:
+        """Terminal per-row failure: clear the request labels and bump
+        so a blocked client unblocks (it finds no vector and degrades
+        client-side) instead of waiting out its timeout against a row
+        that will never embed.  Epoch-gated like every other terminal
+        path: a client rewrite racing the final strike must keep ITS
+        request — the new epoch re-candidates the row with a clean
+        slate instead of being silently dropped."""
+        st = self.store
+        self._strikes.pop(idx, None)
+        try:
+            if st.epoch_at(idx) != epoch:
+                return                # rewritten mid-strike: keep it
+            self.stats.embed_failed += 1
+            self._pending.discard(idx)
+            key = st.key_at(idx)
+            if key is not None:
+                st.label_clear(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+                st.bump(key)
+            self._known_epochs[idx] = st.epoch_at(idx)
+        except (KeyError, OSError):
+            pass
+        log.error("row %d failed %d encode attempts; giving up",
+                  idx, ROW_STRIKE_LIMIT)
+
     def process_rows(self, rows: list[int]) -> int:
         """Embed a set of candidate slot indices; returns committed count.
 
@@ -464,10 +564,12 @@ class Embedder:
         traced = self._begin_trace(keep, epochs)
 
         t_start = Store.now()
+        faults0 = self.stats.batch_faults
         pipe = CommitPipeline(
             lambda r, e, v: self._commit_batch(r, e, v, t_start),
             self.stats, self.inflight_depth,
-            stage_acc=self._stage_acc)
+            stage_acc=self._stage_acc,
+            on_error=self._on_batch_error)
         if len(keep) <= self.probe_batch_max:
             self.stats.probe_lane_hits += 1
             out = self._guard_rows(keep, texts, epochs)
@@ -477,6 +579,13 @@ class Embedder:
             self._drain_windowed(pipe, keep, texts, epochs)
         pipe.flush()
         self._end_trace(traced)
+        if (self._cap_degraded is not None
+                and self.stats.batch_faults == faults0):
+            # clean drain under a degraded cap: restore multiplicatively
+            # (the additive-increase analog of the halving decrease)
+            self._cap_degraded *= 2
+            if self._cap_degraded >= self.batch_cap:
+                self._cap_degraded = None
 
         self.stats.embedded += pipe.committed
         if pipe.committed and P.KEY_DONE_LANE in st:
@@ -632,10 +741,18 @@ class Embedder:
                 pipe.push([int(x) for x in rows_a[ss]],
                           [int(x) for x in eps_a[ss]], pend)
         else:
-            for slo in range(0, len(ok_rows), self.batch_cap):
-                sl = slice(slo, slo + self.batch_cap)
-                vecs = np.asarray(self.encoder_fn(ok_texts[sl]),
-                                  np.float32)
+            cap = self.effective_batch_cap
+            for slo in range(0, len(ok_rows), cap):
+                sl = slice(slo, slo + cap)
+                try:
+                    vecs = np.asarray(self.encoder_fn(ok_texts[sl]),
+                                      np.float32)
+                except Exception as ex:
+                    # a raising encoder_fn fails its slice alone (the
+                    # model path's materialize failures resolve inside
+                    # the pipeline; this is the inline-encode analog)
+                    self._on_batch_error(ok_rows[sl], ok_epochs[sl], ex)
+                    continue
                 pipe.push(ok_rows[sl], ok_epochs[sl],
                           PendingEmbeddings(vecs, len(vecs)))
         if tracer.enabled:
@@ -651,6 +768,7 @@ class Embedder:
         """Epoch-gated bulk vector commit + per-row protocol tail
         (labels, ctime stamp, the reference's epoch==pre+2 race check,
         splinference.cpp:275-287).  Returns the committed count."""
+        fault("embedder.commit")
         st = self.store
         committed = 0
         results = st.vec_commit_batch(
@@ -661,6 +779,7 @@ class Embedder:
         for idx, e, r in zip(ok_rows, ok_epochs, results):
             if r == 0:
                 committed += 1
+                self._strikes.pop(idx, None)  # clean commit: slate wiped
                 expected = e + 2              # our commit's epoch bump
                 key = st.key_at(idx)
                 if key is not None:
@@ -708,6 +827,7 @@ class Embedder:
         # same disjoint slice
         self._drain_t0 = time.perf_counter() if tracer.enabled else None
         with tracer.span("embed.drain_cycle"):
+            fault("embedder.drain")
             bits = st.drain_dirty()
             rows = set(st.dirty_to_indices(bits))
             rows.update(self._pending)
@@ -738,7 +858,10 @@ class Embedder:
         surfaces every update)."""
         payload = {**dataclasses.asdict(self.stats),
                    "overlap_ratio": round(self.stats.overlap_ratio(), 4),
+                   "generation": self.generation,
                    "pending": len(self._pending)}
+        if faults.armed():
+            payload["faults"] = faults.stats()
         model = getattr(self, "_model", None)
         if model is not None and hasattr(model, "compile_count"):
             payload["compile_count"] = model.compile_count()
@@ -774,16 +897,27 @@ class Embedder:
             do_sweep = now >= next_sweep
             if do_sweep:
                 next_sweep = now + sweep_interval_s
-            if got is not None:
-                last = got
-                self.stats.wakes += 1
-                self.drain(sweep=do_sweep)
-            elif do_sweep:
-                # periodic reconciliation only — an idle daemon must not
-                # walk the whole label lane on every idle timeout
-                self.drain(sweep=True)
-            if do_sweep:
-                self.publish_stats()
+            # loop-level exception firewall: per-batch failures are
+            # absorbed inside process_rows (_on_batch_error); anything
+            # reaching here is a gather/store-level surprise — log and
+            # keep serving, the run loop never unwinds
+            try:
+                if got is not None:
+                    last = got
+                    self.stats.wakes += 1
+                    self.drain(sweep=do_sweep)
+                elif do_sweep:
+                    # periodic reconciliation only — an idle daemon
+                    # must not walk the whole label lane on every idle
+                    # timeout.  A restarted daemon's first sweep also
+                    # reclaims requests a crashed predecessor stranded
+                    # (label bit set, no inflight owner).
+                    self.drain(sweep=True)
+                if do_sweep:
+                    self.publish_stats()
+            except Exception:
+                self.stats.drain_faults += 1
+                log.exception("run loop cycle failed; continuing")
             if deadline and now > deadline:
                 break
 

@@ -10,6 +10,8 @@ import json
 import os
 import time
 
+from .. import _native as N
+
 # --- bloom labels (bit masks) -------------------------------------------
 LBL_EMBED_REQ = 0x1            # "embed me" — wakes the embedding daemon
 LBL_WAITING = 0x40             # client is blocked on this key
@@ -57,6 +59,12 @@ KEY_SYSTEM_PROMPT = "__system_prompt"
 KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
 KEY_SEARCH_STATS = "__searcher_stats"
+# the supervisor's own heartbeat (engine/supervisor.py): per-lane
+# process state — pid, generation, restart/backoff/breaker counters,
+# and the breaker's down marker CLI clients consult before dispatching
+# to a lane (daemon_live checks it so a broken lane fails fast instead
+# of burning the full submit timeout)
+KEY_SUPERVISOR_STATS = "__supervisor_stats"
 SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
 # search-daemon results: one JSON row per serviced request, keyed by
 # the REQUEST's slot index (__sr_<idx>) — the client polls its request
@@ -238,8 +246,12 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
     snapshot too big for the store's max_val degrades SECTION BY
     SECTION (largest optional dict/list dropped first, marked
     truncated) so whatever telemetry fits still lands, instead of
-    all-or-nothing removal the moment tracing is enabled."""
-    rec = {"ts": time.time(), **payload}
+    all-or-nothing removal the moment tracing is enabled.
+
+    Every heartbeat carries the publisher's pid: liveness probes
+    (heartbeat_live) kill-0 it, so a crashed daemon reads as dead the
+    moment it dies instead of after max_age_s of heartbeat decay."""
+    rec = {"ts": time.time(), "pid": os.getpid(), **payload}
     for _ in range(2 + len(payload)):
         try:
             store.set(key, json.dumps(rec))
@@ -254,6 +266,81 @@ def publish_heartbeat(store, key: str, payload: dict) -> None:
                 return
             rec.pop(max(sections, key=lambda k: len(json.dumps(rec[k]))))
             rec["truncated"] = True
+
+
+def bump_generation(store, heartbeat_key: str) -> int:
+    """Monotonic per-lane start counter, bumped at daemon attach() and
+    carried in every heartbeat: two snapshots with different
+    generations bracket a restart even when the pid was recycled.
+    Stored as a BIGUINT companion key (<heartbeat_key>_gen) so it
+    survives the daemon that bumped it.  Never raises — a full store
+    must not stop a daemon from starting (generation 0 = unknown)."""
+    gk = heartbeat_key + "_gen"
+    try:
+        if gk not in store:
+            store.set_uint(gk, 0)
+        return int(store.integer_op(gk, N.IOP_INC))
+    except (KeyError, OSError, ValueError):
+        return 0
+
+
+def pid_alive(pid: int) -> bool:
+    """Same-host liveness probe: kill-0.  EPERM means alive under
+    another uid; any lookup failure means gone."""
+    if not pid or pid < 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    return True
+
+
+def heartbeat_live(store, key: str, *, max_age_s: float = 15.0,
+                   lane: str | None = None) -> bool:
+    """THE daemon-liveness probe: a heartbeat counts as live when its
+    ts is fresh AND its publisher pid still exists AND (with `lane`
+    given) the supervisor has not marked the lane down.
+
+    The pid probe is the staleness fix: a daemon that crashed one
+    second after publishing used to read as live for max_age_s more
+    seconds, costing every client its full submit timeout before the
+    local fallback; kill-0 makes the fallback instant.  Heartbeats
+    published before the pid field existed (no "pid" key) fall back to
+    age-only — never treat an old-format heartbeat as dead."""
+    if lane is not None and lane_down(store, lane):
+        return False
+    try:
+        snap = json.loads(store.get(key).rstrip(b"\0"))
+        ts = float(snap.get("ts", 0.0))
+    except (KeyError, OSError, ValueError, AttributeError, TypeError):
+        return False
+    pid = snap.get("pid")
+    if isinstance(pid, int) and not pid_alive(pid):
+        return False
+    return (time.time() - ts) < max_age_s
+
+
+def lane_down(store, lane: str, *, max_age_s: float = 15.0) -> bool:
+    """True when a FRESH supervisor heartbeat marks `lane` down (its
+    circuit breaker is open).  Clients skip dispatch to a down lane
+    instead of burning their submit timeout against a crash loop.  A
+    stale or missing supervisor snapshot never vetoes a lane — an
+    unsupervised deployment must behave exactly as before."""
+    try:
+        snap = json.loads(
+            store.get(KEY_SUPERVISOR_STATS).rstrip(b"\0"))
+    except (KeyError, OSError, ValueError, AttributeError):
+        return False
+    try:
+        if (time.time() - float(snap.get("ts", 0.0))) >= max_age_s:
+            return False
+        info = snap.get("lanes", {}).get(lane)
+        return bool(info) and info.get("state") == "down"
+    except (TypeError, AttributeError):
+        return False
 
 
 # labels that mean "a daemon will still service (and consume the

@@ -51,6 +51,8 @@ import numpy as np
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
 from ..store import Store
+from ..utils import faults
+from ..utils.faults import fault
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
 
@@ -69,6 +71,11 @@ QB_BUCKETS = (8, 32, 256)
 # absorbs post-select drops (system keys, the requester's own row).
 K_BUCKETS = (16, 32, 64, 128)
 K_CUSHION = 4
+
+# orphaned __sr_<idx> result rows older than this are reaped by the
+# periodic sweep (a client that timed out never calls consume_result);
+# generous vs the CLI's 2 s submit timeout so no live poller races it
+RESULT_TTL_S = 120.0
 
 
 def _k_bucket(k: int) -> int:
@@ -110,6 +117,13 @@ class SearcherStats:
     parse_errors: int = 0        # malformed / vectorless requests
     raced: int = 0               # slot changed mid-service; retried
     full_refreshes: int = 0      # lane full uploads
+    # -- failure-domain accounting (the per-batch firewall) ----------
+    batch_faults: int = 0        # batches that failed and degraded
+    retried_unfused: int = 0     # recovered by the unfused retry
+    retried_single: int = 0      # requests recovered one-by-one
+    req_failures: int = 0        # requests failed with error records
+    drain_faults: int = 0        # whole drains failed by the firewall
+    results_reaped: int = 0      # orphaned __sr_ rows retired
 
     def coalesce_ratio(self) -> float:
         """Requests served per device dispatch (1.0 = no batching win;
@@ -158,6 +172,7 @@ class Searcher:
         self.coalesce_window_ms = coalesce_window_ms
         self.lane = lane or StagedLane(store)
         self.stats = SearcherStats()
+        self.generation = 0          # bumped at attach (restart marker)
         self.recorder = FlightRecorder()
         self._trace_published = 0
         self._stage_acc: dict | None = None
@@ -180,6 +195,7 @@ class Searcher:
             st.bus_init()
         else:
             st.bus_open()
+        self.generation = P.bump_generation(st, P.KEY_SEARCH_STATS)
 
     def warmup(self, ks: Sequence[int] = (10, 64)) -> None:
         """Pre-compile the QB-bucketed top-k programs against the live
@@ -221,6 +237,7 @@ class Searcher:
         retry next drain; rows with malformed params or no query
         vector get an error result immediately (they can never
         succeed, so retrying would spin)."""
+        fault("searcher.gather")
         st = self.store
         rows = st.enumerate_indices(P.LBL_SEARCH_REQ)
         if not rows:
@@ -263,8 +280,13 @@ class Searcher:
             out.append(_Request(idx, e, k, bloom, fast, qvec, stamp))
         return out
 
-    def _fail(self, idx: int, epoch: int, err: str) -> None:
-        self.stats.parse_errors += 1
+    def _fail(self, idx: int, epoch: int, err: str, *,
+              counter: str = "parse_errors") -> None:
+        """Terminal per-request failure: commit an error record and
+        clear the labels so the client unblocks immediately instead of
+        burning its timeout (parse errors and post-retry batch
+        failures share this path; `counter` says which)."""
+        setattr(self.stats, counter, getattr(self.stats, counter) + 1)
         self._commit_result(idx, epoch, {"err": err})
 
     # -- masks -------------------------------------------------------------
@@ -311,15 +333,38 @@ class Searcher:
                 except OSError:
                     pass
             with device_profile("search"):
-                served = self._service(reqs)
+                try:
+                    served = self._service(reqs)
+                except Exception as ex:
+                    # drain-level firewall: _service already contains
+                    # per-batch failures, so anything landing here
+                    # (lane refresh, mask build, an exhausted retry
+                    # budget) fails the WHOLE drain's requests with
+                    # error records — clients unblock, the run loop
+                    # never unwinds
+                    log.exception("drain failed; failing %d requests",
+                                  len(reqs))
+                    self.stats.drain_faults += 1
+                    for r in reqs:
+                        try:
+                            self._fail(r.idx, r.epoch,
+                                       f"drain failed: {ex}",
+                                       counter="req_failures")
+                        except Exception:
+                            pass      # store down too: retried next drain
+                    served = 0
         self._end_trace(reqs)
         self.stats.served += served
         return served
 
     def _service(self, reqs: list[_Request]) -> int:
         """Score stage (lane refresh + async batched dispatch), select
-        stage (the one blocking device fetch), commit stage (result
-        rows + label clears)."""
+        stage (the blocking device fetches), commit stage (result
+        rows + label clears).  Every batch is its own failure domain:
+        a batch whose dispatch or fetch raises degrades through
+        _score_degraded (unfused retry, then request-by-request) while
+        its siblings commit normally — a device failure mid-service
+        must never unwind the run loop or starve unrelated requests."""
         acc = self._stage_acc
         t0 = time.perf_counter()
         full0 = self.lane.full_uploads
@@ -331,7 +376,7 @@ class Searcher:
         # the matmul precision are shared across a batch — bucket each
         # group's queries, dispatch ALL batches before fetching any:
         # jax's async dispatch queues them on the device back to back
-        batches = []           # (requests, k_fetch, pending (s, i))
+        batches = []           # (requests, k_fetch, mask, q, pending)
         groups: dict[tuple, list[_Request]] = {}
         for r in reqs:
             groups.setdefault((r.bloom, r.fast), []).append(r)
@@ -356,36 +401,120 @@ class Searcher:
                 q = np.zeros((qb, self.store.vec_dim), np.float32)
                 for i, r in enumerate(chunk):
                     q[i] = r.qvec
-                fn = self._program(k_fetch, mxu_bf16=fast)
-                pend = fn(arr, q, mask, self.lane.norms)
+                # dispatch failures defer to the select stage's
+                # degradation ladder (pend=None) so sibling batches
+                # still queue on the device back to back
+                try:
+                    fault("searcher.dispatch")
+                    fn = self._program(k_fetch, mxu_bf16=fast)
+                    pend = fn(arr, q, mask, self.lane.norms)
+                except Exception as ex:
+                    log.warning("batch dispatch failed: %s", ex)
+                    pend = None
                 self.stats.dispatches += 1
                 self.stats.coalesced_max = max(
                     self.stats.coalesced_max, len(chunk))
-                batches.append((chunk, k_fetch, pend))
+                batches.append((chunk, k_fetch, mask, q, pend))
         t1 = time.perf_counter()
         if acc is not None:
             acc["score"] = (t1 - t0) * 1e3
             tracer.record("search.score", acc["score"])
 
-        # select: ONE combined fetch for every batch's (scores, idx)
+        # select: fetch per batch, in dispatch order (the device work
+        # was queued back to back above, so this still overlaps); a
+        # failed fetch degrades that one batch
         import jax
-        fetched = jax.device_get([p for _, _, p in batches])
+        fetched = []           # (s_all, i_all, ok_rows | None)
+        for chunk, k_fetch, mask, q, pend in batches:
+            try:
+                fault("searcher.select")
+                if pend is None:
+                    raise RuntimeError("batch dispatch failed")
+                s_all, i_all = jax.device_get(pend)
+                fetched.append((s_all, i_all, None))
+            except Exception as ex:
+                fetched.append(self._score_degraded(
+                    arr, chunk, q, mask, k_fetch, ex))
         t2 = time.perf_counter()
         if acc is not None:
             acc["select"] = (t2 - t1) * 1e3
             tracer.record("search.select", acc["select"])
 
         served = 0
-        for (chunk, k_fetch, _), (s_all, i_all) in zip(batches, fetched):
+        for (chunk, k_fetch, _m, _q, _p), (s_all, i_all, ok) in zip(
+                batches, fetched):
             for i, r in enumerate(chunk):
-                served += self._commit_hits(
-                    r, np.asarray(s_all[i]), np.asarray(i_all[i]),
-                    k_fetch)
+                if ok is not None and not ok[i]:
+                    continue       # already failed with an error record
+                try:
+                    served += self._commit_hits(
+                        r, np.asarray(s_all[i]), np.asarray(i_all[i]),
+                        k_fetch)
+                except Exception as ex:
+                    self._fail(r.idx, r.epoch,
+                               f"result commit failed: {ex}",
+                               counter="req_failures")
         t3 = time.perf_counter()
         if acc is not None:
             acc["commit"] = (t3 - t2) * 1e3
             tracer.record("search.commit", acc["commit"])
         return served
+
+    def _score_degraded(self, arr, chunk: list[_Request], q, mask,
+                        k_fetch: int, ex: Exception):
+        """The per-batch degradation ladder: a failed fused batch
+        retries UNFUSED at the same shape (the streaming kernel is the
+        newest code; the score-matrix path is the battle-tested
+        fallback), then request-by-request at the smallest QB bucket.
+        Requests that still fail get error records via _fail — fewer
+        served queries beat an unwound daemon.  Returns
+        (s_all, i_all, ok_rows); ok_rows[i] False = row i already
+        failed terminally."""
+        import jax
+
+        from ..ops.similarity import topk_program
+
+        self.stats.batch_faults += 1
+        log.warning("search batch of %d failed (%s); retrying unfused",
+                    len(chunk), ex)
+        norms = self.lane.norms
+        try:
+            fault("searcher.dispatch")
+            fn = topk_program(k_fetch, batched=True,
+                              use_pallas=self.use_pallas,
+                              mxu_bf16=False, block_n=self.block_n,
+                              fused=False, interpret=self.interpret)
+            s_all, i_all = jax.device_get(fn(arr, q, mask, norms))
+            self.stats.retried_unfused += 1
+            return s_all, i_all, None
+        except Exception as ex2:
+            log.warning("unfused retry failed (%s); degrading to "
+                        "single-query dispatches", ex2)
+        qb0 = QB_BUCKETS[0]
+        s_out = np.full((len(chunk), k_fetch), -np.inf, np.float32)
+        i_out = np.full((len(chunk), k_fetch), -1, np.int64)
+        ok = [False] * len(chunk)
+        for i, r in enumerate(chunk):
+            try:
+                fault("searcher.dispatch")
+                q1 = np.zeros((qb0, self.store.vec_dim), np.float32)
+                q1[0] = r.qvec
+                fn = topk_program(k_fetch, batched=True,
+                                  use_pallas=self.use_pallas,
+                                  mxu_bf16=False, block_n=self.block_n,
+                                  fused=False, interpret=self.interpret)
+                s1, i1 = jax.device_get(fn(arr, q1, mask, norms))
+                s_out[i], i_out[i] = s1[0], i1[0]
+                ok[i] = True
+                self.stats.retried_single += 1
+            except Exception as ex3:
+                try:
+                    self._fail(r.idx, r.epoch,
+                               f"search failed after retries: {ex3}",
+                               counter="req_failures")
+                except Exception:
+                    pass          # store down too: retried next drain
+        return s_out, i_out, ok
 
     # -- commit ------------------------------------------------------------
 
@@ -416,7 +545,11 @@ class Searcher:
         """Epoch-gated result commit: write __sr_<idx>, clear the
         request labels, bump — but ONLY if the request slot is
         unchanged since the gather (a client racing a rewrite must
-        get the NEW query serviced, not the old result)."""
+        get the NEW query serviced, not the old result).  The record
+        carries the request epoch (`e`) and a wall timestamp (`ts`):
+        the orphan sweep retires rows whose slot moved on or whose
+        client never consumed them."""
+        fault("searcher.commit")
         st = self.store
         if st.epoch_at(idx) != epoch:
             self.stats.raced += 1
@@ -425,6 +558,8 @@ class Searcher:
         if key is None:
             return 0
         rec = dict(rec)
+        rec["e"] = int(epoch)
+        rec["ts"] = round(time.time(), 3)
         rkey = P.search_result_key(idx)
         # an oversized result halves its hit list until it fits —
         # fewer candidates beat a request wedged forever
@@ -435,7 +570,8 @@ class Searcher:
                 break
             except OSError:
                 if not rec.get("s"):
-                    rec = {"err": "result too large for store max_val"}
+                    rec = {"err": "result too large for store max_val",
+                           "e": int(epoch), "ts": round(time.time(), 3)}
                     try:
                         st.set(rkey, json.dumps(rec))
                     except OSError:
@@ -494,6 +630,55 @@ class Searcher:
         """One full drain (tests, --oneshot)."""
         return self.drain()
 
+    def sweep_results(self, *, ttl_s: float = RESULT_TTL_S,
+                      now: float | None = None) -> int:
+        """Retire orphaned __sr_<idx> result rows.  A client that
+        times out never calls consume_result, and a daemon that
+        crashed mid-commit leaves rows no client is polling — without
+        a reaper they accumulate until the store is full of corpses.
+        A row is an orphan when its request slot is gone, its slot
+        epoch moved past the one the result was committed under (a
+        NEW request owns the slot; its service will write a fresh
+        row), or it outlived ttl_s.  Runs on the heartbeat cadence
+        (O(nslots) key walk — never on the wake path); a restarted
+        daemon's first sweep reclaims the previous generation's
+        leftovers.  Returns the reaped count."""
+        fault("searcher.sweep")
+        st = self.store
+        now = time.time() if now is None else now
+        pfx = P.SEARCH_RESULT_PREFIX
+        reaped = 0
+        for key in st.list():
+            if not key.startswith(pfx):
+                continue
+            try:
+                idx = int(key[len(pfx):])
+            except ValueError:
+                continue
+            try:
+                rec = json.loads(st.get(key).rstrip(b"\0"))
+            except (KeyError, OSError, ValueError):
+                continue              # unreadable now: next sweep
+            if not isinstance(rec, dict):
+                rec = {}
+            e, ts = rec.get("e"), rec.get("ts")
+            if idx >= st.nslots or st.key_at(idx) is None:
+                retire = True         # request slot gone entirely
+            elif isinstance(e, int) and st.epoch_at(idx) != e:
+                retire = True         # slot epoch moved on
+            elif isinstance(ts, (int, float)):
+                retire = (now - float(ts)) > ttl_s
+            else:
+                retire = True         # pre-TTL format: unowned legacy row
+            if retire:
+                try:
+                    st.unset(key)
+                    reaped += 1
+                except (KeyError, OSError):
+                    pass
+        self.stats.results_reaped += reaped
+        return reaped
+
     def publish_stats(self) -> None:
         """Heartbeat: JSON stats snapshot into __searcher_stats (the
         CLI's daemon-liveness probe reads its ts; `spt metrics`
@@ -503,7 +688,10 @@ class Searcher:
         payload = {**dataclasses.asdict(self.stats),
                    "coalesce_ratio": round(
                        self.stats.coalesce_ratio(), 4),
+                   "generation": self.generation,
                    "lane": self.lane.counters()}
+        if faults.armed():
+            payload["faults"] = faults.stats()
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "search.")
@@ -529,24 +717,39 @@ class Searcher:
             got = st.signal_wait(self.group, last,
                                  timeout_ms=idle_timeout_ms)
             t_wake = time.perf_counter()
-            if got is not None:
-                last = got
-                self.stats.wakes += 1
-                if self.coalesce_window_ms > 0:
-                    time.sleep(self.coalesce_window_ms / 1e3)
-                self.drain(
-                    wake_ms=(time.perf_counter() - t_wake) * 1e3)
-            now = time.monotonic()
-            if now >= next_beat:
-                if got is None:
-                    # reconciliation on the heartbeat cadence, never
-                    # per idle timeout: a request whose pulse raced a
-                    # prior drain (or a torn row left pending) retries
-                    # here without an O(nslots) label scan every idle
-                    # wakeup
-                    self.drain()
-                self.publish_stats()
-                next_beat = now + heartbeat_interval_s
+            # loop-level exception firewall: the drain already fails
+            # requests instead of raising, so anything landing here is
+            # a gather/store-level surprise — log it and keep serving
+            # (the crash-only discipline: the loop never unwinds, and
+            # a real crash is the supervisor's job to absorb)
+            try:
+                if got is not None:
+                    last = got
+                    self.stats.wakes += 1
+                    if self.coalesce_window_ms > 0:
+                        time.sleep(self.coalesce_window_ms / 1e3)
+                    self.drain(
+                        wake_ms=(time.perf_counter() - t_wake) * 1e3)
+                now = time.monotonic()
+                if now >= next_beat:
+                    if got is None:
+                        # reconciliation on the heartbeat cadence,
+                        # never per idle timeout: a request whose
+                        # pulse raced a prior drain (or a torn row
+                        # left pending) retries here without an
+                        # O(nslots) label scan every idle wakeup.  A
+                        # restarted daemon's FIRST pass through here
+                        # reclaims the stranded requests (label bit
+                        # set, no inflight owner) a crashed
+                        # predecessor left behind.
+                        self.drain()
+                    self.sweep_results()
+                    self.publish_stats()
+                    next_beat = now + heartbeat_interval_s
+            except Exception:
+                self.stats.drain_faults += 1
+                log.exception("run loop cycle failed; continuing")
+                now = time.monotonic()
             if deadline and now > deadline:
                 break
 
@@ -557,14 +760,15 @@ class Searcher:
 # -- client side -----------------------------------------------------------
 
 def daemon_live(store: Store, *, max_age_s: float = 15.0) -> bool:
-    """True when a search daemon's heartbeat is fresh enough to route
-    a query through — the CLI's dispatch probe."""
-    try:
-        raw = store.get(P.KEY_SEARCH_STATS)
-        ts = json.loads(raw.rstrip(b"\0")).get("ts", 0.0)
-    except (KeyError, OSError, ValueError, AttributeError):
-        return False
-    return (time.time() - float(ts)) < max_age_s
+    """True when a search daemon is live enough to route a query
+    through — the CLI's dispatch probe.  Heartbeat freshness alone
+    used to hold the answer for max_age_s after a crash (every client
+    then burned its full submit timeout); now the heartbeat's pid is
+    kill-0 probed, so a dead daemon reads dead instantly, and a
+    supervisor heartbeat whose breaker marked the search lane down
+    vetoes dispatch outright (protocol.heartbeat_live)."""
+    return P.heartbeat_live(store, P.KEY_SEARCH_STATS,
+                            max_age_s=max_age_s, lane="searcher")
 
 
 def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
@@ -581,6 +785,7 @@ def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
     store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
     store.bump(key)
     deadline = time.monotonic() + timeout_ms / 1e3
+    re_pulsed = False
     while True:
         if not store.labels(key) & P.LBL_SEARCH_REQ:
             try:
@@ -591,6 +796,17 @@ def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
         left_ms = int((deadline - time.monotonic()) * 1e3)
         if left_ms <= 0:
             return None
+        if not re_pulsed and left_ms * 2 <= timeout_ms:
+            # half the deadline gone with the label still set: the
+            # bump may have raced the daemon's signal_wait re-arm
+            # (the run-loop sweep narrows but cannot close that
+            # window) — one re-pulse costs a signal; silence costs
+            # the client its whole timeout plus the local fallback
+            try:
+                store.bump(key)
+            except (KeyError, OSError):
+                pass
+            re_pulsed = True
         store.poll(key, timeout_ms=min(left_ms, 50))
 
 

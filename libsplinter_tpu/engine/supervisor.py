@@ -1,0 +1,429 @@
+"""Daemon supervisor — crash-only process management for the lanes.
+
+The reference survives hostile clients because every interaction is a
+lock-free slot protocol; the daemons themselves, though, are single
+processes — one XLA RESOURCE_EXHAUSTED past the firewalls, one
+injected `crash`, one OOM kill, and a lane is gone until an operator
+notices.  This module is the missing layer of the serving fault model
+("Crash-Only Software": recovery IS startup, so make restart the
+first-class path):
+
+  - each lane (embedder / completer / searcher) runs as a CHILD
+    process (`python -m libsplinter_tpu.engine.<lane> --store ...`);
+  - the supervisor watches pids (waitpid-level truth) AND heartbeats
+    (a live pid with a stale heartbeat is a hung daemon — it gets
+    SIGKILLed and restarted, the crash-only remedy);
+  - crashes restart with jittered exponential backoff (base doubling
+    per consecutive crash, 0.5–1.5x jitter so a pod of supervisors
+    never thunders back in lockstep);
+  - a circuit breaker (N crashes inside a window) marks the lane DOWN
+    in the supervisor heartbeat instead of burning CPU on a crash
+    loop; CLI clients consult that marker (protocol.lane_down via
+    daemon_live) and skip dispatch instead of timing out.  After a
+    cooldown the breaker half-opens: one probe child — surviving
+    closes the breaker, crashing re-opens it;
+  - restart / backoff / breaker counters publish through the existing
+    obs surface (__supervisor_stats; `spt metrics` renders them).
+
+Chaos drills: when SPTPU_FAULT is set in the supervisor's
+environment, it is handed to each lane's FIRST child only and
+stripped from respawns (a drill asserts the restart recovers — an
+inherited crash@1 would re-fire in every generation and prove
+nothing).  --keep-faults opts back into inheriting, which is how you
+demo the breaker.
+
+Usage: `spt supervise` (cli/supervise.py) or
+`python -m libsplinter_tpu.engine.supervisor --store NAME`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+
+from ..store import Store
+from ..utils.faults import fault
+from . import protocol as P
+
+log = logging.getLogger("libsplinter_tpu.supervisor")
+
+# lane name -> (child module, heartbeat key).  The lane names are the
+# public vocabulary: supervisor heartbeat sections, `spt metrics`
+# labels, and protocol.lane_down all use them.
+LANES: dict[str, tuple[str, str]] = {
+    "embedder": ("libsplinter_tpu.engine.embedder", P.KEY_EMBED_STATS),
+    "completer": ("libsplinter_tpu.engine.completer",
+                  P.KEY_COMPLETE_STATS),
+    "searcher": ("libsplinter_tpu.engine.searcher", P.KEY_SEARCH_STATS),
+}
+
+
+@dataclasses.dataclass
+class LaneProc:
+    """One supervised lane's runtime state."""
+
+    name: str
+    module: str
+    heartbeat_key: str
+    proc: object | None = None
+    pid: int = 0
+    state: str = "init"          # starting|running|backoff|down
+    generation: int = 0          # spawn count
+    restarts: int = 0            # respawns after a crash/hang
+    consecutive: int = 0         # crashes since the last healthy run
+    backoff_ms: float = 0.0      # the live backoff, for the heartbeat
+    backoff_until: float = 0.0   # monotonic deadline
+    breaker_opens: int = 0
+    breaker_until: float = 0.0   # monotonic half-open probe time
+    half_open: bool = False      # probing after a breaker cooldown
+    hung_kills: int = 0          # stale-heartbeat SIGKILLs
+    last_exit: int | None = None
+    spawn_mono: float = 0.0
+    spawn_wall: float = 0.0
+    crash_times: deque = dataclasses.field(default_factory=deque)
+
+    def snapshot(self) -> dict:
+        """The per-lane heartbeat section (what `spt metrics` renders
+        and protocol.lane_down consults)."""
+        return {"state": self.state, "pid": self.pid,
+                "generation": self.generation,
+                "restarts": self.restarts,
+                "consecutive_crashes": self.consecutive,
+                "backoff_ms": round(self.backoff_ms, 1),
+                "breaker_opens": self.breaker_opens,
+                "hung_kills": self.hung_kills,
+                "last_exit": self.last_exit}
+
+
+class Supervisor:
+    """Drive with run() (blocking loop) or poll_once() (one
+    supervision step — tests and deterministic drills).
+
+    spawn_fn and clock are injectable: tests supervise dummy children
+    (no jax import) on a compressed timeline."""
+
+    def __init__(self, store_name: str, *,
+                 lanes=("embedder", "completer", "searcher"),
+                 persistent: bool = False,
+                 lane_args: dict[str, list[str]] | None = None,
+                 backoff_base_ms: float = 500.0,
+                 backoff_max_ms: float = 30_000.0,
+                 breaker_threshold: int = 5,
+                 breaker_window_s: float = 60.0,
+                 breaker_cooldown_s: float = 30.0,
+                 heartbeat_timeout_s: float = 30.0,
+                 startup_grace_s: float = 60.0,
+                 healthy_after_s: float = 30.0,
+                 keep_faults: bool = False,
+                 spawn_fn=None, clock=None,
+                 store: Store | None = None):
+        self.store_name = store_name
+        self.persistent = persistent
+        self.lane_args = lane_args or {}
+        self.backoff_base_ms = backoff_base_ms
+        self.backoff_max_ms = backoff_max_ms
+        self.breaker_threshold = breaker_threshold
+        self.breaker_window_s = breaker_window_s
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        # a fresh child pays imports + XLA compiles before its first
+        # heartbeat: the hang detector must not eat the startup
+        self.startup_grace_s = startup_grace_s
+        self.healthy_after_s = healthy_after_s
+        self.keep_faults = keep_faults
+        self._spawn_fn = spawn_fn or self._spawn_child
+        self._clock = clock or time.monotonic
+        self._rng = random.Random()
+        self.store = store or Store.open(store_name,
+                                         persistent=persistent)
+        unknown = [ln for ln in lanes if ln not in LANES]
+        if unknown:
+            raise ValueError(f"unknown lanes {unknown} "
+                             f"(supervisable: {sorted(LANES)})")
+        self.lanes = {name: LaneProc(name, *LANES[name])
+                      for name in lanes}
+        self.polls = 0
+        self._running = False
+
+    # -- spawning ----------------------------------------------------------
+
+    def _child_env(self, lane: LaneProc) -> dict:
+        env = dict(os.environ)
+        if lane.generation > 1 and not self.keep_faults:
+            # chaos-drill contract: injected faults hit the FIRST
+            # generation only; the respawn must prove clean recovery
+            env.pop("SPTPU_FAULT", None)
+        return env
+
+    def _spawn_child(self, lane: LaneProc):
+        argv = [sys.executable, "-m", lane.module,
+                "--store", self.store_name]
+        if self.persistent:
+            argv.append("--persistent")
+        argv += self.lane_args.get(lane.name, [])
+        return subprocess.Popen(argv, env=self._child_env(lane))
+
+    def _spawn(self, lane: LaneProc, now: float) -> None:
+        lane.generation += 1
+        if lane.generation > 1:
+            lane.restarts += 1
+        lane.spawn_mono = now
+        lane.spawn_wall = time.time()
+        lane.backoff_until = 0.0
+        try:
+            lane.proc = self._spawn_fn(lane)
+            lane.pid = getattr(lane.proc, "pid", 0)
+            lane.state = "starting"
+            log.info("lane %s: spawned pid %d (generation %d)",
+                     lane.name, lane.pid, lane.generation)
+        except Exception as ex:
+            # a spawn that cannot even exec counts as an instant crash
+            log.error("lane %s: spawn failed: %s", lane.name, ex)
+            lane.proc = None
+            lane.pid = 0
+            self._crashed(lane, -1, now)
+
+    # -- crash bookkeeping -------------------------------------------------
+
+    def _crashed(self, lane: LaneProc, code: int, now: float) -> None:
+        lane.proc = None
+        lane.pid = 0
+        lane.last_exit = code
+        lane.consecutive += 1
+        lane.crash_times.append(now)
+        while (lane.crash_times
+               and now - lane.crash_times[0] > self.breaker_window_s):
+            lane.crash_times.popleft()
+        log.warning("lane %s: exited %s (crash %d in window)",
+                    lane.name, code, len(lane.crash_times))
+        if (lane.half_open
+                or len(lane.crash_times) >= self.breaker_threshold):
+            # breaker: a half-open probe crashing re-opens instantly;
+            # otherwise N crashes / window trip it
+            lane.state = "down"
+            lane.half_open = False
+            lane.breaker_opens += 1
+            lane.breaker_until = now + self.breaker_cooldown_s
+            lane.crash_times.clear()
+            lane.backoff_ms = 0.0
+            log.error("lane %s: circuit breaker OPEN for %.1fs",
+                      lane.name, self.breaker_cooldown_s)
+            return
+        lane.state = "backoff"
+        base = min(self.backoff_base_ms * 2 ** (lane.consecutive - 1),
+                   self.backoff_max_ms)
+        lane.backoff_ms = base * self._rng.uniform(0.5, 1.5)
+        lane.backoff_until = now + lane.backoff_ms / 1e3
+
+    def _heartbeat_age(self, lane: LaneProc) -> float | None:
+        """Seconds since the lane's OWN child published a heartbeat;
+        None when no heartbeat from this generation exists yet."""
+        try:
+            snap = json.loads(
+                self.store.get(lane.heartbeat_key).rstrip(b"\0"))
+            ts = float(snap.get("ts", 0.0))
+        except (KeyError, OSError, ValueError, AttributeError):
+            return None
+        if ts < lane.spawn_wall:
+            return None              # a previous generation's snapshot
+        return time.time() - ts
+
+    # -- the supervision step ----------------------------------------------
+
+    def poll_once(self, now: float | None = None) -> None:
+        """One step: reap exits, enforce backoff/breaker timers, hang-
+        check heartbeats, respawn, publish."""
+        fault("supervisor.poll")
+        now = self._clock() if now is None else now
+        self.polls += 1
+        for lane in self.lanes.values():
+            if lane.proc is not None:
+                rc = lane.proc.poll()
+                if rc is not None:
+                    self._crashed(lane, rc, now)
+                else:
+                    self._watch_live(lane, now)
+            if lane.proc is None:
+                if lane.state == "down":
+                    if now >= lane.breaker_until:
+                        lane.half_open = True
+                        log.warning("lane %s: breaker half-open, "
+                                    "probing", lane.name)
+                        self._spawn(lane, now)
+                elif lane.state in ("init", "backoff"):
+                    if now >= lane.backoff_until:
+                        self._spawn(lane, now)
+        self.publish()
+
+    def _watch_live(self, lane: LaneProc, now: float) -> None:
+        age = self._heartbeat_age(lane)
+        uptime = now - lane.spawn_mono
+        if age is not None and age < self.heartbeat_timeout_s:
+            if lane.state == "starting":
+                lane.state = "running"
+            if (lane.consecutive or lane.half_open) \
+                    and uptime >= self.healthy_after_s:
+                # survived long enough: close the breaker / reset the
+                # backoff ladder
+                lane.consecutive = 0
+                lane.half_open = False
+                lane.backoff_ms = 0.0
+                lane.crash_times.clear()
+            return
+        stale = (uptime > self.startup_grace_s
+                 if age is None
+                 else age > self.heartbeat_timeout_s
+                 and uptime > self.heartbeat_timeout_s)
+        if stale:
+            # live pid, dead heartbeat: a hung daemon serves nobody —
+            # SIGKILL (crash-only: the restart path IS the recovery
+            # path) and let the normal crash machinery restart it
+            log.error("lane %s: heartbeat stale (age %s, uptime "
+                      "%.1fs) — killing pid %d", lane.name,
+                      f"{age:.1f}s" if age is not None else "never",
+                      uptime, lane.pid)
+            lane.hung_kills += 1
+            try:
+                lane.proc.kill()
+                lane.proc.wait(timeout=10)
+            except Exception:
+                pass
+            self._crashed(lane, -signal.SIGKILL, now)
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def publish(self) -> None:
+        payload = {
+            "polls": self.polls,
+            "lanes": {n: ln.snapshot()
+                      for n, ln in self.lanes.items()},
+        }
+        P.publish_heartbeat(self.store, P.KEY_SUPERVISOR_STATS, payload)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self, *, poll_interval_s: float = 0.5,
+            stop_after: float | None = None) -> None:
+        self._running = True
+        deadline = (self._clock() + stop_after) if stop_after else None
+        try:
+            while self._running:
+                try:
+                    self.poll_once()
+                except Exception:
+                    # the supervisor of the crash-safe layer must hold
+                    # itself to the same standard
+                    log.exception("supervision step failed; continuing")
+                if deadline and self._clock() > deadline:
+                    break
+                time.sleep(poll_interval_s)
+        finally:
+            self.shutdown()
+
+    def stop(self) -> None:
+        self._running = False
+
+    def shutdown(self, *, grace_s: float = 5.0) -> None:
+        """Terminate every child: SIGTERM, bounded wait, SIGKILL."""
+        for lane in self.lanes.values():
+            if lane.proc is None:
+                continue
+            try:
+                lane.proc.terminate()
+            except Exception:
+                pass
+        for lane in self.lanes.values():
+            if lane.proc is None:
+                continue
+            try:
+                lane.proc.wait(timeout=grace_s)
+            except Exception:
+                try:
+                    lane.proc.kill()
+                    lane.proc.wait(timeout=grace_s)
+                except Exception:
+                    pass
+            lane.proc = None
+            lane.pid = 0
+            lane.state = "init"
+        self.publish()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI entry: python -m libsplinter_tpu.engine.supervisor
+    --store NAME [--lanes embedder,searcher] [child flags via
+    --embedder-args/--completer-args/--searcher-args]."""
+    import argparse
+    import shlex
+
+    ap = argparse.ArgumentParser(
+        description="splinter-tpu daemon supervisor (child-process "
+                    "lanes, heartbeat+pid watch, jittered-backoff "
+                    "restart, circuit breaker)")
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--persistent", action="store_true")
+    ap.add_argument("--lanes", default="embedder,completer,searcher",
+                    help="comma-separated lanes to supervise")
+    # tunables default to None here so Supervisor.__init__ (and
+    # Supervisor.run) stay the single source of truth for defaults —
+    # only user-set flags are forwarded
+    ap.add_argument("--poll-interval-s", type=float, default=None)
+    ap.add_argument("--backoff-base-ms", type=float, default=None)
+    ap.add_argument("--backoff-max-ms", type=float, default=None)
+    ap.add_argument("--breaker-threshold", type=int, default=None,
+                    help="crashes inside --breaker-window-s that trip "
+                         "the breaker (lane marked down)")
+    ap.add_argument("--breaker-window-s", type=float, default=None)
+    ap.add_argument("--breaker-cooldown-s", type=float, default=None)
+    ap.add_argument("--heartbeat-timeout-s", type=float, default=None)
+    ap.add_argument("--startup-grace-s", type=float, default=None)
+    ap.add_argument("--stop-after", type=float, default=None)
+    ap.add_argument("--keep-faults", action="store_true",
+                    help="keep SPTPU_FAULT armed for respawned "
+                         "children too (default: first generation "
+                         "only — the chaos-drill contract)")
+    for lane in LANES:
+        ap.add_argument(f"--{lane}-args", default="",
+                        help=f"extra argv for the {lane} child "
+                             "(shell-quoted)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    lane_args = {lane: shlex.split(getattr(args, f"{lane}_args"))
+                 for lane in LANES}
+    sup_kw = {name: val for name in
+              ("backoff_base_ms", "backoff_max_ms",
+               "breaker_threshold", "breaker_window_s",
+               "breaker_cooldown_s", "heartbeat_timeout_s",
+               "startup_grace_s")
+              if (val := getattr(args, name)) is not None}
+    if args.keep_faults:
+        sup_kw["keep_faults"] = True
+    run_kw = {}
+    if args.poll_interval_s is not None:
+        run_kw["poll_interval_s"] = args.poll_interval_s
+    if args.stop_after is not None:
+        run_kw["stop_after"] = args.stop_after
+    sup = Supervisor(
+        args.store,
+        lanes=tuple(ln.strip() for ln in args.lanes.split(",")
+                    if ln.strip()),
+        persistent=args.persistent,
+        lane_args=lane_args,
+        **sup_kw)
+    try:
+        sup.run(**run_kw)
+    except KeyboardInterrupt:
+        sup.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

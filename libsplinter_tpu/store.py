@@ -22,6 +22,7 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from . import _native as N
+from .utils.faults import fault
 
 _RETRIES = 1024
 
@@ -227,6 +228,7 @@ class Store:
     # -- KV ----------------------------------------------------------------
 
     def set(self, key: str, val: bytes | str) -> None:
+        fault("store.set")
         if isinstance(val, str):
             val = val.encode()
         _retry(self._lib.spt_set, self._h, key.encode(), val, len(val),
@@ -253,6 +255,7 @@ class Store:
         _retry(self._lib.spt_unset, self._h, key.encode(), key=key)
 
     def append(self, key: str, val: bytes | str) -> None:
+        fault("store.append")
         if isinstance(val, str):
             val = val.encode()
         _retry(self._lib.spt_append, self._h, key.encode(), val, len(val),
@@ -697,6 +700,7 @@ class Store:
                          write_once: bool = False) -> np.ndarray:
         """Commit a batch of vectors gated on captured epochs.  Returns the
         per-row int32 results (0 ok / -ESTALE raced / -EEXIST skip)."""
+        fault("store.vec_commit")
         rows = np.ascontiguousarray(rows, dtype=np.uint32)
         epochs = np.ascontiguousarray(epochs, dtype=np.uint64)
         vecs = np.ascontiguousarray(vecs, dtype=np.float32)

@@ -1,4 +1,6 @@
-"""Host-side utilities (platform selection, timing helpers)."""
+"""Host-side utilities (platform selection, timing helpers, fault
+injection)."""
+from .faults import FaultInjected, fault
 from .jaxplatform import force_cpu, tpu_available
 
-__all__ = ["force_cpu", "tpu_available"]
+__all__ = ["force_cpu", "tpu_available", "fault", "FaultInjected"]

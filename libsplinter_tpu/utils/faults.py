@@ -1,0 +1,226 @@
+"""Env-driven fault injection — the chaos layer under the daemons.
+
+The serving stack's robustness claims ("a daemon dying at any
+instruction is recoverable", "a per-batch device failure fails only
+that batch") are only claims until a fault actually fires at each
+instrumented site.  This module turns `SPTPU_FAULT` into near-zero-cost
+site checks, compiled ONCE at import (and re-compilable via arm() for
+tests), in the crash-only-software tradition: the interesting failure
+is the unclean one, so `crash` is os._exit — no atexit handlers, no
+finally blocks, no flushed buffers, the closest a Python process gets
+to SIGKILL-ing itself mid-instruction.
+
+Spec (comma-separated fault points):
+
+    SPTPU_FAULT=searcher.commit:crash@3,embedder.encode:raise@p0.1
+
+    <site>:<action>@<trigger>
+
+site     dotted fault-point name; the instrumented sites are
+         enumerated in docs/operations.md (fault-point catalog)
+action   crash      os._exit(137) — SIGKILL-equivalent unclean death
+         raise      raise FaultInjected (a RuntimeError: daemons'
+                    per-batch firewalls must contain it)
+         eagain     raise store.Eagain — seqlock contention past the
+                    retry budget, the store binding's signature error
+         stall<ms>  sleep that many ms (stall250 = 250 ms): models a
+                    device hiccup / page-in storm without failing
+trigger  @N         fire on the Nth hit of the site, once
+         @N-M       fire on hits N..M inclusive (defeat retry ladders)
+         @pX        fire with probability X on each hit (X in (0, 1];
+                    deterministic under SPTPU_FAULT_SEED)
+         (omitted)  fire on every hit
+
+The disarmed check is one module-global truthiness test — cheap enough
+for the store binding's per-op hot path.  Hit/fired counters per site
+ride the daemons' heartbeats when armed (`spt metrics` renders them),
+so an operator can see which faults actually fired during a drill.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+
+_ENV = "SPTPU_FAULT"
+_ENV_SEED = "SPTPU_FAULT_SEED"
+
+# SIGKILL-style exit status (128 + 9): supervisors and tests can tell
+# an injected crash from a clean non-zero exit
+CRASH_EXIT_CODE = 137
+
+
+class FaultInjected(RuntimeError):
+    """The `raise` action.  A RuntimeError — NOT an OSError — so it
+    models the failures the store's generic handlers do not swallow
+    (XLA RESOURCE_EXHAUSTED, a bug escaping a drain): exactly what the
+    daemons' failure-domain firewalls exist to contain."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at {site}")
+        self.site = site
+
+
+class FaultSpecError(ValueError):
+    """SPTPU_FAULT could not be parsed.  Raised at arm() time — a typo
+    must fail loudly at startup, never silently disarm a chaos drill."""
+
+
+@dataclasses.dataclass
+class _Point:
+    site: str
+    action: str                 # crash | raise | eagain | stall
+    stall_ms: float = 0.0
+    lo: int = 0                 # hit-count window (1-based, inclusive);
+    hi: int = 0                 # lo == 0 means "no count trigger"
+    prob: float = 0.0           # probability per hit; 0 = not a p-trigger
+    hits: int = 0
+    fired: int = 0
+
+    def spec(self) -> str:
+        act = (f"stall{self.stall_ms:g}" if self.action == "stall"
+               else self.action)
+        if self.prob:
+            trig = f"@p{self.prob:g}"
+        elif self.lo == 0:
+            trig = ""
+        elif self.lo == self.hi:
+            trig = f"@{self.lo}"
+        else:
+            trig = f"@{self.lo}-{self.hi}"
+        return f"{self.site}:{act}{trig}"
+
+
+_PLAN: dict[str, _Point] = {}
+_LOCK = threading.Lock()
+_RNG = random.Random()
+
+
+def _parse_point(part: str) -> _Point:
+    site, sep, rest = part.partition(":")
+    site = site.strip()
+    if not sep or not site:
+        raise FaultSpecError(f"fault point {part!r}: expected "
+                             "<site>:<action>[@trigger]")
+    action, _, trig = rest.partition("@")
+    action = action.strip().lower()
+    pt = _Point(site=site, action=action)
+    if action.startswith("stall"):
+        try:
+            pt.stall_ms = float(action[len("stall"):] or 0)
+        except ValueError:
+            raise FaultSpecError(
+                f"fault point {part!r}: stall needs a millisecond "
+                "suffix (stall250)") from None
+        pt.action = "stall"
+    elif action not in ("crash", "raise", "eagain"):
+        raise FaultSpecError(
+            f"fault point {part!r}: unknown action {action!r} "
+            "(crash | raise | eagain | stall<ms>)")
+    trig = trig.strip()
+    if trig:
+        if trig.startswith("p"):
+            try:
+                pt.prob = float(trig[1:])
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault point {part!r}: bad probability") from None
+            if not 0.0 < pt.prob <= 1.0:
+                raise FaultSpecError(
+                    f"fault point {part!r}: probability must be in "
+                    "(0, 1]")
+        else:
+            lo, sep2, hi = trig.partition("-")
+            try:
+                pt.lo = int(lo)
+                pt.hi = int(hi) if sep2 else pt.lo
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault point {part!r}: bad trigger {trig!r} "
+                    "(@N, @N-M, or @pX)") from None
+            if pt.lo < 1 or pt.hi < pt.lo:
+                raise FaultSpecError(
+                    f"fault point {part!r}: hit window must be "
+                    ">= 1 and ordered")
+    return pt
+
+
+def arm(spec: str | None = None) -> int:
+    """(Re)compile the fault plan.  With spec=None, reads SPTPU_FAULT
+    from the environment — the import-time call.  Returns the number
+    of armed fault points.  An empty/missing spec disarms."""
+    global _RNG
+    if spec is None:
+        spec = os.environ.get(_ENV, "")
+    plan: dict[str, _Point] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pt = _parse_point(part)
+        plan[pt.site] = pt
+    seed = os.environ.get(_ENV_SEED)
+    with _LOCK:
+        _RNG = random.Random(int(seed) if seed else None)
+        _PLAN.clear()
+        _PLAN.update(plan)
+    return len(plan)
+
+
+def disarm() -> None:
+    with _LOCK:
+        _PLAN.clear()
+
+
+def armed() -> bool:
+    return bool(_PLAN)
+
+
+def stats() -> dict:
+    """{site: {"spec": ..., "hits": n, "fired": n}} — rides the daemon
+    heartbeats when armed, so `spt metrics` shows which fault points a
+    drill actually exercised."""
+    with _LOCK:
+        return {p.site: {"spec": p.spec(), "hits": p.hits,
+                         "fired": p.fired}
+                for p in _PLAN.values()}
+
+
+def fault(site: str) -> None:
+    """The site check.  Disarmed cost: one global truthiness test.
+    Armed but unmatched: one dict lookup.  Matched: count the hit,
+    evaluate the trigger, perform the action."""
+    if not _PLAN:
+        return
+    pt = _PLAN.get(site)
+    if pt is None:
+        return
+    with _LOCK:
+        pt.hits += 1
+        n = pt.hits
+        if pt.prob:
+            fire = _RNG.random() < pt.prob
+        elif pt.lo:
+            fire = pt.lo <= n <= pt.hi
+        else:
+            fire = True
+        if fire:
+            pt.fired += 1
+    if not fire:
+        return
+    if pt.action == "stall":
+        time.sleep(pt.stall_ms / 1e3)
+        return
+    if pt.action == "crash":
+        # unclean by design: no atexit, no finally, no flush — the
+        # closest Python gets to dying at this exact instruction
+        os._exit(CRASH_EXIT_CODE)
+    if pt.action == "eagain":
+        from ..store import Eagain
+        raise Eagain(site)
+    raise FaultInjected(site)
+
+
+arm()

@@ -191,6 +191,42 @@ native prometheus histograms (cumulative `le` buckets, edges in ms)
 plus any counter groups passed in.  `make obs-check` pins the enabled
 record path's overhead < 3% vs disabled.
 """,
+    "system-keys-user-flags": """
+## Supervision heartbeat keys (`libsplinter_tpu/engine/supervisor.py`)
+
+The daemon heartbeats (`__embedder_stats` / `__completer_stats` /
+`__searcher_stats`) carry two supervision fields beyond their
+counters:
+
+- `pid` — the publishing process.  Liveness probes
+  (`protocol.heartbeat_live`, the CLI's `daemon_live`) kill-0 it, so
+  a crashed daemon reads dead the instant it dies instead of after
+  `max_age_s` of heartbeat decay.
+- `generation` — monotonic per-lane start counter (BIGUINT companion
+  key `__<heartbeat>_gen`, bumped by `protocol.bump_generation` at
+  attach).  Two snapshots with different generations bracket a
+  restart even when the OS recycled the pid.
+
+`__supervisor_stats` is the supervisor's own heartbeat
+(`spt supervise`): per-lane process state consumed by
+`protocol.lane_down` and rendered by `spt metrics`
+(`sptpu_supervisor_lane_*`):
+
+| field | meaning |
+|---|---|
+| `state` | `starting` / `running` / `backoff` / `down` (breaker open) |
+| `pid`, `generation` | current child process, spawn count |
+| `restarts` | respawns after a crash or hung-heartbeat kill |
+| `consecutive_crashes` | backoff ladder position (0 = healthy) |
+| `backoff_ms` | the live jittered backoff |
+| `breaker_opens`, `hung_kills`, `last_exit` | breaker + exit history |
+
+A lane whose `state` is `down` is skipped by dispatching clients
+(`daemon_live` returns False without probing the lane heartbeat) —
+a crash-looping lane costs a client zero timeout.  With `SPTPU_FAULT`
+armed, heartbeats additionally carry a `faults` section (per-site
+hit/fired accounting).  Runbook: `docs/operations.md`.
+""",
 }
 
 

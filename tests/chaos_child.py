@@ -1,0 +1,54 @@
+"""Subprocess driver for the crash-at-every-stage matrix
+(test_crash_recovery.py): opens an existing store and runs one daemon
+drain with whatever SPTPU_FAULT the parent armed in the environment.
+A `crash` fault kills this process mid-drain (exit 137); the parent
+then asserts the restarted daemon + client helpers converge.
+
+Usage: python tests/chaos_child.py {searcher|embedder|completer} STORE
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# invoked by script path: the repo root is not on sys.path by default
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    role, store_name = sys.argv[1], sys.argv[2]
+    import numpy as np
+
+    from libsplinter_tpu import Store
+
+    st = Store.open(store_name)
+    if role == "searcher":
+        from libsplinter_tpu.engine.searcher import Searcher
+        sr = Searcher(st)
+        sr.attach()
+        n = sr.run_once()
+        sr.sweep_results()
+        print(f"served={n}", flush=True)
+    elif role == "embedder":
+        from libsplinter_tpu.engine.embedder import Embedder
+        emb = Embedder(st, encoder_fn=lambda ts: np.full(
+            (len(ts), st.vec_dim), 0.5, np.float32), max_ctx=64)
+        emb.attach()
+        n = emb.run_once()
+        print(f"embedded={n}", flush=True)
+    elif role == "completer":
+        from libsplinter_tpu.engine.completer import Completer
+        comp = Completer(st, generate_fn=lambda p: iter([b"pong "]),
+                         template="none")
+        comp.attach()
+        n = comp.run_once()
+        print(f"completions={n}", flush=True)
+    else:
+        raise SystemExit(f"unknown role {role!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

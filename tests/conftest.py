@@ -57,3 +57,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "obs: observability tier (histograms, flight "
         "recorder, exposition) — `make obs-check` runs these")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / crash-recovery tier "
+        "(SPTPU_FAULT, supervisor) — `make chaos-check` runs these")

@@ -488,7 +488,9 @@ def test_watch_survives_unset_recreate(cli, capsys, monkeypatch):
         for i in range(8):
             st.set(f"filler/{i}", "x")
         st.set("w", "reborn")
-        deadline = _t.monotonic() + 5.0
+        # generous vs the watcher's 100 ms poll: under full-suite load
+        # (XLA compiles saturating the box) 5 s has proven flaky
+        deadline = _t.monotonic() + 15.0
         while "6:reborn" not in _read_captured(capsys) and \
                 _t.monotonic() < deadline:
             st.bump("w")

@@ -1,0 +1,347 @@
+"""Crash-at-every-stage recovery matrix.
+
+For each instrumented fault site, a CHILD daemon process is driven
+into an os._exit(137) crash mid-drain via SPTPU_FAULT=<site>:crash@1;
+the parent then runs a fresh daemon over the same store and asserts
+the request lifecycle converges: no stuck labels, no lost committed
+epochs, no duplicate/leaked __sr_ rows, clients unblocked with
+correct results.  The supervisor acceptance test closes the loop:
+`spt supervise` observes the crash, restarts the lane, and a live
+submit_search round-trips within one backoff.
+
+The per-site matrix spawns one jax-importing child per site, so the
+bulk of it is marked slow (chaos-check runs it; tier-1 keeps the
+representative subset).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store, T_VARTEXT
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.searcher import (Searcher, consume_result,
+                                             submit_search)
+from libsplinter_tpu.utils.faults import CRASH_EXIT_CODE
+
+pytestmark = pytest.mark.chaos
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(HERE, "chaos_child.py")
+
+# every site a `crash` can fire at mid-drain, per daemon role.  The
+# store.* sites are exercised through the searcher's commit path (the
+# result write is its first store.set of the drain).
+SEARCHER_SITES = ("searcher.gather", "searcher.dispatch",
+                  "searcher.select", "searcher.commit", "store.set")
+EMBEDDER_SITES = ("embedder.drain", "embedder.encode",
+                  "embedder.commit", "store.vec_commit")
+COMPLETER_SITES = ("completer.render", "completer.generate",
+                   "completer.commit")
+
+
+@pytest.fixture
+def cstore():
+    name = f"/spt-chaos-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    st = Store.create(name, nslots=128, max_val=2048, vec_dim=16)
+    yield st
+    st.close()
+    Store.unlink(name)
+
+
+def _run_child(role: str, store_name: str, fault_spec: str,
+               timeout: float = 120.0):
+    env = dict(os.environ)
+    env["SPTPU_FAULT"] = fault_spec
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, CHILD, role, store_name],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _fill_docs(store, n, rng):
+    vecs = rng.normal(size=(n, store.vec_dim)).astype(np.float32)
+    for i in range(n):
+        store.set(f"doc/{i}", f"text {i}")
+        store.vec_set(f"doc/{i}", vecs[i])
+    return vecs
+
+
+def _stage_search_requests(store, rng, n=2, k=3):
+    keys = [f"__sqtmp_{2000 + i}" for i in range(n)]
+    for key in keys:
+        store.set(key, json.dumps({"k": k}))
+        store.vec_set(key, rng.normal(size=store.vec_dim)
+                      .astype(np.float32))
+        store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+        store.bump(key)
+    return keys
+
+
+def _assert_search_converged(store, keys):
+    """The recovery invariants: labels clear, every request answered
+    exactly once, and after consumption zero __sr_ rows remain."""
+    for key in keys:
+        assert not store.labels(key) & (P.LBL_SEARCH_REQ
+                                        | P.LBL_WAITING), key
+        rec = json.loads(store.get(
+            P.search_result_key(store.find_index(key))).rstrip(b"\0"))
+        assert rec.get("keys"), rec   # a real answer, not an error
+        assert all(k.startswith("doc/") for k in rec["keys"])
+        consume_result(store, key)
+    leaked = [k for k in store.list()
+              if k.startswith(P.SEARCH_RESULT_PREFIX)]
+    assert leaked == [], f"leaked result rows: {leaked}"
+
+
+# --------------------------------------------------- searcher matrix
+
+def _searcher_site_recovers(cstore, site):
+    rng = np.random.default_rng(17)
+    _fill_docs(cstore, 24, rng)
+    keys = _stage_search_requests(cstore, rng)
+
+    out = _run_child("searcher", cstore.name, f"{site}:crash@1")
+    assert out.returncode == CRASH_EXIT_CODE, (site, out.stderr[-800:])
+
+    # stranded state is allowed mid-crash; a restarted daemon's first
+    # drain + sweep must reclaim it all
+    sr = Searcher(cstore)
+    sr.attach()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        sr.run_once()
+        if not cstore.enumerate_indices(P.LBL_SEARCH_REQ):
+            break
+    sr.sweep_results()
+    _assert_search_converged(cstore, keys)
+    if not site.startswith("store."):
+        # the restart is visible in the generation counter (a store.*
+        # crash can fire inside attach()'s own bump, before the
+        # counter exists — the child then dies pre-generation)
+        assert sr.generation == 2
+    assert sr.generation >= 1
+
+
+def test_searcher_crash_at_commit_recovers(cstore):
+    """Tier-1 representative: the widest window (result row possibly
+    written, labels still set — the re-serve must overwrite, not
+    duplicate)."""
+    _searcher_site_recovers(cstore, "searcher.commit")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", [s for s in SEARCHER_SITES
+                                  if s != "searcher.commit"])
+def test_searcher_crash_at_site_recovers(cstore, site):
+    _searcher_site_recovers(cstore, site)
+
+
+# --------------------------------------------------- embedder matrix
+
+def _embedder_site_recovers(cstore, site):
+    for i in range(3):
+        cstore.set(f"txt/{i}", f"embed me {i}")
+        cstore.set_type(f"txt/{i}", T_VARTEXT)
+        cstore.label_or(f"txt/{i}", P.LBL_EMBED_REQ | P.LBL_WAITING)
+        cstore.bump(f"txt/{i}")
+
+    out = _run_child("embedder", cstore.name, f"{site}:crash@1")
+    assert out.returncode == CRASH_EXIT_CODE, (site, out.stderr[-800:])
+
+    from libsplinter_tpu.engine.embedder import Embedder
+    emb = Embedder(cstore, encoder_fn=lambda ts: np.full(
+        (len(ts), cstore.vec_dim), 0.5, np.float32), max_ctx=64)
+    emb.attach()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        emb.run_once()
+        if not cstore.enumerate_indices(P.LBL_EMBED_REQ):
+            break
+    for i in range(3):
+        assert not cstore.labels(f"txt/{i}") & (P.LBL_EMBED_REQ
+                                                | P.LBL_WAITING)
+        assert cstore.vec_get(f"txt/{i}")[0] == 0.5   # committed epoch
+    assert emb.generation == 2
+
+
+def test_embedder_crash_at_commit_recovers(cstore):
+    """Tier-1 representative: mid-commit death (some vectors may have
+    landed; the restart must re-baseline, not double-commit)."""
+    _embedder_site_recovers(cstore, "embedder.commit")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", [s for s in EMBEDDER_SITES
+                                  if s != "embedder.commit"])
+def test_embedder_crash_at_site_recovers(cstore, site):
+    _embedder_site_recovers(cstore, site)
+
+
+# -------------------------------------------------- completer matrix
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", COMPLETER_SITES)
+def test_completer_crash_at_site_recovers(cstore, site):
+    """A crash after the WAITING->SERVICING claim strands the key in
+    SERVICING (no label watch will ever fire for it again): the
+    restarted daemon's attach() reclaim must re-queue and serve it."""
+    cstore.set("q", "ping?")
+    cstore.label_or("q", P.LBL_INFER_REQ | P.LBL_WAITING)
+    cstore.bump("q")
+
+    out = _run_child("completer", cstore.name, f"{site}:crash@1")
+    assert out.returncode == CRASH_EXIT_CODE, (site, out.stderr[-800:])
+
+    from libsplinter_tpu.engine.completer import Completer
+    comp = Completer(cstore, generate_fn=lambda p: iter([b"pong "]),
+                     template="none")
+    comp.attach()                     # reclaims stranded SERVICING rows
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        comp.run_once()
+        if cstore.labels("q") & P.LBL_READY:
+            break
+    assert cstore.labels("q") & P.LBL_READY
+    assert not cstore.labels("q") & (P.LBL_INFER_REQ | P.LBL_SERVICING)
+    assert b"pong" in cstore.get("q")
+    if site != "completer.render":    # render dies before the claim
+        assert comp.stats.reclaimed >= 1
+
+
+def test_completer_drain_fault_requeues_servicing(cstore):
+    """An exception escaping process_key AFTER the WAITING->SERVICING
+    claim (here: an injected _finalize fault) in a LIVE daemon must not
+    wedge the key: the run_once firewall flips it back to WAITING and
+    the next sweep serves it — no crash, so the attach() reclaim never
+    gets a chance to."""
+    from libsplinter_tpu.engine.completer import Completer
+    from libsplinter_tpu.utils import faults
+
+    cstore.set("q", "ping?")
+    cstore.label_or("q", P.LBL_INFER_REQ | P.LBL_WAITING)
+    cstore.bump("q")
+    comp = Completer(cstore, generate_fn=lambda p: iter([b"pong "]),
+                     template="none")
+    comp.attach()
+    faults.arm("completer.commit:raise@1")
+    try:
+        assert comp.run_once() == 0
+    finally:
+        faults.disarm()
+    assert comp.stats.faults == 1
+    assert comp.stats.reclaimed == 1
+    assert not cstore.labels("q") & P.LBL_SERVICING
+    assert cstore.labels("q") & P.LBL_INFER_REQ
+    assert comp.run_once() == 1       # fault window passed: served
+    assert cstore.labels("q") & P.LBL_READY
+    assert b"pong" in cstore.get("q")
+
+
+# ------------------------------------------- supervisor acceptance
+
+def _supervised_search_recovers(cstore, site, monkeypatch):
+    """`spt supervise` + SPTPU_FAULT crash: the lane dies mid-drain,
+    the supervisor restarts it (fault stripped from the respawn), and
+    a live submit_search returns a correct result — within one
+    restart backoff."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    rng = np.random.default_rng(23)
+    vecs = _fill_docs(cstore, 16, rng)
+    keys = _stage_search_requests(cstore, rng)
+
+    monkeypatch.setenv("SPTPU_FAULT", f"{site}:crash@1")
+    monkeypatch.setenv("SPTPU_FORCE_CPU", "1")
+    sup = Supervisor(cstore.name, lanes=("searcher",), store=cstore,
+                     backoff_base_ms=100, backoff_max_ms=2000,
+                     breaker_threshold=8, breaker_window_s=120,
+                     startup_grace_s=300)
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 120.0})
+    t.start()
+    try:
+        qkey = "__sqtmp_live"
+        cstore.set(qkey, "placeholder")
+        cstore.vec_set(qkey, vecs[5])
+        rec = submit_search(cstore, qkey, 3, timeout_ms=90_000)
+        assert rec is not None and rec["keys"][0] == "doc/5", rec
+        consume_result(cstore, qkey)
+        ln = sup.lanes["searcher"]
+        assert ln.restarts >= 1       # the crash was observed
+        assert ln.state != "down"     # one crash never trips the breaker
+        # stranded pre-crash requests drained too; zero stuck bits
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not cstore.enumerate_indices(P.LBL_SEARCH_REQ):
+                break
+            time.sleep(0.2)
+        _assert_search_converged(cstore, keys)
+        cstore.unset(qkey)
+    finally:
+        sup.stop()
+        t.join()
+        sup.shutdown()
+
+
+def test_supervise_restores_searcher_lane(cstore, monkeypatch):
+    """Acceptance: crash at the drain's entry, supervised recovery,
+    correct answer for a request submitted AFTER the crash."""
+    _supervised_search_recovers(cstore, "searcher.gather", monkeypatch)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", [s for s in SEARCHER_SITES
+                                  if s != "searcher.gather"])
+def test_supervise_restores_searcher_lane_all_sites(cstore, site,
+                                                    monkeypatch):
+    _supervised_search_recovers(cstore, site, monkeypatch)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", EMBEDDER_SITES)
+def test_supervise_restores_embedder_lane(cstore, site, monkeypatch):
+    """The embed lane under supervision: crash mid-drain, restart,
+    and the pending embed requests all commit."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    for i in range(3):
+        cstore.set(f"txt/{i}", f"embed me {i}")
+        cstore.set_type(f"txt/{i}", T_VARTEXT)
+        cstore.label_or(f"txt/{i}", P.LBL_EMBED_REQ | P.LBL_WAITING)
+        cstore.bump(f"txt/{i}")
+
+    monkeypatch.setenv("SPTPU_FAULT", f"{site}:crash@1")
+    monkeypatch.setenv("SPTPU_FORCE_CPU", "1")
+    sup = Supervisor(cstore.name, lanes=("embedder",), store=cstore,
+                     backoff_base_ms=100, backoff_max_ms=2000,
+                     breaker_threshold=8, breaker_window_s=120,
+                     startup_grace_s=300)
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 120.0})
+    t.start()
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            labels = [cstore.labels(f"txt/{i}") for i in range(3)]
+            if not any(lb & P.LBL_EMBED_REQ for lb in labels):
+                break
+            time.sleep(0.25)
+        for i in range(3):
+            assert not cstore.labels(f"txt/{i}") & P.LBL_EMBED_REQ
+            assert np.abs(cstore.vec_get(f"txt/{i}")).max() > 0
+        assert sup.lanes["embedder"].restarts >= 1
+    finally:
+        sup.stop()
+        t.join()
+        sup.shutdown()

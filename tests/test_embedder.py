@@ -208,3 +208,99 @@ def test_fused_model_path_end_to_end(store):
     # parity: the decision matches the pure two-pass predicate
     assert not emb._too_long("a few ordinary words")
     assert emb._too_long("word " * 80)
+
+
+# ------------------------------------------------ failure domains
+
+def test_encoder_failure_degrades_and_retries(store):
+    """A raising encoder fails its batch ALONE: the drain survives,
+    the batch cap halves, and the next drain (fault cleared) retries
+    the same rows to success — clients never see the transient."""
+    calls = {"n": 0}
+
+    def flaky(texts):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient device loss")
+        return fake_encoder(texts)
+
+    emb = Embedder(store, encoder_fn=flaky, max_ctx=64, batch_cap=8)
+    emb.attach()
+    for i in range(4):
+        _request(store, f"d{i}", f"text {i}")
+    assert emb.run_once() == 0                # batch failed, absorbed
+    assert emb.stats.batch_faults == 1
+    assert emb.effective_batch_cap == 2       # halved (4-row batch)
+    for i in range(4):                        # still pending, not wedged
+        assert store.labels(f"d{i}") & P.LBL_EMBED_REQ
+    assert emb.run_once() == 4                # clean retry commits all
+    for i in range(4):
+        assert store.vec_get(f"d{i}")[2] == 1.0
+    assert emb.run_once() == 0                # idle
+    assert emb.effective_batch_cap > 2        # cap restoring
+
+
+def test_poison_row_fails_terminally_after_strikes(store):
+    """A row whose batch fails ROW_STRIKE_LIMIT times is failed
+    terminally: labels cleared + bump, so a blocked client unblocks
+    and degrades instead of waiting forever."""
+    from libsplinter_tpu.engine.embedder import ROW_STRIKE_LIMIT
+
+    def always_bad(texts):
+        raise RuntimeError("poison")
+
+    emb = Embedder(store, encoder_fn=always_bad, max_ctx=64)
+    emb.attach()
+    _request(store, "bad", "unembeddable")
+    for _ in range(ROW_STRIKE_LIMIT):
+        assert emb.run_once() == 0
+    assert emb.stats.embed_failed == 1
+    assert not store.labels("bad") & (P.LBL_EMBED_REQ | P.LBL_WAITING)
+    assert np.abs(store.vec_get("bad")).max() == 0
+    assert emb.run_once() == 0                # no respin on the corpse
+    # a rewrite re-candidates the row with a clean slate
+    good = Embedder(store, encoder_fn=fake_encoder, max_ctx=64)
+    good.attach()
+    _request(store, "bad", "now fine")
+    assert good.run_once() == 1
+    assert store.vec_get("bad")[2] == 1.0
+
+
+def test_rewrite_racing_final_strike_keeps_new_request(store, embedder):
+    """Epoch gate on the terminal strike path: a client rewrite that
+    lands while the old text's batch is failing its final strike must
+    NOT have its labels cleared — the new request stays live and
+    embeds on the next drain."""
+    from libsplinter_tpu.engine.embedder import ROW_STRIKE_LIMIT
+
+    _request(store, "r", "old text")
+    [idx] = store.enumerate_indices(P.LBL_EMBED_REQ)
+    old_epoch = store.epoch_at(idx)
+    # the rewrite lands first; the old text's batch then strikes out
+    # carrying the OLD epoch (gathered before the rewrite)
+    _request(store, "r", "new text")
+    for _ in range(ROW_STRIKE_LIMIT):
+        embedder._on_batch_error([idx], [old_epoch],
+                                 RuntimeError("poison"))
+    assert embedder.stats.embed_failed == 0   # gate held
+    assert store.labels("r") & (P.LBL_EMBED_REQ | P.LBL_WAITING)
+    assert embedder.run_once() == 1           # the NEW text embeds
+    assert store.vec_get("r")[0] == len("new text")
+
+
+def test_injected_commit_fault_contained(store):
+    """An injected store.vec_commit failure rides the same per-batch
+    firewall as an encode failure (the daemon stays up, rows retry)."""
+    from libsplinter_tpu.utils import faults
+
+    emb = Embedder(store, encoder_fn=fake_encoder, max_ctx=64)
+    emb.attach()
+    _request(store, "c1", "hello")
+    faults.arm("store.vec_commit:raise@1")
+    try:
+        assert emb.run_once() == 0
+        assert emb.stats.batch_faults == 1
+        assert emb.run_once() == 1            # fault window passed
+    finally:
+        faults.disarm()
+    assert store.vec_get("c1")[2] == 1.0

@@ -399,6 +399,144 @@ def test_cli_search_dispatches_to_daemon(store, monkeypatch):
     assert ses._lane is None
 
 
+def test_daemon_live_dead_pid_reads_dead_instantly(store):
+    """The staleness fix: a fresh heartbeat ts whose publisher pid is
+    gone must NOT hold daemon_live true for max_age_s — the CLI's
+    fallback to local scoring should be instant after a crash."""
+    import os
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    snap = {"ts": time.time(), "pid": proc.pid, "served": 0}
+    store.set(P.KEY_SEARCH_STATS, json.dumps(snap))
+    assert not daemon_live(store)
+    # same snapshot with a live pid (ours) is live
+    snap["pid"] = os.getpid()
+    store.set(P.KEY_SEARCH_STATS, json.dumps(snap))
+    assert daemon_live(store)
+    # pre-pid-format heartbeats fall back to age-only (compat)
+    store.set(P.KEY_SEARCH_STATS, json.dumps({"ts": time.time()}))
+    assert daemon_live(store)
+    store.set(P.KEY_SEARCH_STATS,
+              json.dumps({"ts": time.time() - 3600}))
+    assert not daemon_live(store)
+
+
+def test_submit_search_repulses_once_at_half_deadline(store):
+    """A pulse that races the daemon's signal_wait re-arm used to cost
+    the whole timeout; submit_search now re-bumps exactly once when
+    half the deadline is gone with the label still set."""
+    bumps = []
+    orig = store.bump
+    store.bump = lambda key: (bumps.append(key), orig(key))[1]
+    try:
+        store.set("__sqtmp_rp", "x")
+        store.vec_set("__sqtmp_rp", np.ones(store.vec_dim, np.float32))
+        rec = submit_search(store, "__sqtmp_rp", 3, timeout_ms=250)
+    finally:
+        store.bump = orig
+    assert rec is None                 # no daemon: times out
+    assert bumps.count("__sqtmp_rp") == 2   # initial + ONE re-pulse
+
+
+def test_result_ttl_sweep_reaps_orphans(store):
+    """A client that times out never consumes its __sr_ row; the
+    periodic sweep retires rows past the TTL and rows whose request
+    slot epoch moved on — and leaves live rows alone."""
+    rng = np.random.default_rng(21)
+    _fill_docs(store, 12, rng)
+    sr = Searcher(store)
+    sr.attach()
+    for name in ("__sqtmp_o1", "__sqtmp_o2", "__sqtmp_keep"):
+        _request(store, name, rng.normal(size=store.vec_dim)
+                 .astype(np.float32))
+    assert sr.run_once() == 3
+    # all three rows exist; nobody consumed them
+    rows = [k for k in store.list()
+            if k.startswith(P.SEARCH_RESULT_PREFIX)]
+    assert len(rows) == 3
+
+    # o2's slot is rewritten (a NEW request will own it): epoch moved
+    store.set("__sqtmp_o2", "brand new content")
+    assert sr.sweep_results() == 1     # only the epoch-moved row
+    assert sr.stats.results_reaped == 1
+
+    # TTL expiry: pretend 10 minutes pass — both leftovers reap
+    assert sr.sweep_results(now=time.time() + 600) == 2
+    assert not [k for k in store.list()
+                if k.startswith(P.SEARCH_RESULT_PREFIX)]
+
+    # a fresh result row within TTL with an unmoved slot survives
+    _request(store, "__sqtmp_keep",
+             rng.normal(size=store.vec_dim).astype(np.float32))
+    assert sr.run_once() == 1
+    assert sr.sweep_results() == 0
+
+
+def test_per_batch_failure_fails_only_that_batch(store):
+    """Acceptance: a device failure injected mid-_service fails only
+    the faulted batch's requests with error records; the sibling batch
+    commits normally and the daemon's loop never unwinds."""
+    from libsplinter_tpu.utils import faults
+
+    rng = np.random.default_rng(22)
+    _fill_docs(store, 16, rng)
+    marked = [f"doc/{i}" for i in (2, 5)]
+    for key in marked:
+        store.label_or(key, P.LBL_CHUNK)
+    sr = Searcher(store)
+    sr.attach()
+    q = rng.normal(size=store.vec_dim).astype(np.float32)
+    # two bloom groups -> two batches, dispatched [poison, fine].
+    # Site hit order: dispatch(b1)=1, dispatch(b2)=2, then b1's
+    # degradation ladder re-hits dispatch at 3 (unfused) and 4
+    # (per-request) — so select@1 fails b1's fetch and dispatch@3-4
+    # defeats exactly b1's ladder, leaving b2 untouched
+    _request(store, "__sqtmp_poison", q, k=3, bloom=0)
+    _request(store, "__sqtmp_fine", q, k=3, bloom=P.LBL_CHUNK)
+    faults.arm("searcher.select:raise@1,searcher.dispatch:raise@3-4")
+    try:
+        served = sr.run_once()
+    finally:
+        faults.disarm()
+    assert served == 1                 # the healthy batch committed
+    assert sr.stats.batch_faults == 1
+    assert sr.stats.req_failures == 1
+    rec_bad = _result(store, "__sqtmp_poison")
+    assert "err" in rec_bad            # failed WITH an error record
+    rec_ok = _result(store, "__sqtmp_fine")
+    assert sorted(rec_ok["keys"]) == sorted(marked)
+    for key in ("__sqtmp_poison", "__sqtmp_fine"):
+        assert not store.labels(key) & P.LBL_SEARCH_REQ
+
+
+def test_batch_failure_recovers_unfused(store):
+    """One transient device failure: the unfused retry serves the
+    batch's requests correctly — no client ever sees it."""
+    from libsplinter_tpu.utils import faults
+
+    rng = np.random.default_rng(23)
+    _fill_docs(store, 16, rng)
+    sr = Searcher(store)
+    sr.attach()
+    q = rng.normal(size=store.vec_dim).astype(np.float32)
+    _request(store, "__sqtmp_tr1", q, k=4)
+    faults.arm("searcher.select:raise@1")
+    try:
+        served = sr.run_once()
+    finally:
+        faults.disarm()
+    assert served == 1
+    assert sr.stats.retried_unfused == 1
+    lane = np.array(store.vectors)
+    ref = _dense_ref(lane, q,
+                     exclude={store.find_index("__sqtmp_tr1")})
+    rec = _result(store, "__sqtmp_tr1")
+    assert rec["i"] == list(np.argsort(-ref)[:4])
+
+
 def test_cli_search_local_flag_bypasses_daemon(store):
     """--local forces client-side scoring even with a fresh daemon
     heartbeat."""

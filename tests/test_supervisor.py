@@ -1,0 +1,253 @@
+"""Supervisor (engine/supervisor.py): crash detection, jittered
+exponential backoff, circuit breaker + half-open probe, hung-heartbeat
+kill, the chaos-drill fault-env contract, and the obs surface
+(supervisor heartbeat, `spt metrics`, protocol.lane_down /
+daemon_live veto).  Dummy children (no jax) keep this tier fast."""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.supervisor import Supervisor
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def sstore():
+    name = f"/spt-sup-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    st = Store.create(name, nslots=128, max_val=2048, vec_dim=8)
+    yield st
+    st.close()
+    Store.unlink(name)
+
+
+def _crasher(code=7):
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, "-c", f"import sys; sys.exit({code})"])
+    return spawn
+
+
+def _sleeper():
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"])
+    return spawn
+
+
+def _drain(sup, rounds, dt=0.02):
+    for _ in range(rounds):
+        sup.poll_once()
+        time.sleep(dt)
+
+
+def _poll_until(sup, cond, *, timeout=15.0, dt=0.02,
+                between=None) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.poll_once()
+        if between is not None:
+            between()
+        if cond():
+            return True
+        time.sleep(dt)
+    return False
+
+
+def test_crash_restarts_with_growing_backoff(sstore):
+    sup = Supervisor(sstore.name, lanes=("searcher",),
+                     spawn_fn=_crasher(), store=sstore,
+                     backoff_base_ms=40, backoff_max_ms=10_000,
+                     breaker_threshold=100, breaker_window_s=60)
+    try:
+        backoffs = []
+        # time-based deadline, not an iteration budget: each crash
+        # cycle pays a real interpreter spawn plus jittered backoff,
+        # so a fixed poll count is flaky on a slow box
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(backoffs) < 4:
+            sup.poll_once()
+            ln = sup.lanes["searcher"]
+            if ln.state == "backoff" and (not backoffs
+                                          or ln.backoff_ms != backoffs[-1]):
+                backoffs.append(ln.backoff_ms)
+            time.sleep(0.02)
+        ln = sup.lanes["searcher"]
+        assert ln.restarts >= 2
+        assert ln.last_exit == 7
+        assert len(backoffs) >= 4
+        # exponential growth through the jitter: crash k's backoff is
+        # base*2^(k-1)*U(0.5,1.5), so backoff[k+2] > backoff[k] always
+        for a, b in zip(backoffs, backoffs[2:]):
+            assert b > a
+    finally:
+        sup.shutdown()
+
+
+def test_breaker_opens_and_marks_lane_down(sstore):
+    sup = Supervisor(sstore.name, lanes=("searcher",),
+                     spawn_fn=_crasher(), store=sstore,
+                     backoff_base_ms=5, breaker_threshold=3,
+                     breaker_window_s=30, breaker_cooldown_s=600)
+    try:
+        ln = sup.lanes["searcher"]
+        assert _poll_until(sup, lambda: ln.state == "down")
+        assert ln.breaker_opens == 1
+        # the down marker is what CLI clients consult: lane_down True,
+        # and daemon_live refuses dispatch even with a fresh searcher
+        # heartbeat on the store
+        assert P.lane_down(sstore, "searcher")
+        P.publish_heartbeat(sstore, P.KEY_SEARCH_STATS, {"served": 0})
+        from libsplinter_tpu.engine.searcher import daemon_live
+        assert not daemon_live(sstore)
+        assert not P.lane_down(sstore, "embedder")   # only the broken lane
+    finally:
+        sup.shutdown()
+
+
+def test_breaker_half_open_probe_closes_on_health(sstore):
+    """After the cooldown the breaker spawns ONE probe child; a probe
+    that stays healthy past healthy_after_s closes the breaker."""
+    calls = {"n": 0}
+
+    def spawn(lane):
+        calls["n"] += 1
+        if calls["n"] <= 3:           # first three children crash
+            return subprocess.Popen(
+                [sys.executable, "-c", "import sys; sys.exit(9)"])
+        return subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"])
+
+    sup = Supervisor(sstore.name, lanes=("searcher",), spawn_fn=spawn,
+                     store=sstore, backoff_base_ms=5,
+                     breaker_threshold=3, breaker_window_s=30,
+                     breaker_cooldown_s=0.2, healthy_after_s=0.1,
+                     startup_grace_s=600)
+    try:
+        ln = sup.lanes["searcher"]
+        assert _poll_until(sup, lambda: ln.breaker_opens == 1)
+        # the probe child publishes nothing itself; a fresh heartbeat
+        # is what _watch_live needs to call it healthy
+        assert _poll_until(
+            sup,
+            lambda: (ln.state == "running" and not ln.half_open
+                     and ln.consecutive == 0),
+            between=lambda: P.publish_heartbeat(
+                sstore, P.KEY_SEARCH_STATS, {}),
+            dt=0.05)
+        assert ln.state == "running"
+        assert not ln.half_open
+        assert ln.consecutive == 0
+        assert not P.lane_down(sstore, "searcher")
+    finally:
+        sup.shutdown()
+
+
+def test_hung_heartbeat_gets_killed_and_restarted(sstore):
+    """A live pid with a stale heartbeat is a hung daemon: SIGKILL +
+    restart (the crash-only remedy), counted as hung_kills."""
+    sup = Supervisor(sstore.name, lanes=("embedder",),
+                     spawn_fn=_sleeper(), store=sstore,
+                     backoff_base_ms=5, breaker_threshold=50,
+                     heartbeat_timeout_s=0.2, startup_grace_s=0.2)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sup.poll_once()
+            if sup.lanes["embedder"].hung_kills >= 1:
+                break
+            time.sleep(0.05)
+        ln = sup.lanes["embedder"]
+        assert ln.hung_kills >= 1
+        assert ln.last_exit == -9     # SIGKILL, not a polite exit
+    finally:
+        sup.shutdown()
+
+
+def test_fault_env_stripped_from_respawns(sstore, monkeypatch):
+    """The chaos-drill contract: SPTPU_FAULT reaches generation 1 only
+    (a drill proves the RESTART recovers; an inherited crash@1 would
+    re-fire forever) unless keep_faults opts back in."""
+    monkeypatch.setenv("SPTPU_FAULT", "searcher.gather:crash@1")
+    sup = Supervisor(sstore.name, lanes=("searcher",),
+                     spawn_fn=_crasher(), store=sstore)
+    ln = sup.lanes["searcher"]
+    ln.generation = 1
+    assert "SPTPU_FAULT" in sup._child_env(ln)
+    ln.generation = 2
+    assert "SPTPU_FAULT" not in sup._child_env(ln)
+    keep = Supervisor(sstore.name, lanes=("searcher",),
+                      spawn_fn=_crasher(), store=sstore,
+                      keep_faults=True)
+    keep.lanes["searcher"].generation = 2
+    assert "SPTPU_FAULT" in keep._child_env(keep.lanes["searcher"])
+
+
+def test_supervisor_heartbeat_and_metrics_exposition(sstore):
+    """Restart/backoff/breaker counters publish through the existing
+    obs surface: __supervisor_stats JSON and `spt metrics`
+    Prometheus lines."""
+    sup = Supervisor(sstore.name, lanes=("searcher", "embedder"),
+                     spawn_fn=_crasher(), store=sstore,
+                     backoff_base_ms=5, breaker_threshold=3,
+                     breaker_window_s=30, breaker_cooldown_s=600)
+    try:
+        assert _poll_until(
+            sup, lambda: all(ln.state == "down"
+                             for ln in sup.lanes.values()))
+        snap = json.loads(
+            sstore.get(P.KEY_SUPERVISOR_STATS).rstrip(b"\0"))
+        assert snap["pid"] == os.getpid()
+        for lane in ("searcher", "embedder"):
+            sec = snap["lanes"][lane]
+            assert sec["state"] == "down"
+            assert sec["restarts"] >= 2
+            assert sec["breaker_opens"] == 1
+
+        from libsplinter_tpu.cli.main import COMMANDS, Session
+        ses = Session(sstore.name)
+        try:
+            fn, _, _ = COMMANDS["metrics"]
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                fn(ses, [])
+            out = buf.getvalue()
+        finally:
+            ses.close()
+        assert 'sptpu_supervisor_lane_down{lane="searcher"} 1' in out
+        assert 'sptpu_supervisor_lane_breaker_opens{lane="searcher"} 1' \
+            in out
+        assert 'sptpu_supervisor_lane_restarts{lane="embedder"}' in out
+        assert "sptpu_supervisor_polls" in out
+    finally:
+        sup.shutdown()
+
+
+def test_unknown_lane_rejected(sstore):
+    with pytest.raises(ValueError):
+        Supervisor(sstore.name, lanes=("warp-drive",), store=sstore)
+
+
+def test_shutdown_terminates_children(sstore):
+    sup = Supervisor(sstore.name, lanes=("completer",),
+                     spawn_fn=_sleeper(), store=sstore)
+    sup.poll_once()
+    pid = sup.lanes["completer"].pid
+    assert pid and P.pid_alive(pid)
+    sup.shutdown()
+    deadline = time.monotonic() + 5
+    while P.pid_alive(pid) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not P.pid_alive(pid)
+    assert sup.lanes["completer"].state == "init"

@@ -14,6 +14,11 @@
 #   make search-check  fused top-k tier: interpret-mode kernel parity
 #                   vs the lax.top_k reference + the search daemon's
 #                   coalescing smoke (N clients « N dispatches)
+#   make decode-check  paged decode tier: interpret-mode ragged
+#                   paged-attention parity vs dense flash, pool
+#                   alloc/free leak checks, the paged continuous-
+#                   batching smoke (token-exact vs dense, joiner
+#                   past the dense window), and spec-demotion (CPU)
 #   make chaos-check   fault-injection tier: SPTPU_FAULT unit tests,
 #                   supervisor backoff/breaker, and the CPU-only
 #                   crash-at-every-stage recovery matrix (child
@@ -44,8 +49,9 @@ quick: native
 
 # the full sweep excludes the chaos tier, which runs once on its own
 # line (it needs JAX_PLATFORMS=cpu for the crash-matrix children and
-# would otherwise run twice); search-check/chaos-check stay standalone
-# fast gates, same pattern as obs-check's `-m obs` group
+# would otherwise run twice); search-check/decode-check/chaos-check
+# stay standalone fast gates, same pattern as obs-check's `-m obs`
+# group — the full pytest sweep below collects their tiers too
 check: native
 	$(MAKE) -C native check
 	$(PY) scripts/obs_overhead_check.py
@@ -58,6 +64,10 @@ obs-check: native
 
 search-check: native
 	$(PY) -m pytest tests/test_fused_topk.py tests/test_searcher.py -q
+
+decode-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_attention.py \
+		tests/test_paged_continuous.py -q
 
 chaos-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
@@ -72,5 +82,5 @@ bench-cpu:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native quick check obs-check search-check chaos-check \
-	memcheck bench-cpu clean
+.PHONY: all native quick check obs-check search-check decode-check \
+	chaos-check memcheck bench-cpu clean

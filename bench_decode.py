@@ -8,8 +8,13 @@ implementation every tunnel client runs, VERDICT r3 #1):
                  reference's cadence is a serial per-token llama.cpp
                  decode with an 8-token flush, splainference.cpp:333-354;
                  vs_baseline = chunked / per-token-sync on the SAME
-                 hardware and weights)
-  decode_daemon  completion-daemon e2e + continuous serving
+                 hardware and weights), plus the paged-vs-dense KV
+                 sweep: block-paged decode at batch {8, 32, 64} inside
+                 a FIXED pool of 8 windows' pages (the r05 dense
+                 batch=8 cache HBM envelope) — ledgered under the
+                 kv_cache_dense / kv_cache_paged detail labels
+  decode_daemon  completion-daemon e2e + continuous serving (now the
+                 block-paged lane: batch_cap 32 default)
 
 Prints ONE JSON line {"metric": "decode_tokens_per_sec", ...}; every
 phase record appends to bench_results.jsonl.
@@ -17,7 +22,8 @@ phase record appends to bench_results.jsonl.
 Run strictly alone: the tunneled TPU admits one client.  Env:
 BENCH_CPU=1, DECODE_TOKENS (256), DECODE_CHUNK (8),
 DECODE_GEOMETRY=tiny|flagship, DECODE_QUANT=1 (int8 weight residency),
-DECODE_DAEMON=0 (skip the daemon phase).
+DECODE_DAEMON=0 (skip the daemon phase), DECODE_PAGED=0 (skip the
+paged sweep), DECODE_PAGED_SWEEP=8,32,64 (batch widths; CPU default 8).
 """
 from __future__ import annotations
 

@@ -26,7 +26,9 @@ single claim window produces the complete evidence set:
   search         cosine top-k queries/sec over the largest lane the
                  remaining window affords (target 1M rows)
   decode         prefill / chunked / per-token-sync / batched /
-                 speculative tokens per second
+                 speculative tokens per second, plus the paged-vs-
+                 dense KV sweep (batch {8,32,64} over a fixed
+                 8-window page pool)
   decode_quant   the same core decode with int8 weight residency
   decode_daemon  completion-daemon e2e + continuous serving (the
                  only phase that ever hung on-chip, so it runs LAST)
@@ -1372,6 +1374,71 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
     tps_b8 = batch_tokens_per_sec(8, n_tokens)
     log(f"batched decode: {tps_b8:,.1f} aggregate tok/s (batch=8)")
 
+    # paged-vs-dense: the block-paged pool decodes the same geometry
+    # at growing batch widths inside a FIXED cache budget (8 full
+    # windows of pages — the r05 dense batch=8 HBM envelope), so the
+    # sweep shows batch width, not cache padding, consuming HBM.
+    # Env: DECODE_PAGED=0 skips, DECODE_PAGED_SWEEP=8,32,64 overrides
+    # (CPU default stops at 8 to keep the host run bounded).
+    paged_tps: dict[str, float] = {}
+    paged_skipped: list[int] = []
+    paged_page = 128
+    paged_pool = 8 * (-(-cfg.max_len // paged_page))
+    if os.environ.get("DECODE_PAGED", "1") == "1" \
+            and getattr(model, "paged_supported", False):
+        sweep_default = "8" if os.environ.get("BENCH_CPU") == "1" \
+            else "8,32,64"
+        sweep = [int(x) for x in os.environ.get(
+            "DECODE_PAGED_SWEEP", sweep_default).split(",") if x]
+
+        def paged_row_budget(bsz: int) -> int:
+            """Decode tokens each row can take inside the FIXED pool.
+            Pages allocate whole: rows grow in near-lockstep (prompts
+            24..31, same chunk cadence), so each of the bsz rows can
+            own at most pool // bsz pages — budgeting raw tokens
+            (pool*page // bsz) would overshoot at the page boundary
+            and exhaust the pool mid-sweep.  Margin: max prompt 31 +
+            up to chunk-1 of final-chunk overshoot."""
+            row_cap = (paged_pool // bsz) * paged_page
+            return min(row_cap, cfg.max_len) - 32 - chunk
+
+        def paged_tokens_per_sec(bsz: int, n: int) -> float:
+            cache = model.init_paged(bsz, page=paged_page,
+                                     pool_pages=paged_pool)
+            toks = np.zeros((bsz,), np.int32)
+            for r in range(bsz):
+                lg = model.paged_prefill_row(
+                    cache, np.ones((24 + r % 8,), np.int32), r)
+                toks[r] = int(np.argmax(lg))
+            n = min(n, paged_row_budget(bsz))
+            t0 = time.perf_counter()
+            got = 0
+            while got < n * bsz:
+                blk = model.paged_decode_chunk(cache, toks, chunk)
+                toks = blk[:, -1].astype(np.int32)
+                got += bsz * chunk
+            dt = time.perf_counter() - t0
+            cache.reset()
+            return got / dt
+
+        for bsz in sweep:
+            if paged_row_budget(bsz) < chunk:
+                # the claim under test is batch width inside the FIXED
+                # dense-batch8 envelope; growing the pool to fit a
+                # width the envelope can't hold would measure a
+                # different (bigger) cache budget — skip loudly
+                paged_skipped.append(bsz)
+                log(f"paged decode: batch={bsz} SKIPPED — the fixed "
+                    f"{paged_pool}-page pool leaves its rows no decode "
+                    f"budget at this width")
+                continue
+            paged_tokens_per_sec(bsz, chunk * 2)      # warm/compile
+            paged_tps[str(bsz)] = round(
+                paged_tokens_per_sec(bsz, n_tokens), 1)
+            log(f"paged decode: {paged_tps[str(bsz)]:,.1f} aggregate "
+                f"tok/s (batch={bsz}, pool={paged_pool} pages of "
+                f"{paged_page})")
+
     tps_spec = accept = None
     if os.environ.get("DECODE_SPEC", "1") == "1":
         from libsplinter_tpu.models import (CompletionModel,
@@ -1408,6 +1475,20 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
             "tokens_per_sec_serial_sync": round(tps_serial, 1),
             "tokens_per_sec_chunk32": round(tps_c32, 1),
             "tokens_per_sec_batch8_aggregate": round(tps_b8, 1),
+            # the paged/dense ledger label: dense is the batch8 row
+            # above, paged entries are keyed by sweep batch width
+            "kv_cache_dense": {"batch": 8,
+                               "tokens_per_sec": round(tps_b8, 1)},
+            "kv_cache_paged": {
+                "page": paged_page, "pool_pages": paged_pool,
+                "tokens_per_sec_by_batch": paged_tps,
+                # widths the FIXED envelope cannot hold are skipped,
+                # never measured against a silently grown pool
+                "skipped_batches": paged_skipped,
+                "vs_dense_batch8": (
+                    round(max(paged_tps.values()) / tps_b8, 3)
+                    if paged_tps and tps_b8 > 0 else None),
+            },
             "tokens_per_sec_speculative": (round(tps_spec, 1)
                                            if tps_spec else None),
             "speculative_acceptance": (round(accept, 3)
@@ -1487,10 +1568,15 @@ def phase_decode_daemon(ctx: SeriesCtx) -> dict:
             raise probe_err[0]
         e2e_ms = float(np.median(e2e))
 
+        # the block-paged continuous lane: batch_cap at the new 32
+        # default, pool capped at 8 windows of pages (the old dense
+        # batch=8 cache HBM) — batch width rides live tokens
         comp2 = Completer(st, model=model, max_new_tokens=32,
                           flush_tokens=chunk, template="none",
-                          batch_cap=8)
+                          batch_cap=32,
+                          pool_pages=8 * (-(-cfg.max_len // 128)))
         comp2.attach()
+        comp2.warmup_paged()          # compile outside the timed window
         runner = threading.Thread(
             target=comp2.run_continuous,
             kwargs=dict(idle_timeout_ms=20, stop_after=600.0),

@@ -117,6 +117,8 @@ class CompleterStats:
     vanished: int = 0                 # keys deleted mid-request
     faults: int = 0                   # per-key failures the firewall ate
     reclaimed: int = 0                # stranded SERVICING rows re-queued
+    join_backpressure: int = 0        # admissions deferred: pool full
+    spec_demotions: int = 0           # speculative -> plain fallbacks
 
 
 class Completer:
@@ -132,12 +134,28 @@ class Completer:
                  rebid_tokens: int = 32,
                  template: str = "chatml",
                  group: int = P.GROUP_INFER,
-                 batch_cap: int = 8):
+                 batch_cap: int | None = None,
+                 page_size: int = 128,
+                 pool_pages: int | None = None,
+                 spec_min_acceptance: float = 0.2):
         self.store = store
         self.max_new = max_new_tokens
         self.flush_tokens = flush_tokens
         self.rebid_tokens = rebid_tokens
-        self.batch_cap = batch_cap
+        # per-lane defaults: the dense drains keep the r05-proven 8
+        # (a wider dense batch multiplies (B, max_len, KH, D) cache
+        # HBM — the very wall this PR removes), while the continuous
+        # lane defaults to 32 because the block-paged pool's HBM
+        # scales with live tokens instead of batch x max_len.  An
+        # explicit batch_cap applies to both lanes unchanged.
+        self.batch_cap = 8 if batch_cap is None else batch_cap
+        self.paged_batch_cap = 32 if batch_cap is None else batch_cap
+        self.page_size = page_size
+        self.pool_pages = pool_pages
+        self.spec_min_acceptance = spec_min_acceptance
+        self._spec_hist: list[tuple[int, int]] = []
+        self._spec_acceptance_rolling: float | None = None
+        self._paged_cache = None
         if template not in TEMPLATES:
             raise ValueError(
                 f"unknown chat template {template!r} (supported: "
@@ -585,36 +603,68 @@ class Completer:
                 pass
             return "full"
 
-    # -- continuous batching ----------------------------------------------
+    # -- continuous batching (block-paged) --------------------------------
+
+    def _paged_ok(self) -> bool:
+        """True when the model can serve the block-paged continuous
+        lane (paged_supported) with a usable bucket geometry."""
+        m = getattr(self, "_model", None)
+        return (m is not None
+                and getattr(m, "paged_supported", False)
+                and self.paged_batch_cap >= 2
+                and self._batched_budget() is not None)
+
+    def _ensure_paged_cache(self):
+        if self._paged_cache is None:
+            self._paged_cache = self._model.init_paged(
+                self.paged_batch_cap, page=self.page_size,
+                pool_pages=self.pool_pages)
+        return self._paged_cache
+
+    def warmup_paged(self) -> None:
+        """Pre-compile the continuous lane's whole program set (paged
+        prefill buckets + commit scatters + the chunked paged decode
+        step) against the SAME pool geometry run_continuous will
+        serve with — compile_count stays flat across join/finish/join
+        cycles afterwards."""
+        if not self._paged_ok():
+            return
+        self._model.warmup_paged(self._ensure_paged_cache(),
+                                 chunk=max(1, self.flush_tokens),
+                                 max_prompt=self._batched_budget())
 
     def run_continuous(self, *, idle_timeout_ms: int = 100,
                        stop_after: float | None = None) -> None:
-        """Continuous batched serving: requests join and leave the live
-        batch at chunk boundaries instead of waiting for the whole
-        drain to finish (engine-level vLLM-style slot scheduling over
-        decoder.join_row).
+        """Continuous batched serving over the block-paged KV pool:
+        requests join and leave the live batch at chunk boundaries
+        (vLLM-style slot scheduling over decoder.PagedKVCache +
+        ops/paged_attention).
 
-        batch_cap slots decode together; after every flush_tokens-step
-        chunk, finished rows finalize (label trifecta, stamp) and free
-        their slot, and newly WAITING keys join mid-flight — their
-        prompt prefills into the freed row ending at the batch's
-        current position (decoder.py join_row; token-exact vs serial).
-        A row joining late in the window may be truncated at the
-        window before reaching max_new_tokens (the window is shared);
-        when every slot is free the cache resets and the window starts
-        fresh.  Serial-only models (speculative) and window-only
-        bucket geometries fall back to run()."""
-        m = getattr(self, "_model", None)
-        if (m is None or not hasattr(m, "join_row")
-                or self.batch_cap < 2
-                or self._batched_budget() is None):
+        batch_cap rows decode together, each over its OWN logical
+        positions 0..len-1 in pages of a global pool — there is no
+        shared window: a joiner prefills its FULL prompt into freshly
+        allocated pages at any time (no join budget, no oversized-
+        joiner deferral), a finished row's pages return to the pool
+        immediately (no full-batch cache reset), and a row ends at
+        ITS window edge, not the batch's.  Admission is gated on free
+        pages: a request whose worst case (prompt + max_new rounded
+        up to a decode-chunk boundary, capped at the window) exceeds
+        the pool stays WAITING and
+        join_backpressure counts the deferral — backpressure, never a
+        mid-decode strand.  Serial-only models (speculative), sharded
+        models (paged_supported False), and window-only bucket
+        geometries fall back to run()."""
+        if not self._paged_ok():
             return self.run(idle_timeout_ms=idle_timeout_ms,
                             stop_after=stop_after)
         import numpy as np
 
+        m = self._model
         st = self.store
         tok_izer = self._tok
-        B = self.batch_cap
+        B = self.paged_batch_cap
+        cfg = m.cfg
+        cache = self._ensure_paged_cache()
         self._running = True
         deadline = (time.monotonic() + stop_after) if stop_after else None
         last = st.signal_count(self.group)
@@ -622,77 +672,101 @@ class Completer:
 
         rows: list[dict | None] = [None] * B
         toks = np.zeros((B,), np.int32)
-        # oversized joiners, per window: slot idx -> epoch at deferral.
-        # Keyed on epoch so a recycled slot (deferred key unset, a new
-        # short-prompt request landing in the same slot) is re-checked
-        # instead of skipped until the window resets
-        deferred: dict[int, int] = {}
         rebid_due = 0                 # decoded steps since last rebid
+        step = max(1, self.flush_tokens)   # decode chunk granularity
+        # backpressured requests, idx -> (slot epoch, pages needed):
+        # admit() runs every chunk, and re-rendering + re-tokenizing a
+        # denied prompt each time would burn host CPU alongside device
+        # decode — the memo re-checks only free_pages until the slot
+        # is rewritten (epoch moves) or the pool might fit it
+        bp_memo: dict[int, tuple[int, int]] = {}
 
-        def admit(limit: int | None = None) -> int:
-            """Fill free slots from waiting keys.  Starting a FRESH
-            batch prefills all admitted prompts together; a live batch
-            takes joiners one join_row each.  With `limit` set (the
-            live batch's join_budget), longer prompts are put BACK to
-            WAITING for the next fresh batch — joining would silently
-            clip their context."""
+        def worst_len(n_ids: int) -> int:
+            """Worst-case cache length for an admitted prompt.  Decode
+            appends whole `step`-token chunks (paged_decode_chunk),
+            so the final chunk can grow the cache up to step-1 tokens
+            PAST the prompt + max_new budget — the admission
+            reservation must cover that chunk-boundary ceiling, or a
+            fully reserved pool could still raise mid-decode and
+            abort every live row.  The first output token comes from
+            the prefill sample; the remaining max_new - 1 arrive in
+            whole chunks."""
+            chunks = (-(-(self.max_new - 1) // step)
+                      if self.max_new > 1 else 0)
+            return min(n_ids + chunks * step, cfg.max_len)
+
+        def span(row: dict | None, name: str, ms: float) -> None:
+            """Accumulate a stage span: the lane histogram always, the
+            row's flight-recorder event list when the request was
+            client-stamped (LBL_TRACED)."""
+            tracer.record(f"infer.{name}", ms)
+            if row is not None and row.get("spans") is not None:
+                row["spans"].append([name, round(ms, 3)])
+
+        def admit() -> int:
+            """Fill free rows from waiting keys.  EVERY admission is a
+            join — the prompt prefills into freshly allocated pages
+            right here, whether the batch is empty or mid-decode.
+            Reserving prompt + max_new pages up front means decode can
+            never exhaust the pool mid-flight; a request the pool
+            cannot cover yet stays WAITING (join_backpressure)."""
             free = [r for r in range(B) if rows[r] is None]
             if not free:
                 return 0
             n = 0
+            traced = tracer.enabled
             for idx in st.enumerate_indices(P.LBL_INFER_REQ):
                 if not free:
                     break
-                peek = ids = None
-                if limit is not None:
-                    # epoch read BEFORE the peek: if the slot recycles
-                    # mid-admission we defer under the stale epoch and
-                    # the next pass re-checks (never the reverse —
-                    # a fresh request skipped under an old verdict)
-                    e_seen = st.epoch_at(idx)
-                    if deferred.get(idx) == e_seen:
-                        continue      # known oversized: fresh batch only
-                    deferred.pop(idx, None)   # slot changed: re-check
-                    # peek BEFORE claiming: an oversized joiner stays
-                    # WAITING untouched (a claim would overwrite its
-                    # slot with the rendered prompt, double-rendering
-                    # it on the next admission)
-                    peek = self._read_rendered(idx)
-                    if peek is None:
-                        continue
-                    ids = self._clip_context(tok_izer.encode(peek[1]),
-                                             bucketed=True)
-                    if len(ids) > limit:
-                        deferred[idx] = e_seen
-                        continue
+                e = st.epoch_at(idx)
+                memo = bp_memo.get(idx)
+                if memo is not None and memo[0] == e:
+                    if memo[1] > cache.free_pages:
+                        continue      # still too big: skip the render
+                    del bp_memo[idx]  # pool may fit now: peek fresh
+                # peek BEFORE claiming: a backpressured request stays
+                # WAITING untouched (a claim would overwrite its slot
+                # with the rendered prompt)
+                peek = self._read_rendered(idx)
+                if peek is None:
+                    continue
+                ids = self._clip_context(tok_izer.encode(peek[1]),
+                                         bucketed=True)
+                if len(ids):
+                    need = cache.pages_needed(worst_len(len(ids)))
+                    if need > cache.free_pages:
+                        self.stats.join_backpressure += 1
+                        bp_memo[idx] = (e, need)
+                        continue      # pool full: next cycle retries
                 prep = self._prepare(idx, peek=peek)
                 if prep is None:
                     continue
-                key, rendered, t0, _stamp = prep   # consumed
-                if ids is None:
-                    ids = self._clip_context(tok_izer.encode(rendered),
-                                             bucketed=True)
+                key, rendered, t0, stamp = prep
                 if not len(ids):
                     self._finalize(key, t0, 0, False)
                     continue
                 r = free.pop(0)
                 rows[r] = {"key": key, "t0": t0, "n_tok": 0,
                            "pending": b"", "remaining": self.max_new,
-                           "ids": np.asarray(ids, np.int32)}
+                           "stamp": stamp,
+                           "spans": ([] if traced and stamp is not None
+                                     else None),
+                           "wall0": time.perf_counter()}
+                cache.ensure(r, worst_len(len(ids)))
+                ta = time.perf_counter()
+                logits = m.paged_prefill_row(
+                    cache, np.asarray(ids, np.int32), r)
+                tb = time.perf_counter()
+                t = int(m.sample(logits))
+                if traced:
+                    tc = time.perf_counter()
+                    span(rows[r], "join", (tb - ta) * 1e3)
+                    span(rows[r], "sample", (tc - tb) * 1e3)
+                emit(r, t)
+                if rows[r] is not None:
+                    toks[r] = t
                 n += 1
             return n
-
-        def start_fresh_batch() -> None:
-            """Prefill every occupied slot together (free slots get a
-            dummy row so the cache always has B addressable rows)."""
-            prompts = [rows[r]["ids"] if rows[r] is not None
-                       else np.ones((1,), np.int32) for r in range(B)]
-            logits = m.prefill_batch(prompts)
-            first = m.sample_batch(logits)
-            for r in range(B):
-                if rows[r] is not None:
-                    emit(r, int(first[r]))
-                    toks[r] = int(first[r])
 
         def emit(r: int, t: int) -> None:
             """One sampled token for row r: eos / flush / budget."""
@@ -705,7 +779,11 @@ class Completer:
             row["remaining"] -= 1
             boundary = row["pending"].endswith((b" ", b"\n", b"\t"))
             if boundary or row["n_tok"] % self.flush_tokens == 0:
+                tf = time.perf_counter()
                 res = self._flush(row["key"], row["pending"])
+                if tracer.enabled:
+                    span(row, "flush",
+                         (time.perf_counter() - tf) * 1e3)
                 row["pending"] = b""
                 if res != "ok":
                     finish(r, truncated=res == "full",
@@ -723,110 +801,108 @@ class Completer:
                 vanished = res == "gone"
             self._finalize(row["key"], row["t0"], row["n_tok"],
                            truncated, vanished)
+            if row.get("stamp") is not None \
+                    and row.get("spans") is not None:
+                tid, ts = row["stamp"]
+                wall = ((time.time() - ts) * 1e3 if ts > 0 else
+                        (time.perf_counter() - row["wall0"]) * 1e3)
+                self.recorder.record(tid, row["key"], wall,
+                                     row["spans"])
+            cache.free_row(r)         # pages back to the pool NOW
             rows[r] = None
             toks[r] = 0
 
-        def abort_batch(reason: str) -> None:
+        def abort_all(reason: str) -> None:
             """Model failure must not wedge WAITING/SERVICING (the
             invariant process_key/process_batch keep): every live row
-            finalizes with what it already streamed."""
+            finalizes with what it already streamed and the pool
+            starts clean."""
+            nonlocal cache
             self._debug(f"continuous batch aborted: {reason}")
             for r in range(B):
                 if rows[r] is not None:
                     finish(r)
-            m.reset()
+            # the failure may have escaped a DONATING program (commit
+            # scatter / decode chunk) after it consumed the device
+            # pools but before the reassignment — reusing them would
+            # raise "buffer donated" on every admission forever.
+            # Rebuild the pool outright: the dense path's
+            # reset()-then-fresh-cache recovery, paged edition.
+            self._paged_cache = None
+            cache = self._ensure_paged_cache()
+            bp_memo.clear()
 
-        batch_live = False
-        while self._running:
-            now = time.monotonic()
-            if deadline and now > deadline:
-                break
-            if now >= next_beat:
-                next_beat = now + 2.0
-                self.publish_stats()
+        try:
+            while self._running:
+                now = time.monotonic()
+                if deadline and now > deadline:
+                    break
+                if now >= next_beat:
+                    next_beat = now + 2.0
+                    self.publish_stats()
 
-            if not batch_live:
-                if admit() == 0:
-                    got = st.signal_wait(self.group, last,
-                                         timeout_ms=idle_timeout_ms)
-                    if got is not None:
-                        last = got
-                        self.stats.wakes += 1
-                    continue
                 try:
-                    start_fresh_batch()
-                except Exception as ex:
-                    abort_batch(f"prefill failed: {ex}")
-                    continue
-                batch_live = True
-                continue
+                    if all(r is None for r in rows):
+                        if admit() == 0:
+                            got = st.signal_wait(
+                                self.group, last,
+                                timeout_ms=idle_timeout_ms)
+                            if got is not None:
+                                last = got
+                                self.stats.wakes += 1
+                        continue
 
-            try:
-                # every slot free: reset FIRST — new arrivals get a
-                # fresh window, never a join into the drained one
-                if all(r is None for r in rows):
-                    m.reset()
-                    deferred.clear()
-                    batch_live = False
-                    continue
+                    if any(r is None for r in rows):
+                        admit()       # joiners enter at ANY time
 
-                # live batch: joiners enter through the freed rows —
-                # but only prompts the current position can hold whole
-                if any(r is None for r in rows) \
-                        and admit(limit=m.join_budget()):
+                    # per-row window edge: a row without room for the
+                    # next chunk finalizes with what it has — ITS
+                    # window, nobody else's
                     for r in range(B):
-                        row = rows[r]
-                        if row is not None and row["n_tok"] == 0 \
-                                and "joined" not in row:
-                            row["joined"] = True
-                            logits = m.join_row(row["ids"], r)
-                            t = int(m.sample(logits))
-                            emit(r, t)
-                            if rows[r] is not None:
-                                toks[r] = t
-
-                if all(r is None for r in rows):
-                    m.reset()         # the joins all finished at once
-                    deferred.clear()
-                    batch_live = False
-                    continue
-
-                # window edge: rows still live finalize with what they
-                # have — the same "generation ends at the window"
-                # semantics as the serial path (no truncation marker;
-                # pending bytes flush inside finish)
-                step = min(self.flush_tokens,
-                           m.cfg.max_len - m.pos)
-                if step <= 0:
-                    for r in range(B):
-                        if rows[r] is not None:
+                        if rows[r] is not None and \
+                                int(cache.lengths[r]) + step > cfg.max_len:
                             finish(r)
-                    continue
+                    if all(r is None for r in rows):
+                        continue
 
-                blk = m.decode_chunk_batch(toks, step)
-                rebid_due += step
-                if self.rebid_tokens and rebid_due >= self.rebid_tokens:
-                    rebid_due = 0
-                    self._rebid()
-                for c in range(step):
+                    td = time.perf_counter()
+                    blk = m.paged_decode_chunk(cache, toks, step)
+                    if tracer.enabled:
+                        # one chunk = one histogram sample, whatever
+                        # the occupancy — per-row recording would make
+                        # decode quantiles occupancy-weighted, unlike
+                        # every other stage; traced rows still each
+                        # get the shared span in their event list
+                        ms = (time.perf_counter() - td) * 1e3
+                        tracer.record("infer.decode", ms)
+                        for r in range(B):
+                            if rows[r] is not None and \
+                                    rows[r].get("spans") is not None:
+                                rows[r]["spans"].append(
+                                    ["decode", round(ms, 3)])
+                    rebid_due += step
+                    if self.rebid_tokens and rebid_due >= self.rebid_tokens:
+                        rebid_due = 0
+                        self._rebid()
+                    for c in range(step):
+                        for r in range(B):
+                            if rows[r] is not None:
+                                # tokens decoded after this row
+                                # finished mid-chunk are speculative:
+                                # emit in order, discard the rest
+                                emit(r, int(blk[r, c]))
                     for r in range(B):
                         if rows[r] is not None:
-                            # tokens decoded before this row finished
-                            # mid-chunk are speculative: emit in order
-                            emit(r, int(blk[r, c]))
-                for r in range(B):
-                    if rows[r] is not None:
-                        toks[r] = int(blk[r, -1])
-            except Exception as ex:
-                abort_batch(str(ex))
-                batch_live = False
-
-        # stop()/stop_after mid-batch: never strand keys in SERVICING
-        for r in range(B):
-            if rows[r] is not None:
-                finish(r)
-        if batch_live:
-            m.reset()
+                            toks[r] = int(blk[r, -1])
+                except Exception as ex:
+                    abort_all(str(ex))
+        finally:
+            # stop()/stop_after mid-batch: never strand keys in
+            # SERVICING; the pool is reusable for the next run
+            for r in range(B):
+                if rows[r] is not None:
+                    finish(r)
+            cache.reset()
 
     # -- drain loop --------------------------------------------------------
 
@@ -877,7 +953,58 @@ class Completer:
                     self.stats.faults += 1
                     self._debug(f"request at slot {idx} failed: {ex}")
                     self._requeue_failed([idx])
+        if n:
+            self._maybe_demote_spec()
         return n
+
+    # -- speculative degradation ------------------------------------------
+
+    def _spec_acceptance(self) -> float | None:
+        """The live speculative acceptance rate, or None when the
+        model isn't speculative (including after a demotion — the
+        rolling rate that triggered it survives in
+        _spec_acceptance_rolling for the heartbeat)."""
+        m = getattr(self, "_model", None)
+        if m is None or not hasattr(m, "acceptance_rate"):
+            return None
+        try:
+            return float(m.acceptance_rate)
+        except Exception:
+            return None
+
+    def _maybe_demote_spec(self) -> None:
+        """Speculative decode graceful degradation: r05 measured 6.0
+        tok/s at acceptance=0.05 — a draft that the target rejects is
+        strictly WORSE than plain decode (every rejected proposal cost
+        a draft forward and bought nothing).  Track a rolling
+        acceptance over the recent drains; when it stays under
+        spec_min_acceptance with enough proposals behind it, swap the
+        model for its own target and decode plain for the rest of the
+        run (spec_demotions counts it; 0 disables the floor)."""
+        m = getattr(self, "_model", None)
+        if (m is None or self.spec_min_acceptance <= 0
+                or not hasattr(m, "acceptance_rate")
+                or not hasattr(m, "target")):
+            return
+        if not self._spec_hist:
+            self._spec_hist.append((0, 0))
+        self._spec_hist.append((m.stats_proposed, m.stats_accepted))
+        if len(self._spec_hist) > 8:
+            self._spec_hist.pop(0)
+        p0, a0 = self._spec_hist[0]
+        dp = m.stats_proposed - p0
+        da = m.stats_accepted - a0
+        if dp < 32:
+            return                    # not enough evidence yet
+        rate = da / dp
+        self._spec_acceptance_rolling = rate
+        if rate < self.spec_min_acceptance:
+            self.stats.spec_demotions += 1
+            self._debug(
+                f"speculative acceptance {rate:.3f} < floor "
+                f"{self.spec_min_acceptance}: demoting to plain "
+                "decode (target model) for the rest of the run")
+            self._model = m.target
 
     def publish_stats(self) -> None:
         """Heartbeat: JSON stats snapshot into the debug-labeled
@@ -887,6 +1014,19 @@ class Completer:
         quantiles, recorder accounting, and the slow log."""
         payload = dataclasses.asdict(self.stats)
         payload["generation"] = self.generation
+        acc = self._spec_acceptance()
+        if acc is not None:
+            # sptpu_completer_spec_acceptance in `spt metrics`
+            payload["spec_acceptance"] = round(acc, 4)
+        elif self._spec_acceptance_rolling is not None:
+            # demoted: keep the rolling rate that tripped the floor
+            payload["spec_acceptance"] = round(
+                self._spec_acceptance_rolling, 4)
+        if self._paged_cache is not None:
+            # sptpu_completer_pages_{free,used} pool gauges
+            payload["pages_free"] = self._paged_cache.free_pages
+            payload["pages_used"] = self._paged_cache.used_pages
+            payload["live_tokens"] = self._paged_cache.live_tokens()
         if faults.armed():
             payload["faults"] = faults.stats()
         if tracer.enabled:
@@ -972,10 +1112,31 @@ def main(argv: list[str] | None = None) -> int:
                          "shard the stacked expert FFNs over an ep "
                          "mesh axis (must divide the model's "
                          "expert_count; composes with --tp)")
-    ap.add_argument("--batch-cap", type=int, default=8,
-                    help="serve up to this many waiting keys as one "
-                         "left-padded batched decode (1 = serial, the "
-                         "reference's cadence)")
+    ap.add_argument("--batch-cap", type=int, default=None,
+                    help="serve up to this many waiting keys "
+                         "concurrently (1 = serial, the reference's "
+                         "cadence).  Default: 32 with --continuous "
+                         "(the block-paged pool's HBM scales with "
+                         "live tokens, so batch width no longer pays "
+                         "for B x max_len padding), 8 otherwise (a "
+                         "wider DENSE batch still multiplies "
+                         "B x max_len cache HBM)")
+    ap.add_argument("--page-size", type=int, default=128,
+                    help="KV pool page size in tokens (continuous "
+                         "serving; must be a multiple of the 128-"
+                         "lane tile on TPU hardware)")
+    ap.add_argument("--pool-pages", type=int, default=None,
+                    help="total pages in the paged KV pool (default: "
+                         "batch-cap full windows — cap it lower to "
+                         "spend cache HBM on batch width instead of "
+                         "padding; admission backpressures when the "
+                         "pool is full)")
+    ap.add_argument("--spec-min-acceptance", type=float, default=0.2,
+                    help="speculative decoding floor: when the "
+                         "rolling draft acceptance stays below this, "
+                         "demote to plain decode for the rest of the "
+                         "run (0 disables; the completer heartbeat "
+                         "publishes sptpu_completer_spec_acceptance)")
     ap.add_argument("--quantized", action="store_true",
                     help="int8 weight residency: keep attention/MLP "
                          "kernels in HBM as Q8_0-geometry int8 + "
@@ -1077,15 +1238,29 @@ def main(argv: list[str] | None = None) -> int:
                  args.gamma, args.draft_weights)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
-                     template=template, batch_cap=args.batch_cap)
+                     template=template, batch_cap=args.batch_cap,
+                     page_size=args.page_size,
+                     pool_pages=args.pool_pages,
+                     spec_min_acceptance=args.spec_min_acceptance)
     comp.attach()
     if args.warmup:
         t0 = time.monotonic()
-        kw = {}
-        if args.batch_cap > 1 and hasattr(model, "prefill_batch") \
-                and comp._batched_budget() is not None:
-            kw["batch"] = args.batch_cap   # batched/continuous shapes
-        model.warmup(chunk=comp.flush_tokens, **kw)
+        paged = args.continuous and comp._paged_ok()
+        if paged:
+            # the continuous lane only ever runs the paged program
+            # set (paged prefill buckets + commit scatters + chunked
+            # paged decode) — compiling the serial/dense sweep too
+            # would roughly double first-boot warmup for programs
+            # this lane never executes.  A join/finish/join cycle at
+            # serve time must never compile.
+            comp.warmup_paged()
+        else:
+            kw = {}
+            if comp.batch_cap > 1 \
+                    and hasattr(model, "prefill_batch") \
+                    and comp._batched_budget() is not None:
+                kw["batch"] = comp.batch_cap   # dense batched shapes
+            model.warmup(chunk=comp.flush_tokens, **kw)
         log.info("warmup compiled in %.1fs (.xla_cache persists "
                  "programs across restarts)", time.monotonic() - t0)
     if args.oneshot:

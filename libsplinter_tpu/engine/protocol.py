@@ -98,6 +98,17 @@ PIPELINE_STAGES = ("drain", "tokenize", "dispatch", "device_wait",
 # appends; commit = oom bookkeeping + ctime backfill + READY flip
 INFER_STAGES = ("render", "generate", "commit")
 
+# the continuous (block-paged) lane's decomposition, published under
+# the same infer.* histogram prefix: join = one row's prompt prefill
+# into freshly allocated pages (admission IS a join — there is no
+# fresh-batch/live-batch distinction); sample = the host draw of its
+# first token; decode = a flush_tokens-step paged decode chunk (the
+# span every live row shares); flush = a streaming append run.  A
+# client-stamped request (stamp_trace) gets a flight-recorder entry
+# with its accumulated spans, so `spt trace tail` reconstructs
+# batched-lane requests too, not just the serial path's.
+CONT_INFER_STAGES = ("join", "sample", "decode", "flush")
+
 # the search daemon's per-drain decomposition: wake = signal to drain
 # entry (the coalescing window's scheduling cost); drain = request
 # discovery + param parse + torn-safe query-vector gather; score =

@@ -1,5 +1,5 @@
 from .decoder import (CompletionModel, Decoder, DecoderConfig, init_cache,
-                      sample_top_p)
+                      PagedKVCache, sample_top_p)
 from .encoder import Encoder, EncoderConfig, EmbeddingModel
 from .moe import MoeDecoder, MoeDecoderConfig, moe_completion_model
 from .speculative import SpeculativeCompletionModel
@@ -9,6 +9,6 @@ from .tokenizer import (ByteTokenizer, HashTokenizer, WordPieceTokenizer,
 __all__ = ["Encoder", "EncoderConfig", "EmbeddingModel", "HashTokenizer",
            "WordPieceTokenizer", "ByteTokenizer", "batch_encode",
            "default_tokenizer", "CompletionModel", "Decoder",
-           "DecoderConfig", "init_cache", "sample_top_p",
+           "DecoderConfig", "init_cache", "PagedKVCache", "sample_top_p",
            "MoeDecoder", "MoeDecoderConfig", "moe_completion_model",
            "SpeculativeCompletionModel"]

@@ -77,6 +77,117 @@ def init_cache(cfg: DecoderConfig, batch: int):
     return [(z, z) for _ in range(cfg.layers)]
 
 
+class PagedKVCache:
+    """Block-paged KV pool for the continuous-batching decode lane.
+
+    The dense cache above costs HBM proportional to B x max_len no
+    matter how many tokens each row holds; this pool costs HBM
+    proportional to its page count — cache memory scales with LIVE
+    TOKENS, so batch width can grow (8 -> 32 by default in the
+    completion daemon) without the cache exploding.  Per layer:
+
+        k_pool / v_pool: (n_blocks, kv_heads, page, head_dim)
+
+    plus a host-side (batch, pages_per_row) int32 block table and a
+    (batch,) lengths vector.  Block 0 is the reserved TRASH block:
+    never allocated, every unused table entry points at it, so dead
+    rows' appends land harmlessly and gathers of unused pages read
+    garbage the ragged length mask excludes (ops/paged_attention.py).
+
+    Allocation is host-side and page-granular: `ensure(row, tokens)`
+    grows a row's table to cover `tokens`, `free_row` returns every
+    page to the pool the moment a request finishes.  The admission
+    path reserves a row's worst case (prompt + max_new rounded up to
+    the decode-chunk boundary, capped at the window) up front, so an
+    admitted row can never strand mid-decode on an exhausted pool — backpressure happens at admission, where the
+    request can simply stay WAITING.
+
+    `page` must be a multiple of the 128-lane tile on real TPU
+    hardware (the Pallas kernel's page axis); CPU tests use small
+    pages through interpret/reference dispatch.
+    """
+
+    def __init__(self, cfg: DecoderConfig, batch: int, *,
+                 page: int = 128, pool_pages: int | None = None):
+        if page < 1:
+            raise ValueError("page must be >= 1")
+        if page % 128 and jax.default_backend() == "tpu":
+            # fail at construction, not in the first decode chunk: a
+            # Pallas tile error mid-serve would abort_all every live
+            # request and then re-admit into the same failure forever
+            raise ValueError(
+                f"page {page} must be a multiple of the 128-lane tile "
+                "on TPU (the ragged paged-attention kernel's page "
+                "axis); only CPU interpret/reference runs may use "
+                "smaller pages")
+        self.cfg = cfg
+        self.batch = batch
+        self.page = page
+        self.pages_per_row = -(-cfg.max_len // page)
+        if pool_pages is None:
+            # safe default: the pool can hold every row's full window
+            # (== dense HBM at this batch).  Deployments cap it lower
+            # (--pool-pages) to spend the savings on batch width.
+            pool_pages = batch * self.pages_per_row
+        if pool_pages < self.pages_per_row:
+            raise ValueError(
+                f"pool_pages {pool_pages} cannot hold even one full "
+                f"window ({self.pages_per_row} pages)")
+        self.n_blocks = pool_pages + 1               # + the trash block
+        shape = (self.n_blocks, cfg.kv_heads, page, cfg.head_dim)
+        # distinct buffers per layer/side: the paged programs donate
+        # the pools, and XLA rejects donating one buffer twice
+        self.k_pools = [jnp.zeros(shape, cfg.dtype)
+                        for _ in range(cfg.layers)]
+        self.v_pools = [jnp.zeros(shape, cfg.dtype)
+                        for _ in range(cfg.layers)]
+        self.tables = np.zeros((batch, self.pages_per_row), np.int32)
+        self.lengths = np.zeros((batch,), np.int32)
+        self._free = list(range(self.n_blocks - 1, 0, -1))
+        self._owned: list[list[int]] = [[] for _ in range(batch)]
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_blocks - 1 - len(self._free)
+
+    def pages_needed(self, tokens: int) -> int:
+        tokens = min(int(tokens), self.cfg.max_len)
+        return -(-tokens // self.page) if tokens > 0 else 0
+
+    def ensure(self, row: int, tokens: int) -> bool:
+        """Grow row's table to cover `tokens`; False (nothing
+        allocated) when the pool cannot — admission backpressure."""
+        need = self.pages_needed(tokens)
+        have = len(self._owned[row])
+        if need <= have:
+            return True
+        if need - have > len(self._free):
+            return False
+        for p in range(have, need):
+            bid = self._free.pop()
+            self._owned[row].append(bid)
+            self.tables[row, p] = bid
+        return True
+
+    def free_row(self, row: int) -> None:
+        """Return every page row owns to the pool (request finished)."""
+        self._free.extend(self._owned[row])
+        self._owned[row] = []
+        self.tables[row, :] = 0
+        self.lengths[row] = 0
+
+    def reset(self) -> None:
+        for r in range(self.batch):
+            self.free_row(r)
+
+    def live_tokens(self) -> int:
+        return int(self.lengths.sum())
+
+
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
@@ -103,14 +214,25 @@ class CausalAttention(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, cache_kv, pos, start=None):
+    def __call__(self, x, cache_kv, pos, start=None, lengths=None,
+                 tables=None):
         """x: (B, S, H) chunk at cache slots pos..pos+S-1.
         cache_kv: (k, v) each (B, T, KH, D).  start: None, or (B,)
         left-pad offsets for batched serving — row r's real tokens
         occupy slots start[r].., its rotary position at slot s is
         s - start[r], and slots below start[r] (pad K/V) are masked.
         With start=None the graph is the classic single-request one
-        (slot == position).  Returns (out, new_cache)."""
+        (slot == position).  Returns (out, new_cache).
+
+        PAGED decode (lengths is not None): cache_kv is a per-layer
+        (k_pool, v_pool) pair of the global block pool
+        (n_blocks, KH, page, D), tables is the (B, P) block table and
+        lengths the (B,) per-row token counts — S must be 1 (one
+        decode token per row).  Row r's new token sits at ITS OWN
+        logical position lengths[r] (no shared pos, no left pad): its
+        K/V appends into page lengths[r] // page of the row's table,
+        and attention runs the ragged paged kernel over j < lengths[r]
+        + 1.  pos/start are ignored on this path."""
         cfg = self.cfg
         B, S, _ = x.shape
         D = cfg.head_dim
@@ -122,6 +244,31 @@ class CausalAttention(nn.Module):
 
         # rotary at per-row positions (dynamic under jit)
         cos_t, sin_t = _rotary_angles(cfg.max_len, D, cfg.rope_base)
+
+        if lengths is not None:
+            # block-paged decode step (ops/paged_attention.py)
+            from ..ops.paged_attention import paged_attention
+            kp, vp = cache_kv
+            page = kp.shape[2]
+            # append position, clamped so a contract violation (a row
+            # decoded past its window — the scheduler finishes rows
+            # first) rewrites ITS last slot instead of wrapping into a
+            # neighbour's page
+            app = jnp.minimum(lengths, cfg.max_len - 1)
+            rp = app[:, None]                     # (B, 1) positions
+            q = _apply_rotary(q, cos_t[rp], sin_t[rp])
+            k = _apply_rotary(k, cos_t[rp], sin_t[rp])
+            bids = jnp.take_along_axis(
+                tables, (app // page)[:, None], axis=1)[:, 0]
+            offs = app % page
+            # dead rows (length 0 everywhere on the host) route to the
+            # trash block 0 via their zeroed table entries
+            kp = kp.at[bids, :, offs, :].set(k[:, 0])
+            vp = vp.at[bids, :, offs, :].set(v[:, 0])
+            out = paged_attention(q[:, 0], kp, vp, tables, app + 1)
+            out = out.reshape(B, S, cfg.heads * D)
+            return _proj(cfg, cfg.hidden, "out")(out), (kp, vp)
+
         idx = pos + jnp.arange(S)                  # cache slots (S,)
         if start is None:
             cos, sin = cos_t[idx], sin_t[idx]      # (S, D/2)
@@ -167,11 +314,12 @@ class DecoderLayer(nn.Module):
     mlp_cls: Any = None
 
     @nn.compact
-    def __call__(self, x, cache_kv, pos, start=None):
+    def __call__(self, x, cache_kv, pos, start=None, lengths=None,
+                 tables=None):
         cfg = self.cfg
         a, cache_kv = CausalAttention(cfg, name="attn")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_attn")(x),
-            cache_kv, pos, start)
+            cache_kv, pos, start, lengths, tables)
         x = x + a
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_mlp")(x)
         if self.mlp_cls is not None:
@@ -191,12 +339,15 @@ class Decoder(nn.Module):
     mlp_cls: Any = None
 
     @nn.compact
-    def __call__(self, token_ids, cache, pos, start=None):
+    def __call__(self, token_ids, cache, pos, start=None, lengths=None,
+                 tables=None):
         """token_ids: (B, S) int32; cache: list of per-layer (k, v);
         pos: scalar int32 — cache slot of token_ids[:, 0]; start:
         optional (B,) left-pad offsets (batched serving — see
-        CausalAttention).  Returns (logits (B, S, V) float32,
-        new_cache)."""
+        CausalAttention).  With lengths/tables given the cache entries
+        are (k_pool, v_pool) block pools and the step runs the paged
+        decode path (CausalAttention).  Returns (logits (B, S, V)
+        float32, new_cache)."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
                      name="tok_emb")(token_ids)
@@ -204,7 +355,8 @@ class Decoder(nn.Module):
         for i in range(cfg.layers):
             x, kv = DecoderLayer(cfg, self.mlp_cls,
                                  name=f"layer_{i}")(x, cache[i], pos,
-                                                    start)
+                                                    start, lengths,
+                                                    tables)
             new_cache.append(kv)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_out")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
@@ -267,6 +419,12 @@ def sample_top_p_batch(rng, logits, *, top_p: float = 0.9,
 class CompletionModel:
     """Bucketed prefill + token-at-a-time decode with persistent cache.
 
+    paged_supported marks the block-paged continuous-batching surface
+    (init_paged / paged_prefill_row / paged_decode_chunk) as usable;
+    subclasses whose cache placement the paged pool does not yet
+    honour (parallel.ShardedCompletionModel) override it to False and
+    the completion daemon falls back to dense serving.
+
     The generation surface the completion daemon drives:
         pos, logits = model.prefill(prompt_ids)
         tok = model.sample(logits)
@@ -274,6 +432,8 @@ class CompletionModel:
     Cache state lives on device between calls (no host round-trip of the
     KV tensors).
     """
+
+    paged_supported = True
 
     def __init__(self, cfg: DecoderConfig, *, seed: int = 0,
                  buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
@@ -317,6 +477,7 @@ class CompletionModel:
         self._batch = 0
         self._chunk_progs: dict[tuple, Any] = {}
         self._join_progs: dict[int, Any] = {}     # continuous-batch joins
+        self._paged_progs: dict[tuple, Any] = {}  # paged decode/commit
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -543,7 +704,10 @@ class CompletionModel:
 
     def _join_program(self, b: int):
         """One program prefilling a SINGLE row's prompt into the live
-        batch cache (continuous batching: a request joins mid-decode).
+        batch cache.  LEGACY dense-join surface: the continuous lane
+        now joins through paged_prefill_row (no shared window); this
+        model-level API remains for the dense batched cache and its
+        tests (tests/test_continuous.py).
         The row's prompt is left-padded so its last token lands at slot
         pos-1 — the batch's next decode step then serves it like any
         other row.  Returns (new_batch_cache, last_logits (V,))."""
@@ -611,6 +775,210 @@ class CompletionModel:
             return 0
         return max((b for b in self.buckets if b <= self._pos),
                    default=0)
+
+    # -- paged serving (the continuous-batching path) ---------------------
+    #
+    # The dense batched path above shares ONE window across the batch:
+    # prefill parks every row at the same bucket position, joiners can
+    # only reach back join_budget() tokens, and the cache resets when
+    # every slot frees.  The paged path drops all of that: each row
+    # has its own logical positions 0..len-1 in pages of a global pool
+    # (PagedKVCache), a joiner prefills into freshly allocated pages
+    # at ANY time with its full context, and a finished row's pages
+    # return to the pool immediately.  Prefill itself reuses the
+    # serial bucket programs over a bucket-sized dense scratch cache,
+    # then one commit program per bucket scatters the rows into pages
+    # — prompts keep attending through causal_flash_attention; only
+    # the decode step runs the ragged paged kernel.
+
+    def init_paged(self, batch: int, *, page: int = 128,
+                   pool_pages: int | None = None) -> PagedKVCache:
+        """Fresh paged pool serving `batch` concurrent rows.  The
+        default pool holds batch full windows (== dense HBM at this
+        batch); cap pool_pages lower to spend HBM on batch width
+        instead of cache padding."""
+        return PagedKVCache(self.cfg, batch, page=page,
+                            pool_pages=pool_pages)
+
+    def _paged_commit_program(self, bucket: int, page: int):
+        """One program scattering a (1, bucket) dense prefill cache
+        into pool pages at the given block ids (page-granular; the
+        tail of the last page holds garbage the length mask hides
+        until decode appends overwrite it)."""
+        key = ("commit", bucket, page)
+        fn = self._paged_progs.get(key)
+        if fn is None:
+            n_cp = -(-bucket // page)
+            pad = n_cp * page - bucket
+
+            def run(k_pools, v_pools, dense, bids):
+                def blocks(x):
+                    x = x[0]                           # (bucket, KH, D)
+                    if pad:
+                        x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+                    return x.reshape(n_cp, page, *x.shape[1:]) \
+                            .transpose(0, 2, 1, 3)     # (n_cp,KH,pg,D)
+
+                outk, outv = [], []
+                for (kd, vd), kp, vp in zip(dense, k_pools, v_pools):
+                    outk.append(kp.at[bids].set(blocks(kd)))
+                    outv.append(vp.at[bids].set(blocks(vd)))
+                return outk, outv
+
+            fn = jax.jit(run, donate_argnums=(0, 1))
+            self._paged_progs[key] = fn
+        return fn
+
+    def paged_prefill_row(self, cache: PagedKVCache,
+                          prompt_ids: np.ndarray, row: int) -> np.ndarray:
+        """Prefill one row's prompt into its pages: bucketed dense
+        prefill over a (1, bucket) scratch cache, then the commit
+        scatter.  Unlike join_row there is no clipping to a shared
+        position — the row keeps its FULL prompt (callers clip only
+        to the window budget).  Returns the last real token's logits
+        (V,) for sampling the first output token."""
+        cfg = self.cfg
+        P = len(prompt_ids)
+        if P == 0:
+            raise ValueError("empty prompt")
+        if P >= cfg.max_len:
+            raise ValueError("prompt exceeds context window")
+        if not cache.ensure(row, P):
+            raise RuntimeError(
+                f"paged pool exhausted: row {row} needs "
+                f"{cache.pages_needed(P)} pages, {cache.free_pages} free")
+        b = self.bucket_for(P)
+        ids = np.zeros((1, b), np.int32)
+        ids[0, :P] = np.asarray(prompt_ids[:P], np.int32)
+        # bucket-sized dense scratch (NOT max_len): the same jitted
+        # trunk runs with T = bucket, so paged prefill costs one small
+        # program per bucket instead of a full-window cache
+        z = jnp.zeros((1, b, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+        scratch = [(z, z) for _ in range(cfg.layers)]
+        logits, dense = self._fn(self.params, jnp.asarray(ids), scratch,
+                                 jnp.int32(0))
+        n_cp = -(-b // cache.page)
+        # table entries past the prompt's pages are 0 = trash: the
+        # scatter's excess bucket rows land there harmlessly
+        bids = cache.tables[row, :n_cp].copy()
+        kp, vp = self._paged_commit_program(b, cache.page)(
+            cache.k_pools, cache.v_pools, dense, jnp.asarray(bids))
+        cache.k_pools, cache.v_pools = list(kp), list(vp)
+        cache.lengths[row] = P
+        return np.asarray(logits[0, P - 1])
+
+    def _paged_chunk_program(self, n: int, bp: int):
+        """lax.scan of n paged decode steps for bp rows: append one
+        token per row into its pages, ragged paged attention, sample
+        in-graph (_sample_rows — the same sampler graph as every other
+        path).  The pool never round-trips to the host (donated)."""
+        key = ("chunk", n, bp, self.top_p, self.temp)
+        fn = self._paged_progs.get(key)
+        if fn is None:
+            module, top_p, temp = self.module, self.top_p, self.temp
+
+            def run(params, k_pools, v_pools, tables, lengths, rng,
+                    toks):
+                def step(carry, _):
+                    k_pools, v_pools, lengths, rng, toks = carry
+                    cache = list(zip(k_pools, v_pools))
+                    logits, new_cache = module.apply(
+                        params, toks.reshape(-1, 1), cache,
+                        jnp.int32(0), None, lengths, tables)
+                    k_pools = [c[0] for c in new_cache]
+                    v_pools = [c[1] for c in new_cache]
+                    rng, sub = jax.random.split(rng)
+                    nxt = _sample_rows(sub, logits[:, 0], top_p, temp)
+                    return (k_pools, v_pools, lengths + 1, rng, nxt), nxt
+
+                (k_pools, v_pools, _, _, _), out = jax.lax.scan(
+                    step, (k_pools, v_pools, lengths, rng, toks), None,
+                    length=n)
+                return k_pools, v_pools, out           # out: (n, bp)
+
+            fn = jax.jit(run, donate_argnums=(1, 2))
+            self._paged_progs[key] = fn
+            if len(self._paged_progs) > 16:
+                cur = (self.top_p, self.temp)
+                self._paged_progs = {
+                    k: v for k, v in self._paged_progs.items()
+                    if k[0] != "chunk" or k[-2:] == cur}
+        return fn
+
+    def paged_decode_chunk(self, cache: PagedKVCache, tokens, n: int
+                           ) -> np.ndarray:
+        """Append tokens (batch,), decode+sample n steps for every
+        row of the pool in one program.  Rows with lengths == 0 are
+        dead: they decode into the trash block and the caller discards
+        their column.  Live rows must have window room for n more
+        tokens (the scheduler finishes rows first).  Returns
+        (batch, n) sampled ids."""
+        bp = cache.batch
+        for r in range(bp):
+            length = int(cache.lengths[r])
+            if length > 0 and not cache.ensure(
+                    r, min(length + n, self.cfg.max_len)):
+                raise RuntimeError(
+                    f"paged pool exhausted mid-decode: row {r} "
+                    f"(admission must reserve prompt + max_new)")
+        toks = np.zeros((bp,), np.int32)
+        toks[: len(tokens)] = np.asarray(tokens, np.int32)
+        self._rng, sub = jax.random.split(self._rng)
+        kp, vp, out = self._paged_chunk_program(n, bp)(
+            self.params, cache.k_pools, cache.v_pools,
+            jnp.asarray(cache.tables), jnp.asarray(cache.lengths), sub,
+            jnp.asarray(toks))
+        cache.k_pools, cache.v_pools = list(kp), list(vp)
+        live = cache.lengths > 0
+        cache.lengths[live] = np.minimum(cache.lengths[live] + n,
+                                         self.cfg.max_len)
+        return np.asarray(out).T                       # (bp, n)
+
+    def warmup_paged(self, cache: PagedKVCache, chunk: int = 8,
+                     max_prompt: int | None = None) -> None:
+        """Pre-compile every paged program the continuous lane hot
+        path touches — per-bucket prefill scratch + commit scatter,
+        the host sampler, and the chunked paged decode step — so a
+        join/finish/join cycle at serve time never compiles
+        (compile_count stays flat; the steady-state test pins it).
+        max_prompt bounds the bucket sweep: a caller that clips every
+        prompt (the continuous lane's window budget) never selects a
+        bucket above bucket_for(max_prompt), so warming the ones past
+        it — including the max_len bucket, the slowest compile —
+        would only inflate startup for dead programs."""
+        chunk_done = False
+        cap = (self.bucket_for(max_prompt) if max_prompt is not None
+               else self.buckets[-1])
+        for b in self.buckets:
+            if b > cap:
+                break
+            n = max(1, min(b, self.cfg.max_len) - 1)
+            logits = self.paged_prefill_row(
+                cache, np.ones((n,), np.int32), 0)
+            self.sample(logits)
+            if not chunk_done and n + chunk < self.cfg.max_len:
+                self.paged_decode_chunk(
+                    cache, np.ones((cache.batch,), np.int32), chunk)
+                chunk_done = True
+            cache.free_row(0)
+
+    def compile_count(self) -> int:
+        """Distinct XLA programs compiled across every program cache
+        (trunk, chunk/join/paged dispatch tables) — the obs surface
+        the encoder already publishes: a count still growing after
+        warmup means some serving geometry escapes the bucket set and
+        pays jit compiles on the wake path.  -1 when the private jax
+        cache API is unavailable."""
+        fns = ([self._fn] + list(self._chunk_progs.values())
+               + list(self._join_progs.values())
+               + list(self._paged_progs.values()))
+        total = 0
+        for f in fns:
+            try:
+                total += int(f._cache_size())
+            except Exception:   # private jax API: absence isn't an error
+                return -1
+        return total
 
     def generate_batch(self, prompts: list[np.ndarray], max_new: int,
                        *, chunk: int = 8):

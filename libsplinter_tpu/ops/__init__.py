@@ -1,3 +1,4 @@
+from .paged_attention import paged_attention
 from .similarity import (FUSED_K_MAX, cosine_scores, cosine_topk,
                          cosine_topk_batch, euclidean_distances,
                          topk_program)
@@ -5,4 +6,4 @@ from .staged_lane import StagedLane
 
 __all__ = ["FUSED_K_MAX", "cosine_scores", "cosine_topk",
            "cosine_topk_batch", "euclidean_distances", "topk_program",
-           "StagedLane"]
+           "StagedLane", "paged_attention"]

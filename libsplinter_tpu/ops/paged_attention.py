@@ -1,0 +1,216 @@
+"""Ragged paged decode attention over a block-paged KV pool.
+
+The decoder's dense KV cache — per-layer (B, max_len, KH, D) tensors —
+makes cache HBM scale with B x max_len regardless of how many tokens
+each row actually holds, which is exactly why the continuous-batching
+lane capped at batch_cap=8 (r05: 612.3 aggregate tok/s) and had to
+share one decode window across the batch.  This module is the TPU-
+native fix (Ragged Paged Attention, PAPERS.md arxiv 2604.15464): K/V
+live in a GLOBAL page pool
+
+    k_pool / v_pool: (n_blocks, KH, page, D)     per layer
+
+and each batch row owns an int32 block table mapping its logical pages
+to pool blocks.  Rows are RAGGED — row r's length is lengths[r], there
+is no shared position, no window mask padding, and freeing a row
+returns its pages to the pool without touching its neighbours.
+
+The decode kernel (one query token per row) runs on grid
+(B, KH, n_pages): the block table rides scalar prefetch so each
+program's index map gathers exactly its page of the pool
+(pltpu.PrefetchScalarGridSpec — the table lands in SMEM before the
+body runs), and a flash-style online softmax (running max / sum /
+accumulator in VMEM scratch, carried across the page axis) computes
+each row's attention over its OWN length.  Pages wholly past a row's
+length are skipped (@pl.when), so compute scales with live tokens,
+not table width.  Per (b, kh) program the kv page block is
+(1, 1, page, D) — each page's bytes cross HBM once per kv head, and
+the (rep, page) logits tile never leaves VMEM.
+
+Page size must be a multiple of the 128-lane tile on real TPU
+hardware; interpret mode (CPU parity tests) accepts any page size.
+Block 0 of the pool is reserved by convention as the TRASH block
+(models/decoder.PagedKVCache): unallocated table entries point at it,
+so gathers of unused pages read garbage that the length mask excludes
+and scatters from dead rows land harmlessly.
+
+Rows with lengths == 0 are DON'T-CARE: the kernel returns zeros for
+them (every page skipped), the jnp reference returns a uniform average
+of trash — consumers (the completion daemon) discard dead rows'
+outputs before anything can read them, same contract as the flash
+kernels' fully-masked rows.
+
+Prefill is NOT this kernel's job: prompt chunks attend through the
+dense bucket programs (ops/flash_attention.causal_flash_attention for
+long chunks) and their K/V rows are then scattered into freshly
+allocated pages (decoder.CompletionModel.paged_prefill_row) — one
+compiled program per bucket, like every other program in the serving
+stack.
+
+On non-TPU backends the same math runs as plain jnp over a gathered
+page view (tests exercise the kernel itself via interpret=True).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
+                  m_s, l_s, acc_s, *, page: int, scale: float):
+    """One (batch row, kv head, page) program.
+
+    tab_ref: (B, P) SMEM block table (scalar prefetch)
+    len_ref: (B,)   SMEM row lengths (scalar prefetch)
+    q_ref:   (1, 1, rep, D) this row's queries for this kv head
+    k_ref/v_ref: (1, 1, page, D) the page the table routed here
+    out_ref: (1, 1, rep, D)
+    m_s/l_s: (rep, 1) f32 running max / sum;  acc_s: (rep, D) f32
+
+    The page axis is innermost, so the scratch carries the online
+    softmax across a row's pages and the output block (revisited per
+    page) is written once on the last page.
+    """
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    length = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(p * page < length)
+    def _accumulate():
+        q = q_ref[0, 0]                                 # (rep, D)
+        k = k_ref[0, 0]                                 # (page, D)
+        v = v_ref[0, 0]
+        rep = q.shape[0]
+        logits = jnp.dot(q, k.T,
+                         preferred_element_type=jnp.float32) * scale
+        j = jax.lax.broadcasted_iota(jnp.int32, (rep, page), 1)
+        valid = (p * page + j) < length                 # ragged mask
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev, l_prev = m_s[...], l_s[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(logits, -1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        pexp = jnp.where(valid, jnp.exp(logits - m_new), 0.0)
+        m_s[...] = m_new
+        l_s[...] = l_prev * corr + jnp.sum(pexp, -1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + jnp.dot(
+            pexp.astype(v.dtype), v,
+            preferred_element_type=jnp.float32)
+
+    @pl.when(p == n_pages - 1)
+    def _write():
+        l = l_s[...]
+        out = jnp.where(l > 0.0, acc_s[...] / jnp.maximum(l, 1e-30),
+                        0.0)
+        out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_pallas(q4, k_pool, v_pool, tables, lengths, *,
+                  interpret: bool):
+    """q4: (B, KH, rep, D); pools: (n_blocks, KH, page, D);
+    tables: (B, P) int32; lengths: (B,) int32.
+    Returns (B, KH, rep, D)."""
+    B, KH, rep, D = q4.shape
+    page = k_pool.shape[2]
+    P = tables.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    kv_spec = pl.BlockSpec(
+        (1, 1, page, D),
+        lambda b, h, p, tab, lens: (tab[b, p], h, 0, 0),
+        memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KH, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, D),
+                         lambda b, h, p, tab, lens: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, D),
+                               lambda b, h, p, tab, lens: (b, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, page=page, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, rep, D), q4.dtype),
+        interpret=interpret,
+    )(tables, lengths, q4, k_pool, v_pool)
+
+
+def _paged_ref(q, k_pool, v_pool, tables, lengths):
+    """Reference math: gather every table page into a dense
+    (B, KH, P*page, D) view and run the masked softmax — the
+    correctness mirror the kernel is pinned against (and the non-TPU
+    serving path; XLA fuses the gather fine on CPU)."""
+    B, H, D = q.shape
+    KH, page = k_pool.shape[1], k_pool.shape[2]
+    rep = H // KH
+    kg = k_pool[tables].transpose(0, 2, 1, 3, 4)     # (B, KH, P, pg, D)
+    vg = v_pool[tables].transpose(0, 2, 1, 3, 4)
+    T = kg.shape[2] * page
+    kseq = kg.reshape(B, KH, T, D)
+    vseq = vg.reshape(B, KH, T, D)
+    qr = q.reshape(B, KH, rep, D)
+    logits = jnp.einsum(
+        "bkrd,bktd->bkrt", qr.astype(jnp.float32),
+        kseq.astype(jnp.float32)) / np.sqrt(D)
+    valid = jnp.arange(T)[None, :] < lengths[:, None]       # (B, T)
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkrt,bktd->bkrd", probs.astype(vseq.dtype), vseq)
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    interpret: bool = False,
+                    force_pallas: bool = False):
+    """Ragged paged decode attention (FORWARD/serving only).
+
+    q: (B, H, D) — ONE query token per row, at position lengths[b]-1
+    (call after appending the step's K/V, so lengths counts it);
+    k_pool/v_pool: (n_blocks, KH, page, D) — kv heads UNREPEATED (GQA:
+    query head h reads kv head h // (H//KH), grouped like
+    causal_flash_attention);
+    tables: (B, P) int32 block table — entry (b, p) is the pool block
+    holding row b's tokens [p*page, (p+1)*page); unused entries point
+    at the trash block 0;
+    lengths: (B,) int32 — row b attends keys j < lengths[b].
+    Returns (B, H, D) in q's dtype.
+    """
+    B, H, D = q.shape
+    KH = k_pool.shape[1]
+    rep = H // KH
+    use_pallas = (force_pallas or interpret
+                  or jax.default_backend() == "tpu")
+    if not use_pallas:
+        return _paged_ref(q, k_pool, v_pool, tables, lengths)
+    q4 = q.reshape(B, KH, rep, D)
+    out = _paged_pallas(q4, k_pool, v_pool,
+                        jnp.asarray(tables, jnp.int32),
+                        jnp.asarray(lengths, jnp.int32),
+                        interpret=interpret)
+    return out.reshape(B, H, D)

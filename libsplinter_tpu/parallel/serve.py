@@ -84,6 +84,11 @@ class ShardedCompletionModel(CompletionModel):
     programs run over sharded arrays and GSPMD inserts the block psums.
     """
 
+    # the paged pool is host-scheduled and unsharded; until the pools
+    # get a tp placement (and the paged kernel a shard_map), sharded
+    # serving stays on the dense batched path
+    paged_supported = False
+
     def __init__(self, cfg, mesh: Mesh | None = None, **kw):
         import dataclasses
 

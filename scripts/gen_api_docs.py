@@ -62,6 +62,58 @@ class Section:
 # that extend a section — kept HERE so the docs stay regenerable and
 # tests/test_api_docs.py's sync check covers them too.
 _APPENDICES = {
+    "bloom-labels": """
+## Paged KV cache + ragged paged attention (`models/decoder.py`, `ops/paged_attention.py`)
+
+The completion lane behind `LBL_INFER_REQ` serves continuous batching
+(`spt … --continuous`, `completer.run_continuous`) over a
+**block-paged KV pool** instead of the dense per-batch cache:
+
+### `PagedKVCache` (`libsplinter_tpu/models/decoder.py`)
+
+| surface | contents |
+|---|---|
+| `k_pools` / `v_pools` | per layer `(n_blocks, kv_heads, page, head_dim)` global page pool |
+| `tables` | host `(batch, pages_per_row)` int32 block table — entry `(b, p)` holds row b's tokens `[p*page, (p+1)*page)` |
+| `lengths` | host `(batch,)` int32 per-row token counts (row b attends `j < lengths[b]`) |
+| `ensure(row, tokens)` / `free_row(row)` | page-granular alloc (all-or-nothing; False = backpressure) and immediate release |
+| `free_pages` / `used_pages` / `live_tokens()` | the pool gauges the completer heartbeat publishes (`sptpu_completer_pages_{free,used}`) |
+
+Block 0 is the reserved **trash block**: unallocated table entries
+point at it, so dead rows' appends land harmlessly and gathers of
+unused pages read garbage the length mask excludes.  Cache HBM scales
+with LIVE TOKENS, not `batch x max_len` — which is why `--batch-cap`
+defaults to 32 (was 8) and `--pool-pages` caps the budget (default:
+batch full windows).
+
+### `paged_attention` (`libsplinter_tpu/ops/paged_attention.py`)
+
+Pallas decode kernel, grid `(B, kv_heads, pages_per_row)`: the block
+table rides scalar prefetch (`PrefetchScalarGridSpec`) so each
+program's index map gathers exactly its page; a flash-style online
+softmax carried across the page axis computes every row's attention
+over its OWN ragged length — no shared `pos`, no window-mask padding,
+pages wholly past a row's length skipped.  `interpret=True` runs it on
+CPU for parity tests; non-TPU backends serve through the identical
+jnp gathered-page math.  Prefill stays on the dense bucket programs
+(`causal_flash_attention` for long chunks) and scatters into pages via
+one commit program per bucket (`CompletionModel.paged_prefill_row`).
+
+### Scheduler contract (`completer.run_continuous`)
+
+Every admission is a join: the prompt prefills into freshly allocated
+pages at any time (no join budget, no oversized-joiner deferral — a
+joiner longer than a neighbour's remaining window is fine), finished
+rows return pages immediately, and admission reserves the row's worst
+case (`prompt + max_new` rounded up to a decode-chunk boundary —
+decode appends whole `flush_tokens` chunks — capped at the window) so
+decode can never
+strand on an exhausted pool — a request the pool cannot cover stays
+WAITING and `join_backpressure` counts it.  Stage spans publish under
+`CONT_INFER_STAGES` (join / sample / decode / flush) and
+client-stamped requests land in the flight recorder (`spt trace
+tail`).  `make decode-check` gates the tier.
+""",
     "embedding-vector-lane": """
 ## Search daemon (`libsplinter_tpu/engine/searcher.py`)
 

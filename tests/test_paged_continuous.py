@@ -1,0 +1,384 @@
+"""The block-paged continuous-batching lane (completer.run_continuous
+over PagedKVCache): token-exact paged-vs-dense serving, the
+no-shared-window joiner guarantee, pool backpressure, page-leak
+freedom across request lifecycles, heartbeat gauges, and speculative
+demotion.  `make decode-check` runs this file +
+tests/test_paged_attention.py.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.models.decoder import CompletionModel, DecoderConfig
+
+
+def _mkstore(tmp_path, tag, **kw):
+    name = f"/spt-{tag}-{tmp_path.name}"
+    Store.unlink(name)
+    kw.setdefault("nslots", 128)
+    kw.setdefault("max_val", 4096)
+    kw.setdefault("vec_dim", 8)
+    return name, Store.create(name, **kw)
+
+
+def _submit(st, key, prompt):
+    st.set(key, prompt)
+    st.label_or(key, P.LBL_INFER_REQ)
+    st.bump(key)
+
+
+def _await_ready(st, keys, timeout=75):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(st.labels(k) & P.LBL_READY for k in keys):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _run_bg(comp, stop_after=90.0):
+    th = threading.Thread(
+        target=comp.run_continuous,
+        kwargs=dict(idle_timeout_ms=20, stop_after=stop_after),
+        daemon=True)
+    th.start()
+    time.sleep(0.2)
+    return th
+
+
+def test_paged_continuous_token_exact_vs_dense(tmp_path):
+    """Greedy completions must be byte-identical whether the keys
+    were served through the dense batched drain or the paged
+    continuous lane — the paged-vs-dense token-exactness bar at a
+    fixed weight seed (dense == serial is already pinned by
+    tests/test_batch_decode.py)."""
+    out: dict[str, bytes] = {}
+    model = CompletionModel(
+        DecoderConfig.tiny(dtype=jnp.float32), buckets=(32,),
+        temp=0.0, seed=1)
+    for tag in ("dense", "paged"):
+        name, st = _mkstore(tmp_path, f"pvd-{tag}")
+        try:
+            comp = Completer(st, model=model, max_new_tokens=10,
+                             flush_tokens=4, template="none",
+                             batch_cap=4, page_size=16)
+            comp.attach()
+            for i in range(3):
+                _submit(st, f"q/{i}", f"say {i} things")
+            if tag == "paged":
+                th = _run_bg(comp)
+                assert _await_ready(st, [f"q/{i}" for i in range(3)])
+                comp.stop()
+                th.join(timeout=5)
+            else:
+                assert comp.run_once() == 3
+            out[tag] = b"|".join(
+                st.get(f"q/{i}").rstrip(b"\0") for i in range(3))
+        finally:
+            st.close()
+            Store.unlink(name)
+    assert out["dense"] == out["paged"]
+
+
+@pytest.mark.slow
+def test_paged_joiner_exceeding_dense_window_untruncated(tmp_path):
+    """THE no-shared-window regression test: while a short row is
+    mid-decode, a joiner arrives whose prompt is longer than the
+    dense batch's remaining window would have allowed (dense
+    join_budget would defer or clip it).  Paged serving admits it
+    immediately, keeps the FULL prompt, and its completion is
+    byte-identical to serving it alone."""
+    model = CompletionModel(DecoderConfig.tiny(dtype=jnp.float32,
+                                               max_len=128),
+                            buckets=(16, 64), temp=0.0, seed=1)
+    # 160 byte tokens: far past the dense live batch's join_budget
+    # (16 at pos=16), inside the paged lane's own per-row budget
+    long_prompt = ("tok " * 40).encode()
+
+    # ground truth: the long prompt served ALONE through the SAME
+    # paged lane (identical context budget), nobody else in the batch
+    name, st = _mkstore(tmp_path, "alone")
+    try:
+        comp = Completer(st, model=model, max_new_tokens=30,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        th = _run_bg(comp)
+        _submit(st, "long", long_prompt)
+        assert _await_ready(st, ["long"]), comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        alone = st.get("long").rstrip(b"\0")
+    finally:
+        st.close()
+        Store.unlink(name)
+
+    name, st = _mkstore(tmp_path, "joined")
+    try:
+        comp = Completer(st, model=model, max_new_tokens=30,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        th = _run_bg(comp, stop_after=120.0)
+        _submit(st, "short", b"hi")
+        time.sleep(0.8)                # batch live, short mid-decode
+        _submit(st, "long", long_prompt)
+        assert _await_ready(st, ["short", "long"], timeout=100), \
+            comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        val = st.get("long").rstrip(b"\0")
+        assert val.startswith(long_prompt.rstrip()), "prompt clipped"
+        assert val == alone, \
+            "joiner's completion differs from serving it alone"
+        assert st.labels("short") & P.LBL_READY
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_paged_pool_backpressure_and_recovery(tmp_path):
+    """A pool too small for two concurrent worst-case rows admits one
+    request, backpressures the second (it STAYS WAITING, untouched),
+    and serves it after the first finishes — join_backpressure counts
+    the deferral and no pages leak."""
+    name, st = _mkstore(tmp_path, "bp")
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=128,
+                                                   dtype=jnp.float32),
+                                buckets=(16, 32), temp=0.0)
+        # 8 pages of 16 = one full window: the second worst-case
+        # reservation (prompt + max_new) cannot fit while the first
+        # row is live
+        comp = Completer(st, model=model, max_new_tokens=100,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16, pool_pages=8)
+        comp.attach()
+        th = _run_bg(comp, stop_after=120.0)
+        _submit(st, "first", b"aaaa bbbb cccc dddd")
+        _submit(st, "second", b"eeee ffff gggg hhhh")
+        assert _await_ready(st, ["first", "second"], timeout=100), \
+            comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        assert comp.stats.completions == 2
+        assert comp.stats.join_backpressure > 0, comp.stats
+        assert comp._paged_cache.used_pages == 0, "pages leaked"
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.slow
+def test_paged_lifecycle_frees_pages_and_counts(tmp_path):
+    """Staggered arrivals across several chunks: every key gets the
+    full label protocol, and after the drain the pool is empty (every
+    finished row returned all its pages).  Slow tier: the fast sweep
+    covers the same protocol via tests/test_continuous.py and the
+    leak check via the backpressure test."""
+    name, st = _mkstore(tmp_path, "life")
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=128),
+                                buckets=(16, 32), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=24,
+                         flush_tokens=4, template="none", batch_cap=4,
+                         page_size=16)
+        comp.attach()
+        th = _run_bg(comp)
+        for i in range(2):
+            _submit(st, f"w1/{i}", f"first wave {i}")
+        time.sleep(1.0)
+        for i in range(3):
+            _submit(st, f"w2/{i}", f"second wave {i}")
+        keys = [f"w1/{i}" for i in range(2)] + \
+            [f"w2/{i}" for i in range(3)]
+        assert _await_ready(st, keys), comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        for k in keys:
+            labels = st.labels(k)
+            assert labels & P.LBL_READY, (k, comp.stats)
+            assert not labels & (P.LBL_INFER_REQ | P.LBL_SERVICING), k
+            assert len(st.get(k).rstrip(b"\0")) > len(k) + 8
+        assert comp.stats.completions == 5
+        assert comp._paged_cache.used_pages == 0, "pages leaked"
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.slow
+def test_paged_heartbeat_pool_gauges(tmp_path):
+    """The completer heartbeat carries the paged-pool gauges
+    (pages_free / pages_used -> sptpu_completer_pages_{free,used})
+    once the continuous lane has a pool.  Slow tier: warmup_paged
+    dominates the runtime and the gauges ride every backpressure /
+    churn assertion too (tier-1 870 s budget)."""
+    name, st = _mkstore(tmp_path, "hb")
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=128),
+                                buckets=(16,), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        comp.warmup_paged()            # creates the pool
+        comp.publish_stats()
+        snap = json.loads(st.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+        assert snap["pages_used"] == 0
+        assert snap["pages_free"] == comp._paged_cache.free_pages
+        assert "join_backpressure" in snap
+        assert "live_tokens" in snap
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.slow
+def test_paged_continuous_traces_requests(tmp_path, monkeypatch):
+    """Satellite: the continuous lane stamps CONT_INFER_STAGES spans
+    and records client-stamped (LBL_TRACED) requests in the flight
+    recorder — `spt trace tail` works on the batched lane.  Slow
+    tier: tier-1 870 s budget (`make check`'s full sweep runs it)."""
+    from libsplinter_tpu.engine import completer as cmod
+
+    monkeypatch.setattr(cmod.tracer, "enabled", True)
+    cmod.tracer.reset()
+    name, st = _mkstore(tmp_path, "trace")
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=128),
+                                buckets=(16, 32), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=12,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        st.set("traced", b"tell me a story")
+        st.label_or("traced", P.LBL_INFER_REQ)
+        tid = P.stamp_trace(st, "traced")
+        assert tid is not None
+        st.bump("traced")
+        th = _run_bg(comp)
+        assert _await_ready(st, ["traced"]), comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        recs = comp.recorder.tail(8)
+        assert recs, "traced request missing from the flight recorder"
+        rec = recs[-1]
+        assert rec["id"] == tid and rec["key"] == "traced"
+        stages = {name for name, _ in rec["events"]}
+        assert "join" in stages and "decode" in stages, rec
+        assert stages <= set(P.CONT_INFER_STAGES), rec
+        # the span histograms publish under the infer.* prefix so the
+        # heartbeat quantiles + `spt metrics` pick them up
+        snap = cmod.tracer.snapshot()
+        assert "infer.join" in snap and "infer.decode" in snap
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.slow
+def test_spec_acceptance_heartbeat_and_demotion(tmp_path):
+    """Satellite: a speculative model with hopeless acceptance
+    publishes sptpu_completer_spec_acceptance and is demoted to its
+    target below --spec-min-acceptance; serving continues.  Slow
+    tier for the 870 s tier-1 budget — `make decode-check` runs the
+    whole file (no slow filter), so the gate keeps this test."""
+    from libsplinter_tpu.models import SpeculativeCompletionModel
+
+    name, st = _mkstore(tmp_path, "spec")
+    try:
+        # disjoint seeds: the draft proposes junk the target rejects
+        t = CompletionModel(DecoderConfig.tiny(dtype=jnp.float32),
+                            buckets=(16,), temp=0.0, seed=2)
+        d = CompletionModel(
+            DecoderConfig.tiny(dtype=jnp.float32, layers=1),
+            buckets=(16,), temp=0.0, seed=99)
+        spec = SpeculativeCompletionModel(t, d, gamma=4)
+        comp = Completer(st, model=spec, max_new_tokens=40,
+                         flush_tokens=4, template="none", batch_cap=1,
+                         spec_min_acceptance=0.95)
+        comp.attach()
+        _submit(st, "q1", b"first question")
+        assert comp.run_once() == 1
+        comp.publish_stats()
+        snap = json.loads(st.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+        assert "spec_acceptance" in snap
+        assert snap["spec_acceptance"] < 0.95
+        assert comp.stats.spec_demotions == 1, comp.stats
+        assert comp._model is t, "completer still speculative"
+        # plain decode keeps serving after the demotion
+        _submit(st, "q2", b"second question")
+        assert comp.run_once() == 1
+        assert st.labels("q2") & P.LBL_READY
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.slow
+def test_spec_demotion_respects_floor_zero(tmp_path):
+    """--spec-min-acceptance 0 disables the demotion entirely.  Slow
+    tier for the 870 s tier-1 budget (`make decode-check` and `make
+    check` run it)."""
+    from libsplinter_tpu.models import SpeculativeCompletionModel
+
+    name, st = _mkstore(tmp_path, "spec0")
+    try:
+        t = CompletionModel(DecoderConfig.tiny(dtype=jnp.float32),
+                            buckets=(16,), temp=0.0, seed=2)
+        d = CompletionModel(
+            DecoderConfig.tiny(dtype=jnp.float32, layers=1),
+            buckets=(16,), temp=0.0, seed=99)
+        spec = SpeculativeCompletionModel(t, d, gamma=4)
+        comp = Completer(st, model=spec, max_new_tokens=40,
+                         flush_tokens=4, template="none", batch_cap=1,
+                         spec_min_acceptance=0.0)
+        comp.attach()
+        _submit(st, "q", b"a question")
+        assert comp.run_once() == 1
+        assert comp.stats.spec_demotions == 0
+        assert comp._model is spec
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+@pytest.mark.slow
+def test_paged_continuous_churn_no_leak(tmp_path):
+    """Heavy tier: three waves of staggered joins/finishes through a
+    deliberately tight pool — every request completes, backpressure
+    engages, and the pool ends empty."""
+    name, st = _mkstore(tmp_path, "churn", nslots=256)
+    try:
+        model = CompletionModel(DecoderConfig.tiny(max_len=128),
+                                buckets=(16, 32), temp=0.0)
+        comp = Completer(st, model=model, max_new_tokens=20,
+                         flush_tokens=4, template="none", batch_cap=4,
+                         page_size=16, pool_pages=16)
+        comp.attach()
+        th = _run_bg(comp, stop_after=300.0)
+        keys = []
+        for wave in range(3):
+            for i in range(5):
+                k = f"c/{wave}/{i}"
+                keys.append(k)
+                _submit(st, k, f"wave {wave} question {i} ")
+            time.sleep(0.5)
+        assert _await_ready(st, keys, timeout=240), comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        assert comp.stats.completions == len(keys)
+        assert comp._paged_cache.used_pages == 0, "pages leaked"
+    finally:
+        st.close()
+        Store.unlink(name)

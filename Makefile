@@ -24,6 +24,12 @@
 #                   crash-at-every-stage recovery matrix (child
 #                   daemons crashed mid-drain via crash@k, restarted,
 #                   convergence asserted; `pytest -m chaos`)
+#   make dispatch-check  dispatch-floor tier: resident-ring /
+#                   K-overlap parity vs the per-call paths (byte-
+#                   identical vectors, search results, decode tokens)
+#                   + the depth-amortization smoke (per-drain host
+#                   overhead must shrink monotonically with depth;
+#                   scripts/dispatch_amortization_check.py)
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -55,6 +61,7 @@ quick: native
 check: native
 	$(MAKE) -C native check
 	$(PY) scripts/obs_overhead_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -72,6 +79,11 @@ decode-check: native
 chaos-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
+dispatch-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resident.py -q \
+		-m "not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
+
 memcheck: native
 	$(MAKE) -C native memcheck
 
@@ -83,4 +95,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native quick check obs-check search-check decode-check \
-	chaos-check memcheck bench-cpu clean
+	chaos-check dispatch-check memcheck bench-cpu clean

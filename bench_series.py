@@ -70,13 +70,14 @@ BASELINE_PER_CHIP = 12_500.0
 # ledger timestamp format — shared with bench.py's age check
 TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
 
-ALL_PHASES = ("embed", "embed_sweep", "profile", "kernels", "search",
-              "restage", "decode", "decode_quant", "decode_daemon",
-              "store_ops")
+ALL_PHASES = ("embed", "embed_sweep", "profile", "dispatch", "kernels",
+              "search", "restage", "decode", "decode_quant",
+              "decode_daemon", "store_ops")
 
 # conservative floor (seconds) a phase needs to be worth starting;
 # compile costs dominate these on a cold .xla_cache
 PHASE_MIN_S = {"embed": 0, "embed_sweep": 120, "profile": 90,
+               "dispatch": 20,
                "kernels": 120, "search": 150, "restage": 180,
                "decode": 180, "decode_quant": 150, "decode_daemon": 120,
                "store_ops": 15}
@@ -360,6 +361,11 @@ def phase_embed(ctx: SeriesCtx) -> dict:
             "blocking_waits": emb.stats.blocking_waits,
             "ready_commits": emb.stats.ready_commits,
             "inflight_peak": emb.stats.inflight_peak,
+            # resident-ring evidence (PR 7): how many device dispatches
+            # the throughput drains actually paid per batch
+            "ring_dispatches": emb.stats.ring_dispatches,
+            "resident_iterations": emb.stats.resident_iterations,
+            "ring_occupancy_peak": emb.stats.ring_occupancy_peak,
         }
         log(f"p50 set->vector (event-driven): {p50:.2f} ms  p95: "
             f"{p95:.2f} ms  p99: {p99:.2f} ms  "
@@ -684,6 +690,116 @@ def phase_profile(ctx: SeriesCtx) -> dict:
         "value": big["device_ms"], "unit": "ms", "vs_baseline": 0.0,
         "detail": {"backend": ctx.backend, "reps": reps,
                    "runtime_floor": floor, "shapes": rows}})
+
+
+# ---------------------------------------------------------------------------
+# phase: dispatch — the runtime dispatch floor and its depth amortization
+# ---------------------------------------------------------------------------
+
+def dispatch_depth_rows(depths=(1, 2, 4, 8), reps: int = 30) -> list:
+    """Per-drain runtime dispatch cost amortized over depth, for BOTH
+    PR-7 mechanisms (ISSUE 7; engine/resident.py):
+
+      overlap    K un-awaited null dispatches held, then one blocking
+                 drain of them all (the InflightWindow discipline) —
+                 amortized per-drain cost = wall / K;
+      resident   ONE dispatch whose lax.while_loop runs K iterations
+                 (the resident-ring discipline; the trip count is a
+                 scalar OPERAND, so every depth reuses one compiled
+                 program) — amortized = wall / K.
+
+    The work per iteration is a scalar add — pure dispatch/loop
+    overhead, no compute to hide behind — so the rows attribute the
+    floor itself, the way null_dispatch_ms did for depth 1 in r05.
+    Returns [{depth, overlap_ms_per_drain, resident_ms_per_drain,
+    ...}] with p50s over `reps`."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(np.float32(1.0))
+    add1 = jax.jit(lambda v: v + 1.0)
+
+    @jax.jit
+    def ring(v, n):
+        def body(c):
+            i, acc = c
+            return i + 1, acc + 1.0
+
+        return jax.lax.while_loop(lambda c: c[0] < n, body,
+                                  (jnp.int32(0), v))[1]
+
+    add1(x).block_until_ready()                    # compile both once
+    ring(x, jnp.int32(max(depths))).block_until_ready()
+
+    def _p50(fn) -> float:
+        fn()
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return float(np.percentile(ts, 50))
+
+    rows = []
+    for k in depths:
+        def overlap(k=k):
+            futs = [add1(x) for _ in range(k)]
+            for f in futs:
+                f.block_until_ready()
+
+        def resident(k=k):
+            ring(x, jnp.int32(k)).block_until_ready()
+
+        o = _p50(overlap)
+        rt = _p50(resident)
+        rows.append({"depth": k,
+                     "overlap_total_ms": round(o, 4),
+                     "overlap_ms_per_drain": round(o / k, 4),
+                     "resident_total_ms": round(rt, 4),
+                     "resident_ms_per_drain": round(rt / k, 4)})
+    return rows
+
+
+def phase_dispatch(ctx: SeriesCtx) -> dict:
+    """Dispatch-floor attribution arm: r05 measured null_dispatch_ms
+    ~63 ms (94% of the 67.2 ms p50 set->vector) at depth 1 — the
+    before-row.  This sweeps dispatch_depth in {1,2,4,8} and ledgers
+    the amortized per-drain dispatch cost for the resident-ring and
+    K-overlap paths, so the serving knobs (--ring-depth /
+    --inflight-depth) have attribution data on the same backend the
+    latencies were measured on.  Env: DISPATCH_DEPTHS (1,2,4,8),
+    DISPATCH_REPS (30)."""
+    depths = tuple(int(x) for x in os.environ.get(
+        "DISPATCH_DEPTHS", "1,2,4,8").split(","))
+    reps = int(os.environ.get("DISPATCH_REPS", "30"))
+    rows = dispatch_depth_rows(depths, reps)
+    d1 = rows[0]
+    dk = rows[-1]
+
+    def _x(a: float, b: float) -> float:
+        return round(a / max(b, 1e-9), 1)
+
+    detail = {
+        "backend": ctx.backend, "reps": reps,
+        # the r05 before-rows this arm attributes (BENCH_r05 profile
+        # phase: the dispatch floor ~= the whole p50)
+        "before": {"r05_null_dispatch_ms": 63.0,
+                   "r05_p50_set_to_vector_ms": 67.2},
+        "rows": rows,
+        "resident_amortization_x": _x(d1["resident_ms_per_drain"],
+                                      dk["resident_ms_per_drain"]),
+        "overlap_amortization_x": _x(d1["overlap_ms_per_drain"],
+                                     dk["overlap_ms_per_drain"]),
+    }
+    log(f"[dispatch] {json.dumps(detail['rows'])}")
+    return ctx.record({
+        "metric": "dispatch_depth",
+        "value": dk["resident_ms_per_drain"],
+        "unit": f"ms/drain (amortized, depth {dk['depth']})",
+        "vs_baseline": 0.0,
+        "detail": detail})
 
 
 # ---------------------------------------------------------------------------
@@ -1715,6 +1831,7 @@ PHASE_FNS = {
     "embed": phase_embed,
     "embed_sweep": phase_embed_sweep,
     "profile": phase_profile,
+    "dispatch": phase_dispatch,
     "kernels": phase_kernels,
     "search": phase_search,
     "restage": phase_restage,

@@ -86,6 +86,9 @@ def cmd_metrics(ses, args):
         lane = snap.pop("lane", None)  # searcher: StagedLane counters
         if isinstance(lane, dict):
             w.scalars(f"sptpu_{daemon}_lane", lane)
+        disp = snap.pop("dispatch", None)  # PR-7 overlap gauges: their
+        if isinstance(disp, dict):         # own (size-droppable)
+            w.scalars(f"sptpu_{daemon}", disp)  # section, flat names
         flt = snap.pop("faults", None)  # armed SPTPU_FAULT accounting
         if isinstance(flt, dict):
             for site, counts in flt.items():

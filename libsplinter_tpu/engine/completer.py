@@ -35,6 +35,7 @@ import errno
 import logging
 import os
 import time
+from collections import deque
 from typing import Callable, Iterator
 
 from .. import _native as N
@@ -119,6 +120,9 @@ class CompleterStats:
     reclaimed: int = 0                # stranded SERVICING rows re-queued
     join_backpressure: int = 0        # admissions deferred: pool full
     spec_demotions: int = 0           # speculative -> plain fallbacks
+    # -- K-deep decode overlap (engine/resident.py): un-awaited paged
+    # decode chunks held while the host emits/admits ----------------
+    inflight_peak: int = 0
 
 
 class Completer:
@@ -137,6 +141,7 @@ class Completer:
                  batch_cap: int | None = None,
                  page_size: int = 128,
                  pool_pages: int | None = None,
+                 inflight_depth: int | None = None,
                  spec_min_acceptance: float = 0.2):
         self.store = store
         self.max_new = max_new_tokens
@@ -152,6 +157,19 @@ class Completer:
         self.paged_batch_cap = 32 if batch_cap is None else batch_cap
         self.page_size = page_size
         self.pool_pages = pool_pages
+        # K-deep decode overlap on the continuous lane: the chunk
+        # pipeline runs K deep — dispatch chunk K, then collect the
+        # OLDEST while the newest computes (the token hand-off between
+        # chunks rides the device, PendingChunk.last), so the host's
+        # emit/flush/admit work overlaps device compute and the
+        # per-chunk runtime round trip amortizes.  K counts the chunk
+        # being collected: K-1 chunks stay un-awaited between loop
+        # iterations (one less than the searcher/embedder windows,
+        # whose depth bounds fully un-awaited entries), and 1 =
+        # collect each chunk before dispatching the next — the
+        # pre-overlap sync cadence.
+        self.inflight_depth = (2 if inflight_depth is None
+                               else max(1, inflight_depth))
         self.spec_min_acceptance = spec_min_acceptance
         self._spec_hist: list[tuple[int, int]] = []
         self._spec_acceptance_rolling: float | None = None
@@ -657,6 +675,8 @@ class Completer:
         if not self._paged_ok():
             return self.run(idle_timeout_ms=idle_timeout_ms,
                             stop_after=stop_after)
+        import itertools
+
         import numpy as np
 
         m = self._model
@@ -671,7 +691,22 @@ class Completer:
         next_beat = time.monotonic() + 2.0
 
         rows: list[dict | None] = [None] * B
-        toks = np.zeros((B,), np.int32)
+        # K-deep chunk window (engine/resident.py discipline): up to
+        # inflight_depth dispatched chunks fly un-awaited; the token
+        # hand-off between chunks stays ON DEVICE (PendingChunk.last),
+        # and each entry snapshots (row, serial) of the rows live at
+        # its dispatch so a lagged collect can never emit into a row a
+        # later admission re-seated (the serial is the guard — pages a
+        # stale in-flight chunk touches are either still owned by the
+        # finished row or fully overwritten by the joiner's commit
+        # scatter, which the device executes in dispatch order).
+        window: deque = deque()       # (PendingChunk, [(row, serial)])
+        serial = itertools.count()
+        carry = None                  # device-side last-token column
+        # host-fed fresh tokens: a row whose token was produced on the
+        # host since the last dispatch (a joiner's prefill sample)
+        # rides this column; -1 = take the device carry
+        fresh = np.full((B,), -1, np.int32)
         rebid_due = 0                 # decoded steps since last rebid
         step = max(1, self.flush_tokens)   # decode chunk granularity
         # backpressured requests, idx -> (slot epoch, pages needed):
@@ -749,6 +784,13 @@ class Completer:
                 rows[r] = {"key": key, "t0": t0, "n_tok": 0,
                            "pending": b"", "remaining": self.max_new,
                            "stamp": stamp,
+                           # serial: the lagged-collect guard (a chunk
+                           # in flight across this row's re-seat must
+                           # never emit into the newcomer); disp_left:
+                           # decode steps still dispatchable before
+                           # every budgeted token is in flight
+                           "serial": next(serial),
+                           "disp_left": self.max_new - 1,
                            "spans": ([] if traced and stamp is not None
                                      else None),
                            "wall0": time.perf_counter()}
@@ -764,8 +806,8 @@ class Completer:
                     span(rows[r], "sample", (tc - tb) * 1e3)
                 emit(r, t)
                 if rows[r] is not None:
-                    toks[r] = t
-                n += 1
+                    fresh[r] = t      # host-side token: next dispatch
+                n += 1                # reads it over the device carry
             return n
 
         def emit(r: int, t: int) -> None:
@@ -810,15 +852,45 @@ class Completer:
                                      row["spans"])
             cache.free_row(r)         # pages back to the pool NOW
             rows[r] = None
-            toks[r] = 0
+            fresh[r] = -1
+
+        def collect(entry) -> None:
+            """Resolve one in-flight chunk: force the block (the one
+            device->host transfer per chunk) and emit its columns to
+            the rows that were live at ITS dispatch — serial-guarded,
+            so tokens for a finished-and-re-seated row are discarded,
+            never delivered to the newcomer."""
+            pend, live = entry
+            tc0 = time.perf_counter()
+            blk = pend.block()
+            if tracer.enabled:
+                # collect = the host's blocked wait on the chunk; the
+                # decode span now measures only the (async) dispatch
+                ms = (time.perf_counter() - tc0) * 1e3
+                tracer.record("infer.collect", ms)
+                for r, ser in live:
+                    row = rows[r]
+                    if row is not None and row["serial"] == ser \
+                            and row.get("spans") is not None:
+                        row["spans"].append(["collect", round(ms, 3)])
+            for c in range(pend.n):
+                for r, ser in live:
+                    row = rows[r]
+                    if row is not None and row["serial"] == ser:
+                        emit(r, int(blk[r, c]))
 
         def abort_all(reason: str) -> None:
             """Model failure must not wedge WAITING/SERVICING (the
             invariant process_key/process_batch keep): every live row
             finalizes with what it already streamed and the pool
             starts clean."""
-            nonlocal cache
+            nonlocal cache, carry
             self._debug(f"continuous batch aborted: {reason}")
+            # in-flight chunks may be poisoned by the same failure:
+            # drop them (rows finalize with what they streamed)
+            window.clear()
+            carry = None
+            fresh[:] = -1
             for r in range(B):
                 if rows[r] is not None:
                     finish(r)
@@ -843,6 +915,12 @@ class Completer:
 
                 try:
                     if all(r is None for r in rows):
+                        # nothing live: retire any in-flight chunks
+                        # (their rows finished — serial guards drop
+                        # every column) and reset the device carry
+                        while window:
+                            collect(window.popleft())
+                        carry = None
                         if admit() == 0:
                             got = st.signal_wait(
                                 self.group, last,
@@ -853,52 +931,85 @@ class Completer:
                         continue
 
                     if any(r is None for r in rows):
-                        admit()       # joiners enter at ANY time
+                        admit()       # joiners enter at ANY time —
+                        # even with chunks in flight: the serial guard
+                        # keeps lagged collects out of re-seated rows
 
-                    # per-row window edge: a row without room for the
-                    # next chunk finalizes with what it has — ITS
-                    # window, nobody else's
-                    for r in range(B):
-                        if rows[r] is not None and \
-                                int(cache.lengths[r]) + step > cfg.max_len:
-                            finish(r)
+                    # per-row edges: a row without window room for the
+                    # next chunk, or whose whole token budget is
+                    # already in flight, must not be dispatched again.
+                    # Its final tokens are still in the window —
+                    # collect oldest-first until the edge rows have
+                    # finished (budget-exhausted rows self-finish the
+                    # moment their last tokens emit, so the common
+                    # end-of-request edge drains only the entries that
+                    # carry those tokens, preserving the overlap for
+                    # the rest of the batch), then force any survivor
+                    # (a true window-edge row) closed
+                    edge = [r for r in range(B) if rows[r] is not None
+                            and (int(cache.lengths[r]) + step
+                                 > cfg.max_len
+                                 or rows[r]["disp_left"] <= 0)]
+                    if edge:
+                        while window and any(rows[r] is not None
+                                             for r in edge):
+                            collect(window.popleft())
+                        for r in edge:
+                            if rows[r] is not None:
+                                finish(r)
                     if all(r is None for r in rows):
                         continue
 
                     td = time.perf_counter()
-                    blk = m.paged_decode_chunk(cache, toks, step)
+                    pend = m.paged_decode_chunk_async(
+                        cache, fresh, step, carry=carry)
+                    live = [(r, rows[r]["serial"]) for r in range(B)
+                            if rows[r] is not None]
                     if tracer.enabled:
-                        # one chunk = one histogram sample, whatever
-                        # the occupancy — per-row recording would make
+                        # decode = the async dispatch (host-side);
+                        # the blocked wait surfaces as the collect
+                        # span when the window forces the chunk.  One
+                        # chunk = one histogram sample, whatever the
+                        # occupancy — per-row recording would make
                         # decode quantiles occupancy-weighted, unlike
                         # every other stage; traced rows still each
                         # get the shared span in their event list
                         ms = (time.perf_counter() - td) * 1e3
                         tracer.record("infer.decode", ms)
-                        for r in range(B):
-                            if rows[r] is not None and \
-                                    rows[r].get("spans") is not None:
+                        for r, _ in live:
+                            if rows[r].get("spans") is not None:
                                 rows[r]["spans"].append(
                                     ["decode", round(ms, 3)])
+                    carry = pend.last
+                    fresh[:] = -1
+                    for r, _ in live:
+                        rows[r]["disp_left"] -= step
+                    window.append((pend, live))
+                    self.stats.inflight_peak = max(
+                        self.stats.inflight_peak, len(window))
                     rebid_due += step
                     if self.rebid_tokens and rebid_due >= self.rebid_tokens:
                         rebid_due = 0
                         self._rebid()
-                    for c in range(step):
-                        for r in range(B):
-                            if rows[r] is not None:
-                                # tokens decoded after this row
-                                # finished mid-chunk are speculative:
-                                # emit in order, discard the rest
-                                emit(r, int(blk[r, c]))
-                    for r in range(B):
-                        if rows[r] is not None:
-                            toks[r] = int(blk[r, -1])
+                    # K-deep window: collect the oldest chunk only
+                    # once inflight_depth are un-awaited — its emit/
+                    # flush host work overlaps the newest chunk's
+                    # device compute, so the per-chunk dispatch floor
+                    # amortizes instead of serializing
+                    while len(window) >= self.inflight_depth:
+                        collect(window.popleft())
                 except Exception as ex:
                     abort_all(str(ex))
         finally:
             # stop()/stop_after mid-batch: never strand keys in
-            # SERVICING; the pool is reusable for the next run
+            # SERVICING; the pool is reusable for the next run.
+            # In-flight tokens are delivered first — a stopped stream
+            # keeps everything that was already decoded.
+            try:
+                while window:
+                    collect(window.popleft())
+            except Exception:
+                pass              # poisoned futures: keep what landed
             for r in range(B):
                 if rows[r] is not None:
                     finish(r)
@@ -1014,6 +1125,9 @@ class Completer:
         quantiles, recorder accounting, and the slow log."""
         payload = dataclasses.asdict(self.stats)
         payload["generation"] = self.generation
+        # decode-overlap gauge: inflight_peak pinned here means the
+        # chunk window saturates (sptpu_completer_inflight_depth)
+        payload["inflight_depth"] = self.inflight_depth
         acc = self._spec_acceptance()
         if acc is not None:
             # sptpu_completer_spec_acceptance in `spt metrics`
@@ -1131,6 +1245,14 @@ def main(argv: list[str] | None = None) -> int:
                          "spend cache HBM on batch width instead of "
                          "padding; admission backpressures when the "
                          "pool is full)")
+    ap.add_argument("--inflight-depth", type=int, default=None,
+                    help="continuous lane: paged decode chunk "
+                         "pipeline depth — dispatch chunk K, collect "
+                         "the oldest while the newest computes (the "
+                         "inter-chunk token hand-off stays on-"
+                         "device), so host emit/admit work overlaps "
+                         "device compute.  Default 2; 1 restores the "
+                         "collect-every-chunk sync cadence")
     ap.add_argument("--spec-min-acceptance", type=float, default=0.2,
                     help="speculative decoding floor: when the "
                          "rolling draft acceptance stays below this, "
@@ -1241,6 +1363,7 @@ def main(argv: list[str] | None = None) -> int:
                      template=template, batch_cap=args.batch_cap,
                      page_size=args.page_size,
                      pool_pages=args.pool_pages,
+                     inflight_depth=args.inflight_depth,
                      spec_min_acceptance=args.spec_min_acceptance)
     comp.attach()
     if args.warmup:

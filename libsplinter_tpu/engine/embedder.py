@@ -33,7 +33,6 @@ import dataclasses
 import logging
 import os
 import time
-from collections import deque
 from typing import Callable, Sequence
 
 import numpy as np
@@ -45,6 +44,7 @@ from ..utils import faults
 from ..utils.faults import fault
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
+from .resident import InflightWindow
 
 log = logging.getLogger("libsplinter_tpu.embedder")
 
@@ -80,6 +80,14 @@ class EmbedderStats:
     blocking_waits: int = 0     # host had to block on a device future
     inflight_peak: int = 0      # max dispatched-uncommitted depth seen
     probe_lane_hits: int = 0    # drains through the small-batch lane
+    # -- resident-ring telemetry (engine/resident.py): one ring
+    # dispatch services ring_occupancy batches, so the per-drain
+    # dispatch floor amortizes to ~floor/occupancy -----------------
+    ring_dispatches: int = 0    # resident device programs dispatched
+    resident_iterations: int = 0  # batches serviced inside rings
+    ring_occupancy: int = 0     # last ring's occupied slot count
+    ring_occupancy_peak: int = 0
+    ring_faults: int = 0        # ring dispatches degraded to per-call
     device_wait_ms: float = 0.0  # host wall time blocked in materialize
     overlap_ms: float = 0.0      # device in-flight time host spent staging
     commit_host_ms: float = 0.0  # epoch-gated commit + protocol tail
@@ -92,22 +100,27 @@ class EmbedderStats:
         return self.overlap_ms / total if total > 0 else 0.0
 
 
-class CommitPipeline:
-    """The drain stage of the embed->commit lane.
+class CommitPipeline(InflightWindow):
+    """The drain stage of the embed->commit lane — the original
+    instance of the K-deep overlap pattern, now built on the shared
+    InflightWindow skeleton (engine/resident.py) the searcher and the
+    continuous decode lane reuse.
 
-    Dispatched encode futures (PendingEmbeddings) queue here instead of
-    being forced inline.  Commits resolve in COMPLETION order: any
-    future that has finished is committed immediately (zero wait) while
-    later batches are still being tokenized/dispatched, and the host
-    only blocks on the device when the in-flight bound is hit with
-    nothing ready — back-pressure, not a synchronous round-trip per
-    batch.  The old path forced each batch FIFO with a blocking
-    device_get inside the wake handler: wake->commit paid the full
-    device round-trip every time (BENCH_r05: 62.2 of the 67.2 ms p50).
+    Dispatched encode futures (PendingEmbeddings, or ring slot views
+    of a resident multi-batch dispatch) queue here instead of being
+    forced inline.  Commits resolve in COMPLETION order: any future
+    that has finished is committed immediately (zero wait) while later
+    batches are still being tokenized/dispatched, and the host only
+    blocks on the device when the in-flight bound is hit with nothing
+    ready — back-pressure, not a synchronous round-trip per batch.
+    The old path forced each batch FIFO with a blocking device_get
+    inside the wake handler: wake->commit paid the full device
+    round-trip every time (BENCH_r05: 62.2 of the 67.2 ms p50).
     """
 
     def __init__(self, commit_fn, stats: EmbedderStats, depth: int,
                  *, stage_acc: dict | None = None, on_error=None):
+        super().__init__(depth)
         self._commit = commit_fn      # (rows, epochs, f32 vecs) -> int
         self._stats = stats
         # per-batch failure domain: (rows, epochs, exc) -> None.  With
@@ -120,47 +133,18 @@ class CommitPipeline:
         # resolve path adds its device_wait/commit wall here so traced
         # requests get real stage events, not re-measured estimates
         self._stage_acc = stage_acc
-        self.depth = max(1, depth)
-        # (rows, epochs, pending, t_dispatch, blocked_ms_at_dispatch)
-        self._q: deque = deque()
         self._blocked_ms = 0.0        # cumulative materialize-block time
         self.committed = 0
 
-    def __len__(self) -> int:
-        return len(self._q)
-
     def push(self, rows, epochs, pending) -> None:
-        st = self._stats
-        self._q.append((rows, epochs, pending, time.perf_counter(),
-                        self._blocked_ms))
-        st.futures_dispatched += 1
-        st.inflight_peak = max(st.inflight_peak, len(self._q))
-        self.drain_ready()
-        while len(self._q) > self.depth:
-            self._resolve(self._q.popleft())
+        self._stats.futures_dispatched += 1
+        self.push_entry((rows, epochs, pending, time.perf_counter(),
+                         self._blocked_ms))
+        self._stats.inflight_peak = max(self._stats.inflight_peak,
+                                        self.inflight_peak)
 
-    def drain_ready(self) -> int:
-        """Commit every future that has already completed (in queue
-        order among the ready ones); never blocks."""
-        done = 0
-        if self._q:
-            still: deque = deque()
-            for item in self._q:
-                if item[2].is_ready():
-                    self._resolve(item)
-                    done += 1
-                else:
-                    still.append(item)
-            self._q = still
-        return done
-
-    def flush(self) -> None:
-        """Drain everything: ready futures first, then block for the
-        rest in dispatch order (the unavoidable tail wait — by now it
-        overlapped the whole remaining host-side staging)."""
-        self.drain_ready()
-        while self._q:
-            self._resolve(self._q.popleft())
+    def _entry_ready(self, item) -> bool:
+        return item[2].is_ready()
 
     def _resolve(self, item) -> None:
         rows, epochs, pending, t_dispatch, blocked_at_dispatch = item
@@ -224,6 +208,7 @@ class Embedder:
                  group: int = P.GROUP_EMBED,
                  batch_cap: int = 256,
                  inflight_depth: int | None = None,
+                 ring_depth: int | None = None,
                  probe_batch_max: int | None = None):
         self.store = store
         self.max_ctx = max_ctx
@@ -231,6 +216,7 @@ class Embedder:
         self.group = group
         self.batch_cap = batch_cap
         self._inflight_override = inflight_depth
+        self._ring_override = ring_depth
         # drains at or below this size take the latency short-circuit
         # lane (no sort, no windowing — straight to the pre-compiled
         # small-bucket programs)
@@ -339,24 +325,99 @@ class Embedder:
     def _dispatch_bucketed(self, ids: np.ndarray, lens: np.ndarray):
         """Group rows by their own padding bucket and dispatch one
         encode per (bucket, <=batch_cap) group, without forcing any
-        result.  Yields (row_selection, PendingEmbeddings) lazily so
-        the consumer's in-flight bound actually applies back-pressure
+        result.  Yields (row_selection, pending) lazily so the
+        consumer's in-flight bound actually applies back-pressure
         between dispatches (an eager list would enqueue the whole
         window on the device before the first commit).
 
         Grouping matters: the reference pays each text its own length
         (serial llama.cpp decode); a naive batch pays every text the
         LONGEST text's bucket.  Grouping keeps short texts on narrow
-        programs — most of the padding FLOPs come back."""
+        programs — most of the padding FLOPs come back.
+
+        When a bucket group yields two or more FULL batches and the
+        model supports the resident ring, those batches pre-stage into
+        a (ring_depth, cap, bucket) ring serviced by ONE device
+        dispatch (encode_ring_async: lax.while_loop over the occupied
+        slots) — the ~63 ms per-dispatch runtime round trip amortizes
+        to floor/occupancy.  The short tail batch rides the per-call
+        path on its own (smaller, pre-compiled) program."""
         cap = self.effective_batch_cap
+        depth = self.ring_depth
+        ring_async = (getattr(self._model, "encode_ring_async", None)
+                      if depth > 1 else None)
         bkts = self._model.buckets_for(np.asarray(lens))
         for b in np.unique(bkts):
             sel = np.nonzero(bkts == b)[0]
-            for lo in range(0, len(sel), cap):
-                ss = sel[lo: lo + cap]
+            chunks = [sel[lo: lo + cap]
+                      for lo in range(0, len(sel), cap)]
+            full = len(chunks) - (1 if len(chunks[-1]) < cap else 0)
+            lo = 0
+            if ring_async is not None and full >= 2:
+                while full - lo >= 2:
+                    group = chunks[lo: lo + min(depth, full - lo)]
+                    yield from self._dispatch_ring(ids, lens, group,
+                                                   int(b), cap)
+                    lo += len(group)
+            for ss in chunks[lo:]:
                 yield ss, self._model.encode_ids_async(
                     np.ascontiguousarray(ids[ss, : int(b)]),
                     np.minimum(lens[ss], b).astype(np.int32))
+
+    def _dispatch_ring(self, ids, lens, group, b: int, cap: int):
+        """Pre-stage `group` (full cap-sized chunks of one bucket)
+        into a host-fed ring and dispatch the resident program once;
+        yields one RingSlot pending per chunk so the CommitPipeline
+        consumes ring and per-call dispatches identically.  A ring
+        dispatch that fails degrades to the per-call path for its
+        chunks (the battle-tested programs; ring_faults counts it) —
+        the resident optimization must never cost a drain."""
+        from ..models.encoder import _batch_pad
+
+        depth = self.ring_depth
+        bpad = _batch_pad(cap)
+        ids_ring = np.zeros((depth, bpad, b), np.int32)
+        lens_ring = np.zeros((depth, bpad), np.int32)
+        for j, ss in enumerate(group):
+            ids_ring[j, : len(ss)] = ids[ss, :b]
+            lens_ring[j, : len(ss)] = np.minimum(lens[ss], b)
+        st = self.stats
+
+        def retry(j: int, n: int) -> np.ndarray:
+            # collect-time fallback: async dispatch surfaces device
+            # failures at the ring FETCH — re-encode the one slot on
+            # the per-call programs so a transient error costs a
+            # re-dispatch, never a failed batch (let alone 8: without
+            # this, one poisoned ring would halve the cap and strike
+            # rows once PER SLOT, defeating the PR-4 bisection)
+            st.ring_faults += 1
+            log.warning("resident ring collect failed; re-encoding "
+                        "slot %d of %d per-call", j, len(group))
+            return self._model.encode_ids_async(
+                np.ascontiguousarray(ids_ring[j, :n]),
+                lens_ring[j, :n].copy()).materialize()
+
+        try:
+            ring = self._model.encode_ring_async(ids_ring, lens_ring,
+                                                 len(group),
+                                                 retry=retry)
+        except Exception as ex:
+            st.ring_faults += 1
+            log.warning("resident ring dispatch of %d batches failed "
+                        "(%s); falling back to per-call", len(group),
+                        ex)
+            for ss in group:
+                yield ss, self._model.encode_ids_async(
+                    np.ascontiguousarray(ids[ss, :b]),
+                    np.minimum(lens[ss], b).astype(np.int32))
+            return
+        st.ring_dispatches += 1
+        st.resident_iterations += len(group)
+        st.ring_occupancy = len(group)
+        st.ring_occupancy_peak = max(st.ring_occupancy_peak,
+                                     len(group))
+        for j, ss in enumerate(group):
+            yield ss, ring.slot(j, len(ss))
 
     def _encode_bucketed(self, ids: np.ndarray, lens: np.ndarray):
         """Synchronous encode tail for the public encoder_fn surface."""
@@ -475,6 +536,23 @@ class Embedder:
     @inflight_depth.setter
     def inflight_depth(self, value: int) -> None:
         self._inflight_override = value
+
+    # resident-ring depth: how many full same-bucket batches one
+    # device dispatch services (lax.while_loop over a host-fed ring,
+    # engine/resident.py).  <=1 disables — every batch pays its own
+    # runtime round trip, the pre-PR-7 behavior.  Same three-way
+    # tunability as inflight_depth.
+    _RING_DEPTH = 8
+
+    @property
+    def ring_depth(self) -> int:
+        return (type(self)._RING_DEPTH
+                if self._ring_override is None
+                else self._ring_override)
+
+    @ring_depth.setter
+    def ring_depth(self, value: int) -> None:
+        self._ring_override = value
 
     @property
     def effective_batch_cap(self) -> int:
@@ -860,6 +938,20 @@ class Embedder:
                    "overlap_ratio": round(self.stats.overlap_ratio(), 4),
                    "generation": self.generation,
                    "pending": len(self._pending)}
+        # dispatch-overlap gauges ride their own SECTION so a tiny
+        # store's max_val drops them (publish_heartbeat's size
+        # degradation) instead of losing the whole heartbeat; `spt
+        # metrics` renders them flat (sptpu_embedder_ring_depth etc.).
+        # Saturation of the overlap window is visible when
+        # ring_occupancy pins at ring_depth / inflight_peak pins at
+        # inflight_depth.
+        payload["dispatch"] = {
+            "inflight_depth": self.inflight_depth,
+            "ring_depth": self.ring_depth,
+            **{k: payload.pop(k)
+               for k in ("ring_dispatches", "resident_iterations",
+                         "ring_occupancy", "ring_occupancy_peak",
+                         "ring_faults")}}
         if faults.armed():
             payload["faults"] = faults.stats()
         model = getattr(self, "_model", None)
@@ -977,6 +1069,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="context window override (default: the "
                          "checkpoint's trained window, or 2048 for "
                          "seeded-random weights)")
+    ap.add_argument("--batch-cap", type=int, default=256,
+                    help="rows per encode batch (padding bucket "
+                         "grouping happens under this cap)")
+    ap.add_argument("--ring-depth", type=int, default=None,
+                    help="resident device loop: service up to this "
+                         "many full same-bucket batches per device "
+                         "dispatch (lax.while_loop over a host-fed "
+                         "ring; default 8, <=1 disables — every "
+                         "batch then pays its own ~63 ms runtime "
+                         "round trip)")
+    ap.add_argument("--inflight-depth", type=int, default=None,
+                    help="K-deep dispatch overlap: un-awaited encode "
+                         "futures held before the host blocks on the "
+                         "oldest (default 2)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile the (1, bucket) and (batch_cap, "
@@ -1019,6 +1125,9 @@ def main(argv: list[str] | None = None) -> int:
         model = EmbeddingModel(cfg, weights=args.weights)
     emb = Embedder(store, model=model, tokenizer=tokenizer,
                    max_ctx=max_ctx,
+                   batch_cap=args.batch_cap,
+                   ring_depth=args.ring_depth,
+                   inflight_depth=args.inflight_depth,
                    vector_training=args.vector_training)
     emb.attach()
     if args.warmup:
@@ -1034,6 +1143,11 @@ def main(argv: list[str] | None = None) -> int:
         emb._model.warmup(
             batch_sizes=tuple(dict.fromkeys(probe_pads
                                             + [emb.batch_cap])))
+        # the resident ring program too: a big drain's first ring
+        # dispatch must not pay a fresh while_loop compile on the
+        # wake path (occupancy is an operand — one probe per bucket
+        # covers every occupancy)
+        emb._model.warmup_ring(emb.ring_depth, emb.batch_cap)
         log.info("warmup compiled in %.1fs", time.monotonic() - t0)
     if args.backfill_text_keys:
         n = emb.backfill()

@@ -55,7 +55,14 @@ KEY_SYSTEM_PROMPT = "__system_prompt"
 # periodic daemon heartbeats: JSON stats snapshots, debug-labeled so
 # the sidecar's group-63 watch surfaces them (the reference's only
 # runtime telemetry is the __debug append channel; these are the
-# structured counterpart)
+# structured counterpart).  Every lane's heartbeat carries the
+# dispatch-overlap gauges (PR 7, engine/resident.py): inflight_depth
+# (the configured K) + inflight_peak, and on the embedder the
+# resident-ring gauges (ring_depth / ring_occupancy /
+# resident_iterations / ring_faults) in their own size-droppable
+# "dispatch" section — `spt metrics` renders them flat as
+# sptpu_<lane>_inflight_depth etc., so saturation of the overlap
+# window is visible in production.
 KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
 KEY_SEARCH_STATS = "__searcher_stats"
@@ -102,12 +109,16 @@ INFER_STAGES = ("render", "generate", "commit")
 # the same infer.* histogram prefix: join = one row's prompt prefill
 # into freshly allocated pages (admission IS a join — there is no
 # fresh-batch/live-batch distinction); sample = the host draw of its
-# first token; decode = a flush_tokens-step paged decode chunk (the
-# span every live row shares); flush = a streaming append run.  A
-# client-stamped request (stamp_trace) gets a flight-recorder entry
-# with its accumulated spans, so `spt trace tail` reconstructs
-# batched-lane requests too, not just the serial path's.
-CONT_INFER_STAGES = ("join", "sample", "decode", "flush")
+# first token; decode = the ASYNC dispatch of a flush_tokens-step
+# paged decode chunk (the span every live row shares); collect = the
+# host's blocked wait forcing a chunk out of the K-deep in-flight
+# window (engine/resident.py — with the window saturated this is
+# where the amortized dispatch floor surfaces); flush = a streaming
+# append run.  A client-stamped request (stamp_trace) gets a
+# flight-recorder entry with its accumulated spans, so `spt trace
+# tail` reconstructs batched-lane requests too, not just the serial
+# path's.
+CONT_INFER_STAGES = ("join", "sample", "decode", "collect", "flush")
 
 # the search daemon's per-drain decomposition: wake = signal to drain
 # entry (the coalescing window's scheduling cost); drain = request

@@ -55,6 +55,7 @@ from ..utils import faults
 from ..utils.faults import fault
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
+from .resident import CallbackWindow
 
 log = logging.getLogger("libsplinter_tpu.searcher")
 
@@ -117,6 +118,11 @@ class SearcherStats:
     parse_errors: int = 0        # malformed / vectorless requests
     raced: int = 0               # slot changed mid-service; retried
     full_refreshes: int = 0      # lane full uploads
+    # -- K-deep dispatch overlap (engine/resident.py): batch k's
+    # select+commit resolve while batches k+1..k+K compute ---------
+    inflight_peak: int = 0       # max un-awaited batch dispatches held
+    ready_selects: int = 0       # batch already complete at select
+    blocking_selects: int = 0    # host blocked on the device fetch
     # -- failure-domain accounting (the per-batch firewall) ----------
     batch_faults: int = 0        # batches that failed and degraded
     retried_unfused: int = 0     # recovered by the unfused retry
@@ -155,6 +161,7 @@ class Searcher:
                  fused: bool | None = None,
                  interpret: bool = False,
                  block_n: int = 1024,
+                 inflight_depth: int = 2,
                  coalesce_window_ms: float = 0.0):
         from ..ops import StagedLane
 
@@ -165,6 +172,13 @@ class Searcher:
         self.fused = fused
         self.interpret = interpret
         self.block_n = block_n
+        # K-deep dispatch overlap: un-awaited top-k batch dispatches
+        # held before the oldest's select+commit resolves — batch k's
+        # host-side commit work overlaps the device computing batches
+        # k+1..k+K, so the per-dispatch runtime round trip amortizes
+        # to ~floor/K on multi-batch drains.  1 = the pre-PR-7
+        # fetch-in-dispatch-order behavior.
+        self.inflight_depth = max(1, inflight_depth)
         # >0: sleep this long after a wake before draining, widening
         # the coalescing window at the cost of per-request latency.
         # 0 (default): the natural window — requests landing while a
@@ -359,9 +373,13 @@ class Searcher:
 
     def _service(self, reqs: list[_Request]) -> int:
         """Score stage (lane refresh + async batched dispatch), select
-        stage (the blocking device fetches), commit stage (result
-        rows + label clears).  Every batch is its own failure domain:
-        a batch whose dispatch or fetch raises degrades through
+        stage (the device fetches), commit stage (result rows + label
+        clears) — select+commit resolve through a K-deep
+        InflightWindow (engine/resident.py), so batch k's host-side
+        fetch/commit work overlaps the device computing batches
+        k+1..k+K instead of every batch queueing behind a full drain
+        of dispatches.  Every batch is its own failure domain: a batch
+        whose dispatch or fetch raises degrades through
         _score_degraded (unfused retry, then request-by-request) while
         its siblings commit normally — a device failure mid-service
         must never unwind the run loop or starve unrelated requests."""
@@ -372,11 +390,20 @@ class Searcher:
         self.stats.full_refreshes += self.lane.full_uploads - full0
         req_rows = np.asarray([r.idx for r in reqs], np.int64)
 
+        # select/commit wall + served count accrued by the window's
+        # resolver as batches complete (out of lockstep with dispatch)
+        state = {"served": 0, "select_ms": 0.0, "commit_ms": 0.0}
+        win = CallbackWindow(
+            self.inflight_depth,
+            lambda payload, pend, ready: self._resolve_batch(
+                arr, payload, pend, ready, state))
+
         # group by (bloom prefilter, bf16 flag) — the kernel mask and
         # the matmul precision are shared across a batch — bucket each
-        # group's queries, dispatch ALL batches before fetching any:
-        # jax's async dispatch queues them on the device back to back
-        batches = []           # (requests, k_fetch, mask, q, pending)
+        # group's queries, dispatch each batch and push it into the
+        # window: jax's async dispatch queues device work back to
+        # back, and the window resolves whatever completes while
+        # later batches are still being staged
         groups: dict[tuple, list[_Request]] = {}
         for r in reqs:
             groups.setdefault((r.bloom, r.fast), []).append(r)
@@ -414,51 +441,58 @@ class Searcher:
                 self.stats.dispatches += 1
                 self.stats.coalesced_max = max(
                     self.stats.coalesced_max, len(chunk))
-                batches.append((chunk, k_fetch, mask, q, pend))
-        t1 = time.perf_counter()
-        if acc is not None:
-            acc["score"] = (t1 - t0) * 1e3
-            tracer.record("search.score", acc["score"])
-
-        # select: fetch per batch, in dispatch order (the device work
-        # was queued back to back above, so this still overlaps); a
-        # failed fetch degrades that one batch
-        import jax
-        fetched = []           # (s_all, i_all, ok_rows | None)
-        for chunk, k_fetch, mask, q, pend in batches:
-            try:
-                fault("searcher.select")
-                if pend is None:
-                    raise RuntimeError("batch dispatch failed")
-                s_all, i_all = jax.device_get(pend)
-                fetched.append((s_all, i_all, None))
-            except Exception as ex:
-                fetched.append(self._score_degraded(
-                    arr, chunk, q, mask, k_fetch, ex))
-        t2 = time.perf_counter()
-        if acc is not None:
-            acc["select"] = (t2 - t1) * 1e3
-            tracer.record("search.select", acc["select"])
-
-        served = 0
-        for (chunk, k_fetch, _m, _q, _p), (s_all, i_all, ok) in zip(
-                batches, fetched):
-            for i, r in enumerate(chunk):
-                if ok is not None and not ok[i]:
-                    continue       # already failed with an error record
-                try:
-                    served += self._commit_hits(
-                        r, np.asarray(s_all[i]), np.asarray(i_all[i]),
-                        k_fetch)
-                except Exception as ex:
-                    self._fail(r.idx, r.epoch,
-                               f"result commit failed: {ex}",
-                               counter="req_failures")
+                win.push((chunk, k_fetch, mask, q), pend)
+        win.flush()
+        self.stats.inflight_peak = max(self.stats.inflight_peak,
+                                       win.inflight_peak)
+        self.stats.ready_selects += win.ready_resolves
+        self.stats.blocking_selects += win.blocking_resolves
         t3 = time.perf_counter()
         if acc is not None:
-            acc["commit"] = (t3 - t2) * 1e3
-            tracer.record("search.commit", acc["commit"])
-        return served
+            # the resolver accrued select/commit; score is the
+            # remaining host-side wall of the service (refresh, mask
+            # build, batching, dispatch) — the stages stay disjoint
+            acc["select"] = state["select_ms"]
+            acc["commit"] = state["commit_ms"]
+            acc["score"] = max(
+                (t3 - t0) * 1e3 - state["select_ms"]
+                - state["commit_ms"], 0.0)
+            for stage in ("score", "select", "commit"):
+                tracer.record(f"search.{stage}", acc[stage])
+        return state["served"]
+
+    def _resolve_batch(self, arr, payload, pend, ready: bool,
+                       state: dict) -> None:
+        """Window resolver: one batch's select (device fetch, with the
+        per-batch degradation ladder) + commit, in COMPLETION order —
+        runs while sibling batches still compute on-device."""
+        import jax
+
+        chunk, k_fetch, mask, q = payload
+        t1 = time.perf_counter()
+        try:
+            fault("searcher.select")
+            if pend is None:
+                raise RuntimeError("batch dispatch failed")
+            s_all, i_all = jax.device_get(pend)
+            ok = None
+        except Exception as ex:
+            s_all, i_all, ok = self._score_degraded(
+                arr, chunk, q, mask, k_fetch, ex)
+        t2 = time.perf_counter()
+        state["select_ms"] += (t2 - t1) * 1e3
+        for i, r in enumerate(chunk):
+            if ok is not None and not ok[i]:
+                continue           # already failed with an error record
+            try:
+                state["served"] += self._commit_hits(
+                    r, np.asarray(s_all[i]), np.asarray(i_all[i]),
+                    k_fetch)
+            except Exception as ex:
+                self._fail(r.idx, r.epoch,
+                           f"result commit failed: {ex}",
+                           counter="req_failures")
+        state["commit_ms"] += (time.perf_counter() - t2) * 1e3
 
     def _score_degraded(self, arr, chunk: list[_Request], q, mask,
                         k_fetch: int, ex: Exception):
@@ -689,6 +723,10 @@ class Searcher:
                    "coalesce_ratio": round(
                        self.stats.coalesce_ratio(), 4),
                    "generation": self.generation,
+                   # overlap-window gauge: inflight_peak pinned at
+                   # inflight_depth means the window saturates (raise
+                   # --inflight-depth for more dispatch amortization)
+                   "inflight_depth": self.inflight_depth,
                    "lane": self.lane.counters()}
         if faults.armed():
             payload["faults"] = faults.stats()
@@ -833,6 +871,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="bf16 MXU scoring (2x kernel throughput, "
                          "~2e-2 score precision)")
     ap.add_argument("--coalesce-window-ms", type=float, default=0.0)
+    ap.add_argument("--inflight-depth", type=int, default=2,
+                    help="K-deep dispatch overlap: un-awaited top-k "
+                         "batch dispatches held before the oldest's "
+                         "select+commit resolves (1 = fetch in "
+                         "dispatch order, the pre-overlap behavior)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile the QB-bucketed top-k programs "
@@ -847,6 +890,7 @@ def main(argv: list[str] | None = None) -> int:
     enable_compile_cache()
     store = Store.open(args.store, persistent=args.persistent)
     sr = Searcher(store, mxu_bf16=args.fast,
+                  inflight_depth=args.inflight_depth,
                   coalesce_window_ms=args.coalesce_window_ms)
     sr.attach()
     if args.warmup:

@@ -188,6 +188,32 @@ class PagedKVCache:
         return int(self.lengths.sum())
 
 
+class PendingChunk:
+    """One in-flight paged decode chunk (paged_decode_chunk_async):
+    the (n, batch) sampled block still on device, plus `last` — the
+    final sampled column as a DEVICE array, which the next chunk's
+    dispatch consumes directly (carry=) so chaining K chunks costs
+    zero host round trips.  block() forces the host copy (the one
+    transfer per chunk) and transposes to the (batch, n) shape the
+    sync path returns."""
+
+    __slots__ = ("_out", "last", "n")
+
+    def __init__(self, out, last, n: int):
+        self._out = out
+        self.last = last
+        self.n = n
+
+    def is_ready(self) -> bool:
+        try:
+            return bool(self._out.is_ready())
+        except AttributeError:
+            return True
+
+    def block(self) -> np.ndarray:
+        return np.asarray(self._out).T                 # (batch, n)
+
+
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
@@ -871,16 +897,26 @@ class CompletionModel:
         """lax.scan of n paged decode steps for bp rows: append one
         token per row into its pages, ragged paged attention, sample
         in-graph (_sample_rows — the same sampler graph as every other
-        path).  The pool never round-trips to the host (donated)."""
+        path).  The pool never round-trips to the host (donated).
+
+        The first step's input tokens come from
+        where(fresh_mask, fresh, carry): `fresh` is the host-fed
+        column (prefill samples of freshly joined rows), `carry` the
+        previous chunk's last sampled column — which the program ALSO
+        returns as a device array, so K-deep chunk chaining
+        (paged_decode_chunk_async) never pays a host round trip for
+        the token hand-off."""
         key = ("chunk", n, bp, self.top_p, self.temp)
         fn = self._paged_progs.get(key)
         if fn is None:
             module, top_p, temp = self.module, self.top_p, self.temp
 
             def run(params, k_pools, v_pools, tables, lengths, rng,
-                    toks):
-                def step(carry, _):
-                    k_pools, v_pools, lengths, rng, toks = carry
+                    fresh, fresh_mask, carry):
+                toks0 = jnp.where(fresh_mask, fresh, carry)
+
+                def step(carry_s, _):
+                    k_pools, v_pools, lengths, rng, toks = carry_s
                     cache = list(zip(k_pools, v_pools))
                     logits, new_cache = module.apply(
                         params, toks.reshape(-1, 1), cache,
@@ -892,9 +928,9 @@ class CompletionModel:
                     return (k_pools, v_pools, lengths + 1, rng, nxt), nxt
 
                 (k_pools, v_pools, _, _, _), out = jax.lax.scan(
-                    step, (k_pools, v_pools, lengths, rng, toks), None,
+                    step, (k_pools, v_pools, lengths, rng, toks0), None,
                     length=n)
-                return k_pools, v_pools, out           # out: (n, bp)
+                return k_pools, v_pools, out, out[-1]  # out: (n, bp)
 
             fn = jax.jit(run, donate_argnums=(1, 2))
             self._paged_progs[key] = fn
@@ -913,6 +949,29 @@ class CompletionModel:
         their column.  Live rows must have window room for n more
         tokens (the scheduler finishes rows first).  Returns
         (batch, n) sampled ids."""
+        return self.paged_decode_chunk_async(cache, tokens, n).block()
+
+    def paged_decode_chunk_async(self, cache: PagedKVCache, tokens,
+                                 n: int, carry=None) -> "PendingChunk":
+        """K-deep variant: dispatch a decode chunk WITHOUT forcing the
+        sampled block.  `tokens` (batch,) int32 host values are the
+        fresh first-step inputs for rows in `tokens`'s mask... two
+        forms compose per row:
+
+          - a freshly joined row's prefill sample arrives host-side in
+            `tokens` with its bit set in the implied mask (tokens >= 0
+            entries where carry is absent);
+          - a row live since the previous chunk hands its token over
+            ON DEVICE via `carry` (the previous PendingChunk's .last)
+            — chaining chunks costs zero host syncs, so the host can
+            hold K un-awaited chunks while the device stays fed.
+
+        Concretely: pass `carry=prev.last` and set tokens[r] >= 0 only
+        for rows whose token was produced host-side since the last
+        dispatch (tokens[r] < 0 = use the carry).  With carry=None
+        every row reads from `tokens` (the sync path).  Host
+        bookkeeping (cache.lengths) advances at DISPATCH, so window
+        edge checks already account for in-flight chunks."""
         bp = cache.batch
         for r in range(bp):
             length = int(cache.lengths[r])
@@ -921,18 +980,25 @@ class CompletionModel:
                 raise RuntimeError(
                     f"paged pool exhausted mid-decode: row {r} "
                     f"(admission must reserve prompt + max_new)")
-        toks = np.zeros((bp,), np.int32)
+        toks = np.full((bp,), -1, np.int32)
         toks[: len(tokens)] = np.asarray(tokens, np.int32)
+        if carry is None:
+            fresh_mask = np.ones((bp,), bool)
+            carry = np.zeros((bp,), np.int32)
+            toks = np.maximum(toks, 0)
+        else:
+            fresh_mask = toks >= 0
+            toks = np.maximum(toks, 0)
         self._rng, sub = jax.random.split(self._rng)
-        kp, vp, out = self._paged_chunk_program(n, bp)(
+        kp, vp, out, last = self._paged_chunk_program(n, bp)(
             self.params, cache.k_pools, cache.v_pools,
             jnp.asarray(cache.tables), jnp.asarray(cache.lengths), sub,
-            jnp.asarray(toks))
+            jnp.asarray(toks), jnp.asarray(fresh_mask), carry)
         cache.k_pools, cache.v_pools = list(kp), list(vp)
         live = cache.lengths > 0
         cache.lengths[live] = np.minimum(cache.lengths[live] + n,
                                          self.cfg.max_len)
-        return np.asarray(out).T                       # (bp, n)
+        return PendingChunk(out, last, n)
 
     def warmup_paged(self, cache: PagedKVCache, chunk: int = 8,
                      max_prompt: int | None = None) -> None:

@@ -250,13 +250,11 @@ class PendingEmbeddings:
     def materialize(self) -> np.ndarray:
         # fetch in the model's wire dtype (f16 halves, int8 quarters
         # the device->host bytes on the commit path), hand f32 to
-        # callers.  int8 is fixed-scale x127: components of an
-        # L2-normalized embedding lie in [-1, 1], so no per-vector
-        # scale row is needed.
-        out = np.asarray(self._out)[: self.n]
-        if out.dtype == np.int8:
-            return out.astype(np.float32) * np.float32(1.0 / 127.0)
-        return out.astype(np.float32, copy=False)
+        # callers via the shared wire upcast (engine/resident.py —
+        # ring slot views apply the identical conversion).
+        from ..engine.resident import _wire_to_f32
+
+        return _wire_to_f32(np.asarray(self._out)[: self.n])
 
 
 def _batch_pad(n: int) -> int:
@@ -335,16 +333,26 @@ class EmbeddingModel:
                                 -127.0, 127.0).astype(jnp.int8)
             return out.astype(wire)
 
+        self._fwd = fwd               # the ring program re-traces THIS
+        self._wire = wire             # (same graph -> same numerics)
         self._fn = jax.jit(fwd)
+        self._ring_fn = None          # resident multi-batch program
+        self._ring_pool: dict = {}    # (depth, B) -> spare out buffers
 
     def compile_count(self) -> int:
         """Distinct XLA programs compiled for the encode fn (one per
-        (batch, bucket) shape).  Obs surface: this riding the
-        heartbeat makes a shape leak visible — a count still growing
-        after warmup means some drain geometry escapes the bucket
-        set and is paying jit compiles on the wake path."""
+        (batch, bucket) shape) plus the resident ring program (one per
+        (ring_depth, batch, bucket) shape — ring OCCUPANCY is a scalar
+        operand, so varying it must never grow this count).  Obs
+        surface: this riding the heartbeat makes a shape leak visible
+        — a count still growing after warmup means some drain
+        geometry escapes the bucket set and is paying jit compiles on
+        the wake path."""
         try:
-            return int(self._fn._cache_size())
+            n = int(self._fn._cache_size())
+            if self._ring_fn is not None:
+                n += int(self._ring_fn._cache_size())
+            return n
         except Exception:      # private jax API: absence is not an error
             return -1
 
@@ -383,6 +391,81 @@ class EmbeddingModel:
         """token_ids: (B, S) int32 already padded to a bucket length;
         lengths: (B,) valid lengths.  Returns (B, out_dim) float32."""
         return self.encode_ids_async(token_ids, lengths).materialize()
+
+    # -- resident multi-batch ring -----------------------------------------
+
+    def _ring_program(self):
+        """The resident device loop: ONE dispatch services up to
+        ring_depth pre-staged (B, S) batches — a lax.while_loop over
+        the occupied ring slots, each iteration the SAME fwd graph the
+        per-call path jits (identical numerics by construction).  The
+        occupancy `n` is a scalar operand: one compiled program per
+        (depth, B, S) shape serves every occupancy 1..depth, skipping
+        empty slots outright.  The output ring is donated — callers
+        recycle it through _ring_pool (RingResult release)."""
+        if self._ring_fn is None:
+            fwd = self._fwd
+
+            def run(params, ids_ring, lens_ring, n, out_ring):
+                def body(carry):
+                    i, acc = carry
+                    vecs = fwd(params, ids_ring[i], lens_ring[i])
+                    acc = jax.lax.dynamic_update_index_in_dim(
+                        acc, vecs.astype(acc.dtype), i, 0)
+                    return i + 1, acc
+
+                _, acc = jax.lax.while_loop(
+                    lambda c: c[0] < n, body, (jnp.int32(0), out_ring))
+                return acc
+
+            self._ring_fn = jax.jit(run, donate_argnums=(4,))
+        return self._ring_fn
+
+    def encode_ring_async(self, ids_ring: np.ndarray,
+                          lens_ring: np.ndarray, n_valid: int,
+                          *, retry=None):
+        """Dispatch ONE resident program over a host-fed ring of
+        pre-staged batches.  ids_ring: (depth, B, S) int32 with S a
+        bucket width and B a fixed (power-of-two) batch pad; lens_ring:
+        (depth, B) valid counts (0 = padding row); n_valid: occupied
+        slot count (slots past it are never computed).  Returns a
+        RingResult whose slot(i, n) views satisfy the
+        PendingEmbeddings contract — the whole ring fetches in one
+        transfer on first materialize.  `retry` ((slot_i, n) -> f32
+        rows) arms the per-slot fallback for collect-time device
+        failures (async dispatch surfaces errors at the fetch)."""
+        from ..engine.resident import RingResult
+        from ..utils.faults import fault
+
+        depth, B = int(ids_ring.shape[0]), int(ids_ring.shape[1])
+        if not 1 <= n_valid <= depth:
+            raise ValueError(f"n_valid {n_valid} outside 1..{depth}")
+        fault("resident.ring_dispatch")
+        pool = self._ring_pool.setdefault((depth, B), [])
+        out = pool.pop() if pool else jnp.zeros(
+            (depth, B, self.cfg.out_dim), self._wire or jnp.float32)
+        res = self._ring_program()(
+            self.params, jnp.asarray(ids_ring, jnp.int32),
+            jnp.asarray(lens_ring.astype(np.int32)),
+            jnp.int32(n_valid), out)
+        return RingResult(res, n_valid, release=pool.append,
+                          retry=retry)
+
+    def warmup_ring(self, depth: int, batch: int,
+                    buckets: tuple[int, ...] | None = None) -> None:
+        """Pre-compile the resident ring program for each bucket at
+        the serving (depth, batch-pad) geometry.  One probe per bucket
+        at occupancy 1 suffices — occupancy is an operand, so a drain
+        at ANY occupancy reuses the same program (compile_count stays
+        flat; tests pin it)."""
+        if depth <= 1:
+            return
+        bpad = _batch_pad(batch)
+        for b in buckets or self.buckets:
+            ids = np.zeros((depth, bpad, b), np.int32)
+            lens = np.zeros((depth, bpad), np.int32)
+            lens[0, :] = b
+            self.encode_ring_async(ids, lens, 1).materialize_host()
 
     def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
         """Pre-compile each (batch, bucket) program off the hot path."""

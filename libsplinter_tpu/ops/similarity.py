@@ -183,6 +183,43 @@ def scatter_rows_with_norms(arr, norms, rows, vals, nvals):
     return _scatter_rows_norms_fn()(arr, norms, rows, vals, nvals)
 
 
+@functools.lru_cache(maxsize=None)
+def _scatter_rows_norms_ring_fn():
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def scatter(arr, norms, rows_ring, vals_ring, nvals_ring, n):
+        def body(carry):
+            i, arr, norms = carry
+            arr = arr.at[rows_ring[i]].set(
+                vals_ring[i].astype(arr.dtype))
+            norms = norms.at[rows_ring[i]].set(
+                nvals_ring[i].astype(norms.dtype))
+            return i + 1, arr, norms
+
+        _, arr, norms = jax.lax.while_loop(
+            lambda c: c[0] < n, body, (jnp.int32(0), arr, norms))
+        return arr, norms
+
+    return scatter
+
+
+def scatter_rows_with_norms_ring(arr, norms, rows_ring, vals_ring,
+                                 nvals_ring, n_valid: int):
+    """Resident-ring variant of scatter_rows_with_norms: ONE device
+    dispatch applies up to `depth` pre-staged same-bucket scatter
+    chunks (lax.while_loop over the occupied ring slots — occupancy
+    is a scalar operand, so one compiled program per (depth, B, D)
+    shape serves 1..depth and never touches empty slots).  Shapes:
+    rows_ring (depth, B) int32, vals_ring (depth, B, D) any float
+    dtype, nvals_ring (depth, B) f32.  Big refreshes whose chunk plan
+    repeats a bucket stop paying one runtime round trip per chunk —
+    the engine/resident.py amortization, applied to lane staging.
+    Chunks within one refresh touch disjoint rows, so loop order
+    inside the ring cannot change the result."""
+    return _scatter_rows_norms_ring_fn()(
+        arr, norms, rows_ring, vals_ring, nvals_ring,
+        jnp.int32(n_valid))
+
+
 def euclidean_distances(vectors, queries, mask=None) -> jnp.ndarray:
     """(N, D) x (Q, D) -> (N, Q) euclidean distances (inf where masked).
     Computed from norms + dot so it reuses the same fused matmul shape."""

@@ -187,8 +187,18 @@ class StagedLane:
         self.rows_staged = 0             # incremental rows transferred
         self.rows_padded = 0             # incl. bucket padding (wire cost)
         self.refreshes = 0
-        self.scatter_chunks = 0          # device scatters dispatched
+        self.scatter_chunks = 0          # scatter chunks staged
         self.chunk_hist: dict[int, int] = {}   # bucket size -> count
+        # resident-ring staging (engine/resident.py discipline): when
+        # a refresh's chunk plan repeats a bucket, up to ring_depth
+        # same-shape chunks pre-stage into one ring and ONE device
+        # dispatch applies them all (similarity.scatter_rows_with_
+        # norms_ring) — big refreshes stop paying one ~63 ms runtime
+        # round trip per chunk.  <=1 disables (per-chunk dispatch).
+        self.ring_depth = int(os.environ.get("SPTPU_LANE_RING_DEPTH",
+                                             "8"))
+        self.ring_dispatches = 0         # ring programs dispatched
+        self.ring_chunks = 0             # chunks applied inside rings
 
     # -- staging -----------------------------------------------------------
 
@@ -248,15 +258,55 @@ class StagedLane:
     def _stage_rows(self, changed: np.ndarray) -> None:
         """Incremental re-stage of `changed` rows, chunked through the
         fixed bucket set (_chunk_plan).  Each chunk's scatter is a
-        single fused vals+norms device dispatch on donated buffers
-        (ops.similarity.scatter_rows_with_norms) and jax dispatches it
-        asynchronously — so the host-side vec_gather of chunk i+1
-        overlaps the device scatter of chunk i, and no dirty count ever
-        pads to more than 2x its size or compiles a fresh program."""
-        from .similarity import scatter_rows_with_norms
+        fused vals+norms update on donated buffers
+        (ops.similarity.scatter_rows_with_norms); when the plan
+        repeats a bucket (big refreshes decompose into runs of the
+        largest bucket), up to ring_depth same-shape chunks pre-stage
+        into a host-fed ring and ONE resident dispatch applies them
+        all — per-refresh dispatch cost amortizes to
+        ~floor/ring-occupancy instead of one runtime round trip per
+        chunk.  No dirty count ever pads to more than 2x its size or
+        compiles a fresh program (ring shapes are (ring_depth, bucket)
+        with occupancy a scalar operand)."""
+        from .similarity import (scatter_rows_with_norms,
+                                 scatter_rows_with_norms_ring)
 
         st = self._st
         plan = _chunk_plan(int(changed.size))
+        depth = max(1, self.ring_depth)
+        # per-bucket staging buffers: prepared chunks wait here until
+        # a ring fills (or the gather ends) — chunks touch disjoint
+        # rows, so applying them out of plan order is safe
+        staged: dict[int, list[tuple]] = {}
+
+        def flush(b: int, group: list[tuple]) -> None:
+            """Dispatch one scatter (ring or per-call) and ONLY THEN
+            record its rows' staged epochs — a buffered chunk lost to
+            a mid-refresh exception must stay dirty, never read as
+            current against a stale device row."""
+            if len(group) == 1:
+                rows_p, vals_p, norms_p, rows, eps = group[0]
+                self._arr, self._norms = scatter_rows_with_norms(
+                    self._arr, self._norms, rows_p, vals_p, norms_p)
+            else:
+                rows_ring = np.zeros((depth, b), np.int32)
+                vals_ring = np.zeros((depth, b, st.vec_dim),
+                                     self._wire_np)
+                norms_ring = np.zeros((depth, b), np.float32)
+                for j, (rows_p, vals_p, norms_p, _, _) in \
+                        enumerate(group):
+                    rows_ring[j] = rows_p
+                    vals_ring[j] = vals_p
+                    norms_ring[j] = norms_p
+                self._arr, self._norms = scatter_rows_with_norms_ring(
+                    self._arr, self._norms, rows_ring, vals_ring,
+                    norms_ring, len(group))
+                self.ring_dispatches += 1
+                self.ring_chunks += len(group)
+            for _, _, _, rows, eps in group:
+                self._staged[rows] = eps
+                self.rows_staged += len(rows)
+
         for off, vecs, eps in st.vec_gather_iter(changed, plan):
             ok = eps != Store.GATHER_TORN
             n = int(ok.sum())
@@ -280,13 +330,20 @@ class StagedLane:
             norms_p = np.empty(b, np.float32)
             norms_p[:n] = np.linalg.norm(g, axis=1)
             norms_p[n:] = norms_p[0]
-            self._arr, self._norms = scatter_rows_with_norms(
-                self._arr, self._norms, rows_p, vals_p, norms_p)
-            self._staged[rows] = eps[ok]
-            self.rows_staged += n
+            chunk = (rows_p, vals_p, norms_p, rows, eps[ok])
+            if depth > 1:
+                buf = staged.setdefault(b, [])
+                buf.append(chunk)
+                if len(buf) >= depth:
+                    flush(b, staged.pop(b))
+            else:
+                flush(b, [chunk])
             self.rows_padded += b
             self.scatter_chunks += 1
             self.chunk_hist[b] = self.chunk_hist.get(b, 0) + 1
+        for b, group in staged.items():
+            if group:
+                flush(b, group)
 
     def counters(self) -> dict:
         """Transfer/chunk accounting as flat numerics — the shape
@@ -296,7 +353,9 @@ class StagedLane:
                "refreshes": self.refreshes,
                "rows_staged": self.rows_staged,
                "rows_padded": self.rows_padded,
-               "scatter_chunks": self.scatter_chunks}
+               "scatter_chunks": self.scatter_chunks,
+               "ring_dispatches": self.ring_dispatches,
+               "ring_chunks": self.ring_chunks}
         for b, n in sorted(self.chunk_hist.items()):
             out[f"chunks_bucket_{b}"] = n
         return out

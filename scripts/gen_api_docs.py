@@ -278,6 +278,27 @@ A lane whose `state` is `down` is skipped by dispatching clients
 a crash-looping lane costs a client zero timeout.  With `SPTPU_FAULT`
 armed, heartbeats additionally carry a `faults` section (per-site
 hit/fired accounting).  Runbook: `docs/operations.md`.
+
+### Dispatch-overlap gauges (`libsplinter_tpu/engine/resident.py`)
+
+Every lane heartbeat also carries the PR-7 overlap-window gauges —
+the embedder's ring gauges ride a `dispatch` sub-section (dropped
+first when a tiny store's `max_val` bites, like every optional
+section) and `spt metrics` renders everything flat as
+`sptpu_<lane>_<field>`:
+
+| field | lanes | meaning |
+|---|---|---|
+| `inflight_depth` | all | configured K: un-awaited device dispatches the lane may hold (`--inflight-depth`) |
+| `inflight_peak` | all | max un-awaited depth observed; pinned at `inflight_depth` = the overlap window saturates |
+| `ring_depth` | embedder | configured resident-ring depth (`--ring-depth`; ≤1 = per-call dispatch) |
+| `ring_occupancy` / `ring_occupancy_peak` | embedder | occupied slots of the last / fullest resident ring dispatch |
+| `ring_dispatches` / `resident_iterations` | embedder | resident programs dispatched / batches serviced inside them — `resident_iterations ÷ ring_dispatches` is the live dispatch-floor amortization factor |
+| `ring_faults` | embedder | ring dispatches degraded to the per-call programs |
+
+The searcher's `lane` section additionally counts the StagedLane's
+ring staging (`ring_dispatches` / `ring_chunks`: refresh scatter
+chunks coalesced into resident dispatches).
 """,
 }
 

@@ -38,6 +38,21 @@ def main() -> int:
         emb.attach()
         n = emb.run_once()
         print(f"embedded={n}", flush=True)
+    elif role == "embedder_ring":
+        # the MODEL path at a tiny geometry with the resident ring
+        # engaged (>= 2 full batches per drain): the resident.ring_*
+        # fault sites are only reachable through a real ring dispatch
+        from libsplinter_tpu.engine.embedder import Embedder
+        from libsplinter_tpu.models import default_tokenizer
+        from libsplinter_tpu.models.encoder import (EmbeddingModel,
+                                                    EncoderConfig)
+        cfg = EncoderConfig.tiny(out_dim=st.vec_dim)
+        emb = Embedder(st, model=EmbeddingModel(cfg, buckets=(16, 32)),
+                       tokenizer=default_tokenizer(cfg.vocab_size),
+                       max_ctx=128, batch_cap=4, ring_depth=4)
+        emb.attach()
+        n = emb.run_once()
+        print(f"embedded={n}", flush=True)
     elif role == "completer":
         from libsplinter_tpu.engine.completer import Completer
         comp = Completer(st, generate_fn=lambda p: iter([b"pong "]),

@@ -213,9 +213,12 @@ class TestPromExposition:
         lane.rows_staged = 100
         lane.rows_padded = 128
         lane.scatter_chunks = 2
+        lane.ring_dispatches = 1
+        lane.ring_chunks = 2
         lane.chunk_hist = {64: 2}
         c = lane.counters()
         assert c["chunks_bucket_64"] == 2
+        assert c["ring_dispatches"] == 1
         assert all(isinstance(v, (int, float)) for v in c.values())
 
 

@@ -30,6 +30,13 @@
 #                   + the depth-amortization smoke (per-drain host
 #                   overhead must shrink monotonically with depth;
 #                   scripts/dispatch_amortization_check.py)
+#   make pod-check  pod-sharded paged decode tier (fast, CPU
+#                   8-device mesh): sharded-paged vs single-chip-
+#                   paged vs serial token-exact parity, the
+#                   shard_map'd ragged/flash kernels in interpret
+#                   mode, mid-flight joiner, pool backpressure,
+#                   shard-labeled heartbeat gauges, and sharded-
+#                   dispatch fault containment
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -55,9 +62,9 @@ quick: native
 
 # the full sweep excludes the chaos tier, which runs once on its own
 # line (it needs JAX_PLATFORMS=cpu for the crash-matrix children and
-# would otherwise run twice); search-check/decode-check/chaos-check
-# stay standalone fast gates, same pattern as obs-check's `-m obs`
-# group — the full pytest sweep below collects their tiers too
+# would otherwise run twice); search-check/decode-check/chaos-check/
+# pod-check stay standalone fast gates, same pattern as obs-check's
+# `-m obs` group — the full pytest sweep below collects their tiers too
 check: native
 	$(MAKE) -C native check
 	$(PY) scripts/obs_overhead_check.py
@@ -84,6 +91,10 @@ dispatch-check: native
 		-m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
 
+pod-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sharded_paged.py \
+		tests/test_sharded_decode.py -q -m "not slow"
+
 memcheck: native
 	$(MAKE) -C native memcheck
 
@@ -95,4 +106,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native quick check obs-check search-check decode-check \
-	chaos-check dispatch-check memcheck bench-cpu clean
+	chaos-check dispatch-check pod-check memcheck bench-cpu clean

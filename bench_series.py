@@ -30,6 +30,11 @@ single claim window produces the complete evidence set:
                  dense KV sweep (batch {8,32,64} over a fixed
                  8-window page pool)
   decode_quant   the same core decode with int8 weight residency
+  multichip      pod-sharded paged decode: aggregate tok/s through
+                 ShardedCompletionModel (kv-head-sharded pools,
+                 shard_map'd ragged kernel) at batch {32,64} over a
+                 tp mesh of every visible device — vs the r05
+                 single-chip row; CPU-mesh rows are labeled smoke
   decode_daemon  completion-daemon e2e + continuous serving (the
                  only phase that ever hung on-chip, so it runs LAST)
 
@@ -72,15 +77,15 @@ TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
 
 ALL_PHASES = ("embed", "embed_sweep", "profile", "dispatch", "kernels",
               "search", "restage", "decode", "decode_quant",
-              "decode_daemon", "store_ops")
+              "multichip", "decode_daemon", "store_ops")
 
 # conservative floor (seconds) a phase needs to be worth starting;
 # compile costs dominate these on a cold .xla_cache
 PHASE_MIN_S = {"embed": 0, "embed_sweep": 120, "profile": 90,
                "dispatch": 20,
                "kernels": 120, "search": 150, "restage": 180,
-               "decode": 180, "decode_quant": 150, "decode_daemon": 120,
-               "store_ops": 15}
+               "decode": 180, "decode_quant": 150, "multichip": 120,
+               "decode_daemon": 120, "store_ops": 15}
 
 
 def log(*a):
@@ -1432,12 +1437,37 @@ def _decode_model(quant: bool):
 def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
     """Prefill latency + chunked / per-token / wide-chunk / batched /
     speculative decode tokens per second.  Env: DECODE_TOKENS (256),
-    DECODE_CHUNK (8), DECODE_GEOMETRY, DECODE_SPEC, DECODE_GAMMA."""
+    DECODE_CHUNK (8), DECODE_GEOMETRY, DECODE_SPEC, DECODE_GAMMA.
+
+    Every arm past the core measurement is BUDGET-GUARDED: BENCH_r05's
+    series timed out inside phase-decode_quant after a second 57 s
+    warmup compile (the chunk-32 program, freshly compiled for the
+    int8 graph), which erased the later phases from the evidence set.
+    Optional arms (chunk-32, the paged sweep, speculative) now check
+    the remaining window — minus a tail reserve for decode_daemon +
+    store_ops — before compiling anything, and skipped arms are
+    ledgered in `budget_skipped` so a missing number reads as a
+    deliberate skip, never a silent gap."""
     import numpy as np
 
     n_tokens = int(os.environ.get("DECODE_TOKENS", "256"))
     chunk = int(os.environ.get("DECODE_CHUNK", "8"))
     model, cfg, geometry = _decode_model(quant)
+
+    # tail reserve: decode_daemon's floor + store_ops + slack — an
+    # optional arm here must never eat the phases that follow
+    tail_reserve = (PHASE_MIN_S["decode_daemon"]
+                    + PHASE_MIN_S["store_ops"] + 30)
+    budget_skipped: list[str] = []
+
+    def room(arm: str, need_s: float) -> bool:
+        left = ctx.remaining() - tail_reserve
+        if left < need_s:
+            budget_skipped.append(arm)
+            log(f"[decode] SKIP {arm}: {left:.0f}s left after the "
+                f"{tail_reserve}s tail reserve < {need_s:.0f}s")
+            return False
+        return True
 
     log(f"decode{' int8' if quant else ''}: warmup compile ...")
     t0 = time.perf_counter()
@@ -1470,11 +1500,18 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
     tokens_per_sec(chunk, chunk * 2)
     tps_chunked = tokens_per_sec(chunk, n_tokens)
     tps_serial = tokens_per_sec(1, max(32, n_tokens // 4))
-    model.warmup(chunk=32)
-    tokens_per_sec(32, 64)
-    tps_c32 = tokens_per_sec(32, max(n_tokens, 128))
+    tps_c32 = None
+    if room("chunk32", 120):
+        # the r05 killer: warmup(chunk=32) compiles a SECOND chunk
+        # program (57 s on-chip for the int8 graph) — only worth it
+        # when the window still fits the phases behind this one
+        model.warmup(chunk=32)
+        tokens_per_sec(32, 64)
+        tps_c32 = tokens_per_sec(32, max(n_tokens, 128))
     log(f"decode: {tps_chunked:,.1f} tok/s (chunk={chunk}), "
-        f"{tps_c32:,.1f} (chunk=32), {tps_serial:,.1f} per-token sync")
+        + (f"{tps_c32:,.1f} (chunk=32), " if tps_c32 is not None
+           else "chunk=32 budget-skipped, ")
+        + f"{tps_serial:,.1f} per-token sync")
 
     def batch_tokens_per_sec(bsz: int, n: int) -> float:
         prompts = [np.ones((24 + r,), np.int32) for r in range(bsz)]
@@ -1501,7 +1538,8 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
     paged_page = 128
     paged_pool = 8 * (-(-cfg.max_len // paged_page))
     if os.environ.get("DECODE_PAGED", "1") == "1" \
-            and getattr(model, "paged_supported", False):
+            and getattr(model, "paged_supported", False) \
+            and room("paged_sweep", 120):
         sweep_default = "8" if os.environ.get("BENCH_CPU") == "1" \
             else "8,32,64"
         sweep = [int(x) for x in os.environ.get(
@@ -1538,6 +1576,9 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
             return got / dt
 
         for bsz in sweep:
+            if not room(f"paged_b{bsz}", 60):
+                continue      # every unaffordable width gets its own
+                              # budget_skipped entry, never a silent gap
             if paged_row_budget(bsz) < chunk:
                 # the claim under test is batch width inside the FIXED
                 # dense-batch8 envelope; growing the pool to fit a
@@ -1556,7 +1597,8 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
                 f"{paged_page})")
 
     tps_spec = accept = None
-    if os.environ.get("DECODE_SPEC", "1") == "1":
+    if os.environ.get("DECODE_SPEC", "1") == "1" \
+            and room("speculative", 120):
         from libsplinter_tpu.models import (CompletionModel,
                                             DecoderConfig,
                                             SpeculativeCompletionModel)
@@ -1589,7 +1631,11 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
             "chunk": chunk, "n_tokens": n_tokens,
             "prefill_ms_bucket64": round(prefill_ms, 2),
             "tokens_per_sec_serial_sync": round(tps_serial, 1),
-            "tokens_per_sec_chunk32": round(tps_c32, 1),
+            "tokens_per_sec_chunk32": (round(tps_c32, 1)
+                                       if tps_c32 is not None else None),
+            # arms the window could not afford (deliberate skips, not
+            # silent gaps — the r05 timeout fix)
+            "budget_skipped": budget_skipped,
             "tokens_per_sec_batch8_aggregate": round(tps_b8, 1),
             # the paged/dense ledger label: dense is the batch8 row
             # above, paged entries are keyed by sweep batch width
@@ -1618,6 +1664,131 @@ def phase_decode(ctx: SeriesCtx) -> dict:
 
 def phase_decode_quant(ctx: SeriesCtx) -> dict:
     return _decode_core(ctx, quant=True)
+
+
+def phase_multichip(ctx: SeriesCtx) -> dict:
+    """Pod-sharded paged decode (PR 8; ROADMAP item 1): aggregate
+    paged tok/s through ShardedCompletionModel over a tp mesh spanning
+    every visible device, batch {32, 64}, ledgered against the
+    single-chip r05 row (612.3 aggregate tok/s, batch=8).  On a TPU
+    pod the acceptance bar is >= 6x the single-chip aggregate on 8
+    chips; on any other backend the row is a CPU-MESH SMOKE — labeled
+    loudly as such in the record — proving the sharded lane runs
+    mechanically, never a performance claim.
+
+    Env: MULTICHIP_BATCHES (32,64), MULTICHIP_TOKENS (per-row decode
+    budget; 16 CPU / 256 TPU), DECODE_CHUNK (8), DECODE_GEOMETRY."""
+    import numpy as np
+
+    R05_SINGLE_CHIP = 612.3   # BENCH_r05: dense batch=8 aggregate tok/s
+    n_dev = ctx.n_devices
+    on_cpu = os.environ.get("BENCH_CPU") == "1" or ctx.backend == "cpu"
+    chunk = int(os.environ.get("DECODE_CHUNK", "8"))
+    base_rec = {"metric": "multichip_paged_tokens_per_sec",
+                "unit": "tokens/s (aggregate)"}
+    if n_dev < 2:
+        # a single-chip claim cannot exercise the arm — ledger the
+        # skip explicitly so the series stays complete and honest
+        log("[multichip] single device visible: no tp mesh to shard "
+            "over; ledgering a skip row")
+        return ctx.record({
+            **base_rec, "value": 0.0, "vs_baseline": 0.0,
+            "detail": {"backend": ctx.backend, "n_devices": n_dev,
+                       "skipped": "single device — the paged "
+                                  "multi-chip arm needs a pod claim"}})
+
+    from libsplinter_tpu.models import DecoderConfig
+    from libsplinter_tpu.parallel import ShardedCompletionModel
+    from libsplinter_tpu.parallel.mesh import make_mesh
+
+    geometry = os.environ.get("DECODE_GEOMETRY",
+                              "tiny" if on_cpu else "flagship")
+    if geometry == "tiny":
+        cfg = DecoderConfig.tiny()
+    else:
+        cfg = DecoderConfig(vocab_size=512)
+    # widest tp that divides the heads, the kv heads, and the device
+    # count (the rest becomes dp; kv-head pool sharding needs tp | KH)
+    tp = max(t for t in range(1, n_dev + 1)
+             if cfg.heads % t == 0 and cfg.kv_heads % t == 0
+             and n_dev % t == 0)
+    mesh = make_mesh(tp=tp)
+    model = ShardedCompletionModel(cfg, mesh)
+    assert model.paged_supported, "sharded paged lane regressed"
+    page = 16 if on_cpu else 128
+    ppr = -(-cfg.max_len // page)
+    batches = [int(x) for x in os.environ.get(
+        "MULTICHIP_BATCHES", "32,64").split(",") if x]
+    n_tokens = int(os.environ.get("MULTICHIP_TOKENS",
+                                  "16" if on_cpu else "256"))
+
+    def pool_for(bsz: int) -> int:
+        if not on_cpu:
+            # the r05 HBM envelope: 8 full windows of pages, same
+            # fixed-budget discipline as _decode_core's paged sweep
+            return 8 * ppr
+        # CPU smoke: 2 pages per row so every width decodes a few
+        # chunks (the envelope claim is the TPU arm's job)
+        return max(8 * ppr, bsz * 2)
+
+    def paged_tps(bsz: int, n: int) -> float:
+        cache = model.init_paged(bsz, page=page,
+                                 pool_pages=pool_for(bsz))
+        row_cap = (pool_for(bsz) // bsz) * page
+        n = max(chunk, min(n, min(row_cap, cfg.max_len) - 8 - chunk))
+        toks = np.zeros((bsz,), np.int32)
+        for r in range(bsz):
+            lg = model.paged_prefill_row(
+                cache, np.ones((4 + r % 4,), np.int32), r)
+            toks[r] = int(np.argmax(lg))
+        t0 = time.perf_counter()
+        got = 0
+        while got < n * bsz:
+            blk = model.paged_decode_chunk(cache, toks, chunk)
+            toks = blk[:, -1].astype(np.int32)
+            got += bsz * chunk
+        dt = time.perf_counter() - t0
+        cache.reset()
+        return got / dt
+
+    tps_by_batch: dict[str, float] = {}
+    budget_skipped: list[str] = []
+    for bsz in batches:
+        if ctx.remaining() < 120:
+            # ledgered below, never a silent gap (same discipline as
+            # _decode_core's budget_skipped)
+            budget_skipped.append(f"batch{bsz}")
+            log(f"[multichip] batch={bsz} budget-skipped "
+                f"({ctx.remaining():.0f}s left)")
+            continue
+        paged_tps(bsz, chunk * 2)                 # warm/compile
+        tps_by_batch[str(bsz)] = round(paged_tps(bsz, n_tokens), 1)
+        log(f"multichip paged: {tps_by_batch[str(bsz)]:,.1f} aggregate "
+            f"tok/s (batch={bsz}, tp={tp} over {n_dev} devices)")
+
+    best = max(tps_by_batch.values()) if tps_by_batch else 0.0
+    return ctx.record({
+        **base_rec,
+        "value": best,
+        # vs_baseline: the >=6x-single-chip acceptance ratio on TPU;
+        # meaningless (and labeled so) on a CPU mesh
+        "vs_baseline": round(best / R05_SINGLE_CHIP, 3),
+        "detail": {
+            "backend": ctx.backend, "geometry": geometry,
+            "n_devices": n_dev, "tp": tp, "dp": n_dev // tp,
+            "page": page, "chunk": chunk,
+            "pool_pages_by_batch": {str(b): pool_for(b)
+                                    for b in batches},
+            "tokens_per_sec_by_batch": tps_by_batch,
+            "budget_skipped": budget_skipped,
+            "r05_single_chip_dense_batch8": R05_SINGLE_CHIP,
+            "vs_r05_single_chip": round(best / R05_SINGLE_CHIP, 3),
+            "target": ">=6x single-chip aggregate tok/s on 8 chips",
+            # LOUD smoke label: a CPU virtual mesh measures host
+            # arithmetic, not ICI-sharded HBM bandwidth — this row is
+            # mechanical evidence only until a pod claim lands
+            "cpu_mesh_smoke": ctx.backend != "tpu",
+        }})
 
 
 def phase_decode_daemon(ctx: SeriesCtx) -> dict:
@@ -1837,6 +2008,7 @@ PHASE_FNS = {
     "restage": phase_restage,
     "decode": phase_decode,
     "decode_quant": phase_decode_quant,
+    "multichip": phase_multichip,
     "decode_daemon": phase_decode_daemon,
     "store_ops": phase_store_ops,
 }

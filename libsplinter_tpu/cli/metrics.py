@@ -89,6 +89,32 @@ def cmd_metrics(ses, args):
         disp = snap.pop("dispatch", None)  # PR-7 overlap gauges: their
         if isinstance(disp, dict):         # own (size-droppable)
             w.scalars(f"sptpu_{daemon}", disp)  # section, flat names
+        shards = snap.pop("pages_shard", None)  # pod-sharded pool
+        if isinstance(shards, dict):            # occupancy (PR 8)
+            # on the sharded lane the pages_{free,used} family renders
+            # ONLY with shard labels: leaving the flat copies in too
+            # would put labeled and unlabeled samples in one family
+            # and a sum() over it would read (tp+1)x the true count
+            snap.pop("pages_free", None)
+            snap.pop("pages_used", None)
+            for shard, occ in shards.items():
+                if not isinstance(occ, dict):
+                    continue
+                lab_s = {"daemon": daemon, "shard": str(shard)}
+                for field in ("free", "used"):
+                    w.metric(f"sptpu_{daemon}_pages_{field}",
+                             occ.get(field, 0), lab_s,
+                             help_="paged KV pool occupancy; one "
+                                   "series per tp shard backing the "
+                                   "pages (host-global count — read "
+                                   "max(), not sum())")
+                if "shard_mb" in occ:
+                    w.metric(f"sptpu_{daemon}_pool_shard_mb",
+                             occ["shard_mb"], lab_s,
+                             help_="measured on-device pool bytes "
+                                   "per tp shard (k+v, all layers) — "
+                                   "a missing shard key or inflated "
+                                   "MB means the placement broke")
         flt = snap.pop("faults", None)  # armed SPTPU_FAULT accounting
         if isinstance(flt, dict):
             for site, counts in flt.items():

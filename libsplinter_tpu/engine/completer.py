@@ -669,9 +669,11 @@ class Completer:
         up to a decode-chunk boundary, capped at the window) exceeds
         the pool stays WAITING and
         join_backpressure counts the deferral — backpressure, never a
-        mid-decode strand.  Serial-only models (speculative), sharded
-        models (paged_supported False), and window-only bucket
-        geometries fall back to run()."""
+        mid-decode strand.  Sharded models serve this lane too (PR 8:
+        kv-head-sharded pools + shard_map'd ragged kernel,
+        parallel/serve.py).  Serial-only models (speculative), models
+        whose module cannot thread a mesh (paged_supported False),
+        and window-only bucket geometries fall back to run()."""
         if not self._paged_ok():
             return self.run(idle_timeout_ms=idle_timeout_ms,
                             stop_after=stop_after)
@@ -685,6 +687,10 @@ class Completer:
         B = self.paged_batch_cap
         cfg = m.cfg
         cache = self._ensure_paged_cache()
+        # pod-sharded lane (ShardedCompletionModel): the dispatch gets
+        # its own fault site so the chaos matrix can crash/raise inside
+        # a sharded decode specifically (operations.md catalog)
+        sharded = getattr(m, "mesh", None) is not None
         self._running = True
         deadline = (time.monotonic() + stop_after) if stop_after else None
         last = st.signal_count(self.group)
@@ -961,6 +967,8 @@ class Completer:
                         continue
 
                     td = time.perf_counter()
+                    if sharded:
+                        fault("completer.sharded_dispatch")
                     pend = m.paged_decode_chunk_async(
                         cache, fresh, step, carry=carry)
                     live = [(r, rows[r]["serial"]) for r in range(B)
@@ -1117,6 +1125,42 @@ class Completer:
                 "decode (target model) for the rest of the run")
             self._model = m.target
 
+    def _pool_shard_occupancy(self, tp: int) -> dict:
+        """Per-tp-shard view of the paged pool, MEASURED from the
+        placed device buffers (not assumed from the host scheduler):
+        each key is the tp position a shard's kv-head slice covers,
+        `shard_mb` its actual on-device pool bytes (k+v, all layers).
+        Page counts are host-global (every shard backs every page at
+        1/tp of its bytes) — the bytes are the placement signal: a
+        broken placement collapses the key set (a replicated pool
+        covers the full kv-head range -> one key) or inflates
+        shard_mb, so the dashboard shows it instead of rendering a
+        fabricated uniform number."""
+        cache = self._paged_cache
+        out: dict = {}
+        try:
+            arr = cache.k_pools[0]
+            kh = arr.shape[1]
+            per_shard = max(1, kh // tp)
+            layers = len(cache.k_pools)
+            seen: dict[str, int] = {}
+            for sh in arr.addressable_shards:
+                sl = sh.index[1] if len(sh.index) > 1 else slice(None)
+                start = sl.start or 0
+                pos = str(start // per_shard)
+                # replicas (the dp axis) carry identical bytes: keep
+                # one measurement per tp position
+                seen.setdefault(pos, sh.data.nbytes)
+            for pos, nbytes in sorted(seen.items()):
+                out[pos] = {
+                    "free": cache.free_pages,
+                    "used": cache.used_pages,
+                    "shard_mb": round(nbytes * 2 * layers / 1e6, 3),
+                }
+        except Exception:
+            return {}            # obs must never take the lane down
+        return out
+
     def publish_stats(self) -> None:
         """Heartbeat: JSON stats snapshot into the debug-labeled
         __completer_stats key (the structured counterpart of the
@@ -1136,11 +1180,22 @@ class Completer:
             # demoted: keep the rolling rate that tripped the floor
             payload["spec_acceptance"] = round(
                 self._spec_acceptance_rolling, 4)
+        mesh = getattr(getattr(self, "_model", None), "mesh", None)
+        if mesh is not None:
+            # pod-sharded lane: the tensor-parallel degree rides the
+            # heartbeat (sptpu_completer_tp) so dashboards can tell a
+            # sharded daemon from a single-chip one at a glance
+            payload["tp"] = int(mesh.shape.get("tp", 1))
         if self._paged_cache is not None:
             # sptpu_completer_pages_{free,used} pool gauges
             payload["pages_free"] = self._paged_cache.free_pages
             payload["pages_used"] = self._paged_cache.used_pages
             payload["live_tokens"] = self._paged_cache.live_tokens()
+            if mesh is not None and int(mesh.shape.get("tp", 1)) > 1:
+                shards = self._pool_shard_occupancy(
+                    int(mesh.shape["tp"]))
+                if shards:
+                    payload["pages_shard"] = shards
         if faults.armed():
             payload["faults"] = faults.stats()
         if tracer.enabled:
@@ -1218,9 +1273,12 @@ def main(argv: list[str] | None = None) -> int:
                          "2048 for seeded-random weights)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree: shard the decoder "
-                         "(params + KV cache) over a tp-axis mesh of "
-                         "this many devices (parallel.serve; must "
-                         "divide the model's heads and kv_heads)")
+                         "(params + KV cache — incl. the paged block "
+                         "pools with --continuous: kv-head-sharded "
+                         "pools, shard_map'd ragged kernel) over a "
+                         "tp-axis mesh of this many devices "
+                         "(parallel.serve; must divide the model's "
+                         "heads and kv_heads)")
     ap.add_argument("--ep", type=int, default=1,
                     help="expert-parallel degree for MoE checkpoints: "
                          "shard the stacked expert FFNs over an ep "

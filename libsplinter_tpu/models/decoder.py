@@ -77,6 +77,40 @@ def init_cache(cfg: DecoderConfig, batch: int):
     return [(z, z) for _ in range(cfg.layers)]
 
 
+def _tp_of(sharding) -> int:
+    """The tensor-parallel degree a pool sharding splits kv heads
+    over: the mesh size along the axes named at the KV-HEAD position
+    (index 1) of its PartitionSpec.  1 for a replicated spec."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None or len(spec) < 2 or spec[1] is None:
+        return 1
+    names = spec[1] if isinstance(spec[1], tuple) else (spec[1],)
+    tp = 1
+    for n in names:
+        tp *= sharding.mesh.shape[n]
+    return tp
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_zeros_prog(shape, dtype, sharding):
+    """One cached creation program per (shape, dtype, sharding): the
+    continuous lane rebuilds its pool on abort recovery, and a fresh
+    jit wrapper per construction would retrace the (trivial) program
+    on that hot path."""
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sharding)
+
+
+def _pool_zeros(shape, dtype, sharding):
+    """Zeroed-pool factory.  With a sharding, the zeros are created
+    DIRECTLY into it (jit out_shardings) — a host-side jnp.zeros +
+    device_put would materialize the whole pool on one device first,
+    exactly the HBM spike pod sharding exists to avoid."""
+    if sharding is None:
+        return lambda: jnp.zeros(shape, dtype)
+    return _sharded_zeros_prog(tuple(shape), dtype, sharding)
+
+
 class PagedKVCache:
     """Block-paged KV pool for the continuous-batching decode lane.
 
@@ -105,10 +139,20 @@ class PagedKVCache:
     `page` must be a multiple of the 128-lane tile on real TPU
     hardware (the Pallas kernel's page axis); CPU tests use small
     pages through interpret/reference dispatch.
+
+    `sharding` (a NamedSharding, normally P(None, "tp", None, None)
+    from ShardedCompletionModel) places the pools sharded on their
+    KV-HEAD axis across a tensor-parallel mesh: each device holds
+    every page at 1/tp of its bytes, so page scheduling (tables,
+    lengths, alloc/free — all host-side) is IDENTICAL to the
+    single-chip pool while cache HBM per chip divides by tp.  The
+    pools are created directly into the sharding (jit out_shardings)
+    so no device ever materializes the full-size buffer.
     """
 
     def __init__(self, cfg: DecoderConfig, batch: int, *,
-                 page: int = 128, pool_pages: int | None = None):
+                 page: int = 128, pool_pages: int | None = None,
+                 sharding=None):
         if page < 1:
             raise ValueError("page must be >= 1")
         if page % 128 and jax.default_backend() == "tpu":
@@ -135,12 +179,17 @@ class PagedKVCache:
                 f"window ({self.pages_per_row} pages)")
         self.n_blocks = pool_pages + 1               # + the trash block
         shape = (self.n_blocks, cfg.kv_heads, page, cfg.head_dim)
+        if sharding is not None and cfg.kv_heads % _tp_of(sharding):
+            raise ValueError(
+                f"the sharding's tp={_tp_of(sharding)} axis must "
+                f"divide kv_heads={cfg.kv_heads} (pools split on the "
+                "kv-head axis)")
+        self.sharding = sharding
         # distinct buffers per layer/side: the paged programs donate
         # the pools, and XLA rejects donating one buffer twice
-        self.k_pools = [jnp.zeros(shape, cfg.dtype)
-                        for _ in range(cfg.layers)]
-        self.v_pools = [jnp.zeros(shape, cfg.dtype)
-                        for _ in range(cfg.layers)]
+        zeros = _pool_zeros(shape, cfg.dtype, sharding)
+        self.k_pools = [zeros() for _ in range(cfg.layers)]
+        self.v_pools = [zeros() for _ in range(cfg.layers)]
         self.tables = np.zeros((batch, self.pages_per_row), np.int32)
         self.lengths = np.zeros((batch,), np.int32)
         self._free = list(range(self.n_blocks - 1, 0, -1))
@@ -238,6 +287,13 @@ def _proj(cfg: DecoderConfig, features: int, name: str):
 
 class CausalAttention(nn.Module):
     cfg: DecoderConfig
+    # tensor-parallel serving (parallel/serve.py): the mesh the Pallas
+    # attention kernels run under via shard_map — GSPMD cannot
+    # partition a Mosaic custom call, so the flash-prefill and ragged
+    # paged-decode kernels take the mesh explicitly and each device
+    # runs the program over its local H/tp (KH/tp) heads.  None (the
+    # single-device default) leaves every kernel call unchanged.
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, cache_kv, pos, start=None, lengths=None,
@@ -291,7 +347,8 @@ class CausalAttention(nn.Module):
             # trash block 0 via their zeroed table entries
             kp = kp.at[bids, :, offs, :].set(k[:, 0])
             vp = vp.at[bids, :, offs, :].set(v[:, 0])
-            out = paged_attention(q[:, 0], kp, vp, tables, app + 1)
+            out = paged_attention(q[:, 0], kp, vp, tables, app + 1,
+                                  mesh=self.mesh)
             out = out.reshape(B, S, cfg.heads * D)
             return _proj(cfg, cfg.hidden, "out")(out), (kp, vp)
 
@@ -314,7 +371,8 @@ class CausalAttention(nn.Module):
             # in UNREPEATED (the kernel maps query head -> kv head)
             # (serving-only path; the decoder trains nowhere here)
             from ..ops.flash_attention import causal_flash_attention
-            out = causal_flash_attention(q, ck, cv, pos, start)
+            out = causal_flash_attention(q, ck, cv, pos, start,
+                                         mesh=self.mesh)
         else:
             # short chunks: the shared reference math (one mask
             # implementation across naive / fallback / kernel —
@@ -338,12 +396,13 @@ class DecoderLayer(nn.Module):
     (e.g. moe.MoeMlp) mounts at name 'moe' instead."""
     cfg: DecoderConfig
     mlp_cls: Any = None
+    mesh: Any = None                  # see CausalAttention.mesh
 
     @nn.compact
     def __call__(self, x, cache_kv, pos, start=None, lengths=None,
                  tables=None):
         cfg = self.cfg
-        a, cache_kv = CausalAttention(cfg, name="attn")(
+        a, cache_kv = CausalAttention(cfg, self.mesh, name="attn")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_attn")(x),
             cache_kv, pos, start, lengths, tables)
         x = x + a
@@ -363,6 +422,7 @@ class Decoder(nn.Module):
     mlp_cls swaps the per-layer MLP (moe.MoeDecoder passes MoeMlp)."""
     cfg: DecoderConfig
     mlp_cls: Any = None
+    mesh: Any = None                  # see CausalAttention.mesh
 
     @nn.compact
     def __call__(self, token_ids, cache, pos, start=None, lengths=None,
@@ -379,7 +439,7 @@ class Decoder(nn.Module):
                      name="tok_emb")(token_ids)
         new_cache = []
         for i in range(cfg.layers):
-            x, kv = DecoderLayer(cfg, self.mlp_cls,
+            x, kv = DecoderLayer(cfg, self.mlp_cls, self.mesh,
                                  name=f"layer_{i}")(x, cache[i], pos,
                                                     start, lengths,
                                                     tables)
@@ -446,10 +506,12 @@ class CompletionModel:
     """Bucketed prefill + token-at-a-time decode with persistent cache.
 
     paged_supported marks the block-paged continuous-batching surface
-    (init_paged / paged_prefill_row / paged_decode_chunk) as usable;
-    subclasses whose cache placement the paged pool does not yet
-    honour (parallel.ShardedCompletionModel) override it to False and
-    the completion daemon falls back to dense serving.
+    (init_paged / paged_prefill_row / paged_decode_chunk) as usable.
+    parallel.ShardedCompletionModel serves it tensor-parallel (pools
+    sharded on kv heads, the ragged kernel under shard_map); a model
+    whose module cannot thread the mesh (a custom module built
+    without one) clears the flag and the completion daemon falls back
+    to dense serving.
 
     The generation surface the completion daemon drives:
         pos, logits = model.prefill(prompt_ids)
@@ -817,6 +879,38 @@ class CompletionModel:
     # — prompts keep attending through causal_flash_attention; only
     # the decode step runs the ragged paged kernel.
 
+    def _pool_sharding(self):
+        """Device placement for the paged block pools: None here (one
+        chip); ShardedCompletionModel returns the kv-head NamedSharding
+        so the pools split over the tp mesh axis."""
+        return None
+
+    def _paged_pool_out_shardings(self, n_pool_lists: int, n_rep: int):
+        """out_shardings for a paged program returning n_pool_lists
+        per-layer pool lists followed by n_rep replicated arrays, or
+        None when the pools are unsharded.  Pinning the OUTPUT
+        shardings keeps the jit signature stable across the program
+        chain (fresh pool -> commit out -> chunk out -> chunk in ...):
+        without it the first serve-time call after warmup sees
+        GSPMD-chosen output shardings that hash differently from the
+        explicitly placed fresh pools and silently recompiles."""
+        sh = self._pool_sharding()
+        if sh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(sh.mesh, PartitionSpec())
+        layers = self.cfg.layers
+        return tuple([sh] * layers for _ in range(n_pool_lists)) \
+            + (rep,) * n_rep
+
+    def _paged_scratch(self, b: int):
+        """The (1, bucket) dense scratch cache paged prefill runs the
+        trunk over; subclasses place it with an explicit sharding so
+        the commit scatter into a sharded pool stays collective-free."""
+        cfg = self.cfg
+        z = jnp.zeros((1, b, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+        return [(z, z) for _ in range(cfg.layers)]
+
     def init_paged(self, batch: int, *, page: int = 128,
                    pool_pages: int | None = None) -> PagedKVCache:
         """Fresh paged pool serving `batch` concurrent rows.  The
@@ -824,7 +918,8 @@ class CompletionModel:
         batch); cap pool_pages lower to spend HBM on batch width
         instead of cache padding."""
         return PagedKVCache(self.cfg, batch, page=page,
-                            pool_pages=pool_pages)
+                            pool_pages=pool_pages,
+                            sharding=self._pool_sharding())
 
     def _paged_commit_program(self, bucket: int, page: int):
         """One program scattering a (1, bucket) dense prefill cache
@@ -851,7 +946,9 @@ class CompletionModel:
                     outv.append(vp.at[bids].set(blocks(vd)))
                 return outk, outv
 
-            fn = jax.jit(run, donate_argnums=(0, 1))
+            out_sh = self._paged_pool_out_shardings(2, 0)
+            kw = {} if out_sh is None else {"out_shardings": out_sh}
+            fn = jax.jit(run, donate_argnums=(0, 1), **kw)
             self._paged_progs[key] = fn
         return fn
 
@@ -879,8 +976,7 @@ class CompletionModel:
         # bucket-sized dense scratch (NOT max_len): the same jitted
         # trunk runs with T = bucket, so paged prefill costs one small
         # program per bucket instead of a full-window cache
-        z = jnp.zeros((1, b, cfg.kv_heads, cfg.head_dim), cfg.dtype)
-        scratch = [(z, z) for _ in range(cfg.layers)]
+        scratch = self._paged_scratch(b)
         logits, dense = self._fn(self.params, jnp.asarray(ids), scratch,
                                  jnp.int32(0))
         n_cp = -(-b // cache.page)
@@ -932,7 +1028,9 @@ class CompletionModel:
                     length=n)
                 return k_pools, v_pools, out, out[-1]  # out: (n, bp)
 
-            fn = jax.jit(run, donate_argnums=(1, 2))
+            out_sh = self._paged_pool_out_shardings(2, 2)
+            kw = {} if out_sh is None else {"out_shardings": out_sh}
+            fn = jax.jit(run, donate_argnums=(1, 2), **kw)
             self._paged_progs[key] = fn
             if len(self._paged_progs) > 16:
                 cur = (self.top_p, self.temp)

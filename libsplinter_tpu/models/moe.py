@@ -96,14 +96,15 @@ class MoeMlp(nn.Module):
         return jnp.einsum("bseh,bse->bsh", out, gates)
 
 
-def MoeDecoder(cfg: MoeDecoderConfig):
+def MoeDecoder(cfg: MoeDecoderConfig, mesh=None):
     """Causal MoE LM: the shared Decoder trunk (embed, cache threading,
     final norm, LM head — decoder.Decoder) with MoeMlp as each layer's
     MLP.  Same call signature; param tree differs only inside each
-    layer (layer_i/moe/...)."""
+    layer (layer_i/moe/...).  mesh threads through to the attention
+    kernels for sharded serving (decoder.CausalAttention.mesh)."""
     from .decoder import Decoder
 
-    return Decoder(cfg, mlp_cls=MoeMlp)
+    return Decoder(cfg, mlp_cls=MoeMlp, mesh=mesh)
 
 
 def moe_completion_model(cfg: MoeDecoderConfig, mesh=None, **kw) -> Any:
@@ -111,7 +112,7 @@ def moe_completion_model(cfg: MoeDecoderConfig, mesh=None, **kw) -> Any:
     (tp attention + ep experts) serving."""
     from .decoder import CompletionModel
 
-    module = MoeDecoder(cfg)
+    module = MoeDecoder(cfg, mesh=mesh)
     if mesh is None:
         return CompletionModel(cfg, module=module, **kw)
     ep = mesh.shape.get("ep", 1)

@@ -252,31 +252,14 @@ def _causal_flash_pallas(q, k, v, pos, start, *, block_q: int,
     )(q, k, v, pos, start)
 
 
-def causal_flash_attention(q, kk, vv, pos, start=None, *,
-                           block_q: int = 256, interpret: bool = False,
-                           force_pallas: bool = False):
-    """Decoder-prefill attention without HBM-quadratic logits
-    (FORWARD/serving only — the decoder trains nowhere in this
-    framework, so no VJP is defined; jax.grad through this raises).
-
-    q: (B, S, H, D) queries at cache slots pos..pos+S-1;
-    kk/vv: (B, T, KH, D) the updated cache — pass kv heads UNREPEATED
-    (GQA): the kernel maps query head h to kv head h // (H//KH), so
-    the repeated cache never hits HBM;
-    pos: scalar int32; start: None or (B,) left-pad offsets.
-    Returns (B, S, H, D).
-    """
+def _causal_flash_host(q, kk, vv, pos, start, *, block_q: int,
+                       interpret: bool):
+    """The per-device Pallas dispatch (pad S to a block multiple,
+    transpose to head-major, kernel, undo).  Under mesh= this runs
+    PER SHARD inside shard_map with the local H/tp query heads and
+    KH/tp kv heads — the GQA head→kv-head routing stays local because
+    query heads shard consistently with kv heads."""
     B, S, H, D = q.shape
-    if start is None:
-        start = jnp.zeros((B,), jnp.int32)
-    use_pallas = (force_pallas or interpret
-                  or jax.default_backend() == "tpu")
-    if not use_pallas:
-        rep = H // kk.shape[2]
-        if rep > 1:                   # the einsum fallback needs H heads
-            kk = jnp.repeat(kk, rep, axis=2)
-            vv = jnp.repeat(vv, rep, axis=2)
-        return _causal_jnp(q, kk, vv, pos, start)
     bq = min(block_q, S)
     pad = (-S) % bq
     if pad:
@@ -290,6 +273,61 @@ def causal_flash_attention(q, kk, vv, pos, start=None, *,
         interpret=interpret)
     out = out.transpose(0, 2, 1, 3)
     return out[:, :S] if pad else out
+
+
+def causal_flash_attention(q, kk, vv, pos, start=None, *,
+                           block_q: int = 256, interpret: bool = False,
+                           force_pallas: bool = False, mesh=None):
+    """Decoder-prefill attention without HBM-quadratic logits
+    (FORWARD/serving only — the decoder trains nowhere in this
+    framework, so no VJP is defined; jax.grad through this raises).
+
+    q: (B, S, H, D) queries at cache slots pos..pos+S-1;
+    kk/vv: (B, T, KH, D) the updated cache — pass kv heads UNREPEATED
+    (GQA): the kernel maps query head h to kv head h // (H//KH), so
+    the repeated cache never hits HBM;
+    pos: scalar int32; start: None or (B,) left-pad offsets.
+    Returns (B, S, H, D).
+
+    mesh: a Mesh with a tp axis > 1 runs the kernel under shard_map —
+    GSPMD cannot partition a Mosaic custom call, which is why sharded
+    serving used to demote flash_min_seq to 0 and prefill through the
+    naive path (parallel/serve.py pre-PR-8).  With the mesh threaded,
+    queries shard on their head axis and the cache on its kv-head
+    axis, each device runs the same kernel over its local heads, and
+    the jnp fallback (non-TPU, no interpret) stays un-shard_map'd:
+    GSPMD partitions plain einsums natively.
+    """
+    B, S, H, D = q.shape
+    if start is None:
+        start = jnp.zeros((B,), jnp.int32)
+    use_pallas = (force_pallas or interpret
+                  or jax.default_backend() == "tpu")
+    if not use_pallas:
+        rep = H // kk.shape[2]
+        if rep > 1:                   # the einsum fallback needs H heads
+            kk = jnp.repeat(kk, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        return _causal_jnp(q, kk, vv, pos, start)
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        from jax.sharding import PartitionSpec as SP
+
+        from ..parallel.mesh import shard_map
+
+        body = functools.partial(_causal_flash_host, block_q=block_q,
+                                 interpret=interpret)
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(SP(None, None, "tp", None),   # q: heads
+                      SP(None, None, "tp", None),   # kk: kv heads
+                      SP(None, None, "tp", None),   # vv
+                      SP(), SP()),                  # pos / start
+            out_specs=SP(None, None, "tp", None),
+            check_vma=False)
+        return fn(q, kk, vv, jnp.asarray(pos, jnp.int32),
+                  jnp.asarray(start, jnp.int32))
+    return _causal_flash_host(q, kk, vv, pos, start, block_q=block_q,
+                              interpret=interpret)
 
 
 def _causal_jnp(q, kk, vv, pos, start):

@@ -49,6 +49,11 @@ stack.
 
 On non-TPU backends the same math runs as plain jnp over a gathered
 page view (tests exercise the kernel itself via interpret=True).
+
+Tensor-parallel serving (parallel/serve.py) passes mesh= and the whole
+dispatch runs under shard_map: pools sharded on the kv-head axis over
+`tp`, each device executing the same program over its KH/tp local
+heads — see paged_attention's docstring for the sharding contract.
 """
 from __future__ import annotations
 
@@ -185,22 +190,16 @@ def _paged_ref(q, k_pool, v_pool, tables, lengths):
     return out.reshape(B, H, D).astype(q.dtype)
 
 
-def paged_attention(q, k_pool, v_pool, tables, lengths, *,
-                    interpret: bool = False,
-                    force_pallas: bool = False):
-    """Ragged paged decode attention (FORWARD/serving only).
-
-    q: (B, H, D) — ONE query token per row, at position lengths[b]-1
-    (call after appending the step's K/V, so lengths counts it);
-    k_pool/v_pool: (n_blocks, KH, page, D) — kv heads UNREPEATED (GQA:
-    query head h reads kv head h // (H//KH), grouped like
-    causal_flash_attention);
-    tables: (B, P) int32 block table — entry (b, p) is the pool block
-    holding row b's tokens [p*page, (p+1)*page); unused entries point
-    at the trash block 0;
-    lengths: (B,) int32 — row b attends keys j < lengths[b].
-    Returns (B, H, D) in q's dtype.
-    """
+def _paged_host(q, k_pool, v_pool, tables, lengths, *,
+                interpret: bool, force_pallas: bool):
+    """The single-device dispatch body: Pallas kernel on TPU (or under
+    interpret/force_pallas), identical jnp math elsewhere.  Under
+    paged_attention's mesh= this runs PER SHARD inside shard_map —
+    q/k_pool/v_pool arrive with their local KH/tp kv heads (and the
+    matching H/tp query heads), tables/lengths replicated, and the
+    math needs no collective: every kv head's attention is independent
+    and the GQA head-repeat stays local because query heads shard
+    consistently with kv heads."""
     B, H, D = q.shape
     KH = k_pool.shape[1]
     rep = H // KH
@@ -214,3 +213,51 @@ def paged_attention(q, k_pool, v_pool, tables, lengths, *,
                         jnp.asarray(lengths, jnp.int32),
                         interpret=interpret)
     return out.reshape(B, H, D)
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    interpret: bool = False,
+                    force_pallas: bool = False,
+                    mesh=None):
+    """Ragged paged decode attention (FORWARD/serving only).
+
+    q: (B, H, D) — ONE query token per row, at position lengths[b]-1
+    (call after appending the step's K/V, so lengths counts it);
+    k_pool/v_pool: (n_blocks, KH, page, D) — kv heads UNREPEATED (GQA:
+    query head h reads kv head h // (H//KH), grouped like
+    causal_flash_attention);
+    tables: (B, P) int32 block table — entry (b, p) is the pool block
+    holding row b's tokens [p*page, (p+1)*page); unused entries point
+    at the trash block 0;
+    lengths: (B,) int32 — row b attends keys j < lengths[b].
+    Returns (B, H, D) in q's dtype.
+
+    mesh: a Mesh with a tp axis > 1 runs the kernel under shard_map —
+    GSPMD cannot partition a Mosaic custom call, so the tensor-
+    parallel serving path (parallel.serve.ShardedCompletionModel)
+    shards the pools on their kv-head axis and each device runs the
+    SAME Pallas program over its local KH/tp heads (block tables and
+    lengths stay replicated; page scheduling is host-side and
+    unchanged).  No collective is needed here: the one psum pair per
+    block comes from the row-parallel out-projection sharding, exactly
+    like the dense path.
+    """
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        from jax.sharding import PartitionSpec as SP
+
+        from ..parallel.mesh import shard_map
+
+        body = functools.partial(_paged_host, interpret=interpret,
+                                 force_pallas=force_pallas)
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(SP(None, "tp", None),          # q: heads
+                      SP(None, "tp", None, None),    # k_pool: kv heads
+                      SP(None, "tp", None, None),    # v_pool
+                      SP(), SP()),                   # tables / lengths
+            out_specs=SP(None, "tp", None),
+            check_vma=False)
+        return fn(q, k_pool, v_pool, jnp.asarray(tables, jnp.int32),
+                  jnp.asarray(lengths, jnp.int32))
+    return _paged_host(q, k_pool, v_pool, tables, lengths,
+                       interpret=interpret, force_pallas=force_pallas)

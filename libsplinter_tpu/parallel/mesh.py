@@ -70,6 +70,16 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp"))
 
 
+def kv_pool_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement of a paged KV block pool (n_blocks, kv_heads, page,
+    head_dim) for tensor-parallel decode: split on the KV-HEAD axis
+    over tp, so every device holds every page at 1/tp of its bytes
+    and the host-side page scheduler never changes (parallel/serve.py
+    ShardedCompletionModel._pool_sharding; the shard_map'd ragged
+    kernel in ops/paged_attention.py expects exactly this spec)."""
+    return NamedSharding(mesh, P(None, "tp", None, None))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

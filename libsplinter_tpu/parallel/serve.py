@@ -15,6 +15,15 @@ MXU per token — shards Megatron-style over the mesh's `tp` axis:
   - the KV cache shards on its kv_heads axis, so attention stays fully
     local per device (GQA's head-repeat also stays local because query
     heads shard consistently with kv heads);
+  - the PAGED block pools (models/decoder.PagedKVCache) shard the same
+    kv-head axis: every device holds every page at 1/tp of its bytes,
+    so the host-side page scheduler (tables, lengths, alloc/free,
+    admission backpressure) is byte-identical to the single-chip pool
+    while cache HBM per chip divides by tp.  The ragged paged-decode
+    and flash-prefill Pallas kernels run under shard_map (GSPMD cannot
+    partition a Mosaic custom call) with query heads sharded
+    consistently — one psum pair per block still comes from the
+    row/column-parallel Dense shardings, nothing hand-written;
   - embeddings and the LM head stay replicated: logits come out
     replicated, so the in-graph sampler (and therefore the whole
     decode_chunk lax.scan) runs identically on every device with the
@@ -22,8 +31,11 @@ MXU per token — shards Megatron-style over the mesh's `tp` axis:
 
 ShardedCompletionModel IS a CompletionModel: same prefill / decode_one /
 decode_chunk / generate_tokens surface, same compiled-program caching,
-so the completion daemon (engine.completer) drives it unchanged —
-scale-out is a constructor swap.
+AND the same paged continuous-batching surface (init_paged /
+paged_prefill_row / paged_decode_chunk — paged_supported is True), so
+the completion daemon (engine.completer run_continuous, the K-deep
+in-flight window, the supervisor) drives it unchanged — scale-out is a
+constructor swap.
 
 Requires cfg.heads % tp == 0 and cfg.kv_heads % tp == 0.
 """
@@ -32,8 +44,8 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.decoder import CompletionModel, init_cache
-from .mesh import make_mesh
+from ..models.decoder import CompletionModel, Decoder, init_cache
+from .mesh import kv_pool_sharding, make_mesh
 
 
 def decoder_param_pspec(path: tuple, leaf) -> P:
@@ -82,29 +94,52 @@ class ShardedCompletionModel(CompletionModel):
 
     Everything above the placement is inherited: the same jitted
     programs run over sharded arrays and GSPMD inserts the block psums.
+    The paged continuous-batching surface is inherited too — the pools
+    it allocates are kv-head-sharded (_pool_sharding) and the default
+    Decoder module threads the mesh into the shard_map'd attention
+    kernels, so flash prefill is no longer demoted to the naive path
+    and paged_supported stays True.
     """
 
-    # the paged pool is host-scheduled and unsharded; until the pools
-    # get a tp placement (and the paged kernel a shard_map), sharded
-    # serving stays on the dense batched path
-    paged_supported = False
+    paged_supported = True
 
     def __init__(self, cfg, mesh: Mesh | None = None, **kw):
-        import dataclasses
-
         self.mesh = mesh or make_mesh()
         tp = self.mesh.shape["tp"]
         if cfg.heads % tp or cfg.kv_heads % tp:
             raise ValueError(
                 f"heads={cfg.heads}/kv_heads={cfg.kv_heads} must divide "
                 f"the tp={tp} mesh axis")
-        if cfg.flash_min_seq:
-            # GSPMD cannot partition a Mosaic (Pallas) custom call, so
-            # the flash prefill kernel would break (or force full
-            # replication of) the tp-sharded program — sharded serving
-            # prefills through the naive path; a shard_map'd kernel is
-            # future work
-            cfg = dataclasses.replace(cfg, flash_min_seq=0)
+        if kw.get("module") is None:
+            # the default trunk, with the mesh threaded into the
+            # attention kernels (CausalAttention.mesh): flash prefill
+            # and ragged paged decode run under shard_map instead of
+            # breaking the tp-sharded program on a Mosaic custom call
+            kw["module"] = Decoder(cfg, mesh=self.mesh)
+        elif getattr(kw["module"], "mesh", None) is None and tp > 1:
+            # a custom module built WITHOUT the mesh cannot run the
+            # Pallas kernels under GSPMD — leave the paged lane off
+            # for this instance (the completion daemon then serves
+            # dense, engine/completer._paged_ok); builders that want
+            # the paged lane thread the mesh at module construction
+            # (models/moe.MoeDecoder does).  The module's own closed-
+            # over flash_min_seq is out of our reach (it was under the
+            # pre-PR-8 cfg demotion too, which only replaced THIS
+            # class's copy), so on TPU a long prefill chunk would
+            # still hit the un-shard_map'd flash kernel inside the
+            # tp-sharded program — warn loudly instead of failing in
+            # the first long prompt's compile
+            self.paged_supported = False
+            mcfg = getattr(kw["module"], "cfg", None)
+            if getattr(mcfg, "flash_min_seq", 0):
+                import logging
+                logging.getLogger("libsplinter_tpu.serve").warning(
+                    "sharded serving with a meshless module whose "
+                    "flash_min_seq=%d is nonzero: prefill chunks at/"
+                    "above it route through a Pallas kernel GSPMD "
+                    "cannot partition on TPU — build the module with "
+                    "mesh= (or flash_min_seq=0) for tp>1",
+                    mcfg.flash_min_seq)
         super().__init__(cfg, **kw)
         self.params = shard_decoder_params(self.params, self.mesh)
 
@@ -112,3 +147,25 @@ class ShardedCompletionModel(CompletionModel):
         sh = NamedSharding(self.mesh, P(None, None, "tp", None))
         return [(jax.device_put(k, sh), jax.device_put(v, sh))
                 for k, v in init_cache(self.cfg, batch)]
+
+    # -- paged pool placement (the pod-sharded continuous lane) --------
+
+    def _pool_sharding(self):
+        """(n_blocks, KH, page, D) pools split on kv heads over tp —
+        the sharding the shard_map'd ragged kernel expects."""
+        return kv_pool_sharding(self.mesh)
+
+    def _paged_scratch(self, b: int):
+        """Paged prefill's (1, bucket) dense scratch, sharded like the
+        dense cache (kv heads on tp): the trunk runs the same sharded
+        geometry as every other program and the per-bucket commit
+        scatter into the sharded pool stays local per device.  The
+        creation program comes from the SAME cached factory the pools
+        use (decoder._pool_zeros) — one compile per (shape, sharding),
+        never one per join."""
+        from ..models.decoder import _pool_zeros
+        cfg = self.cfg
+        sh = NamedSharding(self.mesh, P(None, None, "tp", None))
+        mk = _pool_zeros((1, b, cfg.kv_heads, cfg.head_dim),
+                         cfg.dtype, sh)
+        return [(mk(), mk()) for _ in range(cfg.layers)]

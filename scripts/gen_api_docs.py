@@ -113,6 +113,25 @@ WAITING and `join_backpressure` counts it.  Stage spans publish under
 `CONT_INFER_STAGES` (join / sample / decode / flush) and
 client-stamped requests land in the flight recorder (`spt trace
 tail`).  `make decode-check` gates the tier.
+
+### Pod-sharded paged serving (`parallel/serve.py`, PR 8)
+
+`ShardedCompletionModel` serves the SAME paged surface tensor-
+parallel (`paged_supported` is True): each layer's pool shards on its
+kv-head axis over the mesh's `tp` axis
+(`parallel/mesh.kv_pool_sharding`; `PagedKVCache(..., sharding=)`
+creates the zeros directly into the sharding), block tables / lengths
+/ alloc / free stay host-side and replicated, and the ragged
+paged-attention + flash-prefill kernels run under `shard_map`
+(`paged_attention(..., mesh=)` /
+`causal_flash_attention(..., mesh=)`) — each device executes the
+same program over its local KH/tp heads, no collective inside the
+kernel.  The commit/chunk programs pin `out_shardings` to the pool
+sharding so warmup covers the whole serve-time signature (a
+join/finish/join cycle never compiles).  `spt … --continuous --tp N`
+is the deployment surface; `make pod-check` gates token-exact parity
+(sharded-paged == single-chip-paged == serial) on the 8-device CPU
+mesh.
 """,
     "embedding-vector-lane": """
 ## Search daemon (`libsplinter_tpu/engine/searcher.py`)
@@ -278,6 +297,24 @@ A lane whose `state` is `down` is skipped by dispatching clients
 a crash-looping lane costs a client zero timeout.  With `SPTPU_FAULT`
 armed, heartbeats additionally carry a `faults` section (per-site
 hit/fired accounting).  Runbook: `docs/operations.md`.
+
+### Pod-sharded completer keys (PR 8)
+
+A completer serving through `ShardedCompletionModel`
+(`--tp N --continuous`) extends `__completer_stats` with:
+
+- `tp` — the tensor-parallel mesh degree
+  (`sptpu_completer_tp` in `spt metrics`);
+- `pages_shard` — per-tp-shard paged-pool view
+  `{"0": {"free": n, "used": m, "shard_mb": x}, ...}`, rendered as
+  `sptpu_completer_pages_{free,used}` and
+  `sptpu_completer_pool_shard_mb` with a `shard` label.  The pool
+  shards on its KV-HEAD axis, so the PAGE counts are host-global
+  (every shard backs every page at 1/tp of its bytes); `shard_mb` is
+  MEASURED from the placed device buffers per tp position — a broken
+  placement collapses the key set (a replicated pool covers the full
+  kv-head range → one key) or inflates the MB, so the dashboard
+  shows real placement state, not an assumed-uniform number.
 
 ### Dispatch-overlap gauges (`libsplinter_tpu/engine/resident.py`)
 

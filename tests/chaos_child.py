@@ -60,6 +60,43 @@ def main() -> int:
         comp.attach()
         n = comp.run_once()
         print(f"completions={n}", flush=True)
+    elif role == "completer_sharded":
+        # the pod-sharded continuous lane at tiny geometry over a
+        # virtual 8-device CPU mesh: the completer.sharded_dispatch
+        # fault site is only reachable through a real sharded paged
+        # dispatch, and `spt supervise` drives this role as a
+        # restartable lane child (test_crash_recovery)
+        import re
+
+        os.environ["XLA_FLAGS"] = (re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""))
+            + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except (AttributeError, RuntimeError):
+            pass
+        import jax.numpy as jnp
+
+        from libsplinter_tpu.engine.completer import Completer
+        from libsplinter_tpu.models.decoder import DecoderConfig
+        from libsplinter_tpu.parallel import (ShardedCompletionModel,
+                                              make_mesh)
+
+        cfg = DecoderConfig.tiny(dtype=jnp.float32)
+        model = ShardedCompletionModel(cfg, make_mesh(dp=4, tp=2),
+                                       buckets=(16,), temp=0.0, seed=1)
+        comp = Completer(st, model=model, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        comp.run_continuous(
+            idle_timeout_ms=20,
+            stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
+        print(f"completions={comp.stats.completions}", flush=True)
     else:
         raise SystemExit(f"unknown role {role!r}")
     return 0

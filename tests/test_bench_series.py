@@ -162,3 +162,36 @@ def test_kernels_phase_real(ledger, monkeypatch):
     assert d["flash_fwd"]["ok"] and d["flash_bwd"]["ok"]
     assert d["causal_prefill_gqa"]["ok"] and d["cosine_topk"]["ok"]
     assert read_ledger(ledger)[0]["metric"] == "kernels_smoke"
+
+
+@pytest.mark.slow
+def test_multichip_phase_real(ledger, monkeypatch):
+    """The pod-sharded paged arm end to end on the virtual 8-device
+    CPU mesh (tiny geometry): batch {32, 64} rows ledger with the
+    LOUD cpu_mesh_smoke label and the r05 single-chip reference."""
+    monkeypatch.setenv("BENCH_CPU", "1")
+    monkeypatch.setenv("MULTICHIP_TOKENS", "8")
+    ctx = bench_series.SeriesCtx(time.time() + 3600)
+    import jax
+    ctx.backend = jax.default_backend()
+    ctx.n_devices = len(jax.devices())
+    rec = bench_series.phase_multichip(ctx)
+    d = rec["detail"]
+    assert d["n_devices"] == 8 and d["tp"] >= 2
+    assert d["cpu_mesh_smoke"] is True       # never a perf claim here
+    assert set(d["tokens_per_sec_by_batch"]) == {"32", "64"}
+    assert all(v > 0 for v in d["tokens_per_sec_by_batch"].values())
+    assert d["r05_single_chip_dense_batch8"] == 612.3
+    assert read_ledger(ledger)[0]["metric"] == \
+        "multichip_paged_tokens_per_sec"
+
+
+def test_multichip_phase_single_device_skips(ledger, monkeypatch):
+    """A single-chip claim cannot shard: the phase ledgers an explicit
+    skip row (series_complete stays true) instead of failing."""
+    ctx = bench_series.SeriesCtx(time.time() + 3600)
+    ctx.backend = "cpu"
+    ctx.n_devices = 1
+    rec = bench_series.phase_multichip(ctx)
+    assert "skipped" in rec["detail"]
+    assert read_ledger(ledger)[0]["value"] == 0.0

@@ -46,6 +46,10 @@ EMBEDDER_SITES = ("embedder.drain", "embedder.encode",
                   "embedder.commit", "store.vec_commit")
 COMPLETER_SITES = ("completer.render", "completer.generate",
                    "completer.commit")
+# completer.sharded_dispatch is only reachable through the pod-sharded
+# continuous lane: its crash drill runs through the completer_sharded
+# chaos_child role under `spt supervise` (see
+# test_supervise_restores_sharded_completer_lane), not this matrix
 
 
 @pytest.fixture
@@ -341,6 +345,73 @@ def test_supervise_restores_embedder_lane(cstore, site, monkeypatch):
             assert not cstore.labels(f"txt/{i}") & P.LBL_EMBED_REQ
             assert np.abs(cstore.vec_get(f"txt/{i}")).max() > 0
         assert sup.lanes["embedder"].restarts >= 1
+    finally:
+        sup.stop()
+        t.join()
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_supervise_restores_sharded_completer_lane(cstore, monkeypatch):
+    """PR-8 chaos coverage: the pod-sharded continuous completer lane
+    (tests/chaos_child.py completer_sharded — ShardedCompletionModel
+    over the virtual 8-device CPU mesh) crashes at its FIRST sharded
+    paged dispatch; `spt supervise` observes the crash, strips the
+    fault from the respawn, and both the stranded pre-crash request
+    and a post-crash request converge to READY."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    monkeypatch.setenv("SPTPU_FAULT",
+                       "completer.sharded_dispatch:crash@1")
+    # the child lane runs long; the supervisor's stop tears it down
+    monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+    cstore.set("q", "hello sharded pod")
+    cstore.label_or("q", P.LBL_INFER_REQ)
+    cstore.bump("q")
+
+    holder: dict = {}
+
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, CHILD, "completer_sharded", cstore.name],
+            env=holder["sup"]._child_env(lane))
+
+    sup = Supervisor(cstore.name, lanes=("completer",), spawn_fn=spawn,
+                     store=cstore, backoff_base_ms=100,
+                     backoff_max_ms=2000, breaker_threshold=8,
+                     breaker_window_s=120, startup_grace_s=300)
+    holder["sup"] = sup
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 240.0})
+    t.start()
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if cstore.labels("q") & P.LBL_READY:
+                break
+            time.sleep(0.25)
+        assert cstore.labels("q") & P.LBL_READY, sup.lanes
+        assert sup.lanes["completer"].restarts >= 1   # crash observed
+        assert sup.lanes["completer"].state != "down"
+        # a request submitted AFTER the crash round-trips too (the
+        # generation-2 child serves with the fault stripped)
+        cstore.set("q2", "again, sharded")
+        cstore.label_or("q2", P.LBL_INFER_REQ)
+        cstore.bump("q2")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if cstore.labels("q2") & P.LBL_READY:
+                break
+            time.sleep(0.25)
+        assert cstore.labels("q2") & P.LBL_READY
+        # the slot holds the rendered prompt (+ any generated pieces —
+        # the tiny random weights may greedily sample eos first, which
+        # is a legitimate zero-token completion)
+        assert cstore.get("q2").rstrip(b"\0").startswith(
+            b"again, sharded")
+        assert not cstore.labels("q2") & (P.LBL_INFER_REQ
+                                          | P.LBL_SERVICING)
     finally:
         sup.stop()
         t.join()

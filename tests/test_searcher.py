@@ -475,10 +475,11 @@ def test_result_ttl_sweep_reaps_orphans(store):
     assert sr.sweep_results() == 0
 
 
-def test_per_batch_failure_fails_only_that_batch(store):
+def test_per_batch_failure_fails_only_that_batch(store, monkeypatch):
     """Acceptance: a device failure injected mid-_service fails only
     the faulted batch's requests with error records; the sibling batch
     commits normally and the daemon's loop never unwinds."""
+    from libsplinter_tpu.engine import resident
     from libsplinter_tpu.utils import faults
 
     rng = np.random.default_rng(22)
@@ -493,7 +494,16 @@ def test_per_batch_failure_fails_only_that_batch(store):
     # Site hit order: dispatch(b1)=1, dispatch(b2)=2, then b1's
     # degradation ladder re-hits dispatch at 3 (unfused) and 4
     # (per-request) — so select@1 fails b1's fetch and dispatch@3-4
-    # defeats exactly b1's ladder, leaving b2 untouched
+    # defeats exactly b1's ladder, leaving b2 untouched.
+    # That hit order is only guaranteed when batches resolve at
+    # flush(): the window's ready-probe (drain_ready) resolves an
+    # already-COMPLETED batch at the next push, so on a fast or
+    # lightly-loaded host b1's select + ladder can fire before b2's
+    # dispatch and the armed 3-4 window lands on the wrong hits.
+    # Forcing every entry not-ready defers resolution to flush()
+    # (dispatch order) — same per-batch domains, deterministic counts.
+    monkeypatch.setattr(resident.CallbackWindow, "_entry_ready",
+                        lambda self, entry: False)
     _request(store, "__sqtmp_poison", q, k=3, bloom=0)
     _request(store, "__sqtmp_fine", q, k=3, bloom=P.LBL_CHUNK)
     faults.arm("searcher.select:raise@1,searcher.dispatch:raise@3-4")

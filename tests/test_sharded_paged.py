@@ -1,0 +1,381 @@
+"""Pod-sharded paged decode (PR 8): the continuous-batching lane
+tensor-parallel over the virtual 8-device CPU mesh.
+
+The per-layer block pools shard on their KV-HEAD axis over `tp`
+(parallel/serve.ShardedCompletionModel._pool_sharding), the ragged
+paged-attention and flash-prefill kernels run under shard_map
+(ops/paged_attention, ops/flash_attention), and the host-side page
+scheduler is byte-identical to the single-chip pool — so sharded paged
+serving must be TOKEN-EXACT with the single-chip paged path (and with
+serial decode) at a fixed weight seed, including a mid-flight joiner
+and pool-exhaustion backpressure.  `make pod-check` runs this file's
+fast tier; the full sweep collects all of it.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.models.decoder import CompletionModel, DecoderConfig
+from libsplinter_tpu.parallel import ShardedCompletionModel, make_mesh
+from libsplinter_tpu.utils import faults
+
+CFG = DecoderConfig.tiny(dtype=jnp.float32)      # heads=4, kv_heads=2
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """(single-chip model, tp=2-sharded model) over the SAME params."""
+    base = CompletionModel(CFG, buckets=(16, 32), temp=0.0, seed=1)
+    mesh = make_mesh(dp=4, tp=2)
+    tp = ShardedCompletionModel(CFG, mesh, params=base.params,
+                                buckets=(16, 32), temp=0.0, seed=1)
+    return base, tp
+
+
+# ------------------------------------------------------- placement
+
+def test_paged_supported_and_pool_sharded(pair):
+    _, tp = pair
+    assert tp.paged_supported is True
+    cache = tp.init_paged(2, page=16)
+    sh = cache.k_pools[0].sharding
+    assert len(sh.device_set) == 8
+    assert tuple(sh.spec) == (None, "tp", None, None)
+    # distinct per-layer buffers (the programs donate the pools)
+    assert cache.k_pools[0] is not cache.k_pools[1]
+
+
+def test_meshless_custom_module_demotes_paged():
+    """A custom module built WITHOUT the mesh cannot run the
+    shard_map'd kernels — the instance (and only the instance) turns
+    the paged lane off and dense serving still works."""
+    from libsplinter_tpu.models.decoder import Decoder
+
+    mesh = make_mesh(dp=4, tp=2)
+    tp = ShardedCompletionModel(CFG, mesh, module=Decoder(CFG),
+                                buckets=(16,), temp=0.0)
+    assert tp.paged_supported is False
+    assert ShardedCompletionModel.paged_supported is True
+
+
+# ------------------------------------------- shard_map'd kernels
+
+def test_paged_kernel_sharded_interpret_parity():
+    """The Pallas ragged kernel under shard_map (interpret mode, the
+    CPU stand-in for the Mosaic build) == the dense gathered-page
+    reference, ragged lengths crossing page boundaries included."""
+    from libsplinter_tpu.ops.paged_attention import (_paged_ref,
+                                                     paged_attention)
+
+    mesh = make_mesh(dp=4, tp=2)
+    rng = np.random.default_rng(0)
+    B, H, KH, D, page, nb, npg = 4, 4, 2, 8, 16, 9, 3
+    q = rng.normal(size=(B, H, D)).astype(np.float32)
+    kp = rng.normal(size=(nb, KH, page, D)).astype(np.float32)
+    vp = rng.normal(size=(nb, KH, page, D)).astype(np.float32)
+    tables = rng.integers(1, nb, size=(B, npg)).astype(np.int32)
+    lengths = np.array([5, 17, 33, 48], np.int32)
+
+    ref = np.asarray(_paged_ref(jnp.asarray(q), jnp.asarray(kp),
+                                jnp.asarray(vp), jnp.asarray(tables),
+                                jnp.asarray(lengths)))
+    out = np.asarray(paged_attention(q, kp, vp, tables, lengths,
+                                     interpret=True, mesh=mesh))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # the jnp per-shard fallback (serving path on CPU) agrees too
+    out2 = np.asarray(paged_attention(q, kp, vp, tables, lengths,
+                                      mesh=mesh))
+    np.testing.assert_allclose(out2, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_kernel_sharded_interpret_parity():
+    """The causal flash-prefill kernel under shard_map (the
+    flash_min_seq demotion lift): sharded interpret run == the shared
+    jnp reference with GQA heads repeated."""
+    from libsplinter_tpu.ops.flash_attention import (_causal_jnp,
+                                                     causal_flash_attention)
+
+    mesh = make_mesh(dp=4, tp=2)
+    rng = np.random.default_rng(1)
+    B, S, H, KH, D, T = 4, 8, 4, 2, 8, 16
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    kk = rng.normal(size=(B, T, KH, D)).astype(np.float32)
+    vv = rng.normal(size=(B, T, KH, D)).astype(np.float32)
+    start = np.array([0, 1, 2, 0], np.int32)
+    rep = H // KH
+    ref = np.asarray(_causal_jnp(
+        jnp.asarray(q), jnp.repeat(jnp.asarray(kk), rep, 2),
+        jnp.repeat(jnp.asarray(vv), rep, 2), jnp.int32(4),
+        jnp.asarray(start)))
+    out = np.asarray(causal_flash_attention(
+        q, kk, vv, jnp.int32(4), start, block_q=4, interpret=True,
+        mesh=mesh))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------- token exactness
+
+def _paged_greedy(m, prompt, n, batch=2, page=16):
+    """Greedy tokens through the paged surface: prefill one row, then
+    chunked paged decode; returns the token list."""
+    cache = m.init_paged(batch, page=page)
+    lg = m.paged_prefill_row(cache, prompt, 0)
+    t0 = int(np.argmax(lg))
+    toks = np.zeros((batch,), np.int32)
+    toks[0] = t0
+    blk = m.paged_decode_chunk(cache, toks, n)
+    out = [t0] + [int(x) for x in blk[0]]
+    cache.reset()
+    return out
+
+
+def test_sharded_paged_token_exact_vs_single_vs_serial(pair):
+    """THE acceptance bar: sharded-paged == single-chip-paged ==
+    serial greedy tokens at the fixed weight seed on the 8-device
+    CPU mesh."""
+    base, tp = pair
+    prompt = np.array([3, 1, 4, 1, 5], np.int32)
+    serial = list(base.generate_tokens(prompt, 9, chunk=8))
+    base.reset()
+    single = _paged_greedy(base, prompt, 8)
+    sharded = _paged_greedy(tp, prompt, 8)
+    assert single == sharded, (single, sharded)
+    assert serial == sharded, (serial, sharded)
+
+
+def test_midflight_joiner_token_exact(pair):
+    """A row joining while its neighbour is mid-decode: both models
+    must produce identical tokens for BOTH rows (the joiner's commit
+    scatter lands in a kv-head-sharded pool)."""
+    base, tp = pair
+
+    def run(m):
+        cache = m.init_paged(2, page=16)
+        lg = m.paged_prefill_row(cache,
+                                 np.array([3, 1, 4, 1, 5], np.int32), 0)
+        t0 = int(np.argmax(lg))
+        blk = m.paged_decode_chunk(cache, np.array([t0, 0], np.int32), 4)
+        lg2 = m.paged_prefill_row(cache, np.array([2, 7, 1], np.int32),
+                                  1)                 # joins mid-decode
+        t1 = int(np.argmax(lg2))
+        blk2 = m.paged_decode_chunk(
+            cache, np.array([int(blk[0, -1]), t1], np.int32), 4)
+        out = ([t0] + [int(x) for x in blk[0]] + [int(x) for x in blk2[0]],
+               [t1] + [int(x) for x in blk2[1]])
+        cache.reset()
+        return out
+
+    assert run(base) == run(tp)
+
+
+def test_kdeep_async_carry_token_exact(pair):
+    """The PR-7 K-deep chunk chain (device-side token carry) over the
+    sharded pools: chained async chunks == the single-chip chain."""
+    base, tp = pair
+
+    def run(m):
+        cache = m.init_paged(2, page=16)
+        lg = m.paged_prefill_row(cache,
+                                 np.array([5, 2, 9], np.int32), 0)
+        toks = np.array([int(np.argmax(lg)), -1], np.int32)
+        p1 = m.paged_decode_chunk_async(cache, toks, 4)
+        p2 = m.paged_decode_chunk_async(
+            cache, np.full((2,), -1, np.int32), 4, carry=p1.last)
+        out = np.concatenate([p1.block(), p2.block()], axis=1)
+        cache.reset()
+        return out[0].tolist()
+
+    assert run(base) == run(tp)
+
+
+def test_warmup_pins_compile_count(pair):
+    """A join/finish/join cycle after warmup_paged must not compile:
+    the out_shardings pin keeps the jit signature stable across the
+    fresh-pool -> commit-out -> chunk-out program chain."""
+    _, tp = pair
+    cache = tp.init_paged(4, page=16)
+    tp.warmup_paged(cache, chunk=4, max_prompt=30)
+    c0 = tp.compile_count()
+    lg = tp.paged_prefill_row(cache, np.ones((7,), np.int32), 0)
+    tp.sample(lg)
+    tp.paged_decode_chunk(cache, np.array([1, 0, 0, 0], np.int32), 4)
+    cache.free_row(0)
+    tp.paged_prefill_row(cache, np.ones((20,), np.int32), 1)
+    tp.paged_decode_chunk(cache, np.array([0, 1, 0, 0], np.int32), 4)
+    assert tp.compile_count() == c0
+    cache.reset()
+
+
+# ------------------------------------------------- pool pressure
+
+def test_pool_exhaustion_backpressure_sharded(pair):
+    """All-or-nothing alloc on the sharded pool: a row the pool
+    cannot cover allocates NOTHING (backpressure), prefill into an
+    exhausted pool raises, and freeing the hog admits the waiter."""
+    _, tp = pair
+    # one full window of pages: the second row cannot fit
+    cache = tp.init_paged(2, page=16, pool_pages=8)
+    assert cache.ensure(0, CFG.max_len)
+    assert cache.free_pages == 0
+    assert not cache.ensure(1, 16)               # nothing allocated
+    assert cache.tables[1].max() == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        tp.paged_prefill_row(cache, np.ones((8,), np.int32), 1)
+    cache.free_row(0)
+    assert cache.free_pages == 8
+    lg = tp.paged_prefill_row(cache, np.ones((8,), np.int32), 1)
+    assert lg.shape[-1] == CFG.vocab_size
+    cache.reset()
+
+
+# ------------------------------------------- the continuous lane
+
+def _submit(st, key, prompt):
+    st.set(key, prompt)
+    st.label_or(key, P.LBL_INFER_REQ)
+    st.bump(key)
+
+
+def _await_ready(st, keys, timeout=75):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(st.labels(k) & P.LBL_READY for k in keys):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _run_bg(comp, stop_after=90.0):
+    th = threading.Thread(
+        target=comp.run_continuous,
+        kwargs=dict(idle_timeout_ms=20, stop_after=stop_after),
+        daemon=True)
+    th.start()
+    time.sleep(0.2)
+    return th
+
+
+def test_continuous_sharded_byte_identical_vs_single(pair, tmp_path):
+    """run_continuous through the sharded model == the single-chip
+    model, byte for byte, with the daemon surface (labels, streaming
+    appends, heartbeat) driving both unchanged."""
+    base, tp = pair
+    out = {}
+    for tag, model in (("single", base), ("sharded", tp)):
+        name = f"/spt-shpg-{tag}-{tmp_path.name[-8:]}"
+        Store.unlink(name)
+        st = Store.create(name, nslots=128, max_val=4096, vec_dim=8)
+        try:
+            comp = Completer(st, model=model, max_new_tokens=10,
+                             flush_tokens=4, template="none",
+                             batch_cap=4, page_size=16)
+            comp.attach()
+            for i in range(3):
+                _submit(st, f"q/{i}", f"say {i} things")
+            th = _run_bg(comp)
+            assert _await_ready(st, [f"q/{i}" for i in range(3)]), \
+                comp.stats
+            comp.stop()
+            th.join(timeout=5)
+            out[tag] = b"|".join(
+                st.get(f"q/{i}").rstrip(b"\0") for i in range(3))
+            assert comp._paged_cache.used_pages == 0, "pages leaked"
+        finally:
+            st.close()
+            Store.unlink(name)
+    assert out["single"] == out["sharded"]
+
+
+def test_heartbeat_and_metrics_shard_labels(pair, tmp_path):
+    """Satellite: the sharded completer heartbeat carries the tp axis
+    size and per-shard pool occupancy, and `spt metrics` renders
+    sptpu_completer_pages_{free,used} with a shard label."""
+    _, tp = pair
+    name = f"/spt-shpm-{tmp_path.name[-8:]}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=128, max_val=4096, vec_dim=8)
+    try:
+        comp = Completer(st, model=tp, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        comp._ensure_paged_cache()
+        comp.publish_stats()
+        snap = json.loads(st.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+        assert snap["tp"] == 2
+        # one key per tp position, MEASURED from the placed buffers
+        # (a broken placement would collapse the key set)
+        assert set(snap["pages_shard"]) == {"0", "1"}
+        cache = comp._paged_cache
+        expect_mb = round(
+            cache.k_pools[0].nbytes / 2 * 2 * CFG.layers / 1e6, 3)
+        for occ in snap["pages_shard"].values():
+            assert occ["used"] == 0
+            assert occ["free"] == cache.free_pages
+            # each tp shard holds half the kv heads of every pool
+            assert occ["shard_mb"] == pytest.approx(expect_mb,
+                                                    rel=0.01)
+
+        from libsplinter_tpu.cli.main import COMMANDS, Session
+        ses = Session(name)
+        try:
+            fn, _, _ = COMMANDS["metrics"]
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                fn(ses, [])
+            out = buf.getvalue()
+            assert "sptpu_completer_tp 2" in out
+            assert 'sptpu_completer_pages_free{daemon="completer",' \
+                   'shard="0"}' in out
+            assert 'shard="1"' in out
+        finally:
+            ses.close()
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def test_sharded_dispatch_fault_contained(pair, tmp_path):
+    """Satellite: a raise at completer.sharded_dispatch aborts the
+    live batch (rows finalize with what they streamed, the pool is
+    rebuilt) and the lane keeps serving — the next request completes
+    normally."""
+    _, tp = pair
+    name = f"/spt-shpf-{tmp_path.name[-8:]}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=128, max_val=4096, vec_dim=8)
+    try:
+        faults.arm("completer.sharded_dispatch:raise@1")
+        comp = Completer(st, model=tp, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16)
+        comp.attach()
+        _submit(st, "first", b"hello pod")
+        th = _run_bg(comp, stop_after=120.0)
+        assert _await_ready(st, ["first"], timeout=60), comp.stats
+        stats = faults.stats()["completer.sharded_dispatch"]
+        assert stats["fired"] == 1
+        # the lane survived the abort: a fresh request serves fully
+        _submit(st, "second", b"still alive?")
+        assert _await_ready(st, ["second"], timeout=60), comp.stats
+        comp.stop()
+        th.join(timeout=5)
+        assert comp._paged_cache.used_pages == 0, "pages leaked"
+        assert len(st.get("second").rstrip(b"\0")) > len(b"still alive?")
+    finally:
+        faults.disarm()
+        st.close()
+        Store.unlink(name)

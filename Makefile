@@ -37,6 +37,14 @@
 #                   mode, mid-flight joiner, pool backpressure,
 #                   shard-labeled heartbeat gauges, and sharded-
 #                   dispatch fault containment
+#   make quant-check  quantized-KV tier (fast, CPU): int8-vs-f32
+#                   ragged paged-attention parity (interpret mode),
+#                   multi-query verify stack, quantize-on-commit /
+#                   rescale-on-append error budgets, spec-paged
+#                   greedy exactness, compile-count pinning, and the
+#                   pool-bytes gate (int8 == 1/2 bf16 == 1/4 f32,
+#                   measured from placed buffers;
+#                   scripts/quant_pool_bytes_check.py)
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -69,6 +77,7 @@ check: native
 	$(MAKE) -C native check
 	$(PY) scripts/obs_overhead_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -95,6 +104,11 @@ pod-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sharded_paged.py \
 		tests/test_sharded_decode.py -q -m "not slow"
 
+quant-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py -q \
+		-m "not slow"
+	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
+
 memcheck: native
 	$(MAKE) -C native memcheck
 
@@ -106,4 +120,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native quick check obs-check search-check decode-check \
-	chaos-check dispatch-check pod-check memcheck bench-cpu clean
+	chaos-check dispatch-check pod-check quant-check memcheck \
+	bench-cpu clean

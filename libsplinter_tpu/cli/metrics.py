@@ -115,6 +115,17 @@ def cmd_metrics(ses, args):
                                    "per tp shard (k+v, all layers) — "
                                    "a missing shard key or inflated "
                                    "MB means the placement broke")
+        kvd = snap.pop("kv_dtype", None)  # paged-pool storage dtype
+        if isinstance(kvd, str):
+            # info-style gauge: the dtype rides a label (Prometheus
+            # has no string samples); pool_mb next to it is the
+            # measured-bytes evidence that the dtype actually took
+            w.metric(f"sptpu_{daemon}_kv_pool_info", 1,
+                     {"daemon": daemon, "kv_dtype": kvd},
+                     help_="paged KV pool storage dtype (int8 = "
+                           "quantized pool with per-page scales); "
+                           "see sptpu_completer_pool_mb for the "
+                           "measured on-device bytes")
         flt = snap.pop("faults", None)  # armed SPTPU_FAULT accounting
         if isinstance(flt, dict):
             for site, counts in flt.items():

@@ -141,6 +141,7 @@ class Completer:
                  batch_cap: int | None = None,
                  page_size: int = 128,
                  pool_pages: int | None = None,
+                 kv_dtype: str | None = None,
                  inflight_depth: int | None = None,
                  spec_min_acceptance: float = 0.2):
         self.store = store
@@ -157,6 +158,15 @@ class Completer:
         self.paged_batch_cap = 32 if batch_cap is None else batch_cap
         self.page_size = page_size
         self.pool_pages = pool_pages
+        # paged-pool storage dtype (--kv-dtype): "int8" quantizes the
+        # continuous lane's KV pool (per-page scales, dequant inside
+        # the ragged kernel) so cache bytes per token halve vs bf16 —
+        # the headroom --batch-cap/--pool-pages then spend on batch
+        # width.  None keeps the model's native dtype.
+        if kv_dtype not in (None, "bf16", "f32", "int8"):
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r} (bf16 | f32 | int8)")
+        self.kv_dtype = kv_dtype
         # K-deep decode overlap on the continuous lane: the chunk
         # pipeline runs K deep — dispatch chunk K, then collect the
         # OLDEST while the newest computes (the token hand-off between
@@ -636,7 +646,7 @@ class Completer:
         if self._paged_cache is None:
             self._paged_cache = self._model.init_paged(
                 self.paged_batch_cap, page=self.page_size,
-                pool_pages=self.pool_pages)
+                pool_pages=self.pool_pages, kv_dtype=self.kv_dtype)
         return self._paged_cache
 
     def warmup_paged(self) -> None:
@@ -671,9 +681,14 @@ class Completer:
         join_backpressure counts the deferral — backpressure, never a
         mid-decode strand.  Sharded models serve this lane too (PR 8:
         kv-head-sharded pools + shard_map'd ragged kernel,
-        parallel/serve.py).  Serial-only models (speculative), models
-        whose module cannot thread a mesh (paged_supported False),
-        and window-only bucket geometries fall back to run()."""
+        parallel/serve.py), as do quantized pools (--kv-dtype int8:
+        per-page scales, dequant in-kernel) and speculative models
+        (PR 9: the wrapper implements the paged surface — drafts
+        verify through the paged kernel's multi-query stack; a
+        tripped acceptance floor swaps in the target at the next
+        idle point).  Models whose module cannot thread a mesh
+        (paged_supported False) and window-only bucket geometries
+        fall back to run()."""
         if not self._paged_ok():
             return self.run(idle_timeout_ms=idle_timeout_ms,
                             stop_after=stop_after)
@@ -801,6 +816,14 @@ class Completer:
                                      else None),
                            "wall0": time.perf_counter()}
                 cache.ensure(r, worst_len(len(ids)))
+                if getattr(cache, "quantized", False):
+                    # the quantized append/commit path: the commit
+                    # scatter about to run quantizes the prompt's K/V
+                    # into int8 pages (per-page scales) — the chaos
+                    # matrix crashes HERE to prove a mid-quantized-
+                    # commit death restarts clean with no poisoned
+                    # pages (tests/chaos_child.py completer_quant)
+                    fault("completer.kv_quant_commit")
                 ta = time.perf_counter()
                 logits = m.paged_prefill_row(
                     cache, np.asarray(ids, np.int32), r)
@@ -917,6 +940,12 @@ class Completer:
                     break
                 if now >= next_beat:
                     next_beat = now + 2.0
+                    # speculative degradation rides the heartbeat
+                    # cadence on this lane (run_once's per-drain hook
+                    # never fires here): a tripped floor swaps
+                    # self._model to the target NOW, and the lane
+                    # adopts it at the next idle point below
+                    self._maybe_demote_spec()
                     self.publish_stats()
 
                 try:
@@ -927,6 +956,21 @@ class Completer:
                         while window:
                             collect(window.popleft())
                         carry = None
+                        if self._model is not m:
+                            # demotion decided mid-run: adopt the
+                            # target model at this idle point (no live
+                            # rows, no in-flight chunks — the paired
+                            # spec pools retire with their wrapper and
+                            # a fresh pool serves the plain model)
+                            m = self._model
+                            sharded = getattr(m, "mesh",
+                                              None) is not None
+                            self._paged_cache = None
+                            cache = self._ensure_paged_cache()
+                            bp_memo.clear()
+                            self._debug(
+                                "continuous lane adopted the demoted "
+                                "(plain) model")
                         if admit() == 0:
                             got = st.signal_wait(
                                 self.group, last,
@@ -1143,19 +1187,32 @@ class Completer:
             kh = arr.shape[1]
             per_shard = max(1, kh // tp)
             layers = len(cache.k_pools)
-            seen: dict[str, int] = {}
-            for sh in arr.addressable_shards:
-                sl = sh.index[1] if len(sh.index) > 1 else slice(None)
-                start = sl.start or 0
-                pos = str(start // per_shard)
-                # replicas (the dp axis) carry identical bytes: keep
-                # one measurement per tp position
-                seen.setdefault(pos, sh.data.nbytes)
+
+            def positions(a) -> dict[str, int]:
+                seen: dict[str, int] = {}
+                for sh in a.addressable_shards:
+                    sl = (sh.index[1] if len(sh.index) > 1
+                          else slice(None))
+                    start = sl.start or 0
+                    pos = str(start // per_shard)
+                    # replicas (the dp axis) carry identical bytes:
+                    # keep one measurement per tp position
+                    seen.setdefault(pos, sh.data.nbytes)
+                return seen
+
+            seen = positions(arr)
+            sseen: dict[str, int] = {}
+            if getattr(cache, "quantized", False):
+                # int8 pools: the per-page scales shard on the same
+                # kv-head axis — their bytes belong to the shard too
+                sseen = positions(cache.k_scales[0])
             for pos, nbytes in sorted(seen.items()):
                 out[pos] = {
                     "free": cache.free_pages,
                     "used": cache.used_pages,
-                    "shard_mb": round(nbytes * 2 * layers / 1e6, 3),
+                    "shard_mb": round(
+                        (nbytes + sseen.get(pos, 0)) * 2 * layers
+                        / 1e6, 3),
                 }
         except Exception:
             return {}            # obs must never take the lane down
@@ -1186,11 +1243,33 @@ class Completer:
             # heartbeat (sptpu_completer_tp) so dashboards can tell a
             # sharded daemon from a single-chip one at a glance
             payload["tp"] = int(mesh.shape.get("tp", 1))
+        m_now = getattr(self, "_model", None)
+        if hasattr(m_now, "stats_proposed"):
+            # speculative draft/verify token counters
+            # (sptpu_completer_spec_* in `spt metrics`): drafted =
+            # proposals the draft generated, verified = positions the
+            # target scored, accepted = proposals the target kept
+            payload["spec_draft_tokens"] = int(m_now.stats_proposed)
+            payload["spec_accepted_tokens"] = int(m_now.stats_accepted)
+            payload["spec_verified_tokens"] = int(
+                getattr(m_now, "stats_verified", 0))
         if self._paged_cache is not None:
             # sptpu_completer_pages_{free,used} pool gauges
             payload["pages_free"] = self._paged_cache.free_pages
             payload["pages_used"] = self._paged_cache.used_pages
             payload["live_tokens"] = self._paged_cache.live_tokens()
+            # the pool's storage dtype + bytes MEASURED from the
+            # placed device buffers (values + scales): `spt metrics`
+            # renders sptpu_completer_kv_pool_info{kv_dtype=...} and
+            # sptpu_completer_pool_mb — the honest int8-halves-bytes
+            # evidence, not a shape*itemsize estimate
+            kvd = getattr(self._paged_cache, "kv_dtype", None)
+            if kvd:
+                payload["kv_dtype"] = kvd
+            try:
+                payload["pool_mb"] = self._paged_cache.device_mb()
+            except Exception:
+                pass
             if mesh is not None and int(mesh.shape.get("tp", 1)) > 1:
                 shards = self._pool_shard_occupancy(
                     int(mesh.shape["tp"]))
@@ -1303,6 +1382,18 @@ def main(argv: list[str] | None = None) -> int:
                          "spend cache HBM on batch width instead of "
                          "padding; admission backpressures when the "
                          "pool is full)")
+    ap.add_argument("--kv-dtype", choices=("bf16", "f32", "int8"),
+                    default=None,
+                    help="paged KV pool storage dtype (continuous "
+                         "serving; default: the model's native "
+                         "activation dtype).  int8 stores the pool "
+                         "quantized with per-page per-kv-head scales "
+                         "— cache HBM per token halves vs bf16 "
+                         "(quarters vs f32), the ragged paged-"
+                         "attention kernel dequantizes in register, "
+                         "and the freed bytes buy batch width "
+                         "(--batch-cap) inside the same --pool-pages "
+                         "envelope")
     ap.add_argument("--inflight-depth", type=int, default=None,
                     help="continuous lane: paged decode chunk "
                          "pipeline depth — dispatch chunk K, collect "
@@ -1332,6 +1423,16 @@ def main(argv: list[str] | None = None) -> int:
                          "metadata) proposes --gamma tokens per "
                          "target forward (models/speculative.py); "
                          "serial serving only")
+    ap.add_argument("--draft-layers", type=int, default=None,
+                    help="SELF-DRAFTING speculative decode: draft "
+                         "with a truncated view of the target's own "
+                         "first N layers (no second checkpoint; the "
+                         "param subtree aliases the target's "
+                         "weights).  Unlike --draft-weights this "
+                         "serves the batched continuous lane too — "
+                         "drafts verify through the paged kernel's "
+                         "multi-query stack.  ~3/4 of the target's "
+                         "depth is a good starting point")
     ap.add_argument("--gamma", type=int, default=4,
                     help="speculative proposal length per verify step")
     ap.add_argument("--continuous", action="store_true",
@@ -1397,6 +1498,12 @@ def main(argv: list[str] | None = None) -> int:
         model = ShardedCompletionModel(cfg, mesh, **mkw)
     else:
         model = CompletionModel(cfg, **mkw)
+    if args.draft_weights and args.draft_layers:
+        raise SystemExit(
+            "--draft-weights and --draft-layers are mutually "
+            "exclusive: the first drafts with a separate checkpoint "
+            "(serial lane only), the second with a truncated view of "
+            "the target (continuous lane capable) — pick one")
     if args.draft_weights:
         from ..models import SpeculativeCompletionModel
         if not args.draft_weights.endswith(".gguf"):
@@ -1416,11 +1523,21 @@ def main(argv: list[str] | None = None) -> int:
                                            gamma=args.gamma)
         log.info("speculative decoding: gamma=%d draft=%s",
                  args.gamma, args.draft_weights)
+    elif args.draft_layers:
+        from ..models import SpeculativeCompletionModel, self_draft_model
+        draft = self_draft_model(model, args.draft_layers)
+        model = SpeculativeCompletionModel(model, draft,
+                                           gamma=args.gamma)
+        log.info("self-drafting speculative decode: first %d of %d "
+                 "layers, gamma=%d (drafts verify through the paged "
+                 "kernel on the continuous lane)",
+                 args.draft_layers, cfg.layers, args.gamma)
     comp = Completer(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
                      template=template, batch_cap=args.batch_cap,
                      page_size=args.page_size,
                      pool_pages=args.pool_pages,
+                     kv_dtype=args.kv_dtype,
                      inflight_depth=args.inflight_depth,
                      spec_min_acceptance=args.spec_min_acceptance)
     comp.attach()

@@ -2,7 +2,7 @@ from .decoder import (CompletionModel, Decoder, DecoderConfig, init_cache,
                       PagedKVCache, sample_top_p)
 from .encoder import Encoder, EncoderConfig, EmbeddingModel
 from .moe import MoeDecoder, MoeDecoderConfig, moe_completion_model
-from .speculative import SpeculativeCompletionModel
+from .speculative import SpeculativeCompletionModel, self_draft_model
 from .tokenizer import (ByteTokenizer, HashTokenizer, WordPieceTokenizer,
                         batch_encode, default_tokenizer)
 
@@ -11,4 +11,4 @@ __all__ = ["Encoder", "EncoderConfig", "EmbeddingModel", "HashTokenizer",
            "default_tokenizer", "CompletionModel", "Decoder",
            "DecoderConfig", "init_cache", "PagedKVCache", "sample_top_p",
            "MoeDecoder", "MoeDecoderConfig", "moe_completion_model",
-           "SpeculativeCompletionModel"]
+           "SpeculativeCompletionModel", "self_draft_model"]

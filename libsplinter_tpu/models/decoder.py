@@ -91,6 +91,78 @@ def _tp_of(sharding) -> int:
     return tp
 
 
+# the paged pool's storage dtypes: "int8" stores values as int8 with
+# one f32 scale per (page block, kv head) — (n_blocks, KH) — alongside
+# each pool; anything else is the dense float layout.  The scale
+# arrays stay separate from the values (not interleaved) so an
+# int4-PACKED value pool later changes only the value buffer + the
+# dequant, never the scale plumbing.
+KV_DTYPES = ("bf16", "f32", "int8")
+
+
+def _kv_storage(cfg: DecoderConfig, kv_dtype: str | None):
+    """(label, value dtype, quantized?) for a pool's storage.  None
+    keeps the model's native activation dtype (the status quo)."""
+    if kv_dtype is None:
+        label = ("bf16" if cfg.dtype == jnp.bfloat16 else
+                 "f32" if cfg.dtype == jnp.float32 else
+                 str(np.dtype(cfg.dtype)))
+        return label, cfg.dtype, False
+    if kv_dtype == "int8":
+        return "int8", jnp.int8, True
+    if kv_dtype == "bf16":
+        return "bf16", jnp.bfloat16, False
+    if kv_dtype == "f32":
+        return "f32", jnp.float32, False
+    raise ValueError(
+        f"unknown kv_dtype {kv_dtype!r} (supported: {KV_DTYPES})")
+
+
+def _quant_append(pool, scales, bids, offs, x):
+    """Append one token's values into an int8 page with
+    RESCALE-ON-APPEND: per (row, kv head), the page's scale grows to
+    cover the new token (s_new = max(s_old, |x|_inf / 127)) and the
+    page's existing int8 values re-round at the new scale — scales
+    are MONOTONIC per page, so re-rounding only happens when the
+    running max actually moves (at most a handful of times per page
+    in practice) and clipping never occurs.  The whole touched page
+    is gathered/rewritten (one page per row per side — the same page
+    the append already dirties; attention reads every live page, so
+    this extra write is noise against the read traffic the int8
+    layout halves).
+
+    pool: (n_blocks, KH, page, D) int8; scales: (n_blocks, KH) f32;
+    bids/offs: (B,) block id + in-page slot per row; x: (B, KH, D).
+    Dead rows point at the trash block 0 — their (duplicate-index,
+    nondeterministic) writes land there harmlessly, same contract as
+    the float scatter.
+
+    A write at in-page offset 0 treats the page as FRESH (s_old = 0):
+    pages return to the free list with their last owner's scale still
+    in the table (free_row is host-only), and without this reset a
+    reallocated decode-grown page would quantize its new row at the
+    stale — monotonically-grown, possibly huge — old scale forever.
+    Offset 0 is exactly the first write of every (re)used page, and
+    any existing entries of a page being rewritten at offset 0 are
+    stale by construction (they sit at positions >= the writing row's
+    length), so discarding their scale is always safe."""
+    s_old = jnp.where(offs[:, None] == 0, 0.0,
+                      scales[bids])                    # (B, KH)
+    xf = x.astype(jnp.float32)
+    s_tok = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    s_new = jnp.maximum(s_old, s_tok)
+    safe = jnp.where(s_new > 0, s_new, 1.0)
+    pages = pool[bids].astype(jnp.float32)             # (B, KH, pg, D)
+    pages = jnp.round(pages * (s_old / safe)[:, :, None, None])
+    qtok = jnp.clip(jnp.round(xf / safe[:, :, None]), -127, 127)
+    slot = (jnp.arange(pool.shape[2])[None, None, :, None]
+            == offs[:, None, None, None])
+    pages = jnp.where(slot, qtok[:, :, None, :], pages)
+    pool = pool.at[bids].set(pages.astype(jnp.int8))
+    scales = scales.at[bids].set(s_new)
+    return pool, scales
+
+
 @functools.lru_cache(maxsize=32)
 def _sharded_zeros_prog(shape, dtype, sharding):
     """One cached creation program per (shape, dtype, sharding): the
@@ -140,6 +212,17 @@ class PagedKVCache:
     hardware (the Pallas kernel's page axis); CPU tests use small
     pages through interpret/reference dispatch.
 
+    `kv_dtype="int8"` stores the pools QUANTIZED: int8 values plus a
+    per-page per-kv-head f32 scale (k_scales/v_scales, (n_blocks, KH)
+    per layer).  Cache HBM per token drops to 1/2 of bf16 (1/4 of
+    f32), which on a memory-bound decode lane converts directly into
+    batch width inside the same pool-byte envelope.  The commit
+    scatter quantizes whole pages (paged_prefill_row) and decode
+    appends rescale-on-append (_quant_append); the ragged kernel
+    dequantizes in register (ops/paged_attention.py).  The scale
+    arrays are separate buffers so an int4-packed value pool later is
+    a value-layout change only.
+
     `sharding` (a NamedSharding, normally P(None, "tp", None, None)
     from ShardedCompletionModel) places the pools sharded on their
     KV-HEAD axis across a tensor-parallel mesh: each device holds
@@ -148,11 +231,15 @@ class PagedKVCache:
     single-chip pool while cache HBM per chip divides by tp.  The
     pools are created directly into the sharding (jit out_shardings)
     so no device ever materializes the full-size buffer.
+    `scale_sharding` places the int8 scales split on THEIR kv-head
+    axis (index 1 of (n_blocks, KH)) — scales shard with the heads
+    they scale.
     """
 
     def __init__(self, cfg: DecoderConfig, batch: int, *,
                  page: int = 128, pool_pages: int | None = None,
-                 sharding=None):
+                 kv_dtype: str | None = None,
+                 sharding=None, scale_sharding=None):
         if page < 1:
             raise ValueError("page must be >= 1")
         if page % 128 and jax.default_backend() == "tpu":
@@ -185,11 +272,20 @@ class PagedKVCache:
                 f"divide kv_heads={cfg.kv_heads} (pools split on the "
                 "kv-head axis)")
         self.sharding = sharding
+        self.kv_dtype, store_dtype, self.quantized = \
+            _kv_storage(cfg, kv_dtype)
         # distinct buffers per layer/side: the paged programs donate
         # the pools, and XLA rejects donating one buffer twice
-        zeros = _pool_zeros(shape, cfg.dtype, sharding)
+        zeros = _pool_zeros(shape, store_dtype, sharding)
         self.k_pools = [zeros() for _ in range(cfg.layers)]
         self.v_pools = [zeros() for _ in range(cfg.layers)]
+        if self.quantized:
+            szeros = _pool_zeros((self.n_blocks, cfg.kv_heads),
+                                 jnp.float32, scale_sharding)
+            self.k_scales = [szeros() for _ in range(cfg.layers)]
+            self.v_scales = [szeros() for _ in range(cfg.layers)]
+        else:
+            self.k_scales = self.v_scales = None
         self.tables = np.zeros((batch, self.pages_per_row), np.int32)
         self.lengths = np.zeros((batch,), np.int32)
         self._free = list(range(self.n_blocks - 1, 0, -1))
@@ -235,6 +331,26 @@ class PagedKVCache:
 
     def live_tokens(self) -> int:
         return int(self.lengths.sum())
+
+    def device_mb(self) -> float:
+        """Pool bytes MEASURED from the placed device buffers (values
+        + scales, all layers, k and v) — the heartbeat's honest gauge:
+        a wrong storage dtype or a broken placement shows up here, a
+        computed shape*itemsize estimate would not.  Sums this host's
+        addressable shards (on a single chip that is simply the full
+        buffers; under tp each chip holds 1/tp — the per-shard view
+        rides the completer's pages_shard section)."""
+        arrs = list(self.k_pools) + list(self.v_pools)
+        if self.quantized:
+            arrs += list(self.k_scales) + list(self.v_scales)
+        total = 0
+        for a in arrs:
+            try:
+                total += sum(sh.data.nbytes
+                             for sh in a.addressable_shards)
+            except Exception:
+                total += a.nbytes
+        return round(total / 1e6, 3)
 
 
 class PendingChunk:
@@ -308,13 +424,18 @@ class CausalAttention(nn.Module):
 
         PAGED decode (lengths is not None): cache_kv is a per-layer
         (k_pool, v_pool) pair of the global block pool
-        (n_blocks, KH, page, D), tables is the (B, P) block table and
-        lengths the (B,) per-row token counts — S must be 1 (one
-        decode token per row).  Row r's new token sits at ITS OWN
-        logical position lengths[r] (no shared pos, no left pad): its
-        K/V appends into page lengths[r] // page of the row's table,
-        and attention runs the ragged paged kernel over j < lengths[r]
-        + 1.  pos/start are ignored on this path."""
+        (n_blocks, KH, page, D) — or (k_pool, v_pool, k_scales,
+        v_scales) for an int8-quantized pool — tables is the (B, P)
+        block table and lengths the (B,) per-row token counts.  Row
+        r's S new tokens sit at ITS OWN logical positions lengths[r]
+        .. lengths[r]+S-1 (no shared pos, no left pad): each token's
+        K/V appends into its page of the row's table (quantized pools
+        rescale-on-append), and attention runs the ragged paged
+        kernel — S == 1 is the decode step (j < lengths[r] + 1),
+        S > 1 is the speculative VERIFY stack (token t attends
+        j < lengths[r] + 1 + t, causal across the stack, one kernel
+        dispatch for all S positions).  pos/start are ignored on this
+        path."""
         cfg = self.cfg
         B, S, _ = x.shape
         D = cfg.head_dim
@@ -330,27 +451,44 @@ class CausalAttention(nn.Module):
         if lengths is not None:
             # block-paged decode step (ops/paged_attention.py)
             from ..ops.paged_attention import paged_attention
-            kp, vp = cache_kv
+            quant = len(cache_kv) == 4
+            if quant:
+                kp, vp, ksc, vsc = cache_kv
+            else:
+                kp, vp = cache_kv
+                ksc = vsc = None
             page = kp.shape[2]
-            # append position, clamped so a contract violation (a row
+            # append positions, clamped so a contract violation (a row
             # decoded past its window — the scheduler finishes rows
             # first) rewrites ITS last slot instead of wrapping into a
             # neighbour's page
-            app = jnp.minimum(lengths, cfg.max_len - 1)
-            rp = app[:, None]                     # (B, 1) positions
+            rp = jnp.minimum(lengths[:, None] + jnp.arange(S)[None, :],
+                             cfg.max_len - 1)     # (B, S) positions
             q = _apply_rotary(q, cos_t[rp], sin_t[rp])
             k = _apply_rotary(k, cos_t[rp], sin_t[rp])
-            bids = jnp.take_along_axis(
-                tables, (app // page)[:, None], axis=1)[:, 0]
-            offs = app % page
-            # dead rows (length 0 everywhere on the host) route to the
-            # trash block 0 via their zeroed table entries
-            kp = kp.at[bids, :, offs, :].set(k[:, 0])
-            vp = vp.at[bids, :, offs, :].set(v[:, 0])
-            out = paged_attention(q[:, 0], kp, vp, tables, app + 1,
+            for s in range(S):
+                app = rp[:, s]
+                bids = jnp.take_along_axis(
+                    tables, (app // page)[:, None], axis=1)[:, 0]
+                offs = app % page
+                # dead rows (length 0 everywhere on the host) route to
+                # the trash block 0 via their zeroed table entries
+                if quant:
+                    kp, ksc = _quant_append(kp, ksc, bids, offs,
+                                            k[:, s])
+                    vp, vsc = _quant_append(vp, vsc, bids, offs,
+                                            v[:, s])
+                else:
+                    kp = kp.at[bids, :, offs, :].set(k[:, s])
+                    vp = vp.at[bids, :, offs, :].set(v[:, s])
+            att_len = rp[:, 0] + 1
+            out = paged_attention(q if S > 1 else q[:, 0], kp, vp,
+                                  tables, att_len,
+                                  k_scales=ksc, v_scales=vsc,
                                   mesh=self.mesh)
             out = out.reshape(B, S, cfg.heads * D)
-            return _proj(cfg, cfg.hidden, "out")(out), (kp, vp)
+            new_kv = (kp, vp, ksc, vsc) if quant else (kp, vp)
+            return _proj(cfg, cfg.hidden, "out")(out), new_kv
 
         idx = pos + jnp.arange(S)                  # cache slots (S,)
         if start is None:
@@ -527,8 +665,12 @@ class CompletionModel:
                  buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
                  params: Any = None, weights: str | None = None,
                  top_p: float = 0.9, temp: float = 0.7,
-                 module: Any = None):
+                 module: Any = None, kv_dtype: str | None = None):
         self.cfg = cfg
+        # default paged-pool storage dtype for init_paged (None = the
+        # model's native activation dtype); "int8" turns the whole
+        # continuous lane quantized (--kv-dtype on the daemon)
+        self.kv_dtype = kv_dtype
         # module override: any flax module with the Decoder call
         # signature (ids, cache, pos) -> (logits, cache) — e.g. the
         # MoE family (models/moe.MoeDecoder)
@@ -885,12 +1027,20 @@ class CompletionModel:
         so the pools split over the tp mesh axis."""
         return None
 
-    def _paged_pool_out_shardings(self, n_pool_lists: int, n_rep: int):
+    def _pool_scale_sharding(self):
+        """Placement for an int8 pool's (n_blocks, KH) scales: None
+        here; ShardedCompletionModel splits them on THEIR kv-head
+        axis so scales shard with the heads they scale."""
+        return None
+
+    def _paged_pool_out_shardings(self, n_pool_lists: int, n_rep: int,
+                                  n_scale_lists: int = 0):
         """out_shardings for a paged program returning n_pool_lists
-        per-layer pool lists followed by n_rep replicated arrays, or
-        None when the pools are unsharded.  Pinning the OUTPUT
-        shardings keeps the jit signature stable across the program
-        chain (fresh pool -> commit out -> chunk out -> chunk in ...):
+        per-layer pool lists, then n_scale_lists per-layer scale
+        lists (int8 pools), then n_rep replicated arrays — or None
+        when the pools are unsharded.  Pinning the OUTPUT shardings
+        keeps the jit signature stable across the program chain
+        (fresh pool -> commit out -> chunk out -> chunk in ...):
         without it the first serve-time call after warmup sees
         GSPMD-chosen output shardings that hash differently from the
         explicitly placed fresh pools and silently recompiles."""
@@ -899,8 +1049,10 @@ class CompletionModel:
             return None
         from jax.sharding import NamedSharding, PartitionSpec
         rep = NamedSharding(sh.mesh, PartitionSpec())
+        ssh = self._pool_scale_sharding() or rep
         layers = self.cfg.layers
         return tuple([sh] * layers for _ in range(n_pool_lists)) \
+            + tuple([ssh] * layers for _ in range(n_scale_lists)) \
             + (rep,) * n_rep
 
     def _paged_scratch(self, b: int):
@@ -912,43 +1064,90 @@ class CompletionModel:
         return [(z, z) for _ in range(cfg.layers)]
 
     def init_paged(self, batch: int, *, page: int = 128,
-                   pool_pages: int | None = None) -> PagedKVCache:
+                   pool_pages: int | None = None,
+                   kv_dtype: str | None = None) -> PagedKVCache:
         """Fresh paged pool serving `batch` concurrent rows.  The
         default pool holds batch full windows (== dense HBM at this
         batch); cap pool_pages lower to spend HBM on batch width
-        instead of cache padding."""
+        instead of cache padding.  kv_dtype None defers to the
+        model's default (the --kv-dtype constructor knob); "int8"
+        stores the pool quantized with per-page scales."""
         return PagedKVCache(self.cfg, batch, page=page,
                             pool_pages=pool_pages,
-                            sharding=self._pool_sharding())
+                            kv_dtype=(self.kv_dtype if kv_dtype is None
+                                      else kv_dtype),
+                            sharding=self._pool_sharding(),
+                            scale_sharding=self._pool_scale_sharding())
 
-    def _paged_commit_program(self, bucket: int, page: int):
+    def _paged_commit_program(self, bucket: int, page: int,
+                              quantized: bool = False):
         """One program scattering a (1, bucket) dense prefill cache
         into pool pages at the given block ids (page-granular; the
         tail of the last page holds garbage the length mask hides
-        until decode appends overwrite it)."""
-        key = ("commit", bucket, page)
+        until decode appends overwrite it).
+
+        The QUANTIZED variant is where int8 pools quantize on commit:
+        rows past the prompt's n_valid are zeroed FIRST (pad-token
+        K/V would otherwise inflate the page scale for nothing), then
+        each (page, kv head) gets a symmetric scale d = absmax/127
+        and int8 values — the same Q8_0-style geometry as the weight
+        residency (models/quant.py), at page granularity."""
+        key = ("commit", bucket, page, quantized)
         fn = self._paged_progs.get(key)
         if fn is None:
             n_cp = -(-bucket // page)
             pad = n_cp * page - bucket
 
-            def run(k_pools, v_pools, dense, bids):
-                def blocks(x):
-                    x = x[0]                           # (bucket, KH, D)
-                    if pad:
-                        x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
-                    return x.reshape(n_cp, page, *x.shape[1:]) \
-                            .transpose(0, 2, 1, 3)     # (n_cp,KH,pg,D)
+            def blocks(x, nvalid=None):
+                x = x[0]                           # (bucket, KH, D)
+                if nvalid is not None:
+                    keep = (jnp.arange(bucket) < nvalid)[:, None, None]
+                    x = jnp.where(keep, x, 0)
+                if pad:
+                    x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+                return x.reshape(n_cp, page, *x.shape[1:]) \
+                        .transpose(0, 2, 1, 3)     # (n_cp,KH,pg,D)
 
-                outk, outv = [], []
-                for (kd, vd), kp, vp in zip(dense, k_pools, v_pools):
-                    outk.append(kp.at[bids].set(blocks(kd)))
-                    outv.append(vp.at[bids].set(blocks(vd)))
-                return outk, outv
+            if quantized:
+                def run(k_pools, v_pools, k_scales, v_scales, dense,
+                        bids, nvalid):
+                    def q8(x):
+                        xb = blocks(x, nvalid).astype(jnp.float32)
+                        d = jnp.max(jnp.abs(xb), axis=(2, 3)) / 127.0
+                        q = jnp.round(
+                            xb / jnp.where(d > 0, d, 1.0)[:, :, None,
+                                                          None])
+                        return (jnp.clip(q, -127, 127)
+                                .astype(jnp.int8), d)
 
-            out_sh = self._paged_pool_out_shardings(2, 0)
-            kw = {} if out_sh is None else {"out_shardings": out_sh}
-            fn = jax.jit(run, donate_argnums=(0, 1), **kw)
+                    outk, outv, outks, outvs = [], [], [], []
+                    for (kd, vd), kp, vp, ks, vs in zip(
+                            dense, k_pools, v_pools, k_scales,
+                            v_scales):
+                        qk, dk = q8(kd)
+                        qv, dv = q8(vd)
+                        outk.append(kp.at[bids].set(qk))
+                        outv.append(vp.at[bids].set(qv))
+                        outks.append(ks.at[bids].set(dk))
+                        outvs.append(vs.at[bids].set(dv))
+                    return outk, outv, outks, outvs
+
+                out_sh = self._paged_pool_out_shardings(
+                    2, 0, n_scale_lists=2)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw)
+            else:
+                def run(k_pools, v_pools, dense, bids):
+                    outk, outv = [], []
+                    for (kd, vd), kp, vp in zip(dense, k_pools,
+                                                v_pools):
+                        outk.append(kp.at[bids].set(blocks(kd)))
+                        outv.append(vp.at[bids].set(blocks(vd)))
+                    return outk, outv
+
+                out_sh = self._paged_pool_out_shardings(2, 0)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(0, 1), **kw)
             self._paged_progs[key] = fn
         return fn
 
@@ -983,17 +1182,29 @@ class CompletionModel:
         # table entries past the prompt's pages are 0 = trash: the
         # scatter's excess bucket rows land there harmlessly
         bids = cache.tables[row, :n_cp].copy()
-        kp, vp = self._paged_commit_program(b, cache.page)(
-            cache.k_pools, cache.v_pools, dense, jnp.asarray(bids))
+        if cache.quantized:
+            kp, vp, ks, vs = self._paged_commit_program(
+                b, cache.page, True)(
+                cache.k_pools, cache.v_pools, cache.k_scales,
+                cache.v_scales, dense, jnp.asarray(bids),
+                jnp.int32(P))
+            cache.k_scales, cache.v_scales = list(ks), list(vs)
+        else:
+            kp, vp = self._paged_commit_program(b, cache.page)(
+                cache.k_pools, cache.v_pools, dense, jnp.asarray(bids))
         cache.k_pools, cache.v_pools = list(kp), list(vp)
         cache.lengths[row] = P
         return np.asarray(logits[0, P - 1])
 
-    def _paged_chunk_program(self, n: int, bp: int):
+    def _paged_chunk_program(self, n: int, bp: int,
+                             quantized: bool = False):
         """lax.scan of n paged decode steps for bp rows: append one
         token per row into its pages, ragged paged attention, sample
         in-graph (_sample_rows — the same sampler graph as every other
         path).  The pool never round-trips to the host (donated).
+        Quantized pools thread their per-page scales through the scan
+        carry (and donate them too — rescale-on-append rewrites them
+        in place).
 
         The first step's input tokens come from
         where(fresh_mask, fresh, carry): `fresh` is the host-fed
@@ -1002,37 +1213,75 @@ class CompletionModel:
         returns as a device array, so K-deep chunk chaining
         (paged_decode_chunk_async) never pays a host round trip for
         the token hand-off."""
-        key = ("chunk", n, bp, self.top_p, self.temp)
+        key = ("chunk", n, bp, quantized, self.top_p, self.temp)
         fn = self._paged_progs.get(key)
         if fn is None:
             module, top_p, temp = self.module, self.top_p, self.temp
 
-            def run(params, k_pools, v_pools, tables, lengths, rng,
-                    fresh, fresh_mask, carry):
-                toks0 = jnp.where(fresh_mask, fresh, carry)
+            if quantized:
+                def run(params, k_pools, v_pools, k_scales, v_scales,
+                        tables, lengths, rng, fresh, fresh_mask,
+                        carry):
+                    toks0 = jnp.where(fresh_mask, fresh, carry)
 
-                def step(carry_s, _):
-                    k_pools, v_pools, lengths, rng, toks = carry_s
-                    cache = list(zip(k_pools, v_pools))
-                    logits, new_cache = module.apply(
-                        params, toks.reshape(-1, 1), cache,
-                        jnp.int32(0), None, lengths, tables)
-                    k_pools = [c[0] for c in new_cache]
-                    v_pools = [c[1] for c in new_cache]
-                    rng, sub = jax.random.split(rng)
-                    nxt = _sample_rows(sub, logits[:, 0], top_p, temp)
-                    return (k_pools, v_pools, lengths + 1, rng, nxt), nxt
+                    def step(carry_s, _):
+                        (k_pools, v_pools, k_scales, v_scales,
+                         lengths, rng, toks) = carry_s
+                        cache = list(zip(k_pools, v_pools,
+                                         k_scales, v_scales))
+                        logits, new_cache = module.apply(
+                            params, toks.reshape(-1, 1), cache,
+                            jnp.int32(0), None, lengths, tables)
+                        k_pools = [c[0] for c in new_cache]
+                        v_pools = [c[1] for c in new_cache]
+                        k_scales = [c[2] for c in new_cache]
+                        v_scales = [c[3] for c in new_cache]
+                        rng, sub = jax.random.split(rng)
+                        nxt = _sample_rows(sub, logits[:, 0], top_p,
+                                           temp)
+                        return (k_pools, v_pools, k_scales, v_scales,
+                                lengths + 1, rng, nxt), nxt
 
-                (k_pools, v_pools, _, _, _), out = jax.lax.scan(
-                    step, (k_pools, v_pools, lengths, rng, toks0), None,
-                    length=n)
-                return k_pools, v_pools, out, out[-1]  # out: (n, bp)
+                    (k_pools, v_pools, k_scales, v_scales, _, _,
+                     _), out = jax.lax.scan(
+                        step, (k_pools, v_pools, k_scales, v_scales,
+                               lengths, rng, toks0), None, length=n)
+                    return (k_pools, v_pools, k_scales, v_scales,
+                            out, out[-1])          # out: (n, bp)
 
-            out_sh = self._paged_pool_out_shardings(2, 2)
-            kw = {} if out_sh is None else {"out_shardings": out_sh}
-            fn = jax.jit(run, donate_argnums=(1, 2), **kw)
+                out_sh = self._paged_pool_out_shardings(
+                    2, 2, n_scale_lists=2)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw)
+            else:
+                def run(params, k_pools, v_pools, tables, lengths, rng,
+                        fresh, fresh_mask, carry):
+                    toks0 = jnp.where(fresh_mask, fresh, carry)
+
+                    def step(carry_s, _):
+                        k_pools, v_pools, lengths, rng, toks = carry_s
+                        cache = list(zip(k_pools, v_pools))
+                        logits, new_cache = module.apply(
+                            params, toks.reshape(-1, 1), cache,
+                            jnp.int32(0), None, lengths, tables)
+                        k_pools = [c[0] for c in new_cache]
+                        v_pools = [c[1] for c in new_cache]
+                        rng, sub = jax.random.split(rng)
+                        nxt = _sample_rows(sub, logits[:, 0], top_p,
+                                           temp)
+                        return (k_pools, v_pools, lengths + 1, rng,
+                                nxt), nxt
+
+                    (k_pools, v_pools, _, _, _), out = jax.lax.scan(
+                        step, (k_pools, v_pools, lengths, rng, toks0),
+                        None, length=n)
+                    return k_pools, v_pools, out, out[-1]
+
+                out_sh = self._paged_pool_out_shardings(2, 2)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(1, 2), **kw)
             self._paged_progs[key] = fn
-            if len(self._paged_progs) > 16:
+            if len(self._paged_progs) > 24:
                 cur = (self.top_p, self.temp)
                 self._paged_progs = {
                     k: v for k, v in self._paged_progs.items()
@@ -1088,10 +1337,19 @@ class CompletionModel:
             fresh_mask = toks >= 0
             toks = np.maximum(toks, 0)
         self._rng, sub = jax.random.split(self._rng)
-        kp, vp, out, last = self._paged_chunk_program(n, bp)(
-            self.params, cache.k_pools, cache.v_pools,
-            jnp.asarray(cache.tables), jnp.asarray(cache.lengths), sub,
-            jnp.asarray(toks), jnp.asarray(fresh_mask), carry)
+        if cache.quantized:
+            kp, vp, ks, vs, out, last = self._paged_chunk_program(
+                n, bp, True)(
+                self.params, cache.k_pools, cache.v_pools,
+                cache.k_scales, cache.v_scales,
+                jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
+                sub, jnp.asarray(toks), jnp.asarray(fresh_mask), carry)
+            cache.k_scales, cache.v_scales = list(ks), list(vs)
+        else:
+            kp, vp, out, last = self._paged_chunk_program(n, bp)(
+                self.params, cache.k_pools, cache.v_pools,
+                jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
+                sub, jnp.asarray(toks), jnp.asarray(fresh_mask), carry)
         cache.k_pools, cache.v_pools = list(kp), list(vp)
         live = cache.lengths > 0
         cache.lengths[live] = np.minimum(cache.lengths[live] + n,

@@ -27,6 +27,33 @@ not table width.  Per (b, kh) program the kv page block is
 (1, 1, page, D) — each page's bytes cross HBM once per kv head, and
 the (rep, page) logits tile never leaves VMEM.
 
+QUANTIZED pools (k_scales/v_scales given): the pools hold int8 values
+with one f32 scale per (page block, kv head) — (n_blocks, KH) — and
+the kernel dequantizes IN REGISTER inside the page loop: the scales
+ride scalar prefetch alongside the block tables (they are per-page
+scalars, exactly what SMEM is for), the K logits pick up scale * ks
+on the already-f32 MXU output, and V dequantizes on its VMEM block
+before the probability matmul.  HBM traffic per page drops to 1/2 of
+bf16 (1/4 of f32) + a scalar, which is the whole point: decode is
+memory-bound, so cache bytes ARE tokens/sec (ROADMAP item 4;
+PowerInfer arxiv 2312.12456, CPU-inference arxiv 2406.07553).  Note
+the scale tables live in SMEM for the whole dispatch — at f32 per
+(block, kv head) that is n_blocks*KH*4 bytes per side, fine for
+serving-sized pools (a 4096-page pool with 8 kv heads is 128 KiB),
+but a pathological million-page pool would need a VMEM spill; the
+layout (separate scale arrays, int8 values) deliberately leaves room
+for an int4-packed value pool later without touching the scales.
+
+MULTI-QUERY verify (q_tokens > 1): the speculative-decode verifier
+scores gamma+1 draft positions in ONE forward.  The kernel already
+carries rep query rows per kv head (GQA); q_tokens stacks the S new
+tokens' queries on the same axis — (B, KH, S*rep, D), token-major —
+and the ragged mask becomes CAUSAL across the stack: query token t
+(rows t*rep..(t+1)*rep) attends keys j < lengths[b] + t.  Appending
+the S tokens' K/V before the call (models/decoder.CausalAttention)
+makes this exactly a batched draft verification through the paged
+pool — no serial fallback, no dense window.
+
 Page size must be a multiple of the 128-lane tile on real TPU
 hardware; interpret mode (CPU parity tests) accepts any page size.
 Block 0 of the pool is reserved by convention as the TRASH block
@@ -45,7 +72,7 @@ dense bucket programs (ops/flash_attention.causal_flash_attention for
 long chunks) and their K/V rows are then scattered into freshly
 allocated pages (decoder.CompletionModel.paged_prefill_row) — one
 compiled program per bucket, like every other program in the serving
-stack.
+stack.  (Quantized pools quantize on that commit scatter, per page.)
 
 On non-TPU backends the same math runs as plain jnp over a gathered
 page view (tests exercise the kernel itself via interpret=True).
@@ -53,7 +80,8 @@ page view (tests exercise the kernel itself via interpret=True).
 Tensor-parallel serving (parallel/serve.py) passes mesh= and the whole
 dispatch runs under shard_map: pools sharded on the kv-head axis over
 `tp`, each device executing the same program over its KH/tp local
-heads — see paged_attention's docstring for the sharding contract.
+heads — the scales shard WITH their kv heads (axis 1 of (n_blocks,
+KH)), so the per-device SMEM tables shrink by tp too.
 """
 from __future__ import annotations
 
@@ -68,22 +96,35 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
-                  m_s, l_s, acc_s, *, page: int, scale: float):
+def _paged_kernel(*refs, page: int, scale: float, rep: int,
+                  q_tokens: int, quantized: bool):
     """One (batch row, kv head, page) program.
 
-    tab_ref: (B, P) SMEM block table (scalar prefetch)
-    len_ref: (B,)   SMEM row lengths (scalar prefetch)
-    q_ref:   (1, 1, rep, D) this row's queries for this kv head
-    k_ref/v_ref: (1, 1, page, D) the page the table routed here
-    out_ref: (1, 1, rep, D)
-    m_s/l_s: (rep, 1) f32 running max / sum;  acc_s: (rep, D) f32
+    refs (quantized=False):
+      tab_ref: (B, P) SMEM block table (scalar prefetch)
+      len_ref: (B,)   SMEM row lengths (scalar prefetch)
+      q_ref:   (1, 1, R, D) this row's queries for this kv head,
+               R = q_tokens*rep, token-major
+      k_ref/v_ref: (1, 1, page, D) the page the table routed here
+      out_ref: (1, 1, R, D)
+      m_s/l_s: (R, 1) f32 running max / sum;  acc_s: (R, D) f32
+    refs (quantized=True) insert ksc_ref/vsc_ref — (n_blocks, KH) f32
+    per-page per-kv-head scales in SMEM — after len_ref.
 
     The page axis is innermost, so the scratch carries the online
     softmax across a row's pages and the output block (revisited per
-    page) is written once on the last page.
+    page) is written once on the last page.  Query token t attends
+    keys j < length + t (causal across the q_tokens stack; t == 0
+    reproduces the classic single-token ragged mask).
     """
+    if quantized:
+        (tab_ref, len_ref, ksc_ref, vsc_ref, q_ref, k_ref, v_ref,
+         out_ref, m_s, l_s, acc_s) = refs
+    else:
+        (tab_ref, len_ref, q_ref, k_ref, v_ref,
+         out_ref, m_s, l_s, acc_s) = refs
     b = pl.program_id(0)
+    h = pl.program_id(1)
     p = pl.program_id(2)
     n_pages = pl.num_programs(2)
     length = len_ref[b]
@@ -94,16 +135,31 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
         l_s[...] = jnp.zeros_like(l_s)
         acc_s[...] = jnp.zeros_like(acc_s)
 
-    @pl.when(p * page < length)
+    # the last query token attends keys j < length + q_tokens - 1:
+    # pages wholly past that are dead for the whole stack
+    @pl.when(p * page < length + (q_tokens - 1))
     def _accumulate():
-        q = q_ref[0, 0]                                 # (rep, D)
-        k = k_ref[0, 0]                                 # (page, D)
-        v = v_ref[0, 0]
-        rep = q.shape[0]
-        logits = jnp.dot(q, k.T,
-                         preferred_element_type=jnp.float32) * scale
-        j = jax.lax.broadcasted_iota(jnp.int32, (rep, page), 1)
-        valid = (p * page + j) < length                 # ragged mask
+        q = q_ref[0, 0]                                 # (R, D)
+        R = q.shape[0]
+        if quantized:
+            bid = tab_ref[b, p]
+            ks = ksc_ref[bid, h]
+            vs = vsc_ref[bid, h]
+            k = k_ref[0, 0].astype(jnp.float32)         # (page, D) deq
+            v = v_ref[0, 0].astype(jnp.float32) * vs    # in-register
+            logits = jnp.dot(q.astype(jnp.float32), k.T,
+                             preferred_element_type=jnp.float32) \
+                * (scale * ks)
+        else:
+            k = k_ref[0, 0]                             # (page, D)
+            v = v_ref[0, 0]
+            logits = jnp.dot(q, k.T,
+                             preferred_element_type=jnp.float32) * scale
+        j = jax.lax.broadcasted_iota(jnp.int32, (R, page), 1)
+        # causal ragged mask: query token t = row // rep sees
+        # j < length + t (q_tokens == 1 -> the classic j < length)
+        t = jax.lax.broadcasted_iota(jnp.int32, (R, page), 0) // rep
+        valid = (p * page + j) < (length + t)
         logits = jnp.where(valid, logits, NEG_INF)
 
         m_prev, l_prev = m_s[...], l_s[...]
@@ -125,53 +181,93 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
         out_ref[0, 0] = out.astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _paged_pallas(q4, k_pool, v_pool, tables, lengths, *,
-                  interpret: bool):
-    """q4: (B, KH, rep, D); pools: (n_blocks, KH, page, D);
-    tables: (B, P) int32; lengths: (B,) int32.
-    Returns (B, KH, rep, D)."""
-    B, KH, rep, D = q4.shape
+def _pallas_call(q4, k_pool, v_pool, scalars, *, interpret: bool,
+                 q_tokens: int, quantized: bool):
+    """Shared pallas_call builder.  q4: (B, KH, R, D) with
+    R = q_tokens*rep; scalars: the prefetch tuple (tables, lengths[,
+    k_scales, v_scales])."""
+    B, KH, R, D = q4.shape
+    rep = R // q_tokens
     page = k_pool.shape[2]
-    P = tables.shape[1]
     scale = 1.0 / np.sqrt(D)
-    kv_spec = pl.BlockSpec(
-        (1, 1, page, D),
-        lambda b, h, p, tab, lens: (tab[b, p], h, 0, 0),
-        memory_space=pltpu.VMEM)
+    n_pre = len(scalars)
+
+    def _q_map(b, h, p, *pre):
+        return (b, h, 0, 0)
+
+    def _kv_map(b, h, p, *pre):
+        return (pre[0][b, p], h, 0, 0)
+
+    kv_spec = pl.BlockSpec((1, 1, page, D), _kv_map,
+                           memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KH, P),
+        num_scalar_prefetch=n_pre,
+        grid=(B, KH, scalars[0].shape[1]),
         in_specs=[
-            pl.BlockSpec((1, 1, rep, D),
-                         lambda b, h, p, tab, lens: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, R, D), _q_map,
                          memory_space=pltpu.VMEM),
             kv_spec,
             kv_spec,
         ],
-        out_specs=pl.BlockSpec((1, 1, rep, D),
-                               lambda b, h, p, tab, lens: (b, h, 0, 0),
+        out_specs=pl.BlockSpec((1, 1, R, D), _q_map,
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, D), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, D), jnp.float32),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_paged_kernel, page=page, scale=scale),
+        functools.partial(_paged_kernel, page=page, scale=scale,
+                          rep=rep, q_tokens=q_tokens,
+                          quantized=quantized),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KH, rep, D), q4.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KH, R, D), q4.dtype),
         interpret=interpret,
-    )(tables, lengths, q4, k_pool, v_pool)
+    )(*scalars, q4, k_pool, v_pool)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "q_tokens"))
+def _paged_pallas(q4, k_pool, v_pool, tables, lengths, *,
+                  interpret: bool, q_tokens: int):
+    """q4: (B, KH, q_tokens*rep, D); pools: (n_blocks, KH, page, D);
+    tables: (B, P) int32; lengths: (B,) int32.
+    Returns (B, KH, q_tokens*rep, D)."""
+    return _pallas_call(q4, k_pool, v_pool, (tables, lengths),
+                        interpret=interpret, q_tokens=q_tokens,
+                        quantized=False)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "q_tokens"))
+def _paged_pallas_quant(q4, k_pool, v_pool, k_scales, v_scales,
+                        tables, lengths, *, interpret: bool,
+                        q_tokens: int):
+    """Quantized variant: int8 pools + (n_blocks, KH) f32 per-page
+    per-kv-head scales riding the scalar prefetch with the tables."""
+    return _pallas_call(q4, k_pool, v_pool,
+                        (tables, lengths, k_scales, v_scales),
+                        interpret=interpret, q_tokens=q_tokens,
+                        quantized=True)
+
+
+def dequantize_pool(pool, scales):
+    """(n_blocks, KH, page, D) int8 + (n_blocks, KH) f32 -> f32 values
+    (the jnp-reference/fallback dequant; the kernel does this per page
+    in register)."""
+    return pool.astype(jnp.float32) * scales[:, :, None, None]
 
 
 def _paged_ref(q, k_pool, v_pool, tables, lengths):
     """Reference math: gather every table page into a dense
     (B, KH, P*page, D) view and run the masked softmax — the
     correctness mirror the kernel is pinned against (and the non-TPU
-    serving path; XLA fuses the gather fine on CPU)."""
-    B, H, D = q.shape
+    serving path; XLA fuses the gather fine on CPU).  q may be
+    (B, H, D) (single decode token) or (B, S, H, D) (multi-query
+    verify: token t attends j < lengths + t)."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    B, S, H, D = q.shape
     KH, page = k_pool.shape[1], k_pool.shape[2]
     rep = H // KH
     kg = k_pool[tables].transpose(0, 2, 1, 3, 4)     # (B, KH, P, pg, D)
@@ -179,85 +275,142 @@ def _paged_ref(q, k_pool, v_pool, tables, lengths):
     T = kg.shape[2] * page
     kseq = kg.reshape(B, KH, T, D)
     vseq = vg.reshape(B, KH, T, D)
-    qr = q.reshape(B, KH, rep, D)
+    qr = q.reshape(B, S, KH, rep, D)
     logits = jnp.einsum(
-        "bkrd,bktd->bkrt", qr.astype(jnp.float32),
+        "bskrd,bktd->bskrt", qr.astype(jnp.float32),
         kseq.astype(jnp.float32)) / np.sqrt(D)
-    valid = jnp.arange(T)[None, :] < lengths[:, None]       # (B, T)
-    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    valid = jnp.arange(T)[None, None, :] \
+        < (lengths[:, None, None] + jnp.arange(S)[None, :, None])
+    logits = jnp.where(valid[:, :, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bkrt,bktd->bkrd", probs.astype(vseq.dtype), vseq)
-    return out.reshape(B, H, D).astype(q.dtype)
+    out = jnp.einsum("bskrt,bktd->bskrd", probs.astype(vseq.dtype),
+                     vseq)
+    out = out.reshape(B, S, H, D).astype(q.dtype)
+    return out[:, 0] if squeeze else out
 
 
-def _paged_host(q, k_pool, v_pool, tables, lengths, *,
+def _paged_host(q, k_pool, v_pool, tables, lengths,
+                k_scales=None, v_scales=None, *,
                 interpret: bool, force_pallas: bool):
     """The single-device dispatch body: Pallas kernel on TPU (or under
     interpret/force_pallas), identical jnp math elsewhere.  Under
     paged_attention's mesh= this runs PER SHARD inside shard_map —
-    q/k_pool/v_pool arrive with their local KH/tp kv heads (and the
-    matching H/tp query heads), tables/lengths replicated, and the
-    math needs no collective: every kv head's attention is independent
-    and the GQA head-repeat stays local because query heads shard
-    consistently with kv heads."""
-    B, H, D = q.shape
+    q/k_pool/v_pool (and the scales) arrive with their local KH/tp kv
+    heads (and the matching H/tp query heads), tables/lengths
+    replicated, and the math needs no collective: every kv head's
+    attention is independent and the GQA head-repeat stays local
+    because query heads shard consistently with kv heads."""
+    multi = q.ndim == 4
+    if multi:
+        B, S, H, D = q.shape
+    else:
+        B, H, D = q.shape
+        S = 1
     KH = k_pool.shape[1]
     rep = H // KH
+    quantized = k_scales is not None
     use_pallas = (force_pallas or interpret
                   or jax.default_backend() == "tpu")
     if not use_pallas:
+        if quantized:
+            k_pool = dequantize_pool(k_pool, k_scales)
+            v_pool = dequantize_pool(v_pool, v_scales)
         return _paged_ref(q, k_pool, v_pool, tables, lengths)
-    q4 = q.reshape(B, KH, rep, D)
-    out = _paged_pallas(q4, k_pool, v_pool,
-                        jnp.asarray(tables, jnp.int32),
-                        jnp.asarray(lengths, jnp.int32),
-                        interpret=interpret)
+    # token-major query stacking: rows [t*rep, (t+1)*rep) of each kv
+    # head's block are query token t's rep heads (the kernel's
+    # row // rep == token-index contract)
+    if multi:
+        q4 = q.reshape(B, S, KH, rep, D).transpose(0, 2, 1, 3, 4) \
+              .reshape(B, KH, S * rep, D)
+    else:
+        q4 = q.reshape(B, KH, rep, D)
+    tables = jnp.asarray(tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if quantized:
+        out = _paged_pallas_quant(
+            q4, k_pool, v_pool,
+            jnp.asarray(k_scales, jnp.float32),
+            jnp.asarray(v_scales, jnp.float32),
+            tables, lengths, interpret=interpret, q_tokens=S)
+    else:
+        out = _paged_pallas(q4, k_pool, v_pool, tables, lengths,
+                            interpret=interpret, q_tokens=S)
+    if multi:
+        return out.reshape(B, KH, S, rep, D).transpose(0, 2, 1, 3, 4) \
+                  .reshape(B, S, H, D)
     return out.reshape(B, H, D)
 
 
 def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    k_scales=None, v_scales=None,
                     interpret: bool = False,
                     force_pallas: bool = False,
                     mesh=None):
     """Ragged paged decode attention (FORWARD/serving only).
 
     q: (B, H, D) — ONE query token per row, at position lengths[b]-1
-    (call after appending the step's K/V, so lengths counts it);
+    (call after appending the step's K/V, so lengths counts it) — or
+    (B, S, H, D) for the MULTI-QUERY verify path: S new tokens per
+    row whose K/V are ALL already appended at positions
+    lengths[b]-1 .. lengths[b]+S-2; query token t attends keys
+    j < lengths[b] + t (causal across the stack — exactly the
+    speculative verifier's one-forward scoring of gamma+1 drafts);
     k_pool/v_pool: (n_blocks, KH, page, D) — kv heads UNREPEATED (GQA:
     query head h reads kv head h // (H//KH), grouped like
     causal_flash_attention);
+    k_scales/v_scales: None for float pools, or (n_blocks, KH) f32
+    per-page per-kv-head scales for int8 pools — the kernel
+    dequantizes in register inside the page loop (the scales ride
+    scalar prefetch with the tables);
     tables: (B, P) int32 block table — entry (b, p) is the pool block
     holding row b's tokens [p*page, (p+1)*page); unused entries point
     at the trash block 0;
-    lengths: (B,) int32 — row b attends keys j < lengths[b].
-    Returns (B, H, D) in q's dtype.
+    lengths: (B,) int32 — row b's FIRST query attends keys
+    j < lengths[b].
+    Returns q's shape in q's dtype.
 
     mesh: a Mesh with a tp axis > 1 runs the kernel under shard_map —
     GSPMD cannot partition a Mosaic custom call, so the tensor-
     parallel serving path (parallel.serve.ShardedCompletionModel)
     shards the pools on their kv-head axis and each device runs the
     SAME Pallas program over its local KH/tp heads (block tables and
-    lengths stay replicated; page scheduling is host-side and
-    unchanged).  No collective is needed here: the one psum pair per
-    block comes from the row-parallel out-projection sharding, exactly
-    like the dense path.
+    lengths stay replicated; the scales shard with their kv heads;
+    page scheduling is host-side and unchanged).  No collective is
+    needed here: the one psum pair per block comes from the
+    row-parallel out-projection sharding, exactly like the dense path.
     """
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         from jax.sharding import PartitionSpec as SP
 
         from ..parallel.mesh import shard_map
 
-        body = functools.partial(_paged_host, interpret=interpret,
-                                 force_pallas=force_pallas)
+        q_spec = SP(None, None, "tp", None) if q.ndim == 4 \
+            else SP(None, "tp", None)
+        pool_spec = SP(None, "tp", None, None)
+        in_specs = [q_spec, pool_spec, pool_spec]
+        args = [q, k_pool, v_pool]
+        if k_scales is not None:
+            in_specs += [SP(None, "tp"), SP(None, "tp")]
+            args += [k_scales, v_scales]
+        in_specs += [SP(), SP()]
+        args += [jnp.asarray(tables, jnp.int32),
+                 jnp.asarray(lengths, jnp.int32)]
+
+        def body(q, kp, vp, *rest):
+            if len(rest) == 4:
+                ksc, vsc, tab, lens = rest
+            else:
+                (tab, lens), ksc, vsc = rest, None, None
+            return _paged_host(q, kp, vp, tab, lens, ksc, vsc,
+                               interpret=interpret,
+                               force_pallas=force_pallas)
+
         fn = shard_map(
             body, mesh=mesh,
-            in_specs=(SP(None, "tp", None),          # q: heads
-                      SP(None, "tp", None, None),    # k_pool: kv heads
-                      SP(None, "tp", None, None),    # v_pool
-                      SP(), SP()),                   # tables / lengths
-            out_specs=SP(None, "tp", None),
+            in_specs=tuple(in_specs),
+            out_specs=q_spec,
             check_vma=False)
-        return fn(q, k_pool, v_pool, jnp.asarray(tables, jnp.int32),
-                  jnp.asarray(lengths, jnp.int32))
+        return fn(*args)
     return _paged_host(q, k_pool, v_pool, tables, lengths,
+                       k_scales, v_scales,
                        interpret=interpret, force_pallas=force_pallas)

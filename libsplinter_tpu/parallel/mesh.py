@@ -80,6 +80,15 @@ def kv_pool_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, "tp", None, None))
 
 
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement of an int8 paged pool's per-page per-kv-head scales
+    (n_blocks, kv_heads): split on THEIR kv-head axis over tp — the
+    scales shard with the heads they scale, so the shard_map'd
+    quantized ragged kernel's scalar-prefetch tables shrink by tp
+    alongside the pools (ops/paged_attention.py)."""
+    return NamedSharding(mesh, P(None, "tp"))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -45,7 +45,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.decoder import CompletionModel, Decoder, init_cache
-from .mesh import kv_pool_sharding, make_mesh
+from .mesh import kv_pool_sharding, kv_scale_sharding, make_mesh
 
 
 def decoder_param_pspec(path: tuple, leaf) -> P:
@@ -154,6 +154,13 @@ class ShardedCompletionModel(CompletionModel):
         """(n_blocks, KH, page, D) pools split on kv heads over tp —
         the sharding the shard_map'd ragged kernel expects."""
         return kv_pool_sharding(self.mesh)
+
+    def _pool_scale_sharding(self):
+        """int8 pools' (n_blocks, KH) per-page scales split on THEIR
+        kv-head axis — scales shard with the heads they scale, so the
+        quantized ragged kernel's per-device scalar-prefetch tables
+        shrink by tp alongside the pools."""
+        return kv_scale_sharding(self.mesh)
 
     def _paged_scratch(self, b: int):
         """Paged prefill's (1, bucket) dense scratch, sharded like the

@@ -132,6 +132,36 @@ join/finish/join cycle never compiles).  `spt … --continuous --tp N`
 is the deployment surface; `make pod-check` gates token-exact parity
 (sharded-paged == single-chip-paged == serial) on the 8-device CPU
 mesh.
+
+### Quantized pool + self-drafting speculation (PR 9)
+
+`PagedKVCache(..., kv_dtype="int8")` (daemon flag `--kv-dtype int8`)
+stores the pools as int8 values plus per-page per-kv-head f32 scales
+(`k_scales`/`v_scales`, `(n_blocks, kv_heads)` per layer — separate
+buffers, layout leaving room for int4-packed values): the prefill
+commit scatter quantizes whole pages, decode appends rescale-on-
+append (monotone page scales), and `paged_attention(...,
+k_scales=, v_scales=)` dequantizes IN REGISTER inside the page loop
+— the scales ride scalar prefetch with the block tables.  Cache HBM
+per token: 1/2 of bf16, 1/4 of f32 (`device_mb()` measures placed
+buffers; heartbeat `pool_mb` + `kv_dtype`, `make quant-check` gates
+the parity + byte tiers).  Under `tp` the scales shard with their kv
+heads (`parallel/mesh.kv_scale_sharding`).
+
+The kernel also accepts a MULTI-QUERY stack — `q` shaped
+`(B, S, H, D)`: token t attends `j < lengths + t` (causal across the
+stack).  That is the speculative verifier:
+`SpeculativeCompletionModel` (with `self_draft_model(target, k)` — a
+draft that is a truncated VIEW of the target's own first k layers,
+`--draft-layers k`) implements the full paged surface
+(`paged_supported` True): the draft proposes gamma tokens via paged
+decode steps, the target scores all gamma+1 positions in ONE
+multi-query paged dispatch, acceptance/resample run on device, and a
+host FIFO adapts ragged per-row acceptance to the daemon's fixed
+chunk cadence.  Draft/verify counters ride the heartbeat
+(`sptpu_completer_spec_{draft,accepted,verified}_tokens`); the PR-5
+demotion floor still guards the lane (the swap lands at the next
+idle point of `run_continuous`).
 """,
     "embedding-vector-lane": """
 ## Search daemon (`libsplinter_tpu/engine/searcher.py`)

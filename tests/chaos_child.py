@@ -60,6 +60,30 @@ def main() -> int:
         comp.attach()
         n = comp.run_once()
         print(f"completions={n}", flush=True)
+    elif role == "completer_quant":
+        # the int8-quantized continuous lane at tiny geometry: the
+        # completer.kv_quant_commit fault site fires right before the
+        # quantized commit scatter, so a crash here dies with a
+        # claimed (SERVICING) request and half-written pool state —
+        # the drill proves the restarted lane reclaims the request
+        # and serves from a clean pool (no poisoned pages: the pool
+        # dies with the process)
+        import jax.numpy as jnp
+
+        from libsplinter_tpu.engine.completer import Completer
+        from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                    DecoderConfig)
+
+        cfg = DecoderConfig.tiny(dtype=jnp.float32)
+        model = CompletionModel(cfg, buckets=(16,), temp=0.0, seed=1)
+        comp = Completer(st, model=model, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=2,
+                         page_size=16, kv_dtype="int8")
+        comp.attach()
+        comp.run_continuous(
+            idle_timeout_ms=20,
+            stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
+        print(f"completions={comp.stats.completions}", flush=True)
     elif role == "completer_sharded":
         # the pod-sharded continuous lane at tiny geometry over a
         # virtual 8-device CPU mesh: the completer.sharded_dispatch

@@ -416,3 +416,89 @@ def test_supervise_restores_sharded_completer_lane(cstore, monkeypatch):
         sup.stop()
         t.join()
         sup.shutdown()
+
+
+@pytest.mark.slow
+def test_supervise_restores_quantized_commit_crash(cstore, monkeypatch):
+    """PR-9 chaos coverage: the int8-quantized continuous lane
+    (tests/chaos_child.py completer_quant) crashes MID-QUANTIZED-
+    COMMIT — completer.kv_quant_commit fires after the request is
+    claimed (SERVICING) and right before the commit scatter quantizes
+    its prompt K/V into pool pages.  `spt supervise` observes the
+    crash, strips the fault from the respawn, and both the stranded
+    pre-crash request and a post-crash request converge to READY —
+    the restarted lane's pool is freshly built, so no half-quantized
+    page can ever serve (no poisoned pages by construction: the pool
+    dies with the process, and the heartbeat's pages_free confirms a
+    clean pool after the requests finish)."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    monkeypatch.setenv("SPTPU_FAULT",
+                       "completer.kv_quant_commit:crash@1")
+    monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+    cstore.set("q", "hello quantized pool")
+    cstore.label_or("q", P.LBL_INFER_REQ)
+    cstore.bump("q")
+
+    holder: dict = {}
+
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, CHILD, "completer_quant", cstore.name],
+            env=holder["sup"]._child_env(lane))
+
+    sup = Supervisor(cstore.name, lanes=("completer",), spawn_fn=spawn,
+                     store=cstore, backoff_base_ms=100,
+                     backoff_max_ms=2000, breaker_threshold=8,
+                     breaker_window_s=120, startup_grace_s=300)
+    holder["sup"] = sup
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 240.0})
+    t.start()
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if cstore.labels("q") & P.LBL_READY:
+                break
+            time.sleep(0.25)
+        assert cstore.labels("q") & P.LBL_READY, sup.lanes
+        assert sup.lanes["completer"].restarts >= 1   # crash observed
+        assert sup.lanes["completer"].state != "down"
+        # a request submitted AFTER the crash round-trips too
+        cstore.set("q2", "again, quantized")
+        cstore.label_or("q2", P.LBL_INFER_REQ)
+        cstore.bump("q2")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if cstore.labels("q2") & P.LBL_READY:
+                break
+            time.sleep(0.25)
+        assert cstore.labels("q2") & P.LBL_READY
+        assert cstore.get("q2").rstrip(b"\0").startswith(
+            b"again, quantized")
+        assert not cstore.labels("q2") & (P.LBL_INFER_REQ
+                                          | P.LBL_SERVICING)
+        # the generation-2 heartbeat shows the quantized pool CLEAN
+        # after both requests finished: every page back on the free
+        # list (a poisoned/leaked page would show as pages_used > 0).
+        # Poll past the 2 s heartbeat cadence so we read a beat
+        # published AFTER the second request freed its pages.
+        deadline = time.monotonic() + 30
+        hb = {}
+        while time.monotonic() < deadline:
+            try:
+                hb = json.loads(cstore.get("__completer_stats")
+                                .rstrip(b"\0"))
+            except (KeyError, ValueError):
+                hb = {}
+            if hb.get("kv_dtype") == "int8" \
+                    and hb.get("pages_used") == 0:
+                break
+            time.sleep(0.5)
+        assert hb.get("kv_dtype") == "int8", hb
+        assert hb.get("pages_used") == 0, hb
+    finally:
+        sup.stop()
+        t.join()
+        sup.shutdown()

@@ -37,6 +37,13 @@
 #                   mode, mid-flight joiner, pool backpressure,
 #                   shard-labeled heartbeat gauges, and sharded-
 #                   dispatch fault containment
+#   make qos-check  multi-tenant QoS tier (fast, CPU): weighted
+#                   fairness within 2x under 10:1 offered-load skew,
+#                   typed overloaded shedding + retry_after_ms at the
+#                   queue high-water mark, deadline fast-fail on a
+#                   real searcher (scripts/qos_fairness_check.py) +
+#                   the `tests/test_qos.py` fast tier (admission
+#                   policy units, all three lanes, loadgen smoke)
 #   make quant-check  quantized-KV tier (fast, CPU): int8-vs-f32
 #                   ragged paged-attention parity (interpret mode),
 #                   multi-query verify stack, quantize-on-commit /
@@ -78,6 +85,7 @@ check: native
 	$(PY) scripts/obs_overhead_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -109,6 +117,11 @@ quant-check: native
 		-m "not slow"
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
 
+qos-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_qos.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
+
 memcheck: native
 	$(MAKE) -C native memcheck
 
@@ -120,5 +133,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native quick check obs-check search-check decode-check \
-	chaos-check dispatch-check pod-check quant-check memcheck \
-	bench-cpu clean
+	chaos-check dispatch-check pod-check quant-check qos-check \
+	memcheck bench-cpu clean

@@ -35,6 +35,11 @@ single claim window produces the complete evidence set:
                  shard_map'd ragged kernel) at batch {32,64} over a
                  tp mesh of every visible device — vs the r05
                  single-chip row; CPU-mesh rows are labeled smoke
+  loadgen        open-loop multi-tenant serving under QoS: a full
+                 in-process stack (tiny real models) serves mixed
+                 3-tenant embed/search/complete traffic from `spt
+                 loadgen`'s clock-driven arrivals — goodput vs shed
+                 + per-tenant p99, cpu_smoke-labeled off-TPU
   decode_daemon  completion-daemon e2e + continuous serving (the
                  only phase that ever hung on-chip, so it runs LAST)
 
@@ -77,7 +82,7 @@ TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
 
 ALL_PHASES = ("embed", "embed_sweep", "profile", "dispatch", "kernels",
               "search", "restage", "decode", "decode_quant",
-              "multichip", "decode_daemon", "store_ops")
+              "multichip", "loadgen", "decode_daemon", "store_ops")
 
 # conservative floor (seconds) a phase needs to be worth starting;
 # compile costs dominate these on a cold .xla_cache
@@ -85,7 +90,7 @@ PHASE_MIN_S = {"embed": 0, "embed_sweep": 120, "profile": 90,
                "dispatch": 20,
                "kernels": 120, "search": 150, "restage": 180,
                "decode": 180, "decode_quant": 150, "multichip": 120,
-               "decode_daemon": 120, "store_ops": 15}
+               "loadgen": 60, "decode_daemon": 120, "store_ops": 15}
 
 
 def log(*a):
@@ -1855,6 +1860,111 @@ def phase_multichip(ctx: SeriesCtx) -> dict:
         }})
 
 
+def phase_loadgen(ctx: SeriesCtx) -> dict:
+    """Open-loop multi-tenant serving under QoS (`spt loadgen`,
+    cli/loadgen.py): a full in-process stack — real tiny encoder +
+    decoder, the fused-top-k searcher — serves mixed 3-tenant
+    embed/search/complete traffic with per-tenant admission
+    (admit_cap + queue high water on the search lane) while the
+    generator's clock, not the server, decides arrivals.  Ledgers
+    goodput vs shed and per-tenant p99 sourced from the PR 2 log
+    histograms — the first bench row that measures the system AS a
+    multi-tenant server instead of a closed benchmark loop.  Off-TPU
+    rows carry a LOUD cpu_smoke label.  Env: LOADGEN_S (duration,
+    default 8), LOADGEN_RATE (aggregate req/s, default 60)."""
+    import threading
+
+    import numpy as np  # noqa: F401  (loadgen pulls it anyway)
+
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.cli.loadgen import LoadGenerator, TenantSpec
+    from libsplinter_tpu.engine.completer import Completer
+    from libsplinter_tpu.engine.embedder import Embedder
+    from libsplinter_tpu.engine.searcher import Searcher
+    from libsplinter_tpu.models import default_tokenizer
+    from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                DecoderConfig)
+    from libsplinter_tpu.models.encoder import (EmbeddingModel,
+                                                EncoderConfig)
+
+    duration = float(os.environ.get("LOADGEN_S", "8"))
+    rate = float(os.environ.get("LOADGEN_RATE", "60"))
+    name = _bench_store_name("loadgen")
+    Store.unlink(name)
+    st = Store.create(name, nslots=1024, max_val=2048, vec_dim=32)
+    daemons: list = []
+    ths: list = []
+    try:
+        ecfg = EncoderConfig.tiny(out_dim=st.vec_dim)
+        emb = Embedder(st, model=EmbeddingModel(ecfg),
+                       tokenizer=default_tokenizer(ecfg.vocab_size),
+                       max_ctx=ecfg.max_len, batch_cap=32)
+        dcfg = DecoderConfig.tiny()
+        comp = Completer(
+            st, model=CompletionModel(dcfg, temp=0.0, seed=1),
+            max_new_tokens=8, flush_tokens=4, template="none",
+            queue_high_water=256)
+        sr = Searcher(st, admit_cap=64, queue_high_water=256)
+        for d in (emb, sr, comp):
+            d.attach()
+            daemons.append(d)
+        run_s = duration + 60
+        ths = [threading.Thread(
+            target=d.run, kwargs=dict(idle_timeout_ms=10,
+                                      stop_after=run_s), daemon=True)
+            for d in daemons]
+        for t in ths:
+            t.start()
+
+        # 3 tenants at 3:2:1 offered rates, one shared deadline —
+        # aggregate LOADGEN_RATE req/s open loop
+        unit = rate / 6.0
+        tenants = [TenantSpec(1, 3 * unit, deadline_ms=10_000),
+                   TenantSpec(2, 2 * unit, deadline_ms=10_000),
+                   TenantSpec(3, 1 * unit, deadline_ms=10_000)]
+        gen = LoadGenerator(st, tenants, duration_s=duration,
+                            corpus=32, seed=7, drain_s=30.0)
+        rep = gen.run()
+
+        per_tenant_p99 = {
+            t: {lane: row.get("p99_ms") for lane, row in lanes.items()
+                if "p99_ms" in row}
+            for t, lanes in rep["per_tenant"].items()}
+        rec = {
+            "metric": "loadgen_goodput",
+            "backend": ctx.backend,
+            "duration_s": rep["duration_s"],
+            "offered_rps": rate,
+            "issued": rep["issued"],
+            "goodput_rps": rep["goodput_rps"],
+            "goodput_ratio": rep["goodput_ratio"],
+            "shed": rep["shed"],
+            "expired": rep["expired"],
+            "lost": rep["lost"],
+            "unserved": rep["unserved"],
+            "per_tenant_p99_ms": per_tenant_p99,
+            "tenant_rates": {"1": 3 * unit, "2": 2 * unit,
+                             "3": unit},
+        }
+        if ctx.backend != "tpu":
+            # tiny models on host CPU: a serving-layer smoke, not a
+            # throughput claim — label it so no before/after compare
+            # ever mistakes it for chip evidence
+            rec["label"] = "cpu_smoke"
+        log(f"loadgen: {rep['issued']} issued, goodput "
+            f"{rep['goodput_rps']:.1f} rps "
+            f"({rep['goodput_ratio']:.1%}), shed={rep['shed']} "
+            f"lost={rep['lost']}")
+        return ctx.record(rec)
+    finally:
+        for d in daemons:
+            d.stop()
+        for t in ths:
+            t.join(timeout=15)
+        st.close()
+        Store.unlink(name)
+
+
 def phase_decode_daemon(ctx: SeriesCtx) -> dict:
     """Completion-daemon e2e latency + continuous serving.  Runs LAST:
     this phase (completer e2e) is the only one that ever hung on-chip
@@ -2073,6 +2183,7 @@ PHASE_FNS = {
     "decode": phase_decode,
     "decode_quant": phase_decode_quant,
     "multichip": phase_multichip,
+    "loadgen": phase_loadgen,
     "decode_daemon": phase_decode_daemon,
     "store_ops": phase_store_ops,
 }

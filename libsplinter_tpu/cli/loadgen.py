@@ -1,0 +1,635 @@
+"""`spt loadgen` — the open-loop multi-tenant traffic generator.
+
+Every bench phase before this was a CLOSED loop: N well-behaved
+clients each waiting for their last request before issuing the next,
+so offered load could never exceed service rate and the admission /
+fairness / shedding machinery (engine/qos.py) had nothing to survive.
+An open-loop generator issues arrivals on a clock — Poisson or fixed
+rate — whether or not the server kept up (the CPU-inference paper's
+point: throughput claims are meaningless without an arrival model
+that can outrun the server).  This is the harness that turns the
+three fast lanes into one testable serving system:
+
+  - mixed embed / search / complete traffic in one run (configurable
+    weights), against whatever daemons serve the store — in-process
+    threads (tests), `spt supervise` children (the chaos drill), or a
+    production deployment;
+  - N tenants, each with its own arrival rate, deadline, and weight
+    (`--tenant ID:RATE[:DEADLINE_MS[:WEIGHT]]`), tenant ids riding
+    the bloom label word per engine/protocol.py;
+  - Zipf hot-key skew over the seeded corpus (`--zipf`), so cache and
+    coalescing behavior sees realistic popularity, not uniform picks;
+  - per-tenant / per-lane p50/p95/p99 from the PR 2 log-bucketed
+    histograms (obs/hist.py — the same quantile machinery the daemon
+    heartbeats publish), goodput vs shed vs expired vs lost, and SLO
+    pass/fail against thresholds given on the command line (non-zero
+    exit on violation: CI gates on it);
+  - `--scenario rag-churn`: each arrival is a scripted RAG pipeline —
+    ingest a fresh doc -> wait for its embedding -> top-k search with
+    a query derived from it -> complete a prompt built from the hits —
+    the end-to-end flow the north star describes, deadline-checked as
+    one request.  Run it against a `spt supervise`d stack with
+    SPTPU_FAULT killing a lane mid-run and the report's `lost` count
+    is the zero-admitted-request-loss evidence (stranded reclaim +
+    supervisor restart under concurrent mixed traffic).
+
+The generator is deliberately single-threaded: one loop issues due
+arrivals and polls outstanding requests, so results are deterministic
+under --seed and the generator itself can never outrun its own GIL
+into measurement noise.  Open-loop fidelity comes from NON-BLOCKING
+submits: a request is labels-and-bump, never a wait.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+
+import numpy as np
+
+from ..engine import protocol as P
+from ..obs.hist import LogHistogram
+from .main import CliError, command
+
+LANES = ("embed", "search", "complete")
+
+# terminal states a request can reach
+OK = "ok"               # served (within deadline unless counted late)
+OK_LATE = "ok_late"     # served, but past the client deadline
+SHED = "shed"           # typed overloaded (or embed label-only shed)
+EXPIRED = "expired"     # daemon fast-failed the deadline
+ERROR = "error"         # typed error record / ctx-exceeded
+UNSERVED = "unserved"   # still WAITING when the run ended (backpressure)
+LOST = "lost"           # admitted (claimed) but never completed — the
+                        # zero-loss chaos assertion counts THESE
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    tenant: int
+    rate: float                      # arrivals / second
+    deadline_ms: float | None = None
+    weight: float = 1.0
+
+    @classmethod
+    def parse(cls, spec: str) -> "TenantSpec":
+        """ID:RATE[:DEADLINE_MS[:WEIGHT]]"""
+        parts = spec.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"tenant spec {spec!r}: want ID:RATE[:DEADLINE_MS"
+                "[:WEIGHT]]")
+        t = cls(tenant=int(parts[0]), rate=float(parts[1]))
+        if len(parts) > 2 and parts[2]:
+            t.deadline_ms = float(parts[2])
+        if len(parts) > 3 and parts[3]:
+            t.weight = float(parts[3])
+        if not 0 <= t.tenant <= P.MAX_TENANT or t.rate <= 0:
+            raise ValueError(f"tenant spec {spec!r}: id 0..15, rate>0")
+        return t
+
+
+class _Req:
+    __slots__ = ("lane", "tenant", "key", "t_submit", "deadline_ts",
+                 "state", "stage", "doc_key", "query_key", "hits")
+
+    def __init__(self, lane, tenant, key, t_submit, deadline_ts):
+        self.lane = lane
+        self.tenant = tenant
+        self.key = key               # the key currently being polled
+        self.t_submit = t_submit     # monotonic submit time
+        self.deadline_ts = deadline_ts   # wall-clock deadline | None
+        self.state = None            # terminal state once classified
+        self.stage = 0               # rag pipeline position
+        self.doc_key = None
+        self.query_key = None
+        self.hits = []
+
+
+class LoadGenerator:
+    """Programmatic surface (tests and the bench phase drive this
+    directly; `spt loadgen` is a thin flag parser over it)."""
+
+    def __init__(self, store, tenants: list[TenantSpec], *,
+                 duration_s: float = 5.0,
+                 mix: dict[str, float] | None = None,
+                 arrivals: str = "poisson",
+                 zipf: float = 1.1,
+                 corpus: int = 32,
+                 seed: int = 0,
+                 scenario: str | None = None,
+                 search_k: int = 4,
+                 drain_s: float | None = None,
+                 prompt: str = "summarize: "):
+        if arrivals not in ("poisson", "fixed"):
+            raise ValueError("arrivals must be poisson|fixed")
+        if scenario not in (None, "rag-churn"):
+            raise ValueError(f"unknown scenario {scenario!r} "
+                             "(available: rag-churn)")
+        self.store = store
+        self.tenants = tenants
+        self.duration_s = duration_s
+        mix = dict(mix or {"embed": 1.0, "search": 1.0,
+                           "complete": 1.0})
+        bad = [ln for ln in mix if ln not in LANES]
+        if bad:
+            raise ValueError(f"unknown lanes in mix: {bad}")
+        total = sum(mix.values()) or 1.0
+        self.mix = {ln: mix.get(ln, 0.0) / total for ln in LANES}
+        self.arrivals = arrivals
+        self.zipf = zipf
+        self.corpus = corpus
+        self.scenario = scenario
+        self.search_k = search_k
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.default_rng(seed)
+        # post-arrival grace: outstanding requests get this long to
+        # resolve (a supervised restart mid-chaos needs real seconds)
+        max_dl = max((t.deadline_ms or 0.0) for t in tenants)
+        self.drain_s = drain_s if drain_s is not None \
+            else max(2.0, 2 * max_dl / 1e3)
+        self.prompt = prompt
+        self._n = 0
+        # per-(tenant, lane) latency histograms — the PR 2 log-bucketed
+        # quantile machinery, so p50/p95/p99 here and in the daemon
+        # heartbeats come from the same estimator
+        self.hists: dict[tuple[int, str], LogHistogram] = {}
+        self.counts: dict[tuple[int, str], dict[str, int]] = {}
+
+    # -- corpus ------------------------------------------------------------
+
+    def seed_corpus(self) -> None:
+        """Pre-seed `corpus` doc rows with deterministic unit vectors
+        so the search lane has candidates from the first arrival (the
+        rag-churn scenario grows it live through real ingests too)."""
+        st = self.store
+        d = st.vec_dim
+        for i in range(self.corpus):
+            key = f"lgd{i}"
+            st.set(key, f"seed document {i} about topic {i % 7}")
+            v = self.np_rng.standard_normal(d).astype(np.float32)
+            st.vec_set(key, v / (np.linalg.norm(v) or 1.0))
+
+    def _zipf_doc(self) -> int:
+        """Zipf-skewed corpus pick: rank r with p ∝ 1/r^s."""
+        if self.corpus <= 1:
+            return 0
+        # inverse-CDF over precomputed weights (tiny corpus: fine)
+        if not hasattr(self, "_zipf_cdf"):
+            w = np.arange(1, self.corpus + 1, dtype=np.float64) \
+                ** -max(self.zipf, 0.0)
+            self._zipf_cdf = np.cumsum(w / w.sum())
+        return int(np.searchsorted(self._zipf_cdf, self.rng.random()))
+
+    def _query_vec(self, doc_key: str) -> np.ndarray:
+        st = self.store
+        try:
+            v = st.vec_get(doc_key).astype(np.float32)
+        except (KeyError, OSError):
+            v = np.zeros(st.vec_dim, np.float32)
+        if not np.abs(v).max() > 0:
+            v = self.np_rng.standard_normal(st.vec_dim) \
+                .astype(np.float32)
+        v = v + 0.1 * self.np_rng.standard_normal(len(v)) \
+            .astype(np.float32)
+        return v / (np.linalg.norm(v) or 1.0)
+
+    # -- non-blocking submits ----------------------------------------------
+
+    def _stamp(self, key: str, tenant: int,
+               deadline_ts: float | None) -> None:
+        if tenant:
+            P.stamp_tenant(self.store, key, tenant)
+        if deadline_ts is not None:
+            P.stamp_deadline(self.store, key, deadline_ts)
+
+    def _submit_embed(self, req: _Req, text: str | None = None) -> None:
+        st = self.store
+        st.set(req.key, text if text is not None else
+               f"live document {self._n} about topic {self._n % 7}")
+        self._stamp(req.key, req.tenant, req.deadline_ts)
+        st.label_or(req.key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+        st.bump(req.key)
+
+    def _submit_search(self, req: _Req, qvec: np.ndarray) -> None:
+        st = self.store
+        params = {"k": self.search_k}
+        if req.deadline_ts is not None:
+            params["deadline"] = round(req.deadline_ts, 6)
+        st.set(req.key, json.dumps(params))
+        st.vec_set(req.key, qvec)
+        self._stamp(req.key, req.tenant, None)  # deadline rides JSON
+        st.label_or(req.key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+        st.bump(req.key)
+
+    def _submit_complete(self, req: _Req, prompt: str) -> None:
+        st = self.store
+        st.set(req.key, prompt)
+        self._stamp(req.key, req.tenant, req.deadline_ts)
+        st.label_or(req.key, P.LBL_INFER_REQ | P.LBL_WAITING)
+        st.bump(req.key)
+
+    def _issue(self, tenant: TenantSpec) -> _Req:
+        self._n += 1
+        n = self._n
+        deadline_ts = (time.time() + tenant.deadline_ms / 1e3
+                       if tenant.deadline_ms else None)
+        if self.scenario == "rag-churn":
+            lane = "rag"
+        else:
+            r = self.rng.random()
+            acc = 0.0
+            lane = LANES[-1]
+            for ln in LANES:
+                acc += self.mix[ln]
+                if r < acc:
+                    lane = ln
+                    break
+        req = _Req(lane, tenant.tenant, f"lg{lane[0]}{n}",
+                   time.monotonic(), deadline_ts)
+        if lane == "embed":
+            self._submit_embed(req)
+        elif lane == "search":
+            req.key = f"lgq{n}"
+            self._submit_search(
+                req, self._query_vec(f"lgd{self._zipf_doc()}"))
+        elif lane == "complete":
+            self._submit_complete(
+                req, f"{self.prompt}document {self._zipf_doc()}")
+        else:                         # rag-churn stage 0: ingest
+            req.doc_key = f"lgr{n}"
+            req.key = req.doc_key
+            req.stage = 0
+            self._submit_embed(
+                req, f"churn document {n} about topic {n % 7}")
+        return req
+
+    # -- polling / classification ------------------------------------------
+
+    def _poll(self, req: _Req) -> bool:
+        """True when `req` reached a terminal state (req.state set)."""
+        try:
+            labels = self.store.labels(req.key)
+        except KeyError:
+            req.state = LOST          # key vanished mid-request
+            return True
+        lane = req.lane if req.lane != "rag" else \
+            ("embed", "search", "complete")[req.stage]
+        if lane == "embed":
+            if labels & P.LBL_EMBED_REQ:
+                return False          # still queued
+            if labels & P.LBL_CTX_EXCEEDED:
+                req.state = ERROR
+                return True
+            vec_ok = False
+            try:
+                vec_ok = bool(
+                    np.abs(self.store.vec_get(req.key)).max() > 0)
+            except (KeyError, OSError):
+                pass
+            if not vec_ok:
+                # label-only unblock with no vector: the embed lane's
+                # shed/deadline signal (the daemon counters say which)
+                req.state = SHED if req.deadline_ts is None \
+                    or time.time() < req.deadline_ts else EXPIRED
+                return True
+            return self._advance(req)
+        if lane == "search":
+            if labels & P.LBL_SEARCH_REQ:
+                return False
+            rec = None
+            try:
+                idx = self.store.find_index(req.key)
+                raw = self.store.get(P.search_result_key(idx))
+                rec = json.loads(raw.rstrip(b"\0"))
+            except (KeyError, OSError, ValueError):
+                pass
+            if rec is None:
+                req.state = LOST      # label cleared, result missing
+                return True
+            err = rec.get("err")
+            if err == P.ERR_OVERLOADED:
+                req.state = SHED
+            elif err == P.ERR_DEADLINE:
+                req.state = EXPIRED
+            elif err:
+                req.state = ERROR
+            else:
+                req.hits = list(rec.get("keys", []))
+                from ..engine.searcher import consume_result
+                consume_result(self.store, req.key)
+                return self._advance(req)
+            from ..engine.searcher import consume_result
+            consume_result(self.store, req.key)
+            return True
+        # complete lane
+        if not labels & P.LBL_READY:
+            return False
+        rec = None
+        try:
+            rec = P.parse_error_payload(self.store.get(req.key))
+        except (KeyError, OSError):
+            req.state = LOST
+            return True
+        if rec is not None:
+            err = rec.get("err")
+            req.state = (SHED if err == P.ERR_OVERLOADED
+                         else EXPIRED if err == P.ERR_DEADLINE
+                         else ERROR)
+            return True
+        return self._advance(req)
+
+    def _advance(self, req: _Req) -> bool:
+        """One stage done: terminal for plain lanes, next stage for the
+        rag pipeline."""
+        if req.lane != "rag" or req.stage >= 2:
+            self._finish_ok(req)
+            return True
+        req.stage += 1
+        n = self._n
+        if req.stage == 1:            # ingest done -> search
+            req.query_key = f"lgrq{req.doc_key}"
+            qvec = self._query_vec(req.doc_key)
+            req.key = req.query_key
+            self._submit_search(req, qvec)
+        else:                         # search done -> complete
+            ctx = ", ".join(req.hits[:3]) or "nothing"
+            req.key = f"lgrc{req.doc_key}"
+            self._submit_complete(
+                req, f"context: {ctx}\nquestion: what is "
+                     f"{req.doc_key} about?")
+        return False
+
+    def _finish_ok(self, req: _Req) -> None:
+        late = (req.deadline_ts is not None
+                and time.time() > req.deadline_ts)
+        req.state = OK_LATE if late else OK
+
+    def _record(self, req: _Req) -> None:
+        lane = req.lane
+        key = (req.tenant, lane)
+        self.counts.setdefault(key, {})
+        self.counts[key][req.state] = \
+            self.counts[key].get(req.state, 0) + 1
+        if req.state in (OK, OK_LATE):
+            self.hists.setdefault(key, LogHistogram()).record(
+                (time.monotonic() - req.t_submit) * 1e3)
+        # recycle terminal keys so a long run cannot exhaust slots
+        for k in (req.key, req.doc_key, req.query_key):
+            if k and req.state != LOST:
+                try:
+                    self.store.unset(k)
+                except (KeyError, OSError):
+                    pass
+
+    # -- the run -----------------------------------------------------------
+
+    def _schedule(self) -> list[tuple[float, TenantSpec]]:
+        """Precompute every arrival's offset: open loop means the
+        clock, not the server, decides when requests exist."""
+        out: list[tuple[float, TenantSpec]] = []
+        for t in self.tenants:
+            when = 0.0
+            while True:
+                if self.arrivals == "poisson":
+                    when += self.rng.expovariate(t.rate)
+                else:
+                    when += 1.0 / t.rate
+                if when >= self.duration_s:
+                    break
+                out.append((when, t))
+        out.sort(key=lambda x: x[0])
+        return out
+
+    def run(self) -> dict:
+        self.seed_corpus()
+        schedule = self._schedule()
+        t0 = time.monotonic()
+        outstanding: list[_Req] = []
+        done: list[_Req] = []
+        i = 0
+        hard_stop = t0 + self.duration_s + self.drain_s
+        while True:
+            now = time.monotonic()
+            while i < len(schedule) and schedule[i][0] <= now - t0:
+                outstanding.append(self._issue(schedule[i][1]))
+                i += 1
+            still: list[_Req] = []
+            for req in outstanding:
+                if self._poll(req):
+                    done.append(req)
+                    self._record(req)
+                else:
+                    still.append(req)
+            outstanding = still
+            if i >= len(schedule) and not outstanding:
+                break
+            if now >= hard_stop:
+                break
+            # pace the poll loop without closing the arrival loop
+            next_due = (schedule[i][0] + t0 if i < len(schedule)
+                        else now + 0.005)
+            time.sleep(min(max(next_due - now, 0.0), 0.005))
+        # whatever is still outstanding: backpressure or in-flight
+        # (request label still up, or SERVICING = a live daemon is
+        # mid-generation at the cutoff) vs LOST (no label at all and
+        # no terminal signal: the request fell out of the protocol —
+        # the chaos drill's zero-loss assertion counts these)
+        for req in outstanding:
+            try:
+                labels = self.store.labels(req.key)
+            except KeyError:
+                labels = 0
+            req.state = UNSERVED if labels & (
+                P.LBL_EMBED_REQ | P.LBL_SEARCH_REQ | P.LBL_INFER_REQ
+                | P.LBL_SERVICING | P.LBL_WAITING) else LOST
+            done.append(req)
+            self._record(req)
+        return self.report(done, time.monotonic() - t0)
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, done: list[_Req], wall_s: float) -> dict:
+        totals = dict.fromkeys(
+            (OK, OK_LATE, SHED, EXPIRED, ERROR, UNSERVED, LOST), 0)
+        for req in done:
+            totals[req.state] = totals.get(req.state, 0) + 1
+        issued = len(done)
+        per_tenant: dict = {}
+        for (tenant, lane), counts in sorted(self.counts.items()):
+            sect = per_tenant.setdefault(str(tenant), {})
+            row = dict(counts)
+            h = self.hists.get((tenant, lane))
+            if h is not None and h.n:
+                row.update(n=h.n,
+                           p50_ms=round(h.quantile(0.5), 3),
+                           p95_ms=round(h.quantile(0.95), 3),
+                           p99_ms=round(h.quantile(0.99), 3))
+            sect[lane] = row
+        return {
+            "scenario": self.scenario or "mixed",
+            "arrivals": self.arrivals,
+            "duration_s": round(wall_s, 3),
+            "issued": issued,
+            **totals,
+            "goodput_rps": round(totals[OK] / wall_s, 3)
+            if wall_s > 0 else 0.0,
+            "goodput_ratio": round(totals[OK] / issued, 4)
+            if issued else 0.0,
+            "per_tenant": per_tenant,
+        }
+
+
+def evaluate_slo(report: dict, *, p99_ms: float | None = None,
+                 goodput: float | None = None,
+                 max_lost: int = 0) -> list[str]:
+    """SLO thresholds -> list of violations (empty = pass).  The
+    zero-admitted-loss bound is always enforced (max_lost)."""
+    out: list[str] = []
+    if report.get("lost", 0) > max_lost:
+        out.append(f"lost={report['lost']} admitted requests never "
+                   f"completed (max {max_lost})")
+    if goodput is not None:
+        if not report.get("issued"):
+            # zero arrivals measured nothing — an SLO gate that
+            # silently passes an empty run is worse than no gate
+            out.append("no requests issued — goodput SLO unevaluable")
+        elif report["goodput_ratio"] < goodput:
+            out.append(f"goodput {report['goodput_ratio']:.3f} < "
+                       f"SLO {goodput}")
+    if p99_ms is not None:
+        for tenant, lanes in report.get("per_tenant", {}).items():
+            for lane, row in lanes.items():
+                p99 = row.get("p99_ms")
+                if p99 is not None and p99 > p99_ms:
+                    out.append(f"tenant {tenant} {lane} p99 "
+                               f"{p99:.1f}ms > SLO {p99_ms}ms")
+    return out
+
+
+@command("loadgen",
+         "loadgen [--duration S] [--rate R] [--tenants N] "
+         "[--tenant ID:RATE[:DEADLINE_MS[:WEIGHT]]]... "
+         "[--mix embed:W,search:W,complete:W] "
+         "[--arrivals poisson|fixed] [--zipf S] [--corpus N] "
+         "[--seed N] [--scenario rag-churn] [--k K] [--drain-s S] "
+         "[--slo-p99-ms MS] [--slo-goodput F] [--json]",
+         "open-loop multi-tenant load generator with per-tenant "
+         "p50/p95/p99, goodput vs shed, and SLO pass/fail")
+def cmd_loadgen(ses, args):
+    duration = 5.0
+    rate = 20.0
+    n_tenants = 1
+    tenants: list[TenantSpec] = []
+    mix = None
+    arrivals = "poisson"
+    zipf = 1.1
+    corpus = 32
+    seed = 0
+    scenario = None
+    k = 4
+    drain_s = None
+    slo_p99 = None
+    slo_goodput = None
+    as_json = False
+
+    it = iter(args)
+
+    def val(flag):
+        try:
+            return next(it)
+        except StopIteration:
+            raise CliError(f"{flag} requires a value") from None
+
+    for a in it:
+        if a == "--duration":
+            duration = float(val(a))
+        elif a == "--rate":
+            rate = float(val(a))
+        elif a == "--tenants":
+            n_tenants = int(val(a))
+        elif a == "--tenant":
+            try:
+                tenants.append(TenantSpec.parse(val(a)))
+            except ValueError as e:
+                raise CliError(str(e)) from None
+        elif a == "--mix":
+            mix = {}
+            for part in val(a).split(","):
+                ln, sep, w = part.partition(":")
+                if not sep:
+                    raise CliError("--mix wants lane:W[,lane:W...]")
+                mix[ln.strip()] = float(w)
+        elif a == "--arrivals":
+            arrivals = val(a)
+        elif a == "--zipf":
+            zipf = float(val(a))
+        elif a == "--corpus":
+            corpus = int(val(a))
+        elif a == "--seed":
+            seed = int(val(a))
+        elif a == "--scenario":
+            scenario = val(a)
+        elif a == "--k":
+            k = int(val(a))
+        elif a == "--drain-s":
+            drain_s = float(val(a))
+        elif a == "--slo-p99-ms":
+            slo_p99 = float(val(a))
+        elif a == "--slo-goodput":
+            slo_goodput = float(val(a))
+        elif a == "--json":
+            as_json = True
+        else:
+            raise CliError(f"unknown flag {a!r} (see `help loadgen`)")
+
+    if not tenants:
+        # N identical tenants sharing --rate (ids 1..N); the id space
+        # is the label field's 15 — validate HERE, not mid-run when
+        # the first arrival's stamp_tenant would raise
+        if not 1 <= n_tenants <= P.MAX_TENANT:
+            raise CliError(
+                f"--tenants wants 1..{P.MAX_TENANT} (tenant ids ride "
+                "a 4-bit label field)")
+        per = rate / n_tenants
+        tenants = [TenantSpec(tenant=i + 1, rate=per)
+                   for i in range(n_tenants)]
+    try:
+        gen = LoadGenerator(ses.store, tenants, duration_s=duration,
+                            mix=mix, arrivals=arrivals, zipf=zipf,
+                            corpus=corpus, seed=seed,
+                            scenario=scenario, search_k=k,
+                            drain_s=drain_s)
+    except ValueError as e:
+        raise CliError(str(e)) from None
+    report = gen.run()
+    violations = evaluate_slo(report, p99_ms=slo_p99,
+                              goodput=slo_goodput)
+    report["slo"] = {"pass": not violations,
+                     "violations": violations}
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"loadgen {report['scenario']} — {report['issued']} "
+              f"issued over {report['duration_s']}s "
+              f"({report['arrivals']} arrivals)")
+        print(f"  ok={report['ok']} ok_late={report['ok_late']} "
+              f"shed={report['shed']} expired={report['expired']} "
+              f"error={report['error']} unserved={report['unserved']} "
+              f"lost={report['lost']}")
+        print(f"  goodput {report['goodput_rps']} req/s "
+              f"({report['goodput_ratio']:.1%} of issued)")
+        for tenant, lanes in report["per_tenant"].items():
+            for lane, row in lanes.items():
+                q = (f" p50={row['p50_ms']}ms p95={row['p95_ms']}ms "
+                     f"p99={row['p99_ms']}ms" if "p50_ms" in row
+                     else "")
+                cnt = " ".join(f"{s}={c}" for s, c in row.items()
+                               if s in (OK, OK_LATE, SHED, EXPIRED,
+                                        ERROR, UNSERVED, LOST))
+                print(f"  tenant {tenant} {lane:<9} {cnt}{q}")
+    if violations:
+        raise CliError("SLO FAIL: " + "; ".join(violations))
+    print("SLO PASS" if (slo_p99 is not None
+                         or slo_goodput is not None) else "done")

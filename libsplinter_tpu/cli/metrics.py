@@ -126,6 +126,25 @@ def cmd_metrics(ses, args):
                            "quantized pool with per-page scales); "
                            "see sptpu_completer_pool_mb for the "
                            "measured on-device bytes")
+        qos = snap.pop("qos", None)  # admission-control config
+        if isinstance(qos, dict):
+            w.scalars(f"sptpu_{daemon}_qos", qos)
+        tenants = snap.pop("tenants", None)  # per-tenant QoS ledger
+        if isinstance(tenants, dict):
+            for tenant, row in tenants.items():
+                if not isinstance(row, dict):
+                    continue
+                for field, v in row.items():
+                    if not isinstance(v, (int, float)):
+                        continue
+                    w.metric(f"sptpu_{daemon}_tenant_{field}", v,
+                             {"daemon": daemon,
+                              "tenant": str(tenant)},
+                             mtype="counter",
+                             help_="per-tenant QoS accounting "
+                                   "(admitted / shed / "
+                                   "deadline_expired / served_tokens "
+                                   "— engine/qos.py TenantLedger)")
         flt = snap.pop("faults", None)  # armed SPTPU_FAULT accounting
         if isinstance(flt, dict):
             for site, counts in flt.items():

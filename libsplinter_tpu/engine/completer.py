@@ -45,6 +45,8 @@ from ..utils import faults
 from ..utils.faults import fault
 from ..utils.trace import tracer
 from . import protocol as P
+from .qos import (AdmissionController, TenantLedger, WaitingRow,
+                  parse_tenant_weights, prune_idle_counters)
 
 log = logging.getLogger("libsplinter_tpu.completer")
 
@@ -120,6 +122,10 @@ class CompleterStats:
     reclaimed: int = 0                # stranded SERVICING rows re-queued
     join_backpressure: int = 0        # admissions deferred: pool full
     spec_demotions: int = 0           # speculative -> plain fallbacks
+    # -- multi-tenant QoS (engine/qos.py) ----------------------------
+    deadline_expired: int = 0         # fast-failed: deadline passed
+    shed: int = 0                     # typed overloaded + retry hint
+    deferred: int = 0                 # held for a later drain/chunk
     # -- K-deep decode overlap (engine/resident.py): un-awaited paged
     # decode chunks held while the host emits/admits ----------------
     inflight_peak: int = 0
@@ -143,7 +149,10 @@ class Completer:
                  pool_pages: int | None = None,
                  kv_dtype: str | None = None,
                  inflight_depth: int | None = None,
-                 spec_min_acceptance: float = 0.2):
+                 spec_min_acceptance: float = 0.2,
+                 queue_high_water: int | None = None,
+                 retry_after_ms: int | None = None,
+                 tenant_weights: dict[int, float] | None = None):
         self.store = store
         self.max_new = max_new_tokens
         self.flush_tokens = flush_tokens
@@ -184,6 +193,24 @@ class Completer:
         self._spec_hist: list[tuple[int, int]] = []
         self._spec_acceptance_rolling: float | None = None
         self._paged_cache = None
+        # multi-tenant QoS (engine/qos.py): every drain/admission
+        # cycle orders the waiting keys fairly across tenants (stride
+        # credit persists, so a starved tenant leads the next cycle);
+        # queue_high_water bounds the waiting backlog — overflow is
+        # claimed and READY-flipped with a typed overloaded JSON value
+        # carrying retry_after_ms.  Deadline fast-fail is always on
+        # for requests carrying a deadline stamp.
+        self.qos = AdmissionController(
+            weights=tenant_weights, high_water=queue_high_water,
+            **({"retry_after_ms": retry_after_ms}
+               if retry_after_ms is not None else {}))
+        self.tenants = TenantLedger()
+        self._had_deferred = False
+        # join-backpressure memo, idx -> (slot epoch, pages needed):
+        # instance state (not a run_continuous local) so the heartbeat
+        # can publish its size and the sweep can bound it — under
+        # sustained shedding it would otherwise grow per denied key
+        self._bp_memo: dict[int, tuple[int, int]] = {}
         if template not in TEMPLATES:
             raise ValueError(
                 f"unknown chat template {template!r} (supported: "
@@ -288,6 +315,113 @@ class Completer:
             self._debug(f"re-queued {n} SERVICING rows after a drain "
                         "fault")
         return n
+
+    # -- multi-tenant QoS --------------------------------------------------
+
+    def _qos_meta(self, idx: int) -> tuple[int, float | None]:
+        """(tenant, deadline) for a waiting slot — tenant from the
+        label word (free: one read), deadline from the companion stamp
+        only when LBL_DEADLINE flags it."""
+        st = self.store
+        try:
+            labels = st.labels_at(idx)
+        except (KeyError, OSError):
+            return 0, None
+        deadline = None
+        if labels & P.LBL_DEADLINE:
+            try:
+                deadline = P.read_deadline(st, idx,
+                                           epoch=st.epoch_at(idx))
+            except (KeyError, OSError):
+                deadline = None
+        return P.read_tenant(labels), deadline
+
+    def _terminal_reject(self, idx: int, payload: bytes,
+                         counter: str, tenant: int) -> bool:
+        """Claim-and-reject a waiting request without spending a batch
+        slot: the slot's value becomes the typed JSON payload
+        (overloaded + retry_after_ms, or deadline_expired) and the
+        label trifecta lands at READY — the client (engine/client.py)
+        parses the record instead of burning its timeout."""
+        st = self.store
+        try:
+            if st.epoch_at(idx) & 1:
+                return False          # writer active: next cycle
+            if not st.labels_at(idx) & P.LBL_INFER_REQ:
+                return False          # recycled since enumeration
+            key = st.key_at(idx)
+            if key is None:
+                return False
+            st.label_clear(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+            st.set(key, payload)
+            st.label_or(key, P.LBL_READY)
+            st.bump(key)
+        except (KeyError, OSError):
+            return False
+        P.clear_deadline(st, idx)
+        setattr(self.stats, counter,
+                getattr(self.stats, counter) + 1)
+        self.tenants.bump(tenant, counter)
+        return True
+
+    def _admit_waiting(self, idxs: list[int],
+                       capacity: int) -> list[int]:
+        """Order one cycle's waiting keys through the shared admission
+        policy: expired deadlines reject fast, the fairness-ordered
+        admit set (up to capacity) is returned for service, overflow
+        past queue_high_water is shed with the typed overloaded
+        record, the rest stay WAITING (their tenants lead the next
+        cycle — stride state persists).  With no QoS config and no
+        stamped rows this is a cheap pass-through."""
+        if not idxs:
+            return idxs
+        rows: list[WaitingRow] = []
+        tagged = False
+        for idx in idxs:
+            tenant, deadline = self._qos_meta(idx)
+            tagged = tagged or tenant or deadline is not None
+            rows.append(WaitingRow(idx, tenant, deadline))
+        if not tagged and self.qos.high_water is None \
+                and capacity >= len(idxs):
+            self._had_deferred = False
+            return idxs
+        plan = self.qos.plan(rows, capacity)
+        for row in plan.expired:
+            self._terminal_reject(row.item,
+                                  P.DEADLINE_EXPIRED_DIAGNOSTIC,
+                                  "deadline_expired", row.tenant)
+        for row in plan.shed:
+            self._terminal_reject(
+                row.item,
+                P.overloaded_payload(self.qos.retry_after_ms),
+                "shed", row.tenant)
+        self.stats.deferred += len(plan.deferred)
+        self._had_deferred = bool(plan.deferred)
+        return [row.item for row in plan.admit]
+
+    def _sweep_bp_memo(self) -> int:
+        """Bound the join-backpressure memo: evict entries whose slot
+        epoch moved on (rewritten/recycled — the memo'd pages-needed
+        no longer describes the slot's request) or whose request label
+        is gone (served, shed, or deadline-rejected).  Runs on the
+        heartbeat cadence; under sustained shedding the memo would
+        otherwise grow one entry per denied key forever.  A hard size
+        cap (oldest-first) backstops even a pathological store."""
+        st = self.store
+        dropped = 0
+        for idx, (e, _need) in list(self._bp_memo.items()):
+            try:
+                if st.epoch_at(idx) != e or \
+                        not st.labels_at(idx) & P.LBL_INFER_REQ:
+                    del self._bp_memo[idx]
+                    dropped += 1
+            except (KeyError, OSError):
+                self._bp_memo.pop(idx, None)
+                dropped += 1
+        while len(self._bp_memo) > 4096:
+            self._bp_memo.pop(next(iter(self._bp_memo)))
+            dropped += 1
+        return dropped
 
     def _debug(self, msg: str) -> None:
         """Append to the shared debug log key
@@ -418,6 +552,18 @@ class Completer:
             if not tracer.enabled:
                 stamp = None
 
+        # QoS accounting at the claim (the real admission moment):
+        # tagged requests count per tenant, and a consumed deadline
+        # stamp must not linger to misjudge a later slot occupant
+        try:
+            labels_now = st.labels_at(idx)
+        except (KeyError, OSError):
+            labels_now = 0
+        if labels_now & (P.TENANT_MASK | P.LBL_DEADLINE):
+            self.tenants.bump(P.read_tenant(labels_now), "admitted")
+            if labels_now & P.LBL_DEADLINE:
+                P.clear_deadline(st, idx)
+
         # WAITING → SERVICING, visible to watchers immediately
         st.label_clear(key, P.LBL_INFER_REQ | P.LBL_WAITING)
         st.label_or(key, P.LBL_SERVICING)
@@ -463,6 +609,14 @@ class Completer:
             return
         self.stats.completions += 1
         self.stats.tokens += n_tok
+        try:
+            tenant = P.read_tenant(st.labels(key))
+        except (KeyError, OSError):
+            tenant = 0
+        if tenant:
+            # tenant bits survive the claim (only INFER/WAITING were
+            # cleared), so goodput attribution needs no plumbing
+            self.tenants.bump(tenant, "served_tokens", n_tok)
 
     def _rebid(self) -> None:
         if self._bid >= 0:
@@ -734,8 +888,12 @@ class Completer:
         # admit() runs every chunk, and re-rendering + re-tokenizing a
         # denied prompt each time would burn host CPU alongside device
         # decode — the memo re-checks only free_pages until the slot
-        # is rewritten (epoch moves) or the pool might fit it
-        bp_memo: dict[int, tuple[int, int]] = {}
+        # is rewritten (epoch moves) or the pool might fit it.  The
+        # dict is instance state (self._bp_memo) so the heartbeat
+        # publishes its size and _sweep_bp_memo bounds it — under
+        # sustained shedding it used to leak one entry per denied key
+        bp_memo = self._bp_memo
+        bp_memo.clear()
 
         def worst_len(n_ids: int) -> int:
             """Worst-case cache length for an admitted prompt.  Decode
@@ -769,9 +927,37 @@ class Completer:
             free = [r for r in range(B) if rows[r] is None]
             if not free:
                 return 0
+            waiting = list(st.enumerate_indices(P.LBL_INFER_REQ))
+            if not waiting:
+                return 0
+            # multi-tenant admission before any render: fair order
+            # across tenants, expired deadlines rejected fast, backlog
+            # past high water shed typed.  Pool-backpressured rows are
+            # EXCLUDED from the fairness plan entirely — they are not
+            # admissible this cycle, and letting the planner "admit"
+            # them would charge their tenant's stride pass every chunk
+            # for a row the pool can never seat, pushing that tenant
+            # behind peers it was never actually served ahead of.
+            # Their deadlines still matter: an expired blocked row is
+            # rejected typed right here.
+            plannable = []
+            now_wall = time.time()
+            for w_idx in waiting:
+                memo = bp_memo.get(w_idx)
+                if memo is not None \
+                        and memo[0] == st.epoch_at(w_idx) \
+                        and memo[1] > cache.free_pages:
+                    tenant, dl = self._qos_meta(w_idx)
+                    if dl is not None and dl <= now_wall:
+                        if self._terminal_reject(
+                                w_idx, P.DEADLINE_EXPIRED_DIAGNOSTIC,
+                                "deadline_expired", tenant):
+                            bp_memo.pop(w_idx, None)
+                    continue
+                plannable.append(w_idx)
             n = 0
             traced = tracer.enabled
-            for idx in st.enumerate_indices(P.LBL_INFER_REQ):
+            for idx in self._admit_waiting(plannable, len(free)):
                 if not free:
                     break
                 e = st.epoch_at(idx)
@@ -946,6 +1132,9 @@ class Completer:
                     # self._model to the target NOW, and the lane
                     # adopts it at the next idle point below
                     self._maybe_demote_spec()
+                    # same cadence: bound the join-backpressure memo
+                    # (evict rewritten / no-longer-waiting slots)
+                    self._sweep_bp_memo()
                     self.publish_stats()
 
                 try:
@@ -1077,6 +1266,18 @@ class Completer:
         generate_fn serves serially (its contract is one prompt)."""
         st = self.store
         idxs = list(st.enumerate_indices(P.LBL_INFER_REQ))
+        if not idxs:
+            self._had_deferred = False    # nothing waiting: the
+            return 0                      # redrain loop must end
+        # multi-tenant admission: fair order across tenants, expired
+        # deadlines rejected fast, backlog past high water shed with
+        # the typed overloaded record.  With a high-water mark set,
+        # one drain also bounds its own work to the mark (deferred
+        # rows stay WAITING; run()'s work-conserving re-drain takes
+        # them next, in fair slices)
+        cap = (len(idxs) if self.qos.high_water is None
+               else min(len(idxs), max(1, self.qos.high_water)))
+        idxs = self._admit_waiting(idxs, cap)
         if not idxs:
             return 0
         if self._bid >= 0:
@@ -1229,6 +1430,23 @@ class Completer:
         # decode-overlap gauge: inflight_peak pinned here means the
         # chunk window saturates (sptpu_completer_inflight_depth)
         payload["inflight_depth"] = self.inflight_depth
+        # join-backpressure memo occupancy: growth here with flat
+        # admissions means denied keys are piling up (the sweep
+        # bounds it, but the gauge shows the pressure)
+        payload["bp_memo"] = len(self._bp_memo)
+        if self.qos.high_water is not None:
+            payload["qos"] = {
+                "queue_high_water": self.qos.high_water,
+                "retry_after_ms": self.qos.retry_after_ms}
+        tenants = self.tenants.snapshot()
+        if tenants:
+            # per-tenant admitted/shed/deadline_expired/served_tokens
+            # — `spt metrics` renders one labeled series per tenant
+            payload["tenants"] = tenants
+        prune_idle_counters(
+            payload, bool(self.qos.high_water is not None or tenants))
+        if not self._bp_memo and self._paged_cache is None:
+            payload.pop("bp_memo", None)  # dense lane: dead gauge
         acc = self._spec_acceptance()
         if acc is not None:
             # sptpu_completer_spec_acceptance in `spt metrics`
@@ -1309,9 +1527,18 @@ class Completer:
                     last = got
                     self.stats.wakes += 1
                     self.run_once()
+                    # work-conserving under a high-water drain bound:
+                    # deferred WAITING rows re-drain immediately in
+                    # fair slices instead of waiting out the sweep
+                    redrains = 0
+                    while self._had_deferred and self._running \
+                            and redrains < 256:
+                        redrains += 1
+                        self.run_once()
                 elif do_sweep:
                     self.run_once()
                 if do_sweep:
+                    self._sweep_bp_memo()
                     self.publish_stats()
             except Exception as ex:
                 self.stats.faults += 1
@@ -1439,6 +1666,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="continuous batching: requests join/leave the "
                          "live batch at chunk boundaries instead of "
                          "waiting for whole drains (run_continuous)")
+    ap.add_argument("--queue-high-water", type=int, default=None,
+                    help="multi-tenant QoS: max waiting backlog — "
+                         "overflow is claimed and READY-flipped with "
+                         "a typed {\"err\": \"overloaded\", "
+                         "\"retry_after_ms\": N} value instead of "
+                         "queueing unboundedly (default: never shed)")
+    ap.add_argument("--retry-after-ms", type=int, default=None,
+                    help="retry hint carried by shed responses")
+    ap.add_argument("--tenant-weights", default=None,
+                    help="per-tenant fair-share weights, "
+                         "TENANT:W[,TENANT:W...] (unlisted weigh 1)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -1539,7 +1777,11 @@ def main(argv: list[str] | None = None) -> int:
                      pool_pages=args.pool_pages,
                      kv_dtype=args.kv_dtype,
                      inflight_depth=args.inflight_depth,
-                     spec_min_acceptance=args.spec_min_acceptance)
+                     spec_min_acceptance=args.spec_min_acceptance,
+                     queue_high_water=args.queue_high_water,
+                     retry_after_ms=args.retry_after_ms,
+                     tenant_weights=parse_tenant_weights(
+                         args.tenant_weights))
     comp.attach()
     if args.warmup:
         t0 = time.monotonic()

@@ -44,6 +44,8 @@ from ..utils import faults
 from ..utils.faults import fault
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
+from .qos import (AdmissionController, TenantLedger, WaitingRow,
+                  parse_tenant_weights, prune_idle_counters)
 from .resident import InflightWindow
 
 log = logging.getLogger("libsplinter_tpu.embedder")
@@ -72,6 +74,10 @@ class EmbedderStats:
     batch_faults: int = 0       # encode/commit batches that failed
     embed_failed: int = 0       # rows failed terminally after strikes
     drain_faults: int = 0       # run-loop cycles the firewall absorbed
+    # -- multi-tenant QoS (engine/qos.py) ----------------------------
+    deadline_expired: int = 0   # fast-failed: client deadline passed
+    shed: int = 0               # unblocked label-only past high water
+    deferred: int = 0           # held for a later drain (fairness)
     # -- commit-pipeline telemetry (the overlap is measured, not
     # asserted: bench.py's p50 stage table reads these) --------------
     futures_dispatched: int = 0
@@ -209,7 +215,11 @@ class Embedder:
                  batch_cap: int = 256,
                  inflight_depth: int | None = None,
                  ring_depth: int | None = None,
-                 probe_batch_max: int | None = None):
+                 probe_batch_max: int | None = None,
+                 admit_cap: int | None = None,
+                 queue_high_water: int | None = None,
+                 retry_after_ms: int | None = None,
+                 tenant_weights: dict[int, float] | None = None):
         self.store = store
         self.max_ctx = max_ctx
         self.vector_training = vector_training
@@ -223,6 +233,23 @@ class Embedder:
         self.probe_batch_max = (P.PROBE_BATCH_MAX_DEFAULT
                                 if probe_batch_max is None
                                 else probe_batch_max)
+        # multi-tenant QoS (engine/qos.py): admit_cap bounds rows per
+        # drain (fairness granularity — the rest stay pending and the
+        # next drain re-plans with stride credit); queue_high_water
+        # bounds that backlog — overflow rows are unblocked label-only
+        # (the embed lane has no value channel to spare for a typed
+        # record: the slot holds the client's text, so the shed signal
+        # is the cleared label + zero vector + the heartbeat's shed /
+        # per-tenant counters).  Deadline fast-fail is always on for
+        # rows carrying a deadline stamp.
+        self.admit_cap = admit_cap
+        self.qos = AdmissionController(
+            weights=tenant_weights, high_water=queue_high_water,
+            **({"retry_after_ms": retry_after_ms}
+               if retry_after_ms is not None else {}))
+        self.tenants = TenantLedger()
+        self._had_deferred = False
+        self._row_labels: dict[int, int] = {}
         self.stats = EmbedderStats()
         # flight recorder: per-request wake->commit traces for rows
         # whose client stamped a trace id (protocol.stamp_trace);
@@ -438,17 +465,20 @@ class Embedder:
     def _candidates(self, indices: Sequence[int]) -> list[int]:
         st = self.store
         out = []
+        self._row_labels.clear()      # per-drain QoS metadata only
         traced = self._traced_hits
         for idx in indices:
             labels = st.labels_at(idx)
             if not labels & P.LBL_EMBED_REQ:
                 self._pending.discard(idx)    # done or never requested
-                if labels & (P.LBL_TRACED | P.LBL_DEBUG):
+                if labels & (P.LBL_TRACED | P.LBL_DEBUG
+                             | P.LBL_DEADLINE):
                     # a stamp that landed after its request was
                     # serviced surfaces here (its own write dirtied
                     # the stamp slot) — shed it or it leaks forever
                     P.shed_orphan_stamp(st, idx, labels)
                 continue
+            self._row_labels[idx] = labels    # tenant/deadline for QoS
             e = st.epoch_at(idx)
             if e & 1:
                 self._pending.add(idx)        # writer active: next drain
@@ -612,6 +642,88 @@ class Embedder:
         log.error("row %d failed %d encode attempts; giving up",
                   idx, ROW_STRIKE_LIMIT)
 
+    def _admission(self, rows: list[int]) -> list[int]:
+        """Multi-tenant QoS over one drain's candidates: expired
+        deadlines fail fast, the fairness-ordered admit set (up to
+        admit_cap) proceeds, overflow past queue_high_water is shed,
+        the rest stay pending with their tenants' stride credit
+        intact.  With no QoS config and no stamped rows this is a
+        cheap pass-through."""
+        labels_of = self._row_labels
+        qos_rows: list[WaitingRow] = []
+        tagged = False
+        for idx in rows:
+            labels = labels_of.get(idx, 0)
+            deadline = None
+            if labels & P.LBL_DEADLINE:
+                deadline = P.read_deadline(
+                    self.store, idx, epoch=self.store.epoch_at(idx))
+            tenant = P.read_tenant(labels)
+            tagged = tagged or tenant or deadline is not None
+            qos_rows.append(WaitingRow(idx, tenant, deadline))
+        if not tagged and self.admit_cap is None \
+                and self.qos.high_water is None:
+            self._had_deferred = False
+            return rows
+        cap = self.admit_cap if self.admit_cap else len(rows)
+        plan = self.qos.plan(qos_rows, cap)
+        for row in plan.expired:
+            self._fail_deadline(row.item, row.tenant)
+        for row in plan.shed:
+            self._shed_row(row.item, row.tenant)
+        self.stats.deferred += len(plan.deferred)
+        self._had_deferred = bool(plan.deferred)
+        for row in plan.admit:
+            if row.tenant or row.deadline is not None:
+                self.tenants.bump(row.tenant, "admitted")
+            if row.deadline is not None:
+                P.clear_deadline(self.store, row.item)
+        # deferred rows stay in the pending set — the next drain (the
+        # work-conserving re-drain in run(), or the next wake)
+        # reconsiders them
+        self._pending.update(row.item for row in plan.deferred)
+        return [row.item for row in plan.admit]
+
+    def _reject_row(self, idx: int) -> None:
+        """Shared terminal-reject tail for deadline expiry and shed:
+        ZERO the vector lane first — a re-embed request's slot still
+        holds the PREVIOUS text's vector, and without the scrub a
+        rejected update would be indistinguishable from success (the
+        client would read the stale vector as the new embedding; the
+        contract is cleared label + zero vector = not embedded) —
+        then unblock the row (labels cleared, bump).  The slot's text
+        is untouched; a rewrite re-candidates it."""
+        st = self.store
+        self._pending.discard(idx)
+        P.clear_deadline(st, idx)
+        try:
+            st.vec_set_at(idx, np.zeros(st.vec_dim, np.float32))
+            key = st.key_at(idx)
+            if key is not None:
+                st.label_clear(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+                st.bump(key)
+            self._known_epochs[idx] = st.epoch_at(idx)
+        except (KeyError, OSError):
+            pass
+
+    def _fail_deadline(self, idx: int, tenant: int) -> None:
+        """Deadline fast-fail: the client stopped waiting — unblock
+        the row without spending a batch slot on a vector nobody
+        reads."""
+        self.stats.deadline_expired += 1
+        self.tenants.bump(tenant, "deadline_expired")
+        self._reject_row(idx)
+
+    def _shed_row(self, idx: int, tenant: int) -> None:
+        """High-water shed: unblock the row label-only (the embed slot
+        holds the client's text, so there is no value channel for a
+        typed record — the cleared label + zero vector IS the signal,
+        and the heartbeat's shed / per-tenant counters plus
+        qos.retry_after_ms tell a monitoring client when to retry)."""
+        self.stats.shed += 1
+        self.tenants.bump(tenant, "shed")
+        self._reject_row(idx)
+
     def process_rows(self, rows: list[int]) -> int:
         """Embed a set of candidate slot indices; returns committed count.
 
@@ -631,7 +743,7 @@ class Embedder:
         # instrumented client leaves, or every stamped request leaks a
         # __tr_<idx> key + a permanent LBL_TRACED bit
         self._traced_hits = []
-        rows = self._candidates(rows)
+        rows = self._admission(self._candidates(rows))
         if not rows:
             self._traced_hits = None
             return 0
@@ -918,7 +1030,8 @@ class Embedder:
                 except OSError:
                     pass
             if not rows:
-                return 0
+                self._had_deferred = False    # nothing pending: the
+                return 0                      # redrain loop must end
             # device profile only around real work: a busy daemon runs
             # many empty sweep drains per second — capturing those
             # would pile up trace dirs with nothing in them
@@ -952,6 +1065,21 @@ class Embedder:
                for k in ("ring_dispatches", "resident_iterations",
                          "ring_occupancy", "ring_occupancy_peak",
                          "ring_faults")}}
+        if self.admit_cap or self.qos.high_water is not None:
+            payload["qos"] = {
+                "admit_cap": self.admit_cap or 0,
+                "queue_high_water": self.qos.high_water
+                if self.qos.high_water is not None else -1,
+                "retry_after_ms": self.qos.retry_after_ms}
+        tenants = self.tenants.snapshot()
+        if tenants:
+            # per-tenant admitted/shed/deadline_expired counters —
+            # `spt metrics` renders one labeled series per tenant
+            payload["tenants"] = tenants
+        prune_idle_counters(
+            payload, bool(self.admit_cap
+                          or self.qos.high_water is not None
+                          or tenants))
         if faults.armed():
             payload["faults"] = faults.stats()
         model = getattr(self, "_model", None)
@@ -998,6 +1126,15 @@ class Embedder:
                     last = got
                     self.stats.wakes += 1
                     self.drain(sweep=do_sweep)
+                    # work-conserving under admit_cap: deferred rows
+                    # stay in the pending set — re-drain immediately
+                    # in fair slices instead of waiting for the next
+                    # wake or the sweep cadence
+                    redrains = 0
+                    while self._had_deferred and self._running \
+                            and redrains < 256:
+                        redrains += 1
+                        self.drain()
                 elif do_sweep:
                     # periodic reconciliation only — an idle daemon
                     # must not walk the whole label lane on every idle
@@ -1084,6 +1221,22 @@ def main(argv: list[str] | None = None) -> int:
                          "futures held before the host blocks on the "
                          "oldest (default 2)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--admit-cap", type=int, default=None,
+                    help="multi-tenant QoS: max rows embedded per "
+                         "drain (fairness granularity; backlog stays "
+                         "pending with stride credit; default: "
+                         "unlimited)")
+    ap.add_argument("--queue-high-water", type=int, default=None,
+                    help="multi-tenant QoS: max deferred backlog — "
+                         "overflow rows are unblocked label-only "
+                         "(shed; the heartbeat counters carry the "
+                         "evidence; default: never shed)")
+    ap.add_argument("--retry-after-ms", type=int, default=None,
+                    help="retry hint published in the qos heartbeat "
+                         "section when shedding")
+    ap.add_argument("--tenant-weights", default=None,
+                    help="per-tenant fair-share weights, "
+                         "TENANT:W[,TENANT:W...] (unlisted weigh 1)")
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile the (1, bucket) and (batch_cap, "
                          "bucket) encoder programs before serving "
@@ -1128,7 +1281,12 @@ def main(argv: list[str] | None = None) -> int:
                    batch_cap=args.batch_cap,
                    ring_depth=args.ring_depth,
                    inflight_depth=args.inflight_depth,
-                   vector_training=args.vector_training)
+                   vector_training=args.vector_training,
+                   admit_cap=args.admit_cap,
+                   queue_high_water=args.queue_high_water,
+                   retry_after_ms=args.retry_after_ms,
+                   tenant_weights=parse_tenant_weights(
+                       args.tenant_weights))
     emb.attach()
     if args.warmup:
         t0 = time.monotonic()

@@ -20,6 +20,7 @@ LBL_CHUNK = 0x200              # ingest: document chunk
 LBL_META = 0x400               # ingest: metadata slot
 LBL_SEARCH_REQ = 0x1 << 57     # "search me" — wakes the search daemon
 LBL_TRACED = 0x1 << 58         # request carries a trace stamp (obs)
+LBL_DEADLINE = 0x1 << 52       # request carries a deadline stamp (QoS)
 LBL_DEBUG = 0x1 << 59          # debug channel (sidecar watches this)
 LBL_INFER_REQ = 0x1 << 60      # "complete me" — wakes the completion daemon
 LBL_SERVICING = 0x1 << 61      # completion in progress
@@ -30,8 +31,50 @@ BIT_EMBED_REQ = 0
 BIT_WAITING = 6
 BIT_CTX_EXCEEDED = 7
 BIT_SEARCH_REQ = 57
+BIT_DEADLINE = 52
 BIT_DEBUG = 59
 BIT_INFER_REQ = 60
+
+# --- multi-tenant QoS label field ----------------------------------------
+# The tenant id rides the request's own bloom label word, bits 48..51
+# (ids 1..15; 0 = the untagged default tenant), the way LBL_TRACED
+# rides bit 58: daemons read every candidate's label word anyway, so
+# tenant discovery costs nothing, and one tenant's waiting rows can be
+# enumerated cheaply with a bloom prefilter
+# (enumerate_indices(tenant_label(t) | LBL_SEARCH_REQ)).  Daemons
+# never clear the tenant field — it survives the WAITING->SERVICING->
+# READY trifecta so post-hoc accounting can still attribute the slot.
+TENANT_SHIFT = 48
+TENANT_BITS = 4
+TENANT_MASK = ((1 << TENANT_BITS) - 1) << TENANT_SHIFT
+MAX_TENANT = (1 << TENANT_BITS) - 1            # 15
+
+
+def tenant_label(tenant: int) -> int:
+    """The label bits encoding `tenant` (1..MAX_TENANT; 0 = none)."""
+    if not 0 <= tenant <= MAX_TENANT:
+        raise ValueError(
+            f"tenant id must be 0..{MAX_TENANT}, got {tenant}")
+    return tenant << TENANT_SHIFT
+
+
+def read_tenant(labels: int) -> int:
+    """Extract the tenant id from a slot's label word (0 = untagged)."""
+    return (labels & TENANT_MASK) >> TENANT_SHIFT
+
+
+def stamp_tenant(store, key: str, tenant: int) -> None:
+    """Client-side: tag the pending request on `key` with its tenant id
+    (best after set, before the bump — like stamp_trace).  Replaces any
+    previous tenant tag.  Never raises: a missing key is the caller's
+    race to discover."""
+    bits = tenant_label(tenant)                # validates range
+    try:
+        store.label_clear(key, TENANT_MASK)
+        if bits:
+            store.label_or(key, bits)
+    except (KeyError, OSError):
+        pass
 
 # --- signal groups -------------------------------------------------------
 GROUP_EMBED = 2                # embedding daemon wake group
@@ -261,6 +304,132 @@ def consume_trace_stamp(store, idx: int,
     return stamp
 
 
+# --- request deadlines ----------------------------------------------------
+# A client with a latency budget stamps an ABSOLUTE wall-clock deadline
+# next to its request (after set + label, before the bump — the trace
+# stamp discipline): "<deadline_ts>:<slot_epoch>" in the slot-indexed
+# companion key deadline_key(idx), flagged by LBL_DEADLINE on the
+# request key so unstamped rows cost one bit-test, never a lookup.
+# The servicing daemon fails an already-expired request fast (an error
+# record / diagnostic instead of a batch slot) and consumes the stamp;
+# the epoch field makes stamps self-invalidating exactly like trace
+# stamps.  Search requests may alternatively carry {"deadline": ts}
+# in their request JSON — the searcher honors either.
+DEADLINE_STAMP_PREFIX = "__dl_"
+
+
+def deadline_key(idx: int) -> str:
+    return f"{DEADLINE_STAMP_PREFIX}{idx}"
+
+
+def stamp_deadline(store, key: str, deadline_ts: float) -> bool:
+    """Client-side: attach an absolute wall-clock deadline (seconds
+    since the epoch) to the pending request on `key`.  Returns True if
+    the stamp landed; never raises (a deadline must never fail the
+    request it guards)."""
+    try:
+        idx = store.find_index(key)
+        dk = deadline_key(idx)
+        store.set(dk, f"{float(deadline_ts):.6f}:{store.epoch_at(idx)}")
+        store.label_or(dk, LBL_DEBUG)
+        store.label_or(key, LBL_DEADLINE)
+        return True
+    except (KeyError, OSError, ValueError):
+        return False
+
+
+def read_deadline(store, idx: int,
+                  epoch: int | None = None) -> float | None:
+    """Daemon-side: the absolute deadline for slot idx, or None.  With
+    `epoch` given (the gathered request's epoch), a stamp from a
+    different epoch is stale: consumed, and None returned."""
+    try:
+        raw = store.get(deadline_key(idx)).rstrip(b"\0").decode()
+        parts = raw.split(":")
+        ts = float(parts[0])
+        e_stamp = int(parts[1]) if len(parts) > 1 and parts[1] else None
+    except (KeyError, OSError, ValueError, IndexError):
+        return None
+    if epoch is not None and e_stamp is not None and e_stamp != epoch:
+        clear_deadline(store, idx)            # stale: consume, never
+        return None                           # bound the wrong request
+    return ts
+
+
+def clear_deadline(store, idx: int) -> None:
+    """Retire slot idx's deadline stamp (companion key + LBL_DEADLINE
+    on the slot's key).  Never raises."""
+    try:
+        store.unset(deadline_key(idx))
+    except (KeyError, OSError):
+        pass
+    try:
+        key = store.key_at(idx)
+        if key is not None:
+            store.label_clear(key, LBL_DEADLINE)
+    except (KeyError, OSError):
+        pass
+
+
+def consume_deadline(store, idx: int,
+                     epoch: int | None = None) -> float | None:
+    """Read AND retire slot idx's deadline stamp — run while the slot
+    still belongs to the gathered request."""
+    ts = read_deadline(store, idx, epoch=epoch)
+    clear_deadline(store, idx)
+    return ts
+
+
+# --- typed overload / expiry records --------------------------------------
+# The shed contract: a saturated lane past its high-water mark fails
+# overflow with THIS record instead of queueing unboundedly or
+# silently dropping — clients (engine/client.py retry wrapper) honor
+# the retry_after_ms hint.  Search results carry it as the __sr_ JSON
+# row; the completer writes it as the slot's value (READY-flipped);
+# the embedder has no value channel to spare (the slot holds the
+# client's text), so its shed unblocks the client label-only and the
+# counters tell the story.
+ERR_OVERLOADED = "overloaded"
+ERR_DEADLINE = "deadline_expired"
+
+
+def overloaded_record(retry_after_ms: int) -> dict:
+    return {"err": ERR_OVERLOADED,
+            "retry_after_ms": int(retry_after_ms)}
+
+
+def overloaded_payload(retry_after_ms: int) -> bytes:
+    """The completer-lane shed value: a typed JSON body a client (or
+    the shared retry wrapper) can parse for the retry hint."""
+    return json.dumps(overloaded_record(retry_after_ms)).encode()
+
+
+def parse_error_payload(raw: bytes | str) -> dict | None:
+    """{"err": ..., ...} if `raw` is one of the typed error payloads
+    above, else None (a normal completion body)."""
+    if isinstance(raw, bytes):
+        raw = raw.rstrip(b"\0")
+        if not raw.startswith(b"{"):
+            return None
+        try:
+            raw = raw.decode()
+        except UnicodeDecodeError:
+            return None
+    elif not raw.startswith("{"):
+        return None
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        return None
+    if isinstance(rec, dict) and isinstance(rec.get("err"), str):
+        return rec
+    return None
+
+
+DEADLINE_EXPIRED_DIAGNOSTIC = json.dumps(
+    {"err": ERR_DEADLINE}).encode()
+
+
 def publish_heartbeat(store, key: str, payload: dict) -> None:
     """Write a timestamped JSON stats snapshot into a debug-labeled
     key.  Telemetry must never wedge serving: a concurrently deleted
@@ -381,23 +550,33 @@ def shed_orphan_stamp(store, idx: int, labels: int) -> bool:
     stamped row itself and a freshly-written stamp slot (__tr_<n>)
     surfacing through the dirty mask.  Returns True if something was
     shed."""
+    shed = False
     if labels & LBL_TRACED and not labels & _REQ_LABELS:
         consume_trace_stamp(store, idx)
+        shed = True
+    if labels & LBL_DEADLINE and not labels & _REQ_LABELS:
+        clear_deadline(store, idx)
+        shed = True
+    if shed:
         return True
     if labels & LBL_DEBUG:
         try:
             key = store.key_at(idx)
         except (KeyError, OSError):
             return False
-        if key and key.startswith(TRACE_STAMP_PREFIX):
-            try:
-                tgt = int(key[len(TRACE_STAMP_PREFIX):])
-                tl = store.labels_at(tgt)
-            except (ValueError, KeyError, OSError):
-                return False
-            if tl & LBL_TRACED and not tl & _REQ_LABELS:
-                consume_trace_stamp(store, tgt)
-                return True
+        for pfx, retire in ((TRACE_STAMP_PREFIX, consume_trace_stamp),
+                            (DEADLINE_STAMP_PREFIX, clear_deadline)):
+            if key and key.startswith(pfx):
+                try:
+                    tgt = int(key[len(pfx):])
+                    tl = store.labels_at(tgt)
+                except (ValueError, KeyError, OSError):
+                    return False
+                flag = LBL_TRACED if pfx == TRACE_STAMP_PREFIX \
+                    else LBL_DEADLINE
+                if tl & flag and not tl & _REQ_LABELS:
+                    retire(store, tgt)
+                    return True
     return False
 
 

@@ -1,0 +1,255 @@
+"""Per-tenant admission control — the overload-survival policy layer.
+
+Every drain in the serving stack (searcher `_service`, embedder
+`process_rows`, completer `run_continuous` admission) faces the same
+three decisions when offered load exceeds capacity:
+
+  1. **Deadline expiry**: a request whose client deadline already
+     passed can never be useful — fail it fast with an error record
+     instead of letting it occupy a batch slot (serving it would burn
+     device time producing an answer nobody is waiting for, and the
+     queue behind it inherits the wasted wall clock).
+  2. **Fairness**: when a lane is saturated, which waiting requests
+     get the next drain's capacity?  Enumeration order hands the whole
+     lane to whichever tenant floods fastest; weighted fair queueing
+     guarantees every tenant its configured share while letting unused
+     share flow to the busy ones.
+  3. **Shedding**: past a configurable high-water mark the queue stops
+     absorbing — overflow is failed with a typed `overloaded` record
+     carrying a `retry_after_ms` hint (backpressure, never a wedge:
+     PR 5's contract, now with an explicit client-visible signal
+     instead of silent deferral into an unbounded backlog).
+
+This module holds the POLICY only: `AdmissionController.plan()` takes
+the drain's waiting set and capacity and partitions it into
+admit / expired / shed / deferred.  The daemons keep the mechanism
+(how to fail, how to defer, how to commit) — so the three lanes cannot
+drift apart on what "overloaded" means, and the fairness property is
+testable without spinning a daemon at all.
+
+The fairness discipline is stride scheduling (deficit round-robin's
+virtual-time formulation): each tenant carries a persistent `pass`
+value advanced by 1/weight per ADMITTED request, and a saturated
+drain's capacity goes to the lowest-pass requests first.  A tenant
+denied this drain keeps its low pass and leads the next one, so
+sustained 10:1 offered-load skew still converges to the configured
+weight ratio over a few drains instead of depending on any single
+drain's arrival order.  A tenant that went idle re-enters at the
+current virtual time (no banked priority to monopolize a later drain).
+
+Tenant identity and deadlines ride the wire per engine/protocol.py:
+the tenant id lives in the request's bloom label word (TENANT_MASK,
+bits 48-51 — daemons already read every candidate's labels, so tenant
+discovery is free), the deadline in a `__dl_<idx>` companion key
+flagged by LBL_DEADLINE (the LBL_TRACED discovery discipline).
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import time
+from typing import Any, Sequence
+
+# default shed hint: long enough that a retrying client skips at least
+# one full drain cycle, short enough that a drained lane re-admits the
+# retry promptly (clients jitter on top — engine/client.py)
+DEFAULT_RETRY_AFTER_MS = 250
+
+
+def prune_idle_counters(payload: dict, active: bool) -> dict:
+    """Drop the all-zero QoS counters from a heartbeat payload when
+    QoS is unconfigured and nothing ever tripped them: an untagged
+    deployment's heartbeat must not grow (tiny stores degrade
+    heartbeats by SIZE — publish_heartbeat — and three dead-zero
+    fields could push a previously-fitting payload over max_val)."""
+    if not active:
+        for k in ("deadline_expired", "shed", "deferred"):
+            if not payload.get(k):
+                payload.pop(k, None)
+    return payload
+
+
+def parse_tenant_weights(spec: str | None) -> dict[int, float] | None:
+    """Parse the daemons' --tenant-weights flag: "1:3,2:1" ->
+    {1: 3.0, 2: 1.0}.  Unlisted tenants weigh 1.  A malformed spec
+    raises ValueError at startup — a typo must never silently serve
+    unweighted."""
+    if not spec:
+        return None
+    out: dict[int, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        t, sep, w = part.partition(":")
+        if not sep:
+            raise ValueError(
+                f"tenant weight {part!r}: expected TENANT:WEIGHT")
+        out[int(t)] = float(w)
+        if out[int(t)] <= 0:
+            raise ValueError(
+                f"tenant weight {part!r}: weight must be > 0")
+    return out or None
+
+
+@dataclasses.dataclass
+class WaitingRow:
+    """One waiting request as the admission policy sees it: an opaque
+    item (slot index, request object — the daemon's business), the
+    tenant that owns it, and its absolute wall-clock deadline (seconds
+    since the epoch, None = no deadline)."""
+
+    item: Any
+    tenant: int = 0
+    deadline: float | None = None
+
+
+@dataclasses.dataclass
+class AdmissionPlan:
+    """One drain's admission decision.  The lists partition the input:
+    admit (serve now, fairness-ordered), expired (deadline already
+    passed — fail fast), shed (past high water — fail with the typed
+    overloaded record), deferred (keep waiting; the next drain
+    reconsiders them, and stride state makes their tenants lead it)."""
+
+    admit: list[WaitingRow] = dataclasses.field(default_factory=list)
+    expired: list[WaitingRow] = dataclasses.field(default_factory=list)
+    shed: list[WaitingRow] = dataclasses.field(default_factory=list)
+    deferred: list[WaitingRow] = dataclasses.field(default_factory=list)
+
+
+class TenantLedger:
+    """Per-tenant serving counters: admitted / shed / deadline_expired
+    / served_tokens.  Rides every daemon heartbeat under a "tenants"
+    section (`spt metrics` renders one labeled series per tenant) so
+    an operator mid-incident can see WHICH tenant is being shed and
+    whether the starved one is still making progress."""
+
+    FIELDS = ("admitted", "shed", "deadline_expired", "served_tokens")
+
+    def __init__(self) -> None:
+        self._t: dict[int, dict[str, int]] = {}
+
+    def bump(self, tenant: int, field: str, n: int = 1) -> None:
+        row = self._t.setdefault(
+            int(tenant), dict.fromkeys(self.FIELDS, 0))
+        row[field] = row.get(field, 0) + n
+
+    def snapshot(self) -> dict[str, dict[str, int]]:
+        """JSON-ready: tenant ids as strings (heartbeats are JSON)."""
+        return {str(t): dict(row) for t, row in sorted(self._t.items())}
+
+    def get(self, tenant: int, field: str) -> int:
+        return self._t.get(int(tenant), {}).get(field, 0)
+
+
+class AdmissionController:
+    """Weighted fair admission (stride scheduling) + high-water
+    shedding.
+
+    `high_water` bounds the post-admission backlog: after capacity is
+    filled, at most high_water further requests stay queued; the rest
+    are shed (tail of the fairness order — the flooding tenant's
+    excess sheds first).  None disables shedding (deferral only, the
+    pre-QoS behavior).  `capacity` <= 0 admits nothing but a wedged
+    lane still expires/sheds correctly.
+    """
+
+    def __init__(self, *, weights: dict[int, float] | None = None,
+                 high_water: int | None = None,
+                 retry_after_ms: int = DEFAULT_RETRY_AFTER_MS):
+        self.weights = dict(weights or {})
+        self.high_water = high_water
+        self.retry_after_ms = int(retry_after_ms)
+        self._pass: dict[int, float] = {}     # tenant -> virtual time
+
+    def weight(self, tenant: int) -> float:
+        w = self.weights.get(int(tenant), 1.0)
+        return w if w > 0 else 1.0
+
+    # -- the decision ------------------------------------------------------
+
+    def plan(self, waiting: Sequence[WaitingRow], capacity: int,
+             *, now: float | None = None) -> AdmissionPlan:
+        now = time.time() if now is None else now
+        plan = AdmissionPlan()
+        live: list[WaitingRow] = []
+        for row in waiting:
+            if row.deadline is not None and row.deadline <= now:
+                plan.expired.append(row)
+            else:
+                live.append(row)
+
+        capacity = max(0, int(capacity))
+        order = self._fair_order(live, capacity)
+        plan.admit = order[:capacity]
+        rest = order[capacity:]
+        if self.high_water is not None and rest:
+            keep = max(0, int(self.high_water))
+            plan.deferred = rest[:keep]
+            plan.shed = rest[keep:]
+        else:
+            plan.deferred = rest
+        return plan
+
+    def _fair_order(self, live: list[WaitingRow],
+                    capacity: int) -> list[WaitingRow]:
+        """Order the waiting rows by stride scheduling over persistent
+        per-tenant pass values; commit pass advancement for the
+        admitted prefix only (a deferred or shed request consumed no
+        share, so its tenant keeps its claim).
+
+        Pass values are stored RELATIVE to the schedule's virtual
+        time: after every plan the laggard waiting tenant's position
+        rebases to 0 and entries at/below it are dropped, so a tenant
+        absent from the map (new, or idle since its entry was
+        dropped) re-enters exactly AT the schedule position — an idle
+        stretch can neither bank priority (monopolizing on return)
+        nor inherit punishment for service rendered while nobody else
+        was waiting."""
+        queues: dict[int, list[WaitingRow]] = {}
+        for row in live:
+            queues.setdefault(int(row.tenant), []).append(row)
+        if not queues:
+            return []
+        scratch = {t: max(self._pass.get(t, 0.0), 0.0)
+                   for t in queues}
+        if len(queues) == 1:
+            (t, q), = queues.items()
+            self._pass[t] = scratch[t] + (min(len(q), capacity)
+                                          / self.weight(t))
+            self._rebase(self._pass[t])
+            return list(live)
+        heap = [(p, t) for t, p in scratch.items()]
+        heapq.heapify(heap)
+        out: list[WaitingRow] = []
+        committed = dict(scratch) if capacity == 0 else None
+        while heap:
+            p, t = heapq.heappop(heap)
+            q = queues[t]
+            out.append(q.pop(0))
+            scratch[t] = p + 1.0 / self.weight(t)
+            if q:
+                heapq.heappush(heap, (scratch[t], t))
+            if committed is None and len(out) == capacity:
+                committed = dict(scratch)     # admitted prefix's cost
+        if committed is None:
+            committed = scratch
+        self._pass.update(committed)
+        self._rebase(min(committed[t] for t in queues))
+        return out
+
+    def _rebase(self, vt: float) -> None:
+        """Advance the schedule's virtual time to `vt` (the laggard
+        WAITING tenant's post-plan position) and renormalize: entries
+        at/below it are deleted (their owners re-enter at the current
+        position), survivors shift down.  Keeps the map bounded to
+        tenants genuinely ahead of schedule and pass values anchored
+        at 0 across a long-lived daemon."""
+        if vt <= 0:
+            return
+        for t in list(self._pass):
+            if self._pass[t] <= vt:
+                del self._pass[t]
+            else:
+                self._pass[t] -= vt

@@ -55,6 +55,8 @@ from ..utils import faults
 from ..utils.faults import fault
 from ..utils.trace import device_profile, tracer
 from . import protocol as P
+from .qos import (AdmissionController, TenantLedger, WaitingRow,
+                  parse_tenant_weights, prune_idle_counters)
 from .resident import CallbackWindow
 
 log = logging.getLogger("libsplinter_tpu.searcher")
@@ -130,6 +132,10 @@ class SearcherStats:
     req_failures: int = 0        # requests failed with error records
     drain_faults: int = 0        # whole drains failed by the firewall
     results_reaped: int = 0      # orphaned __sr_ rows retired
+    # -- multi-tenant QoS (engine/qos.py) ----------------------------
+    deadline_expired: int = 0    # fast-failed: client deadline passed
+    shed: int = 0                # typed overloaded + retry_after_ms
+    deferred: int = 0            # held for a later drain (fairness)
 
     def coalesce_ratio(self) -> float:
         """Requests served per device dispatch (1.0 = no batching win;
@@ -138,9 +144,11 @@ class SearcherStats:
 
 
 class _Request:
-    __slots__ = ("idx", "epoch", "k", "bloom", "fast", "qvec", "stamp")
+    __slots__ = ("idx", "epoch", "k", "bloom", "fast", "qvec", "stamp",
+                 "tenant", "deadline", "traced")
 
-    def __init__(self, idx, epoch, k, bloom, fast, qvec, stamp):
+    def __init__(self, idx, epoch, k, bloom, fast, qvec, stamp,
+                 tenant=0, deadline=None, traced=False):
         self.idx = idx
         self.epoch = epoch
         self.k = k
@@ -148,6 +156,12 @@ class _Request:
         self.fast = fast         # bf16 MXU scoring requested
         self.qvec = qvec
         self.stamp = stamp       # (trace_id, client_wall_ts) | None
+        self.tenant = tenant     # label-word tenant id (0 = untagged)
+        self.deadline = deadline  # absolute wall-clock deadline | None
+        self.traced = traced     # LBL_TRACED seen at gather (stamp is
+                                 # consumed at ADMISSION, not gather —
+                                 # a deferred request keeps its stamp
+                                 # for the drain that serves it)
 
 
 class Searcher:
@@ -162,7 +176,11 @@ class Searcher:
                  interpret: bool = False,
                  block_n: int = 1024,
                  inflight_depth: int = 2,
-                 coalesce_window_ms: float = 0.0):
+                 coalesce_window_ms: float = 0.0,
+                 admit_cap: int | None = None,
+                 queue_high_water: int | None = None,
+                 retry_after_ms: int | None = None,
+                 tenant_weights: dict[int, float] | None = None):
         from ..ops import StagedLane
 
         self.store = store
@@ -184,6 +202,22 @@ class Searcher:
         # 0 (default): the natural window — requests landing while a
         # drain's device work flies batch into the next drain.
         self.coalesce_window_ms = coalesce_window_ms
+        # multi-tenant QoS (engine/qos.py): admit_cap bounds how many
+        # requests one drain services (the fairness granularity —
+        # backlog beyond it re-plans next drain with accumulated
+        # stride credit; None = service everything, the pre-QoS
+        # behavior); queue_high_water bounds the deferred backlog —
+        # overflow is shed with the typed overloaded record instead of
+        # queueing unboundedly.  Deadline fast-fail is always on: a
+        # request that stamps a deadline gets expiry checked whether
+        # or not admission control is configured.
+        self.admit_cap = admit_cap
+        self.qos = AdmissionController(
+            weights=tenant_weights, high_water=queue_high_water,
+            **({"retry_after_ms": retry_after_ms}
+               if retry_after_ms is not None else {}))
+        self.tenants = TenantLedger()
+        self._had_deferred = False
         self.lane = lane or StagedLane(store)
         self.stats = SearcherStats()
         self.generation = 0          # bumped at attach (restart marker)
@@ -267,9 +301,6 @@ class Searcher:
             labels = st.labels_at(idx)
             if not labels & P.LBL_SEARCH_REQ:
                 continue                      # serviced by a peer drain
-            stamp = None
-            if labels & P.LBL_TRACED:
-                stamp = P.consume_trace_stamp(st, idx, epoch=e)
             try:
                 raw = st.get_at(idx)
             except (KeyError, OSError):
@@ -284,15 +315,71 @@ class Searcher:
                     raise ValueError("k must be positive")
                 bloom = int(req.get("bloom", 0))
                 fast = bool(req.get("fast", False))
+                deadline = req.get("deadline")
+                deadline = float(deadline) if deadline else None
             except (ValueError, KeyError, TypeError):
                 self._fail(idx, e, "bad request params")
                 continue
+            # deadline may also ride the companion stamp (the generic
+            # wire form the raw-text lanes use); the JSON field wins
+            if deadline is None and labels & P.LBL_DEADLINE:
+                deadline = P.read_deadline(st, idx, epoch=e)
             qvec = vecs[j]
             if not np.abs(qvec).max() > 0:
                 self._fail(idx, e, "no query vector in request slot")
                 continue
-            out.append(_Request(idx, e, k, bloom, fast, qvec, stamp))
+            out.append(_Request(idx, e, k, bloom, fast, qvec, None,
+                                tenant=P.read_tenant(labels),
+                                deadline=deadline,
+                                traced=bool(labels & P.LBL_TRACED)))
         return out
+
+    # -- admission (multi-tenant QoS) --------------------------------------
+
+    def _admit(self, reqs: list[_Request]) -> list[_Request]:
+        """Partition the gathered requests through the shared admission
+        policy: expired deadlines fail fast with a typed record, the
+        fairness-ordered admit set (up to admit_cap) is serviced now,
+        overflow past queue_high_water is shed with `overloaded` +
+        retry_after_ms, and the rest stay labelled for the next drain
+        (their tenants lead it — stride state persists)."""
+        if not reqs:
+            self._had_deferred = False    # backlog gone (or raced):
+            return reqs                   # the redrain loop must end
+        cap = self.admit_cap if self.admit_cap else len(reqs)
+        plan = self.qos.plan(
+            [WaitingRow(r, r.tenant, r.deadline) for r in reqs], cap)
+        # trace stamps are consumed at the admission decision, not at
+        # gather: a DEFERRED request keeps its stamp (and LBL_TRACED)
+        # for the drain that actually serves it — consuming earlier
+        # lost the flight record of every request that waited a drain
+        for row in (*plan.admit, *plan.expired, *plan.shed):
+            r = row.item
+            if r.traced:
+                r.stamp = P.consume_trace_stamp(self.store, r.idx,
+                                                epoch=r.epoch)
+        for row in plan.expired:
+            r = row.item
+            self.tenants.bump(r.tenant, "deadline_expired")
+            P.clear_deadline(self.store, r.idx)
+            self._fail(r.idx, r.epoch, P.ERR_DEADLINE,
+                       counter="deadline_expired")
+        for row in plan.shed:
+            r = row.item
+            self.tenants.bump(r.tenant, "shed")
+            self.stats.shed += 1
+            P.clear_deadline(self.store, r.idx)
+            self._commit_result(
+                r.idx, r.epoch,
+                P.overloaded_record(self.qos.retry_after_ms))
+        self.stats.deferred += len(plan.deferred)
+        self._had_deferred = bool(plan.deferred)
+        for row in plan.admit:
+            if row.item.tenant or row.item.deadline is not None:
+                self.tenants.bump(row.item.tenant, "admitted")
+            if row.item.deadline is not None:
+                P.clear_deadline(self.store, row.item.idx)
+        return [row.item for row in plan.admit]
 
     def _fail(self, idx: int, epoch: int, err: str, *,
               counter: str = "parse_errors") -> None:
@@ -329,7 +416,7 @@ class Searcher:
             acc["wake"] = wake_ms
         with tracer.span("search.drain_cycle"):
             t0 = time.perf_counter()
-            reqs = self._gather_requests()
+            reqs = self._admit(self._gather_requests())
             if acc is not None:
                 acc["drain"] = (time.perf_counter() - t0) * 1e3
             if not reqs:
@@ -728,6 +815,21 @@ class Searcher:
                    # --inflight-depth for more dispatch amortization)
                    "inflight_depth": self.inflight_depth,
                    "lane": self.lane.counters()}
+        if self.admit_cap or self.qos.high_water is not None:
+            payload["qos"] = {
+                "admit_cap": self.admit_cap or 0,
+                "queue_high_water": self.qos.high_water
+                if self.qos.high_water is not None else -1,
+                "retry_after_ms": self.qos.retry_after_ms}
+        tenants = self.tenants.snapshot()
+        if tenants:
+            # per-tenant admitted/shed/deadline_expired/served_tokens:
+            # `spt metrics` renders one labeled series per tenant
+            payload["tenants"] = tenants
+        prune_idle_counters(
+            payload, bool(self.admit_cap
+                          or self.qos.high_water is not None
+                          or tenants))
         if faults.armed():
             payload["faults"] = faults.stats()
         if tracer.enabled:
@@ -768,6 +870,17 @@ class Searcher:
                         time.sleep(self.coalesce_window_ms / 1e3)
                     self.drain(
                         wake_ms=(time.perf_counter() - t_wake) * 1e3)
+                    # work-conserving under admit_cap: a drain that
+                    # deferred backlog (fairness granularity, not a
+                    # throughput cap) re-drains immediately — each
+                    # pass re-plans admission with accumulated stride
+                    # credit, so the backlog clears in fair slices
+                    # instead of waiting out the heartbeat cadence
+                    redrains = 0
+                    while self._had_deferred and self._running \
+                            and redrains < 256:
+                        redrains += 1
+                        self.drain()
                 now = time.monotonic()
                 if now >= next_beat:
                     if got is None:
@@ -811,41 +924,55 @@ def daemon_live(store: Store, *, max_age_s: float = 15.0) -> bool:
 
 def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
                   fast: bool = False,
-                  timeout_ms: int = 2000) -> dict | None:
+                  timeout_ms: int = 2000,
+                  tenant: int = 0,
+                  deadline_ms: float | None = None,
+                  retry: bool = True) -> dict | None:
     """Client side: turn `key` (whose vector lane already holds the
     embedded query) into a search request and wait for the daemon's
     result.  fast requests bf16 MXU scoring server-side (the CLI's
     --fast).  Returns the result record, or None on timeout (callers
-    fall back to client-side scoring)."""
-    idx = store.find_index(key)
-    store.set(key, json.dumps({"k": int(k), "bloom": int(bloom),
-                               "fast": bool(fast)}))
-    store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
-    store.bump(key)
-    deadline = time.monotonic() + timeout_ms / 1e3
-    re_pulsed = False
-    while True:
-        if not store.labels(key) & P.LBL_SEARCH_REQ:
+    fall back to client-side scoring).
+
+    `tenant` tags the request's label word for per-tenant admission;
+    `deadline_ms` (relative) rides the request JSON as an absolute
+    wall-clock deadline the daemon fast-fails behind.  The submit
+    routes through the shared retry wrapper (engine/client.py): a
+    typed `overloaded` shed is retried after its retry_after_ms hint
+    (jittered) inside the same timeout budget, and a lane whose
+    supervisor breaker is open fails fast instead of burning the
+    timeout (retry=False restores one bare attempt)."""
+    from .client import PENDING, call_with_retries, wait_with_repulse
+
+    deadline_ts = (time.time() + deadline_ms / 1e3
+                   if deadline_ms is not None else None)
+
+    def attempt(left_ms: float) -> dict | None:
+        idx = store.find_index(key)
+        req = {"k": int(k), "bloom": int(bloom), "fast": bool(fast)}
+        if deadline_ts is not None:
+            req["deadline"] = round(deadline_ts, 6)
+        store.set(key, json.dumps(req))
+        if tenant:
+            P.stamp_tenant(store, key, tenant)
+        store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+        store.bump(key)
+
+        def check():
+            if store.labels(key) & P.LBL_SEARCH_REQ:
+                return PENDING
             try:
                 raw = store.get(P.search_result_key(idx))
                 return json.loads(raw.rstrip(b"\0"))
             except (KeyError, OSError, ValueError):
                 return None
-        left_ms = int((deadline - time.monotonic()) * 1e3)
-        if left_ms <= 0:
-            return None
-        if not re_pulsed and left_ms * 2 <= timeout_ms:
-            # half the deadline gone with the label still set: the
-            # bump may have raced the daemon's signal_wait re-arm
-            # (the run-loop sweep narrows but cannot close that
-            # window) — one re-pulse costs a signal; silence costs
-            # the client its whole timeout plus the local fallback
-            try:
-                store.bump(key)
-            except (KeyError, OSError):
-                pass
-            re_pulsed = True
-        store.poll(key, timeout_ms=min(left_ms, 50))
+
+        return wait_with_repulse(store, key, left_ms, check)
+
+    if not retry:
+        return attempt(timeout_ms)
+    return call_with_retries(attempt, timeout_ms=timeout_ms,
+                             store=store, lane="searcher")
 
 
 def consume_result(store: Store, key: str) -> None:
@@ -877,6 +1004,22 @@ def main(argv: list[str] | None = None) -> int:
                          "select+commit resolves (1 = fetch in "
                          "dispatch order, the pre-overlap behavior)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--admit-cap", type=int, default=None,
+                    help="multi-tenant QoS: max requests serviced per "
+                         "drain (the fairness granularity; backlog "
+                         "re-plans next drain with stride credit; "
+                         "default: unlimited)")
+    ap.add_argument("--queue-high-water", type=int, default=None,
+                    help="multi-tenant QoS: max deferred backlog — "
+                         "overflow is shed with a typed `overloaded` "
+                         "result + retry_after_ms hint (default: "
+                         "never shed)")
+    ap.add_argument("--retry-after-ms", type=int, default=None,
+                    help="retry hint carried by shed results")
+    ap.add_argument("--tenant-weights", default=None,
+                    help="per-tenant fair-share weights, "
+                         "TENANT:W[,TENANT:W...] (unlisted tenants "
+                         "weigh 1)")
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile the QB-bucketed top-k programs "
                          "before serving")
@@ -891,7 +1034,12 @@ def main(argv: list[str] | None = None) -> int:
     store = Store.open(args.store, persistent=args.persistent)
     sr = Searcher(store, mxu_bf16=args.fast,
                   inflight_depth=args.inflight_depth,
-                  coalesce_window_ms=args.coalesce_window_ms)
+                  coalesce_window_ms=args.coalesce_window_ms,
+                  admit_cap=args.admit_cap,
+                  queue_high_water=args.queue_high_water,
+                  retry_after_ms=args.retry_after_ms,
+                  tenant_weights=parse_tenant_weights(
+                      args.tenant_weights))
     sr.attach()
     if args.warmup:
         t0 = time.monotonic()

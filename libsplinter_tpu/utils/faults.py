@@ -25,6 +25,14 @@ action   crash      os._exit(137) — SIGKILL-equivalent unclean death
                     retry budget, the store binding's signature error
          stall<ms>  sleep that many ms (stall250 = 250 ms): models a
                     device hiccup / page-in storm without failing
+         slow:<ms>:<p>  probabilistic TAIL latency: with probability p
+                    per hit, sleep a jittered 50-100% of <ms>
+                    (slow:40:0.1 = ~10% of hits pay 20-40 ms).
+                    Unlike the hard stall — which models one discrete
+                    hiccup — this shapes a realistic latency tail for
+                    SLO drills (`spt loadgen` chaos scenarios);
+                    deterministic under SPTPU_FAULT_SEED, composable
+                    with @N/@N-M windows (p applies within the window)
 trigger  @N         fire on the Nth hit of the site, once
          @N-M       fire on hits N..M inclusive (defeat retry ladders)
          @pX        fire with probability X on each hit (X in (0, 1];
@@ -71,8 +79,9 @@ class FaultSpecError(ValueError):
 @dataclasses.dataclass
 class _Point:
     site: str
-    action: str                 # crash | raise | eagain | stall
+    action: str                 # crash | raise | eagain | stall | slow
     stall_ms: float = 0.0
+    slow_prob: float = 0.0      # slow action's per-hit probability
     lo: int = 0                 # hit-count window (1-based, inclusive);
     hi: int = 0                 # lo == 0 means "no count trigger"
     prob: float = 0.0           # probability per hit; 0 = not a p-trigger
@@ -80,8 +89,12 @@ class _Point:
     fired: int = 0
 
     def spec(self) -> str:
-        act = (f"stall{self.stall_ms:g}" if self.action == "stall"
-               else self.action)
+        if self.action == "stall":
+            act = f"stall{self.stall_ms:g}"
+        elif self.action == "slow":
+            act = f"slow:{self.stall_ms:g}:{self.slow_prob:g}"
+        else:
+            act = self.action
         if self.prob:
             trig = f"@p{self.prob:g}"
         elif self.lo == 0:
@@ -115,6 +128,22 @@ def _parse_point(part: str) -> _Point:
                 f"fault point {part!r}: stall needs a millisecond "
                 "suffix (stall250)") from None
         pt.action = "stall"
+    elif action.startswith("slow"):
+        parts = action.split(":")
+        try:
+            if len(parts) != 3:
+                raise ValueError
+            pt.stall_ms = float(parts[1])
+            pt.slow_prob = float(parts[2])
+        except ValueError:
+            raise FaultSpecError(
+                f"fault point {part!r}: slow wants slow:<ms>:<p> "
+                "(slow:40:0.1)") from None
+        if pt.stall_ms <= 0 or not 0.0 < pt.slow_prob <= 1.0:
+            raise FaultSpecError(
+                f"fault point {part!r}: slow wants ms > 0 and "
+                "p in (0, 1]")
+        pt.action = "slow"
     elif action not in ("crash", "raise", "eagain"):
         raise FaultSpecError(
             f"fault point {part!r}: unknown action {action!r} "
@@ -197,6 +226,7 @@ def fault(site: str) -> None:
     pt = _PLAN.get(site)
     if pt is None:
         return
+    sleep_ms = pt.stall_ms
     with _LOCK:
         pt.hits += 1
         n = pt.hits
@@ -206,12 +236,19 @@ def fault(site: str) -> None:
             fire = pt.lo <= n <= pt.hi
         else:
             fire = True
+        if fire and pt.action == "slow":
+            # the slow action's own probability gates INSIDE any
+            # trigger window; `fired` counts actual added-latency
+            # events, and the jitter (50-100% of ms) shapes a tail
+            # instead of a fixed step
+            fire = _RNG.random() < pt.slow_prob
+            sleep_ms = pt.stall_ms * (0.5 + 0.5 * _RNG.random())
         if fire:
             pt.fired += 1
     if not fire:
         return
-    if pt.action == "stall":
-        time.sleep(pt.stall_ms / 1e3)
+    if pt.action in ("stall", "slow"):
+        time.sleep(sleep_ms / 1e3)
         return
     if pt.action == "crash":
         # unclean by design: no atexit, no finally, no flush — the

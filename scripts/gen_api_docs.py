@@ -162,6 +162,31 @@ chunk cadence.  Draft/verify counters ride the heartbeat
 (`sptpu_completer_spec_{draft,accepted,verified}_tokens`); the PR-5
 demotion floor still guards the lane (the swap lands at the next
 idle point of `run_continuous`).
+
+### Multi-tenant requests: tenant labels + deadline stamps (PR 10)
+
+The request label word carries a **tenant id in bits 48-51**
+(`protocol.TENANT_MASK`; `stamp_tenant`/`read_tenant`; ids 1-15, 0 =
+untagged) — daemons read every candidate's labels anyway, so tenant
+discovery is free, one tenant's waiting rows enumerate with a bloom
+prefilter, and the field survives the WAITING→SERVICING→READY
+trifecta for post-hoc attribution.  **`LBL_DEADLINE` (bit 52)** flags
+an absolute wall-clock deadline in the `__dl_<idx>` companion key
+(`stamp_deadline`/`read_deadline` — epoch-gated and self-invalidating
+like trace stamps; search requests may carry `{"deadline": ts}` in
+their request JSON instead).
+
+Every drain runs the shared admission policy (`engine/qos.py`:
+stride-scheduled weighted fairness, persistent across drains) BEFORE
+rendering anything: expired deadlines fail fast with a typed
+`{"err": "deadline_expired"}` record, saturation orders admission by
+tenant weight, and backlog past the queue high-water mark is shed
+with `{"err": "overloaded", "retry_after_ms": N}` — backpressure,
+never a wedge, and past the mark a typed answer, never silence.
+Client side, `engine/client.py::call_with_retries` (under
+`submit_search` / `submit_completion`) honors the hint with jittered
+backoff inside the caller's deadline.  Runbook:
+`docs/operations.md` §Multi-tenant QoS.
 """,
     "embedding-vector-lane": """
 ## Search daemon (`libsplinter_tpu/engine/searcher.py`)
@@ -366,6 +391,34 @@ section) and `spt metrics` renders everything flat as
 The searcher's `lane` section additionally counts the StagedLane's
 ring staging (`ring_dispatches` / `ring_chunks`: refresh scatter
 chunks coalesced into resident dispatches).
+
+### Multi-tenant QoS keys (`libsplinter_tpu/engine/qos.py`)
+
+Every lane heartbeat gains the overload-survival counters
+(`deadline_expired` / `shed` / `deferred`, flat `sptpu_<lane>_*`
+gauges) plus two optional sections:
+
+- `qos` — the live admission config: `admit_cap` (embedder/searcher;
+  0 = unlimited), `queue_high_water` (-1 = shedding disabled),
+  `retry_after_ms` (the hint shed responses carry).  Rendered flat as
+  `sptpu_<lane>_qos_*`.
+- `tenants` — the per-tenant ledger
+  `{"<tenant>": {"admitted": n, "shed": n, "deadline_expired": n,
+  "served_tokens": n}, ...}` (tenant ids 1-15 from the label word's
+  bits 48-51; untagged traffic does not create a section).  Rendered
+  as `sptpu_<lane>_tenant_<field>{tenant="..."}` — the incident view
+  of WHO is being served and WHO is being shed.
+
+The completer additionally publishes `bp_memo` — occupancy of the
+epoch-keyed join-backpressure memo, bounded by the heartbeat-cadence
+sweep (entries whose slot epoch moved or whose request label cleared
+are evicted; a hard 4096 cap backstops pathological stores).
+
+Deadline stamps ride `__dl_<idx>` companion keys (debug-labeled,
+flagged by `LBL_DEADLINE` on the request key, format
+`"<deadline_ts>:<slot_epoch>"` — the trace-stamp discipline: epoch
+self-invalidating, consumed at service, orphans shed).  Runbook:
+`docs/operations.md` §Multi-tenant QoS; harness: `spt loadgen`.
 """,
 }
 

@@ -1,0 +1,176 @@
+"""CPU fast gate for the multi-tenant QoS layer (`make qos-check`).
+
+The serving stack's overload-survival claims (engine/qos.py) are only
+claims until offered load actually exceeds capacity with the gate
+watching.  This check drives a real Searcher — the cheapest daemon to
+stand up, no model — through a saturated 10:1 two-tenant drill on CPU
+and asserts the acceptance properties:
+
+  - FAIRNESS: under sustained 10:1 offered-load skew at equal weights,
+    both tenants make progress and the starved tenant's admitted share
+    lands within 2x of its configured (equal) weight share;
+  - WEIGHTED FAIRNESS: a 3:1 weight split lands the admitted ratio
+    within 2x of 3:1;
+  - SHEDDING: past the queue high-water mark overflow is failed with
+    the typed {"err": "overloaded", "retry_after_ms": N} record —
+    never silent unbounded queueing — and a drained lane admits fresh
+    work again (shed-then-admit);
+  - DEADLINE: an already-expired request is failed fast with a typed
+    deadline_expired record instead of occupying a batch slot.
+
+Runs in a few seconds; tier-1 keeps the full pytest matrix
+(tests/test_qos.py), this is the standalone evidence `make check`
+prints.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import uuid
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _seed(store, n=8):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        v = rng.standard_normal(store.vec_dim).astype(np.float32)
+        store.set(f"doc{i}", f"doc {i}")
+        store.vec_set(f"doc{i}", v / np.linalg.norm(v))
+
+
+def _req(store, key, tenant, deadline=None):
+    import numpy as np
+
+    from libsplinter_tpu.engine import protocol as P
+    params = {"k": 3}
+    if deadline is not None:
+        params["deadline"] = deadline
+    store.set(key, json.dumps(params))
+    qv = np.zeros(store.vec_dim, np.float32)
+    qv[0] = 1.0
+    store.vec_set(key, qv)
+    if tenant:
+        P.stamp_tenant(store, key, tenant)
+    store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+    store.bump(key)
+
+
+def _result(store, key):
+    from libsplinter_tpu.engine import protocol as P
+    return json.loads(store.get(
+        P.search_result_key(store.find_index(key))).rstrip(b"\0"))
+
+
+def fairness_drill(weights, rounds=8, heavy=10, light=1,
+                   admit_cap=4) -> tuple[int, int]:
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.engine.searcher import Searcher
+
+    name = f"/spt-qoscheck-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    st = Store.create(name, nslots=512, max_val=2048, vec_dim=32)
+    try:
+        _seed(st)
+        sr = Searcher(st, admit_cap=admit_cap,
+                      tenant_weights=weights)
+        sr.attach()
+        for r in range(rounds):
+            for j in range(heavy):
+                _req(st, f"h{r}-{j}", 1)
+            for j in range(light):
+                _req(st, f"l{r}-{j}", 2)
+            sr.run_once()
+        # drain the tail so "admitted" reflects steady-state shares,
+        # not one final burst
+        return (sr.tenants.get(1, "admitted"),
+                sr.tenants.get(2, "admitted"))
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def shed_and_deadline_drill() -> dict:
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.searcher import Searcher
+
+    name = f"/spt-qoscheck-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    st = Store.create(name, nslots=512, max_val=2048, vec_dim=32)
+    try:
+        _seed(st)
+        sr = Searcher(st, admit_cap=2, queue_high_water=1,
+                      retry_after_ms=150)
+        sr.attach()
+        _req(st, "expired", 1, deadline=time.time() - 1.0)
+        for i in range(6):
+            _req(st, f"q{i}", 1)
+        sr.run_once()
+        shed = [i for i in range(6)
+                if not st.labels(f"q{i}") & P.LBL_SEARCH_REQ
+                and _result(st, f"q{i}").get("err") == "overloaded"]
+        hints = {_result(st, f"q{i}").get("retry_after_ms")
+                 for i in shed}
+        # drain the deferred backlog, then fresh work must admit
+        for _ in range(4):
+            sr.run_once()
+        _req(st, "fresh", 2)
+        sr.run_once()
+        return {
+            "deadline_expired": sr.stats.deadline_expired,
+            "expired_typed": _result(st, "expired").get("err"),
+            "shed": len(shed),
+            "retry_after_ms": sorted(hints),
+            "fresh_admitted": "err" not in _result(st, "fresh"),
+        }
+    finally:
+        st.close()
+        Store.unlink(name)
+
+
+def main() -> int:
+    h_eq, l_eq = fairness_drill(None)
+    # equal weights, 10:1 offered load: the light tenant's whole
+    # offered load (8 rounds x 1) fits under half the admitted
+    # capacity — it must ALL land, within 2x of the equal share
+    print(f"fairness equal-weights: heavy={h_eq} light={l_eq}")
+    if l_eq == 0 or h_eq == 0:
+        print("FAIL: a tenant starved outright")
+        return 1
+    if l_eq < 8:
+        print(f"FAIL: light tenant served {l_eq}/8 offered under "
+              "equal weights")
+        return 1
+
+    h_w, l_w = fairness_drill({1: 3.0, 2: 1.0}, heavy=10, light=10,
+                              admit_cap=4)
+    ratio = h_w / max(l_w, 1)
+    print(f"fairness 3:1 weights (both saturating): heavy={h_w} "
+          f"light={l_w} ratio={ratio:.2f}")
+    if not (1.5 <= ratio <= 6.0):
+        print("FAIL: weighted share outside 2x of the 3:1 config")
+        return 1
+
+    shed = shed_and_deadline_drill()
+    print(f"shed/deadline: {json.dumps(shed)}")
+    if shed["deadline_expired"] != 1 \
+            or shed["expired_typed"] != "deadline_expired":
+        print("FAIL: expired request not fast-failed typed")
+        return 1
+    if shed["shed"] != 3 or shed["retry_after_ms"] != [150]:
+        print("FAIL: high-water shed not typed overloaded + hint")
+        return 1
+    if not shed["fresh_admitted"]:
+        print("FAIL: lane did not admit fresh work after draining")
+        return 1
+    print("qos-check OK: fairness within 2x of weights, typed "
+          "shedding with retry_after_ms, deadline fast-fail")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

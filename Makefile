@@ -44,6 +44,16 @@
 #                   real searcher (scripts/qos_fairness_check.py) +
 #                   the `tests/test_qos.py` fast tier (admission
 #                   policy units, all three lanes, loadgen smoke)
+#   make lint-check  splint static-analysis tier (pure stdlib ast,
+#                   no jax, no native build needed): protocol-
+#                   registry sync rules (label-bit collisions, raw
+#                   bit literals, fault-site catalog + chaos
+#                   reachability, metrics/heartbeat sync, generated
+#                   doc tables) + JAX dispatch-hazard rules (host
+#                   syncs in drain loops, donated-buffer reuse,
+#                   missing out_shardings pins, unseeded fault-path
+#                   randomness), then the splint test tier.
+#                   Non-zero exit on any unsuppressed finding.
 #   make quant-check  quantized-KV tier (fast, CPU): int8-vs-f32
 #                   ragged paged-attention parity (interpret mode),
 #                   multi-query verify stack, quantize-on-commit /
@@ -82,6 +92,7 @@ quick: native
 # `-m obs` group — the full pytest sweep below collects their tiers too
 check: native
 	$(MAKE) -C native check
+	$(PY) scripts/splint_check.py
 	$(PY) scripts/obs_overhead_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
@@ -117,6 +128,12 @@ quant-check: native
 		-m "not slow"
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
 
+# no `native` dep: splint is stdlib-ast only and must be runnable
+# before (or without) any build step — the cheapest pre-commit gate
+lint-check:
+	$(PY) scripts/splint_check.py
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_splint.py -q
+
 qos-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_qos.py -q \
 		-m "not slow and not chaos"
@@ -134,4 +151,4 @@ clean:
 
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check qos-check \
-	memcheck bench-cpu clean
+	lint-check memcheck bench-cpu clean

@@ -1,0 +1,26 @@
+"""splint — the repo-native static-analysis suite.
+
+Two rule families guard the invariants the serving stack's
+correctness rests on:
+
+- **Registry-sync (SPL1xx)** — `engine/protocol.py` parsed into a
+  canonical registry (label bits, stage tuples, well-known keys) plus
+  the discovered `fault()` sites; rules assert no label-bit
+  collisions, no raw bit literals outside protocol.py, every fault
+  site documented + chaos-reachable, `spt metrics` in sync with the
+  published heartbeat keys, and the generated doc tables derived
+  from (never parallel to) the registry.
+- **JAX dispatch hazards (SPL2xx)** — no blocking host sync inside a
+  drain loop, no donated-buffer use after the donating call, no
+  pool-feeding jit program without an `out_shardings` pin, no
+  unseeded randomness in fault paths.
+
+Entry points: `spt lint` (cli/lint.py), `scripts/splint_check.py`
+(the CI gate, `make lint-check`), `runner.scan()` (in-process).
+Everything under `analysis/` is stdlib-only (`ast`) — no jax, no
+native lib — so the gate runs anywhere the repo checks out.
+"""
+from .core import Finding, RULES, Rule                   # noqa: F401
+from .registry import (ProtocolRegistry, extract_registry,   # noqa: F401
+                       fault_sites, FAULT_SITE_DOCS)
+from .runner import Report, build_context, scan, update_baseline  # noqa: F401,E501

@@ -1,0 +1,43 @@
+"""THE standalone loader for the splint analysis package.
+
+`scripts/splint_check.py`, `scripts/gen_api_docs.py`, and
+`tests/test_splint.py` all need `libsplinter_tpu.analysis` WITHOUT
+importing `libsplinter_tpu` itself (whose __init__ loads the native
+.so) — this module owns the one tricky bit (package spec with
+`submodule_search_locations` + sys.modules registration, so the
+package's relative imports resolve) instead of three drifting
+copies.  Load THIS file with a plain single-module
+`spec_from_file_location`, then call `load()`:
+
+    spec = importlib.util.spec_from_file_location(
+        "_splint_load", "<repo>/libsplinter_tpu/analysis/_load.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    splint = m.load()
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+PKG_NAME = "_splint_analysis"
+
+
+def load(name: str = PKG_NAME):
+    """Load the analysis package standalone (idempotent per name)."""
+    if name in sys.modules:
+        return sys.modules[name]
+    pkgdir = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkgdir, "__init__.py"),
+        submodule_search_locations=[pkgdir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def submodule(splint, leaf: str):
+    """A loaded package's submodule (e.g. ``submodule(m, "core")``)."""
+    return sys.modules[f"{splint.__name__}.{leaf}"]

@@ -1,0 +1,365 @@
+"""JAX dispatch-hazard rules (SPL2xx).
+
+The perf work of PRs 7-9 rests on *absences*: no blocking host sync
+inside a drain loop (the 63 ms dispatch floor PR 7 removed began life
+as exactly one inline `device_get`), no read of a donated buffer
+after the donating call (silent garbage under XLA aliasing), no
+pool-feeding jit program without an `out_shardings` pin (the PR 8
+silent-recompile class), and no unseeded randomness inside fault
+paths (`SPTPU_FAULT_SEED` determinism).  These rules encode the
+absences so the next refactor cannot quietly reintroduce them.
+
+All checks are AST heuristics tuned for this codebase's idioms; a
+justified inline suppression (see core.py) is the designed escape
+for the intentional cases (e.g. the continuous lane's documented
+host `sample` stage).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, rule
+
+# drain/run-loop function names whose bodies must not block on device
+DRAIN_FN_NAMES = {"run_once", "run_continuous", "_service"}
+DRAIN_FN_PREFIXES = ("_dispatch_",)
+
+
+def _is_drain_fn(name: str) -> bool:
+    return name in DRAIN_FN_NAMES or \
+        any(name.startswith(p) for p in DRAIN_FN_PREFIXES)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression (`jax.device_get`,
+    `self._ring_fn`); '' when it isn't a plain name chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _iter_drain_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _is_drain_fn(node.name):
+            yield node
+
+
+# --- SPL201: blocking host sync in a drain loop ---------------------------
+
+_NP_ROOTS = {"np", "numpy", "jnp"}
+
+
+@rule("SPL201", "dispatch", "blocking host sync inside a drain loop",
+      "`device_get` / `.block_until_ready()` / `np.asarray(<fresh "
+      "compute>)` / `float|int(<fresh compute>)` inside "
+      "run_once/run_continuous/_service/_dispatch_* blocks the lane "
+      "on the device — the dispatch-floor bug class PR 1/PR 7 "
+      "removed")
+def check_host_sync_in_drain(ctx: Context) -> list[Finding]:
+    out = []
+    for rel, sf in ctx.engine_files():
+        for fn in _iter_drain_functions(sf.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func)
+                if name.endswith("device_get"):
+                    out.append(Finding(
+                        rel, node.lineno, "SPL201",
+                        f"blocking jax.device_get in {fn.name}() — "
+                        f"resolve through the inflight window / "
+                        f"pending-future path instead"))
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "block_until_ready":
+                    out.append(Finding(
+                        rel, node.lineno, "SPL201",
+                        f"block_until_ready() in {fn.name}() stalls "
+                        f"the drain on the device"))
+                    continue
+                # np.asarray(<call>) — materializing a fresh compute
+                # result is a hidden device->host fence
+                if name.split(".")[0] in _NP_ROOTS and \
+                        name.endswith(("asarray", "array")) and \
+                        node.args and \
+                        isinstance(node.args[0], ast.Call):
+                    inner = _dotted(node.args[0].func)
+                    if inner.split(".")[0] not in _NP_ROOTS:
+                        out.append(Finding(
+                            rel, node.lineno, "SPL201",
+                            f"np.asarray({inner or 'call'}(...)) in "
+                            f"{fn.name}() forces the result to host "
+                            f"— a blocking fetch on the drain path"))
+                    continue
+                # float(<call>) / int(<call>) — scalar coercion of a
+                # fresh result is a one-element device fetch
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ("float", "int") and \
+                        node.args and \
+                        isinstance(node.args[0], ast.Call):
+                    inner = _dotted(node.args[0].func)
+                    root = inner.split(".")[0]
+                    if root not in _NP_ROOTS | {"len", "time", "os",
+                                                "round", "min", "max"}:
+                        out.append(Finding(
+                            rel, node.lineno, "SPL201",
+                            f"{node.func.id}({inner or 'call'}(...))"
+                            f" in {fn.name}() synchronously fetches "
+                            f"a device scalar on the drain path"))
+    return out
+
+
+# --- SPL202: donated buffer used after the donating call ------------------
+
+
+def _donated_argnums(call: ast.Call) -> tuple[int, ...] | None:
+    """`jax.jit(f, donate_argnums=...)` -> the donated positions."""
+    if _dotted(call.func) not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and \
+                    isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                idx = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, int):
+                        idx.append(e.value)
+                return tuple(idx)
+    return None
+
+
+def _assigned_names(target: ast.AST) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out.extend(_assigned_names(e))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    return []
+
+
+@rule("SPL202", "dispatch", "donated buffer read after donation",
+      "an argument passed at a `donate_argnums` position is dead "
+      "after the call — XLA may alias its memory into the outputs; "
+      "a later read sees garbage")
+def check_donated_reuse(ctx: Context) -> list[Finding]:
+    out = []
+    for rel, sf in ctx.engine_files():
+        # pass 1: which local names / attributes are jit-with-donation
+        donators: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                d = _donated_argnums(node.value)
+                if d:
+                    for t in node.targets:
+                        nm = _dotted(t)
+                        if nm:
+                            donators[nm] = d
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and \
+                            _dotted(dec.func) in (
+                                "functools.partial", "partial") \
+                            and dec.args \
+                            and _dotted(dec.args[0]
+                                        ) in ("jax.jit", "jit"):
+                        d = _donated_argnums(ast.Call(
+                            func=dec.args[0], args=[],
+                            keywords=dec.keywords))
+                        if d:
+                            donators[node.name] = d
+        if not donators:
+            continue
+        # pass 2: per function, a line-ordered event scan — a name
+        # donated at line L is dead until rebound; any Load past L
+        # flags.  Line granularity (not full CFG) is deliberately
+        # conservative: `cache, out = fn(..., cache, ...)` rebinds on
+        # the donating line itself and stays clean.
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            events = []               # (lineno, prio, kind, name)
+            donated_arg_nodes = set()  # the donating call's own args:
+            for node in ast.walk(fn):  # their loads are pre-donation
+                if isinstance(node, ast.Call):
+                    d = donators.get(_dotted(node.func))
+                    if d:
+                        for i in d:
+                            if i < len(node.args) and isinstance(
+                                    node.args[i], ast.Name):
+                                donated_arg_nodes.add(
+                                    id(node.args[i]))
+                                events.append((node.lineno, 1,
+                                               "donate",
+                                               node.args[i].id))
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for nm in _assigned_names(t):
+                            events.append((node.lineno, 2, "bind",
+                                           nm))
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        id(node) not in donated_arg_nodes:
+                    events.append((node.lineno, 0, "load", node.id))
+            # per line: loads first (they read pre-line state), then
+            # the donation, then the binding of the call's results
+            events.sort(key=lambda e: (e[0], e[1]))
+            dead: dict[str, int] = {}
+            for lineno, _, kind, nm in events:
+                if kind == "bind":
+                    dead.pop(nm, None)
+                elif kind == "donate":
+                    dead[nm] = lineno
+                elif kind == "load" and nm in dead and \
+                        lineno > dead[nm]:
+                    # no line number in the message: baseline
+                    # fingerprints must survive unrelated edits
+                    out.append(Finding(
+                        rel, lineno, "SPL202",
+                        f"{nm!r} was donated to a jit program "
+                        f"earlier in {fn.name}() — this read may "
+                        f"see aliased garbage; rebind the result "
+                        f"or drop the donation"))
+                    dead.pop(nm)      # one report per donation
+    return out
+
+
+# --- SPL203: pool-feeding jit without out_shardings -----------------------
+
+_POOL_TOKENS = {"k_pools", "v_pools", "k_scales", "v_scales"}
+
+
+def _mentions_pool(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _POOL_TOKENS:
+            return True
+        if isinstance(node, ast.Name) and node.id in _POOL_TOKENS:
+            return True
+    return False
+
+
+def _scope_mentions_out_shardings(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and \
+                node.value == "out_shardings":
+            return True
+        if isinstance(node, ast.keyword) and \
+                node.arg == "out_shardings":
+            return True
+        if isinstance(node, ast.Attribute) and \
+                "out_shardings" in node.attr:
+            return True
+        if isinstance(node, ast.Name) and \
+                "out_shardings" in node.id:
+            return True
+    return False
+
+
+@rule("SPL203", "dispatch", "paged-pool jit program without an "
+      "out_shardings pin",
+      "a jit program that returns KV pool buffers must pin "
+      "`out_shardings` to the pool sharding — without the pin the "
+      "first serve-time call after warmup recompiles silently under "
+      "GSPMD (the PR 8 class)")
+def check_jit_out_shardings(ctx: Context) -> list[Finding]:
+    out = []
+    for rel, sf in ctx.engine_files():
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if not _mentions_pool(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _dotted(node.func) not in ("jax.jit", "jit"):
+                    continue
+                kwargs = {kw.arg for kw in node.keywords}
+                if "out_shardings" in kwargs:
+                    continue
+                if None in kwargs and \
+                        _scope_mentions_out_shardings(fn):
+                    continue          # the `**kw` pin idiom
+                out.append(Finding(
+                    rel, node.lineno, "SPL203",
+                    f"jax.jit in {fn.name}() touches the paged pool "
+                    f"but pins no out_shardings — sharded serving "
+                    f"will recompile on the first post-warmup call"))
+    # nested defs make the same jit call visible from every enclosing
+    # pool-touching scope — report each call site once
+    seen: set[tuple] = set()
+    uniq = []
+    for f in out:
+        k = (f.file, f.line)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(f)
+    return uniq
+
+
+# --- SPL204: unseeded randomness in fault paths ---------------------------
+
+
+def _calls_fault(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            nm = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if nm == "fault":
+                return True
+    return False
+
+
+@rule("SPL204", "dispatch", "unseeded randomness in a fault path",
+      "functions containing a `fault()` site must not draw from the "
+      "global `random` / `np.random` module RNG — chaos drills are "
+      "deterministic under SPTPU_FAULT_SEED only if every draw "
+      "comes from the seeded instance")
+def check_fault_path_nondeterminism(ctx: Context) -> list[Finding]:
+    out = []
+    for rel, sf in ctx.engine_files():
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if not _calls_fault(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func)
+                if name.startswith("random.") and \
+                        name != "random.Random":
+                    out.append(Finding(
+                        rel, node.lineno, "SPL204",
+                        f"{name}() in {fn.name}() draws from the "
+                        f"global RNG inside a fault path — use the "
+                        f"seeded instance (SPTPU_FAULT_SEED "
+                        f"determinism)"))
+                elif name.startswith("np.random.") or \
+                        name.startswith("numpy.random."):
+                    out.append(Finding(
+                        rel, node.lineno, "SPL204",
+                        f"{name}() in {fn.name}() draws from the "
+                        f"global numpy RNG inside a fault path — "
+                        f"use a seeded Generator"))
+    return out

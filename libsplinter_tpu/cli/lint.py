@@ -1,0 +1,82 @@
+"""`spt lint` — splint, the repo-native static-analysis suite.
+
+Runs the registry-sync (SPL1xx) and JAX dispatch-hazard (SPL2xx)
+rule families over `libsplinter_tpu/` + `scripts/` and reports
+`file:line · RULE_ID · message`.  Exit 1 on any unsuppressed,
+unbaselined finding — the same contract as the CI gate
+(`scripts/splint_check.py`, `make lint-check`).
+
+The analysis layer is stdlib-only (`ast`): no store is opened, no
+jax is imported; `spt lint` is safe on a box with daemons holding
+the accelerator.  Runbook: docs/operations.md §Static analysis.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .main import CliError, command
+
+
+def _repo_root() -> str:
+    from ..analysis import registry as R
+    return R.REPO_ROOT
+
+
+@command("lint",
+         "lint [--rules SPL1,SPL2] [--no-baseline] [--write-baseline]",
+         "splint static analysis: protocol-registry sync + JAX "
+         "dispatch-hazard rules (exit 1 on findings)")
+def cmd_lint(ses, args):
+    from ..analysis import runner
+
+    root = _repo_root()
+    rule_ids = None
+    use_baseline = True
+    write = False
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--rules" and i + 1 < len(args):
+            rule_ids = [r.strip() for r in args[i + 1].split(",")
+                        if r.strip()]
+            i += 2
+        elif a == "--no-baseline":
+            use_baseline = False
+            i += 1
+        elif a == "--write-baseline":
+            write = True
+            i += 1
+        elif a == "--root" and i + 1 < len(args):
+            root = args[i + 1]
+            i += 2
+        else:
+            raise CliError(f"unknown lint argument {a!r} (usage: "
+                           f"{'lint [--rules IDS] [--no-baseline] '}"
+                           f"[--write-baseline] [--root DIR])")
+    if write:
+        if rule_ids or not use_baseline:
+            # a baseline written under a rule filter would silently
+            # absorb findings from rules the user never reviewed
+            raise CliError("--write-baseline takes no other flags: "
+                           "it re-scans with EVERY rule")
+        try:
+            path = runner.update_baseline(root)
+        except ValueError as ex:       # engine-layer findings
+            raise CliError(str(ex)) from None
+        rel = os.path.relpath(path, root)
+        print(f"baseline written: {rel}")
+        return
+    try:
+        rep = runner.scan(root, use_baseline=use_baseline,
+                          rule_ids=rule_ids)
+    except ValueError as ex:           # unknown --rules selection
+        raise CliError(str(ex)) from None
+    print(rep.render())
+    for f, sup in rep.suppressed:
+        print(f"  suppressed: {f.render()}  "
+              f"[reason={sup.reason}]", file=sys.stderr)
+    if not rep.clean:
+        raise CliError(
+            f"{len(rep.findings) + len(rep.parse_errors)} "
+            f"unsuppressed splint finding(s)")

@@ -658,6 +658,7 @@ from .script import cmd_lua, cmd_wasm  # noqa: E402
 from .metrics import cmd_metrics, cmd_trace  # noqa: E402
 from .supervise import cmd_supervise  # noqa: E402
 from .loadgen import cmd_loadgen  # noqa: E402
+from .lint import cmd_lint  # noqa: E402
 
 
 # ------------------------------------------------------------------- REPL

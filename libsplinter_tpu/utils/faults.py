@@ -207,6 +207,25 @@ def armed() -> bool:
     return bool(_PLAN)
 
 
+def registered_sites(spec: str | None = None) -> tuple[str, ...]:
+    """The compiled site table: with `spec` given, parse it through
+    THE grammar (`_parse_point`) and return its site names in spec
+    order; with no argument, the currently armed plan's sites.
+
+    This is the one spec-parsing entry point external tooling and
+    the chaos tests share (tests/test_crash_recovery.py validates
+    every drill's spec through it) instead of re-deriving the
+    `<site>:<action>@<trigger>` grammar with their own regexes, so a
+    grammar change cannot silently strand them on an older dialect.
+    Raises FaultSpecError exactly like arm() would: a drill asserting
+    against a typo'd spec must fail at parse, not match nothing."""
+    if spec is None:
+        with _LOCK:
+            return tuple(_PLAN)
+    return tuple(_parse_point(part.strip()).site
+                 for part in spec.split(",") if part.strip())
+
+
 def stats() -> dict:
     """{site: {"spec": ..., "hits": n, "fired": n}} — rides the daemon
     heartbeats when armed, so `spt metrics` shows which fault points a

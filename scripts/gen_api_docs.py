@@ -7,16 +7,41 @@ them into browsable markdown so they cannot drift apart:
 `tests/test_api_docs.py` regenerates into a temp dir and fails when the
 committed pages differ.
 
-Usage: python scripts/gen_api_docs.py [outdir]   (default docs/api)
+Since PR 11 it also renders the splint-registry-derived tables: the
+label-bit map (into the bloom-labels appendix, from
+`engine/protocol.py` via `libsplinter_tpu/analysis/registry.py`) and
+the fault-point catalog + splint rule catalog (into the marked
+regions of `docs/operations.md`).  Those tables are DERIVED, never
+hand-edited — splint rule SPL106 and the doc-sync tests fail on
+drift.
+
+Usage: python scripts/gen_api_docs.py [outdir]   (default docs/api;
+the default run also refreshes docs/operations.md's marked regions)
 """
 from __future__ import annotations
 
+import importlib.util
 import os
 import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HEADER = os.path.join(REPO, "native", "include", "sptpu.h")
+OPERATIONS_MD = os.path.join(REPO, "docs", "operations.md")
+
+
+def load_splint():
+    """Load libsplinter_tpu/analysis as a standalone package, WITHOUT
+    importing libsplinter_tpu itself (whose __init__ needs the built
+    native .so) — the analysis layer is stdlib-only by contract.
+    The package-loading trick lives in analysis/_load.py (shared with
+    scripts/splint_check.py and tests/test_splint.py)."""
+    spec = importlib.util.spec_from_file_location(
+        "_splint_load", os.path.join(
+            REPO, "libsplinter_tpu", "analysis", "_load.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load()
 
 _SECTION_RE = re.compile(r"^/\* -{3,}\s*(.+?)\s*-*\s*(?:\*/)?\s*$")
 _PROTO_START = re.compile(
@@ -63,6 +88,22 @@ class Section:
 # tests/test_api_docs.py's sync check covers them too.
 _APPENDICES = {
     "bloom-labels": """
+## Label-bit map (`libsplinter_tpu/engine/protocol.py`)
+
+The Python engine's bloom-label word, one row per constant — bit
+positions, masks, and meanings extracted STATICALLY from
+`engine/protocol.py` by the splint registry
+(`libsplinter_tpu/analysis/registry.py`), so this table cannot drift
+from the code: splint rule SPL101 fails any bit collision, SPL106
+fails a stale table, and `make lint-check` gates both.
+
+__SPLINT_LABEL_TABLE__
+
+Bits 48-51 form the tenant-id *field* (`TENANT_MASK`); every other
+row is a single-purpose flag.  Raw use of any of these bit values
+outside `protocol.py` is splint violation SPL102 — always spell them
+via the `protocol.LBL_*` / `BIT_*` constants.
+
 ## Paged KV cache + ragged paged attention (`models/decoder.py`, `ops/paged_attention.py`)
 
 The completion lane behind `LBL_INFER_REQ` serves continuous batching
@@ -598,6 +639,12 @@ def render(outdir: str) -> list[str]:
                 page.append("")
         extra = _APPENDICES.get(sec.slug)
         if extra:
+            if "__SPLINT_LABEL_TABLE__" in extra:
+                splint = load_splint()
+                extra = extra.replace(
+                    "__SPLINT_LABEL_TABLE__",
+                    splint.registry.render_label_table(
+                        splint.extract_registry()))
             page.append(extra.strip())
             page.append("")
         path = os.path.join(outdir, f"{sec.slug}.md")
@@ -611,8 +658,33 @@ def render(outdir: str) -> list[str]:
     return written
 
 
+def sync_operations(path: str = OPERATIONS_MD) -> None:
+    """Refresh docs/operations.md's generated regions in place: the
+    fault-point catalog (from the discovered `fault()` sites +
+    FAULT_SITE_DOCS) and the splint rule catalog (from the rule
+    registry).  Markers missing -> loud failure, never a silent
+    stop."""
+    splint = load_splint()
+    R, core = splint.registry, sys.modules[splint.__name__ + ".core"]
+    with open(path) as f:
+        text = f.read()
+    text = R.replace_marked_region(
+        text, R.OPERATIONS_BEGIN, R.OPERATIONS_END,
+        R.render_fault_table())
+    text = R.replace_marked_region(
+        text, core.RULES_BEGIN, core.RULES_END,
+        core.render_rule_table())
+    with open(path, "w") as f:
+        f.write(text)
+
+
 if __name__ == "__main__":
-    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        REPO, "docs", "api")
-    files = render(out)
-    print(f"wrote {len(files)} pages to {out}")
+    out = sys.argv[1] if len(sys.argv) > 1 else None
+    files = render(out or os.path.join(REPO, "docs", "api"))
+    print(f"wrote {len(files)} pages to {out or 'docs/api'}")
+    if out is None:
+        # the default run also refreshes the generated operations.md
+        # regions; an explicit outdir (the doc-sync test's tmp dir)
+        # must never touch the committed runbook
+        sync_operations()
+        print("refreshed docs/operations.md generated regions")

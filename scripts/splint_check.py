@@ -1,0 +1,47 @@
+"""CI gate for splint, the repo-native static-analysis suite
+(`make lint-check`; wired into `make check`).
+
+Runs every cataloged rule over `libsplinter_tpu/` + `scripts/` and
+exits non-zero on any unsuppressed, unbaselined finding — report
+format `file:line · RULE_ID · message`, same as `spt lint`.
+
+Loads `libsplinter_tpu/analysis` by path WITHOUT importing the
+package (whose __init__ needs the built native .so): the gate is
+stdlib-only and runs before any build step.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_splint():
+    spec = importlib.util.spec_from_file_location(
+        "_splint_load", os.path.join(
+            REPO, "libsplinter_tpu", "analysis", "_load.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load()
+
+
+def main() -> int:
+    splint = load_splint()
+    rep = splint.scan(REPO)
+    print(rep.render())
+    for f, sup in rep.suppressed:
+        print(f"  suppressed: {f.render()}  [reason={sup.reason}]")
+    if not rep.clean:
+        print("splint_check: FAIL — fix the findings above, add a "
+              "justified inline suppression, or (outside the engine "
+              "layer) baseline them (spt lint --write-baseline)",
+              file=sys.stderr)
+        return 1
+    print("splint_check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,3 +68,63 @@ def test_index_links_resolve():
     for m in re.finditer(r"\]\(([a-z0-9-]+\.md)\)", idx):
         assert os.path.exists(os.path.join(DOCS, m.group(1))), \
             f"index links to missing page {m.group(1)}"
+
+
+# --- splint-registry-derived tables (PR 11) ---------------------------
+# The label-bit table (bloom-labels appendix) and the operations.md
+# fault-point + rule catalogs are GENERATED from the splint registry
+# (libsplinter_tpu/analysis).  The byte-sync test above already pins
+# docs/api; these pin the operations.md marked regions, which live
+# outside the regenerated page set.
+
+def _load_gen_api_docs():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_gen_api_docs_test",
+        os.path.join(ROOT, "scripts", "gen_api_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_label_bit_table_derived_from_registry():
+    gen = _load_gen_api_docs()
+    splint = gen.load_splint()
+    table = splint.registry.render_label_table(
+        splint.extract_registry())
+    with open(os.path.join(DOCS, "bloom-labels.md")) as f:
+        page = f.read()
+    assert table in page, (
+        "bloom-labels label-bit table stale vs protocol.py — run "
+        "scripts/gen_api_docs.py")
+    # every live LBL_ constant has a row
+    for name in splint.extract_registry().labels:
+        assert f"`{name}`" in table
+
+
+def test_operations_fault_catalog_derived_from_sites():
+    gen = _load_gen_api_docs()
+    splint = gen.load_splint()
+    table = splint.registry.render_fault_table(root=ROOT)
+    with open(os.path.join(ROOT, "docs", "operations.md")) as f:
+        ops = f.read()
+    assert splint.registry.OPERATIONS_BEGIN in ops
+    assert table in ops, (
+        "operations.md fault catalog stale vs the instrumented "
+        "sites — run scripts/gen_api_docs.py")
+    # every discovered fault() call site has a row
+    for site in {s.site for s in splint.fault_sites(ROOT)}:
+        assert f"`{site}`" in table
+
+
+def test_operations_rule_catalog_derived_from_registry():
+    gen = _load_gen_api_docs()
+    splint = gen.load_splint()
+    import sys as _sys
+    core = _sys.modules[splint.__name__ + ".core"]
+    with open(os.path.join(ROOT, "docs", "operations.md")) as f:
+        ops = f.read()
+    assert core.RULES_BEGIN in ops
+    assert core.render_rule_table() in ops, (
+        "operations.md splint rule catalog stale — run "
+        "scripts/gen_api_docs.py")

@@ -30,6 +30,7 @@ from libsplinter_tpu import Store, T_VARTEXT
 from libsplinter_tpu.engine import protocol as P
 from libsplinter_tpu.engine.searcher import (Searcher, consume_result,
                                              submit_search)
+from libsplinter_tpu.utils import faults
 from libsplinter_tpu.utils.faults import CRASH_EXIT_CODE
 
 pytestmark = pytest.mark.chaos
@@ -63,6 +64,11 @@ def cstore():
 
 def _run_child(role: str, store_name: str, fault_spec: str,
                timeout: float = 120.0):
+    # validate the drill's spec through THE grammar entry point
+    # (utils/faults.registered_sites) before spawning: a typo'd spec
+    # must fail the test at parse time, not silently arm nothing and
+    # let the child "survive" a fault that never existed
+    assert faults.registered_sites(fault_spec)
     env = dict(os.environ)
     env["SPTPU_FAULT"] = fault_spec
     env["JAX_PLATFORMS"] = "cpu"

@@ -42,6 +42,23 @@ def test_parse_full_spec():
     assert s["completer.commit"]["spec"] == "completer.commit:stall250@2-4"
 
 
+def test_registered_sites_shares_the_grammar():
+    """registered_sites() is the spec-grammar entry point splint and
+    the chaos drills share: spec -> site names in spec order, armed
+    plan by default, and a typo fails at parse like arm() would."""
+    assert faults.registered_sites(
+        "searcher.commit:crash@3, embedder.encode:raise@p0.1,"
+        "completer.commit:stall250@2-4") == (
+        "searcher.commit", "embedder.encode", "completer.commit")
+    assert faults.registered_sites("") == ()
+    faults.arm("store.set:eagain")
+    assert faults.registered_sites() == ("store.set",)
+    faults.disarm()
+    assert faults.registered_sites() == ()
+    with pytest.raises(FaultSpecError):
+        faults.registered_sites("store.set-eagain")
+
+
 def test_parse_rejects_garbage():
     for bad in ("nosite", "a.b:explode", "a.b:raise@p7", "a.b:crash@0",
                 "a.b:crash@5-2", "a.b:stallfast", "a.b:raise@x"):

@@ -441,6 +441,29 @@ def test_submit_search_repulses_once_at_half_deadline(store):
     assert bumps.count("__sqtmp_rp") == 2   # initial + ONE re-pulse
 
 
+def test_sweep_fault_site_contained(store):
+    """`searcher.sweep` chaos reachability (splint SPL104): an
+    injected raise fires out of sweep_results itself; in production
+    the run loop's cycle firewall absorbs it (drain_faults) and the
+    next heartbeat cadence retries — here we pin that the site is
+    live and that the sweep runs clean once the hit window passes."""
+    from libsplinter_tpu.utils import faults
+
+    rng = np.random.default_rng(23)
+    _fill_docs(store, 4, rng)
+    sr = Searcher(store)
+    sr.attach()
+    faults.arm("searcher.sweep:raise@1")
+    try:
+        assert faults.registered_sites() == ("searcher.sweep",)
+        with pytest.raises(faults.FaultInjected):
+            sr.sweep_results()
+        assert sr.sweep_results() == 0   # window passed: clean sweep
+        assert faults.stats()["searcher.sweep"]["fired"] == 1
+    finally:
+        faults.disarm()
+
+
 def test_result_ttl_sweep_reaps_orphans(store):
     """A client that times out never consumes its __sr_ row; the
     periodic sweep retires rows past the TTL and rows whose request

@@ -234,6 +234,28 @@ def test_supervisor_heartbeat_and_metrics_exposition(sstore):
         sup.shutdown()
 
 
+def test_poll_fault_site_live_and_survivable(sstore):
+    """`supervisor.poll` chaos reachability (splint SPL104): the
+    supervision-step fault site raises out of poll_once on its hit
+    window — run()'s step firewall is the production containment —
+    and the step after the window supervises normally."""
+    from libsplinter_tpu.utils import faults
+
+    sup = Supervisor(sstore.name, lanes=("searcher",),
+                     spawn_fn=_sleeper(), store=sstore)
+    faults.arm("supervisor.poll:raise@1")
+    try:
+        assert faults.registered_sites() == ("supervisor.poll",)
+        with pytest.raises(faults.FaultInjected):
+            sup.poll_once()
+        sup.poll_once()                  # window passed: step runs
+        assert sup.polls == 1
+        assert sup.lanes["searcher"].proc is not None
+    finally:
+        faults.disarm()
+        sup.shutdown()
+
+
 def test_unknown_lane_rejected(sstore):
     with pytest.raises(ValueError):
         Supervisor(sstore.name, lanes=("warp-drive",), store=sstore)

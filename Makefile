@@ -44,6 +44,13 @@
 #                   real searcher (scripts/qos_fairness_check.py) +
 #                   the `tests/test_qos.py` fast tier (admission
 #                   policy units, all three lanes, loadgen smoke)
+#   make pipeline-check  pipeline-lane tier (fast, CPU): sandbox
+#                   containment (hostile scripts die typed while
+#                   siblings complete), scripted-chain end-to-end
+#                   parity, and the script-vs-client-chaining latency
+#                   smoke (stored-script rag-churn p50 >= 30% below
+#                   the client-side chain;
+#                   scripts/pipeline_latency_check.py)
 #   make lint-check  splint static-analysis tier (pure stdlib ast,
 #                   no jax, no native build needed): protocol-
 #                   registry sync rules (label-bit collisions, raw
@@ -97,6 +104,7 @@ check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/dispatch_amortization_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/pipeline_latency_check.py
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -139,6 +147,11 @@ qos-check: native
 		-m "not slow and not chaos"
 	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
 
+pipeline-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pipeliner.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/pipeline_latency_check.py
+
 memcheck: native
 	$(MAKE) -C native memcheck
 
@@ -151,4 +164,4 @@ clean:
 
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check qos-check \
-	lint-check memcheck bench-cpu clean
+	pipeline-check lint-check memcheck bench-cpu clean

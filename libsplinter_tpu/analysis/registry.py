@@ -93,6 +93,17 @@ FAULT_SITE_DOCS: dict[str, str] = {
         "device wedged INSIDE a resident program — the supervisor's "
         "hung-heartbeat kill is the recovery path, "
         "`tests/test_resident.py`)",
+    "pipeliner.exec":
+        "each script execution slice (start + every coroutine "
+        "resume) on the pipeline lane: a `raise` fails ONE script "
+        "with a typed record while siblings keep running, a `crash` "
+        "dies mid-chain with LBL_SCRIPT_REQ still up — the "
+        "supervised restart reclaims and re-runs the stranded "
+        "scripts (`tests/test_pipeliner.py`)",
+    "pipeliner.verb":
+        "each async splinter verb a script dispatches "
+        "(submit_embed / submit_search / submit_completion / sleep), "
+        "before the downstream submit",
     "supervisor.poll":
         "each supervision step",
     "store.set":
@@ -395,7 +406,8 @@ def _site_order(site: str) -> tuple:
     traditional order, then by name."""
     prefix = site.split(".", 1)[0]
     order = {"searcher": 0, "embedder": 1, "completer": 2,
-             "resident": 3, "supervisor": 4, "store": 5}
+             "pipeliner": 3, "resident": 4, "supervisor": 5,
+             "store": 6}
     return (order.get(prefix, 9), site)
 
 

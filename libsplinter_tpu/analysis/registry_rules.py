@@ -302,7 +302,8 @@ def check_doc_tables(ctx: Context) -> list[Finding]:
 _EXTRA_SPANS = {"e2e", "drain_cycle"}
 _PREFIX_FAMILIES = {"embed": ("PIPELINE_STAGES",),
                     "infer": ("INFER_STAGES", "CONT_INFER_STAGES"),
-                    "search": ("SEARCH_STAGES",)}
+                    "search": ("SEARCH_STAGES",),
+                    "script": ("SCRIPT_STAGES",)}
 
 
 @rule("SPL107", "registry", "unknown stage name in tracer span",

@@ -54,6 +54,42 @@ from .main import CliError, command
 
 LANES = ("embed", "search", "complete")
 
+# --- scenario registry ----------------------------------------------------
+# A scenario turns each arrival into a multi-stage workload instead of
+# a single-lane request.  "client" scenarios chain the stages from
+# THIS process (one submit + poll round trip per stage — the pre-
+# pipeline-lane baseline); "script" scenarios submit ONE pipeline-lane
+# request naming a stored script (scripting/library.py) and the whole
+# chain runs server-side.  New scenarios plug in here; an unknown
+# name fails loudly with the valid set.
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    kind: str                    # "client-rag" | "script"
+    script: str | None = None    # stored-script name (script kind)
+    lane: str = "rag"            # report lane label
+
+
+SCENARIOS: dict[str, Scenario] = {
+    # the client-side chain: ingest -> embed -> top-k -> complete,
+    # each hop a client round trip (the baseline the pipeline lane
+    # is measured against)
+    "rag-churn": Scenario("rag-churn", "client-rag"),
+    # the same chain as ONE stored script in the pipeline lane
+    "rag-churn-script": Scenario("rag-churn-script", "script",
+                                 script="rag-churn", lane="script"),
+    # script-only scenarios (no client-side equivalent exists):
+    # iterative agent, two-hop retrieval, fan-out/fan-in summarize
+    "agent-loop": Scenario("agent-loop", "script",
+                           script="agent-loop", lane="script"),
+    "multi-hop": Scenario("multi-hop", "script",
+                          script="multi-hop", lane="script"),
+    "map-reduce": Scenario("map-reduce", "script",
+                           script="map-reduce", lane="script"),
+}
+
 # terminal states a request can reach
 OK = "ok"               # served (within deadline unless counted late)
 OK_LATE = "ok_late"     # served, but past the client deadline
@@ -124,9 +160,11 @@ class LoadGenerator:
                  prompt: str = "summarize: "):
         if arrivals not in ("poisson", "fixed"):
             raise ValueError("arrivals must be poisson|fixed")
-        if scenario not in (None, "rag-churn"):
-            raise ValueError(f"unknown scenario {scenario!r} "
-                             "(available: rag-churn)")
+        if scenario is not None and scenario not in SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {scenario!r} (available: "
+                f"{', '.join(sorted(SCENARIOS))})")
+        self._scen = SCENARIOS.get(scenario) if scenario else None
         self.store = store
         self.tenants = tenants
         self.duration_s = duration_s
@@ -156,6 +194,12 @@ class LoadGenerator:
         # heartbeats come from the same estimator
         self.hists: dict[tuple[int, str], LogHistogram] = {}
         self.counts: dict[tuple[int, str], dict[str, int]] = {}
+        # exact per-request latencies (ms), alongside the log-bucketed
+        # report quantiles: the histogram's ~19%-wide buckets are fine
+        # for dashboards but too coarse for A/B latency GATES (the
+        # pipeline-lane p50 bar) — those read raw_ms and take an
+        # exact percentile
+        self.raw_ms: dict[tuple[int, str], list[float]] = {}
 
     # -- corpus ------------------------------------------------------------
 
@@ -170,6 +214,11 @@ class LoadGenerator:
             st.set(key, f"seed document {i} about topic {i % 7}")
             v = self.np_rng.standard_normal(d).astype(np.float32)
             st.vec_set(key, v / (np.linalg.norm(v) or 1.0))
+        if self._scen is not None and self._scen.kind == "script":
+            # script scenarios run the STORED library program: seed it
+            # so the pipeline lane resolves {"name": ...} requests
+            from ..scripting.library import seed_library
+            seed_library(st, [self._scen.script])
 
     def _zipf_doc(self) -> int:
         """Zipf-skewed corpus pick: rank r with p ∝ 1/r^s."""
@@ -230,13 +279,27 @@ class LoadGenerator:
         st.label_or(req.key, P.LBL_INFER_REQ | P.LBL_WAITING)
         st.bump(req.key)
 
+    def _submit_script(self, req: _Req, name: str, args: list) -> None:
+        """One pipeline-lane request: the whole chain is the stored
+        script's business — the deadline rides the request JSON (the
+        searcher's form) and the tenant rides the label word, so QoS
+        spans every verb the script dispatches."""
+        st = self.store
+        body: dict = {"name": name, "args": args}
+        if req.deadline_ts is not None:
+            body["deadline"] = round(req.deadline_ts, 6)
+        st.set(req.key, json.dumps(body))
+        self._stamp(req.key, req.tenant, None)  # deadline rides JSON
+        st.label_or(req.key, P.LBL_SCRIPT_REQ | P.LBL_WAITING)
+        st.bump(req.key)
+
     def _issue(self, tenant: TenantSpec) -> _Req:
         self._n += 1
         n = self._n
         deadline_ts = (time.time() + tenant.deadline_ms / 1e3
                        if tenant.deadline_ms else None)
-        if self.scenario == "rag-churn":
-            lane = "rag"
+        if self._scen is not None:
+            lane = self._scen.lane
         else:
             r = self.rng.random()
             acc = 0.0
@@ -257,6 +320,11 @@ class LoadGenerator:
         elif lane == "complete":
             self._submit_complete(
                 req, f"{self.prompt}document {self._zipf_doc()}")
+        elif lane == "script":        # one server-side scripted chain
+            req.doc_key = f"lgr{n}"
+            req.key = f"lgp{n}"
+            self._submit_script(req, self._scen.script,
+                                [req.doc_key, n])
         else:                         # rag-churn stage 0: ingest
             req.doc_key = f"lgr{n}"
             req.key = req.doc_key
@@ -276,6 +344,31 @@ class LoadGenerator:
             return True
         lane = req.lane if req.lane != "rag" else \
             ("embed", "search", "complete")[req.stage]
+        if lane == "script":
+            if labels & P.LBL_SCRIPT_REQ:
+                return False          # the chain is the lane's business
+            rec = None
+            try:
+                idx = self.store.find_index(req.key)
+                raw = self.store.get(P.script_result_key(idx))
+                rec = json.loads(raw.rstrip(b"\0"))
+            except (KeyError, OSError, ValueError):
+                pass
+            if rec is None:
+                req.state = LOST      # label cleared, result missing
+                return True
+            err = rec.get("err")
+            if err == P.ERR_OVERLOADED:
+                req.state = SHED
+            elif err == P.ERR_DEADLINE:
+                req.state = EXPIRED
+            elif err:
+                req.state = ERROR
+            else:
+                self._finish_ok(req)
+            from ..engine.pipeliner import consume_script_result
+            consume_script_result(self.store, req.key)
+            return True
         if lane == "embed":
             if labels & P.LBL_EMBED_REQ:
                 return False          # still queued
@@ -373,8 +466,9 @@ class LoadGenerator:
         self.counts[key][req.state] = \
             self.counts[key].get(req.state, 0) + 1
         if req.state in (OK, OK_LATE):
-            self.hists.setdefault(key, LogHistogram()).record(
-                (time.monotonic() - req.t_submit) * 1e3)
+            ms = (time.monotonic() - req.t_submit) * 1e3
+            self.hists.setdefault(key, LogHistogram()).record(ms)
+            self.raw_ms.setdefault(key, []).append(ms)
         # recycle terminal keys so a long run cannot exhaust slots
         for k in (req.key, req.doc_key, req.query_key):
             if k and req.state != LOST:
@@ -443,7 +537,8 @@ class LoadGenerator:
                 labels = 0
             req.state = UNSERVED if labels & (
                 P.LBL_EMBED_REQ | P.LBL_SEARCH_REQ | P.LBL_INFER_REQ
-                | P.LBL_SERVICING | P.LBL_WAITING) else LOST
+                | P.LBL_SCRIPT_REQ | P.LBL_SERVICING
+                | P.LBL_WAITING) else LOST
             done.append(req)
             self._record(req)
         return self.report(done, time.monotonic() - t0)
@@ -513,10 +608,12 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "[--tenant ID:RATE[:DEADLINE_MS[:WEIGHT]]]... "
          "[--mix embed:W,search:W,complete:W] "
          "[--arrivals poisson|fixed] [--zipf S] [--corpus N] "
-         "[--seed N] [--scenario rag-churn] [--k K] [--drain-s S] "
+         "[--seed N] [--scenario rag-churn|rag-churn-script|"
+         "agent-loop|multi-hop|map-reduce] [--k K] [--drain-s S] "
          "[--slo-p99-ms MS] [--slo-goodput F] [--json]",
          "open-loop multi-tenant load generator with per-tenant "
-         "p50/p95/p99, goodput vs shed, and SLO pass/fail")
+         "p50/p95/p99, goodput vs shed, and SLO pass/fail "
+         "(script scenarios run server-side in the pipeline lane)")
 def cmd_loadgen(ses, args):
     duration = 5.0
     rate = 20.0

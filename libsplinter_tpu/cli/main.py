@@ -503,6 +503,7 @@ def cmd_health(ses, args):
     for label, key in (("embedder", P.KEY_EMBED_STATS),
                        ("completer", P.KEY_COMPLETE_STATS),
                        ("searcher", P.KEY_SEARCH_STATS),
+                       ("pipeliner", P.KEY_SCRIPT_STATS),
                        ("supervisor", P.KEY_SUPERVISOR_STATS)):
         try:
             raw = st.get(key)
@@ -659,6 +660,7 @@ from .metrics import cmd_metrics, cmd_trace  # noqa: E402
 from .supervise import cmd_supervise  # noqa: E402
 from .loadgen import cmd_loadgen  # noqa: E402
 from .lint import cmd_lint  # noqa: E402
+from .pipeline import cmd_pipeline  # noqa: E402
 
 
 # ------------------------------------------------------------------- REPL

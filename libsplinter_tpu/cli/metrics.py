@@ -32,10 +32,12 @@ from .main import CliError, command
 
 _HEARTBEATS = (("embedder", P.KEY_EMBED_STATS),
                ("completer", P.KEY_COMPLETE_STATS),
-               ("searcher", P.KEY_SEARCH_STATS))
+               ("searcher", P.KEY_SEARCH_STATS),
+               ("pipeliner", P.KEY_SCRIPT_STATS))
 _TRACE_KEYS = (("embedder", P.KEY_EMBED_TRACE),
                ("completer", P.KEY_COMPLETE_TRACE),
-               ("searcher", P.KEY_SEARCH_TRACE))
+               ("searcher", P.KEY_SEARCH_TRACE),
+               ("pipeliner", P.KEY_SCRIPT_TRACE))
 
 
 def _read_json(store, key: str) -> dict | None:
@@ -89,6 +91,17 @@ def cmd_metrics(ses, args):
         disp = snap.pop("dispatch", None)  # PR-7 overlap gauges: their
         if isinstance(disp, dict):         # own (size-droppable)
             w.scalars(f"sptpu_{daemon}", disp)  # section, flat names
+        verbs = snap.pop("verbs", None)  # pipeline lane: per-verb
+        if isinstance(verbs, dict):      # dispatch counters
+            for verb, n in verbs.items():
+                if not isinstance(n, (int, float)):
+                    continue
+                w.metric(f"sptpu_{daemon}_verb_total", n,
+                         {"daemon": daemon, "verb": str(verb)},
+                         mtype="counter",
+                         help_="async splinter verbs dispatched by "
+                               "scripts, per verb name "
+                               "(engine/pipeliner.py)")
         shards = snap.pop("pages_shard", None)  # pod-sharded pool
         if isinstance(shards, dict):            # occupancy (PR 8)
             # on the sharded lane the pages_{free,used} family renders

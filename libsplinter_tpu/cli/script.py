@@ -13,14 +13,47 @@ from pathlib import Path
 from .main import CliError, command
 
 
-@command("lua", "lua SCRIPT.lua [ARGS...] | lua -e 'CHUNK'",
-         "run a Lua script against the store (splinter.* host API)")
+@command("lua", "lua [--max-steps N] [--deadline-ms MS] "
+         "[--max-sleep-s S] [--max-coroutines N] "
+         "SCRIPT.lua [ARGS...] | lua ... -e 'CHUNK'",
+         "run a Lua script against the store (splinter.* host API) "
+         "in the sandboxed runtime the pipeline lane uses")
 def cmd_lua(ses, args):
-    from ..scripting.lua_host import make_runtime
-    from ..scripting.microlua import LuaError
+    import time
 
+    from ..scripting.microlua import LuaError
+    from ..scripting.sandbox import (LuaRuntime, ScriptBudget,
+                                     ScriptKilled,
+                                     make_sandboxed_runtime)
+
+    # same budget knobs as the pipeline lane (one sandbox constructor
+    # — semantics cannot drift), with CLI-generous defaults: the step
+    # ceiling is the interpreter's historical default, not the lane's
+    # 1M-per-request budget
+    budget_kw: dict = {"max_steps": LuaRuntime.MAX_STEPS_DEFAULT,
+                       "max_coroutines":
+                           LuaRuntime.MAX_COROUTINES_DEFAULT}
+    args = list(args)
+    flags = {"--max-steps": ("max_steps", int),
+             "--max-sleep-s": ("max_sleep_s", float),
+             "--max-coroutines": ("max_coroutines", int)}
+    while args and args[0] in (*flags, "--deadline-ms"):
+        flag = args.pop(0)
+        if not args:
+            raise CliError(f"{flag} requires a value")
+        val = args.pop(0)
+        try:
+            if flag == "--deadline-ms":
+                budget_kw["deadline_ts"] = \
+                    time.time() + float(val) / 1e3
+            else:
+                name, conv = flags[flag]
+                budget_kw[name] = conv(val)
+        except ValueError:
+            raise CliError(f"{flag}: bad value {val!r}") from None
     if not args:
-        raise CliError("usage: lua SCRIPT.lua [ARGS...] | lua -e 'CHUNK'")
+        raise CliError("usage: lua [budget flags] SCRIPT.lua "
+                       "[ARGS...] | lua [budget flags] -e 'CHUNK'")
     if args[0] == "-e":
         if len(args) < 2:
             raise CliError("lua -e needs a chunk")
@@ -33,9 +66,13 @@ def cmd_lua(ses, args):
                                         list(args[1:]))
     # context manager: unwinds any coroutine the script left suspended
     # so a REPL running many scripts can't accumulate parked threads
-    with make_runtime(ses.store) as rt:
+    with make_sandboxed_runtime(ses.store,
+                                ScriptBudget(**budget_kw)) as rt:
         try:
             rt.run(src, script_args=script_args, chunk_name=chunk_name)
+        except ScriptKilled as e:
+            raise CliError(f"lua: script killed ({e.reason}): {e}") \
+                from None
         except LuaError as e:
             raise CliError(f"lua: {e}") from None
 

@@ -1,8 +1,9 @@
 """Serving daemons over the store's event bus: the embedding daemon
-(embedder.py), the completion daemon (completer.py), and the
-query-coalescing search daemon (searcher.py), sharing one coordination
-contract (protocol.py) and supervised as child processes by
-supervisor.py (crash restart + circuit breaker)."""
+(embedder.py), the completion daemon (completer.py), the
+query-coalescing search daemon (searcher.py), and the pipeline lane
+(pipeliner.py — server-side scripted chains in a sandboxed Lua host),
+sharing one coordination contract (protocol.py) and supervised as
+child processes by supervisor.py (crash restart + circuit breaker)."""
 from . import protocol
 
 __all__ = ["protocol", "Searcher", "daemon_live", "submit_search",

@@ -177,3 +177,80 @@ def submit_completion(store, key: str, prompt: str | bytes, *,
         return attempt(timeout_ms)
     return call_with_retries(attempt, timeout_ms=timeout_ms,
                              store=store, lane="completer")
+
+
+def classify_embed_result(store, key: str, labels: int, *,
+                          deadline_ts: float | None = None):
+    """THE embed-lane result read — one definition `submit_embed` and
+    the pipeline lane's verb polling share, so the subtle label-only
+    protocol (the embedder has no value channel: success IS a
+    committed vector, shed IS a cleared label with a zero vector)
+    cannot drift between them.  Returns PENDING while the request is
+    queued, True when the vector landed, else a typed error dict
+    ({"err": "ctx_exceeded" | "deadline_expired" | "overloaded"})."""
+    import numpy as np
+
+    from .qos import DEFAULT_RETRY_AFTER_MS
+
+    if labels & P.LBL_EMBED_REQ:
+        return PENDING
+    if labels & P.LBL_CTX_EXCEEDED:
+        return {"err": "ctx_exceeded"}
+    try:
+        vec = store.vec_get(key)
+        if vec is not None and np.abs(vec).max() > 0:
+            return True
+    except (KeyError, OSError):
+        pass
+    # label-only unblock with no vector: the embed lane's
+    # shed/deadline signal (the heartbeat counters say which;
+    # client-side the deadline disambiguates)
+    if deadline_ts is not None and time.time() >= deadline_ts:
+        return {"err": P.ERR_DEADLINE}
+    return P.overloaded_record(DEFAULT_RETRY_AFTER_MS)
+
+
+def submit_embed(store, key: str, text: str | bytes, *,
+                 timeout_ms: float = 10_000,
+                 tenant: int = 0,
+                 deadline_ms: float | None = None,
+                 retry: bool = True):
+    """The embed-lane client that was missing (`submit_search` and
+    `submit_completion` exist): write `text` to `key`, raise the
+    EMBED request, wait for the daemon to clear it.
+
+    The embedder has no value channel to spare (the slot holds the
+    client's text), so its shed/expiry signal is the cleared label
+    with NO vector committed — this helper reads that protocol and
+    SYNTHESIZES the typed record the other lanes return explicitly:
+    True when the vector landed, {"err": "overloaded"|
+    "deadline_expired"|"ctx_exceeded"} when the daemon rejected it,
+    None on timeout / down lane.  Tenant, deadline, and the shared
+    retry wrapper behave exactly as in the sibling helpers."""
+    deadline_ts = (time.time() + deadline_ms / 1e3
+                   if deadline_ms is not None else None)
+
+    def attempt(left_ms: float):
+        store.set(key, text)
+        # a reused key may still carry CTX_EXCEEDED from a previous
+        # over-long text — left set, a successful re-embed would
+        # still classify as rejected
+        store.label_clear(key, P.LBL_CTX_EXCEEDED)
+        _stamp_qos(store, key, tenant, deadline_ts)
+        store.label_or(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
+        store.bump(key)
+
+        def check():
+            try:
+                labels = store.labels(key)
+            except KeyError:
+                return None               # caller deleted it mid-wait
+            return classify_embed_result(store, key, labels,
+                                         deadline_ts=deadline_ts)
+
+        return wait_with_repulse(store, key, left_ms, check)
+
+    if not retry:
+        return attempt(timeout_ms)
+    return call_with_retries(attempt, timeout_ms=timeout_ms,
+                             store=store, lane="embedder")

@@ -18,6 +18,7 @@ LBL_WAITING = 0x40             # client is blocked on this key
 LBL_CTX_EXCEEDED = 0x80        # input exceeded the model context window
 LBL_CHUNK = 0x200              # ingest: document chunk
 LBL_META = 0x400               # ingest: metadata slot
+LBL_SCRIPT_REQ = 0x1 << 56     # "run my script" — wakes the pipeline lane
 LBL_SEARCH_REQ = 0x1 << 57     # "search me" — wakes the search daemon
 LBL_TRACED = 0x1 << 58         # request carries a trace stamp (obs)
 LBL_DEADLINE = 0x1 << 52       # request carries a deadline stamp (QoS)
@@ -30,6 +31,7 @@ LBL_READY = 0x1 << 62          # completion finished
 BIT_EMBED_REQ = 0
 BIT_WAITING = 6
 BIT_CTX_EXCEEDED = 7
+BIT_SCRIPT_REQ = 56
 BIT_SEARCH_REQ = 57
 BIT_DEADLINE = 52
 BIT_DEBUG = 59
@@ -80,16 +82,19 @@ def stamp_tenant(store, key: str, tenant: int) -> None:
 GROUP_EMBED = 2                # embedding daemon wake group
 GROUP_INFER = 3                # completion daemon wake group
 GROUP_SEARCH = 4               # search daemon wake group
+GROUP_SCRIPT = 5               # pipeline (scripted-chain) lane wake group
 GROUP_DEBUG = 63               # sidecar debug group
 
 # --- shard ids / priorities (cooperative advisement) --------------------
 SHARD_EMBED = 0x5F10
 SHARD_COMPLETE = 0x5F1A
 SHARD_SEARCH = 0x5F1B
+SHARD_SCRIPT = 0x5F1C
 PRIO_EMBED_LIVE = 40
 PRIO_EMBED_BACKFILL = 20
 PRIO_COMPLETE = 200
 PRIO_SEARCH = 150
+PRIO_SCRIPT = 100
 
 # --- well-known keys -----------------------------------------------------
 KEY_DONE_LANE = "__lane_dw_2"  # pulsed after each committed embedding
@@ -109,6 +114,7 @@ KEY_SYSTEM_PROMPT = "__system_prompt"
 KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
 KEY_SEARCH_STATS = "__searcher_stats"
+KEY_SCRIPT_STATS = "__pipeliner_stats"
 # the supervisor's own heartbeat (engine/supervisor.py): per-lane
 # process state — pid, generation, restart/backoff/breaker counters,
 # and the breaker's down marker CLI clients consult before dispatching
@@ -120,12 +126,24 @@ SEARCH_SCRATCH_PREFIX = "__sqtmp_"   # search query scratch key per pid
 # the REQUEST's slot index (__sr_<idx>) — the client polls its request
 # key and reads the companion once LBL_SEARCH_REQ clears
 SEARCH_RESULT_PREFIX = "__sr_"
+# pipeline-lane results: one JSON row per finished script, keyed by
+# the REQUEST's slot index (__pr_<idx>) — {"ok": true, "ret": [...]}
+# or a typed error record ({"err": "budget_exceeded" | "script_error"
+# | "deadline_expired" | "overloaded", ...}); the client polls its
+# request key and reads the companion once LBL_SCRIPT_REQ clears
+SCRIPT_RESULT_PREFIX = "__pr_"
+# stored named scripts (the reference's "programs next to the data"):
+# `spt pipeline put NAME file.lua` writes the source under
+# __script_<NAME>; a request naming it ({"name": "NAME"}) runs it
+# server-side without shipping the source per call
+SCRIPT_STORE_PREFIX = "__script_"
 # flight-recorder dumps (obs/recorder.py): each daemon publishes its
 # ring of per-request wake->commit traces here alongside its stats
 # heartbeat; `spt trace tail` reads them cross-process
 KEY_EMBED_TRACE = "__embedder_trace"
 KEY_COMPLETE_TRACE = "__completer_trace"
 KEY_SEARCH_TRACE = "__searcher_trace"
+KEY_SCRIPT_TRACE = "__pipeliner_trace"
 
 # context guard: reject inputs >= this fraction of the model window
 CTX_GUARD_FRACTION = 0.9
@@ -172,9 +190,26 @@ CONT_INFER_STAGES = ("join", "sample", "decode", "collect", "flush")
 # filtering + __sr_<idx> result writes + label clears + bumps
 SEARCH_STAGES = ("wake", "drain", "score", "select", "commit")
 
+# the pipeline lane's per-script decomposition: parse = source fetch
+# (inline or stored) + chunk compile + sandbox construction; exec =
+# host-interpreter wall (every coroutine resume slice of the script's
+# own Lua steps); verb = time the script spent suspended on async
+# splinter verbs (submit_embed / submit_search / submit_completion /
+# sleep — the downstream lanes' service time as the script saw it);
+# commit = the __pr_<idx> result write + label clear + bump
+SCRIPT_STAGES = ("parse", "exec", "verb", "commit")
+
 
 def search_result_key(idx: int) -> str:
     return f"{SEARCH_RESULT_PREFIX}{idx}"
+
+
+def script_result_key(idx: int) -> str:
+    return f"{SCRIPT_RESULT_PREFIX}{idx}"
+
+
+def stored_script_key(name: str) -> str:
+    return f"{SCRIPT_STORE_PREFIX}{name}"
 
 
 def candidate_mask(store, bloom: int = 0):
@@ -538,7 +573,7 @@ def lane_down(store, lane: str, *, max_age_s: float = 15.0) -> bool:
 # stamp of) this row" — a TRACED row carrying none of them is an
 # orphan whose stamp landed after its request was serviced
 _REQ_LABELS = (LBL_EMBED_REQ | LBL_INFER_REQ | LBL_SERVICING
-               | LBL_SEARCH_REQ)
+               | LBL_SEARCH_REQ | LBL_SCRIPT_REQ)
 
 
 def shed_orphan_stamp(store, idx: int, labels: int) -> bool:

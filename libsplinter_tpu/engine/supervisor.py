@@ -62,6 +62,10 @@ LANES: dict[str, tuple[str, str]] = {
     "completer": ("libsplinter_tpu.engine.completer",
                   P.KEY_COMPLETE_STATS),
     "searcher": ("libsplinter_tpu.engine.searcher", P.KEY_SEARCH_STATS),
+    # the pipeline lane (server-side scripted chains): jax-free, so a
+    # supervised restart costs milliseconds, not an XLA warmup
+    "pipeliner": ("libsplinter_tpu.engine.pipeliner",
+                  P.KEY_SCRIPT_STATS),
 }
 
 

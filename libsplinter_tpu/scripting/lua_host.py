@@ -27,8 +27,15 @@ _IOPS = {
 }
 
 
-def make_splinter_module(store) -> LuaTable:
-    """Build the `splinter` table over a libsplinter_tpu.store.Store."""
+def make_splinter_module(store, budget=None) -> LuaTable:
+    """Build the `splinter` table over a libsplinter_tpu.store.Store.
+
+    `budget` (scripting.sandbox.ScriptBudget) clamps the blocking
+    verbs — today `sleep`, which used to honor any float a script
+    passed (`sleep(1e9)` wedged the host for 31 years): with a budget
+    it sleeps at most `max_sleep_s` and never past the session's
+    remaining deadline.  The CLI host and the pipeline lane both pass
+    one, so their sandbox semantics cannot drift."""
 
     def _get(key):
         if key is None:
@@ -143,7 +150,10 @@ def make_splinter_module(store) -> LuaTable:
             return None
 
     def _sleep(seconds):
-        time.sleep(float(seconds))
+        s = float(seconds)
+        if budget is not None:
+            s = budget.clamp_sleep(s)
+        time.sleep(s)
         return 0
 
     def _get_embedding(key):
@@ -205,9 +215,12 @@ def make_splinter_module(store) -> LuaTable:
     })
 
 
-def make_runtime(store, output=None) -> LuaRuntime:
+def make_runtime(store, output=None, budget=None) -> LuaRuntime:
     """LuaRuntime with the splinter module registered (require-able and
-    predeclared as the global `splinter`)."""
+    predeclared as the global `splinter`).  With `budget` given the
+    blocking verbs are clamped (the sandboxed hosts go further — see
+    scripting.sandbox.make_sandboxed_runtime)."""
     rt = LuaRuntime(output=output)
-    rt.register_module("splinter", make_splinter_module(store))
+    rt.register_module("splinter", make_splinter_module(store,
+                                                        budget=budget))
     return rt

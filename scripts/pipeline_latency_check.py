@@ -1,0 +1,104 @@
+"""CI gate: the pipeline lane must actually collapse per-stage client
+round trips (`make pipeline-check`).
+
+Runs the SAME rag-churn chain two ways against one in-process stack
+(stub encoder/generator — this measures orchestration, not model
+math): the client-side scenario (one submit+poll round trip per
+ingest -> embed -> top-k -> complete hop) and the stored-script
+scenario (ONE pipeline-lane request, the chain server-side).  The
+scripted p50 must land >= 30% below the client-side p50 — the
+ROADMAP item-3 target and the ISSUE 12 acceptance bar.  Both runs
+also enforce the standing zero-admitted-loss invariant.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from libsplinter_tpu import Store  # noqa: E402
+from libsplinter_tpu.cli.loadgen import (LoadGenerator,  # noqa: E402
+                                         TenantSpec)
+from libsplinter_tpu.engine.completer import Completer  # noqa: E402
+from libsplinter_tpu.engine.embedder import Embedder  # noqa: E402
+from libsplinter_tpu.engine.pipeliner import Pipeliner  # noqa: E402
+from libsplinter_tpu.engine.searcher import Searcher  # noqa: E402
+
+REQUIRED_DROP = 0.30
+
+
+def main() -> int:
+    name = f"/spt-plcheck-{os.getpid()}"
+    st = Store.create(name, nslots=512, max_val=1024, vec_dim=32)
+
+    def enc(texts):
+        out = np.zeros((len(texts), st.vec_dim), np.float32)
+        for i, t in enumerate(texts):
+            out[i, hash(t) % st.vec_dim] = 1.0
+        return out
+
+    emb = Embedder(st, encoder_fn=enc, max_ctx=64)
+    sr = Searcher(st)
+    comp = Completer(st, generate_fn=lambda p: iter([b"answer"]),
+                     template="none")
+    pl = Pipeliner(st)
+    daemons = (emb, sr, comp, pl)
+    for d in daemons:
+        d.attach()
+    ths = [threading.Thread(target=d.run,
+                            kwargs=dict(idle_timeout_ms=10,
+                                        stop_after=180.0),
+                            daemon=True) for d in daemons]
+    for t in ths:
+        t.start()
+    time.sleep(0.2)
+
+    def p50_of(scenario: str) -> float:
+        gen = LoadGenerator(st, [TenantSpec(1, 10.0, deadline_ms=8000)],
+                            duration_s=3.0, corpus=8, seed=11,
+                            scenario=scenario)
+        rep = gen.run()
+        assert rep["lost"] == 0, f"{scenario}: lost={rep['lost']}"
+        assert rep["ok"] >= max(1, rep["issued"] - 1), \
+            f"{scenario}: {rep}"
+        lane = "rag" if scenario == "rag-churn" else "script"
+        # exact median from the raw samples — the report's
+        # log-bucketed p50 quantizes to ~19%-wide buckets, too coarse
+        # for a 30% A/B gate
+        return float(np.median(gen.raw_ms[(1, lane)]))
+
+    try:
+        # client first, script second: any store warmup bias favors
+        # the CLIENT side, so a pass is conservative
+        client_p50 = p50_of("rag-churn")
+        script_p50 = p50_of("rag-churn-script")
+    finally:
+        for d in daemons:
+            d.stop()
+        for t in ths:
+            t.join(timeout=15)
+        st.close()
+        Store.unlink(name)
+
+    drop = 1.0 - script_p50 / client_p50
+    print(f"rag-churn p50: client-chained {client_p50:.1f} ms, "
+          f"stored-script {script_p50:.1f} ms "
+          f"({drop:.0%} drop; gate >= {REQUIRED_DROP:.0%})")
+    if drop < REQUIRED_DROP:
+        print("FAIL: the pipeline lane did not beat client-side "
+              "chaining by the required margin")
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

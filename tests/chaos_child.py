@@ -121,6 +121,19 @@ def main() -> int:
             idle_timeout_ms=20,
             stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
         print(f"completions={comp.stats.completions}", flush=True)
+    elif role == "pipeliner":
+        # the pipeline lane (jax-free): runs the script pump for a
+        # bounded window so the pipeliner.exec / pipeliner.verb fault
+        # sites fire mid-chain — a `crash` dies with admitted scripts
+        # stranded (LBL_SCRIPT_REQ still up), and the parent asserts
+        # the restarted lane reclaims and re-runs them
+        from libsplinter_tpu.engine.pipeliner import Pipeliner
+        pl = Pipeliner(st)
+        pl.attach()
+        pl.run(idle_timeout_ms=20,
+               stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S",
+                                               "8")))
+        print(f"scripts={pl.stats.scripts_completed}", flush=True)
     else:
         raise SystemExit(f"unknown role {role!r}")
     return 0

@@ -51,6 +51,17 @@
 #                   smoke (stored-script rag-churn p50 >= 30% below
 #                   the client-side chain;
 #                   scripts/pipeline_latency_check.py)
+#   make trace-check  cross-lane tracing + telemetry tier (fast,
+#                   CPU): trace-context stamp round-trips, span-ring
+#                   wire protocol (staging, crash recovery with
+#                   restart-gap attribution, bounded multi-writer
+#                   ring), orphan sweeps (raced rewrites cannot leak
+#                   staging rows), span-tree assembly parity for both
+#                   chain forms, the Chrome/Perfetto export schema
+#                   check, telemetry-ring persistence across sampler
+#                   restarts, and the EXTENDED obs-overhead gate
+#                   (span stamping + a concurrently-scraping sampler
+#                   must stay under the same <3% budget)
 #   make lint-check  splint static-analysis tier (pure stdlib ast,
 #                   no jax, no native build needed): protocol-
 #                   registry sync rules (label-bit collisions, raw
@@ -147,6 +158,11 @@ qos-check: native
 		-m "not slow and not chaos"
 	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
 
+trace-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spans.py \
+		tests/test_telemetry.py -q -m "not slow and not chaos"
+	$(PY) scripts/obs_overhead_check.py
+
 pipeline-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pipeliner.py -q \
 		-m "not slow and not chaos"
@@ -164,4 +180,4 @@ clean:
 
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check qos-check \
-	pipeline-check lint-check memcheck bench-cpu clean
+	pipeline-check trace-check lint-check memcheck bench-cpu clean

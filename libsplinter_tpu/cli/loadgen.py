@@ -128,7 +128,8 @@ class TenantSpec:
 
 class _Req:
     __slots__ = ("lane", "tenant", "key", "t_submit", "deadline_ts",
-                 "state", "stage", "doc_key", "query_key", "hits")
+                 "state", "stage", "doc_key", "query_key", "hits",
+                 "tid", "hops")
 
     def __init__(self, lane, tenant, key, t_submit, deadline_ts):
         self.lane = lane
@@ -141,6 +142,8 @@ class _Req:
         self.doc_key = None
         self.query_key = None
         self.hits = []
+        self.tid = 0                 # head-sampled trace id (0 = off)
+        self.hops = 0                # trace hops stamped so far
 
 
 class LoadGenerator:
@@ -157,6 +160,7 @@ class LoadGenerator:
                  scenario: str | None = None,
                  search_k: int = 4,
                  drain_s: float | None = None,
+                 trace_sample: float = 0.0,
                  prompt: str = "summarize: "):
         if arrivals not in ("poisson", "fixed"):
             raise ValueError("arrivals must be poisson|fixed")
@@ -180,6 +184,13 @@ class LoadGenerator:
         self.corpus = corpus
         self.scenario = scenario
         self.search_k = search_k
+        # head sampling: each arrival is traced with probability p
+        # (seeded — reruns trace the SAME arrivals), every hop of a
+        # traced chain stamped with one trace id so an SLO miss is
+        # one `spt trace show` away from per-hop attribution
+        if not 0.0 <= trace_sample <= 1.0:
+            raise ValueError("trace_sample must be in [0, 1]")
+        self.trace_sample = trace_sample
         self.rng = random.Random(seed)
         self.np_rng = np.random.default_rng(seed)
         # post-arrival grace: outstanding requests get this long to
@@ -200,6 +211,9 @@ class LoadGenerator:
         # pipeline-lane p50 bar) — those read raw_ms and take an
         # exact percentile
         self.raw_ms: dict[tuple[int, str], list[float]] = {}
+        # (latency_ms, trace_id, lane) per COMPLETED traced request,
+        # per tenant — the report surfaces each tenant's k slowest
+        self.traced_done: dict[int, list[tuple]] = {}
 
     # -- corpus ------------------------------------------------------------
 
@@ -253,11 +267,27 @@ class LoadGenerator:
         if deadline_ts is not None:
             P.stamp_deadline(self.store, key, deadline_ts)
 
+    def _trace_stamp(self, req: _Req) -> None:
+        """One trace id across every hop of a sampled request: the
+        first hop is the root span (span id == trace id), later hops
+        of a client-side chain hang under it — the same tree shape
+        the pipeline lane produces for a stored script."""
+        if not req.tid:
+            return
+        if req.hops == 0:
+            P.stamp_trace(self.store, req.key, trace_id=req.tid,
+                          parent=0, span=req.tid)
+        else:
+            P.stamp_trace(self.store, req.key, trace_id=req.tid,
+                          parent=req.tid)
+        req.hops += 1
+
     def _submit_embed(self, req: _Req, text: str | None = None) -> None:
         st = self.store
         st.set(req.key, text if text is not None else
                f"live document {self._n} about topic {self._n % 7}")
         self._stamp(req.key, req.tenant, req.deadline_ts)
+        self._trace_stamp(req)
         st.label_or(req.key, P.LBL_EMBED_REQ | P.LBL_WAITING)
         st.bump(req.key)
 
@@ -269,6 +299,7 @@ class LoadGenerator:
         st.set(req.key, json.dumps(params))
         st.vec_set(req.key, qvec)
         self._stamp(req.key, req.tenant, None)  # deadline rides JSON
+        self._trace_stamp(req)
         st.label_or(req.key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
         st.bump(req.key)
 
@@ -276,6 +307,7 @@ class LoadGenerator:
         st = self.store
         st.set(req.key, prompt)
         self._stamp(req.key, req.tenant, req.deadline_ts)
+        self._trace_stamp(req)
         st.label_or(req.key, P.LBL_INFER_REQ | P.LBL_WAITING)
         st.bump(req.key)
 
@@ -290,6 +322,7 @@ class LoadGenerator:
             body["deadline"] = round(req.deadline_ts, 6)
         st.set(req.key, json.dumps(body))
         self._stamp(req.key, req.tenant, None)  # deadline rides JSON
+        self._trace_stamp(req)
         st.label_or(req.key, P.LBL_SCRIPT_REQ | P.LBL_WAITING)
         st.bump(req.key)
 
@@ -311,6 +344,9 @@ class LoadGenerator:
                     break
         req = _Req(lane, tenant.tenant, f"lg{lane[0]}{n}",
                    time.monotonic(), deadline_ts)
+        if self.trace_sample and \
+                self.rng.random() < self.trace_sample:
+            req.tid = P.next_trace_id()
         if lane == "embed":
             self._submit_embed(req)
         elif lane == "search":
@@ -469,6 +505,9 @@ class LoadGenerator:
             ms = (time.monotonic() - req.t_submit) * 1e3
             self.hists.setdefault(key, LogHistogram()).record(ms)
             self.raw_ms.setdefault(key, []).append(ms)
+            if req.tid:
+                self.traced_done.setdefault(req.tenant, []).append(
+                    (ms, req.tid, lane))
         # recycle terminal keys so a long run cannot exhaust slots
         for k in (req.key, req.doc_key, req.query_key):
             if k and req.state != LOST:
@@ -562,6 +601,14 @@ class LoadGenerator:
                            p95_ms=round(h.quantile(0.95), 3),
                            p99_ms=round(h.quantile(0.99), 3))
             sect[lane] = row
+        # each tenant's k slowest traced requests: an SLO miss is one
+        # `spt trace show <id>` away from per-hop attribution
+        for tenant, rows in self.traced_done.items():
+            sect = per_tenant.setdefault(str(tenant), {})
+            sect["slow_traces"] = [
+                {"trace": f"{tid:#x}", "ms": round(ms, 3),
+                 "lane": lane}
+                for ms, tid, lane in sorted(rows, reverse=True)[:3]]
         return {
             "scenario": self.scenario or "mixed",
             "arrivals": self.arrivals,
@@ -596,6 +643,8 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
     if p99_ms is not None:
         for tenant, lanes in report.get("per_tenant", {}).items():
             for lane, row in lanes.items():
+                if not isinstance(row, dict):
+                    continue          # slow_traces list rides along
                 p99 = row.get("p99_ms")
                 if p99 is not None and p99 > p99_ms:
                     out.append(f"tenant {tenant} {lane} p99 "
@@ -610,10 +659,12 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "[--arrivals poisson|fixed] [--zipf S] [--corpus N] "
          "[--seed N] [--scenario rag-churn|rag-churn-script|"
          "agent-loop|multi-hop|map-reduce] [--k K] [--drain-s S] "
-         "[--slo-p99-ms MS] [--slo-goodput F] [--json]",
+         "[--trace-sample P] [--slo-p99-ms MS] [--slo-goodput F] "
+         "[--json]",
          "open-loop multi-tenant load generator with per-tenant "
-         "p50/p95/p99, goodput vs shed, and SLO pass/fail "
-         "(script scenarios run server-side in the pipeline lane)")
+         "p50/p95/p99, goodput vs shed, SLO pass/fail, and head-"
+         "sampled tracing (--trace-sample: each tenant's slowest "
+         "trace ids land in the summary)")
 def cmd_loadgen(ses, args):
     duration = 5.0
     rate = 20.0
@@ -627,6 +678,7 @@ def cmd_loadgen(ses, args):
     scenario = None
     k = 4
     drain_s = None
+    trace_sample = 0.0
     slo_p99 = None
     slo_goodput = None
     as_json = False
@@ -672,6 +724,8 @@ def cmd_loadgen(ses, args):
             k = int(val(a))
         elif a == "--drain-s":
             drain_s = float(val(a))
+        elif a == "--trace-sample":
+            trace_sample = float(val(a))
         elif a == "--slo-p99-ms":
             slo_p99 = float(val(a))
         elif a == "--slo-goodput":
@@ -697,7 +751,8 @@ def cmd_loadgen(ses, args):
                             mix=mix, arrivals=arrivals, zipf=zipf,
                             corpus=corpus, seed=seed,
                             scenario=scenario, search_k=k,
-                            drain_s=drain_s)
+                            drain_s=drain_s,
+                            trace_sample=trace_sample)
     except ValueError as e:
         raise CliError(str(e)) from None
     report = gen.run()
@@ -719,6 +774,13 @@ def cmd_loadgen(ses, args):
               f"({report['goodput_ratio']:.1%} of issued)")
         for tenant, lanes in report["per_tenant"].items():
             for lane, row in lanes.items():
+                if lane == "slow_traces":
+                    ids = " ".join(
+                        f"{r['trace']}({r['ms']}ms)" for r in row)
+                    print(f"  tenant {tenant} slowest traces: {ids} "
+                          f"— `spt trace show <id>` for the hop "
+                          f"breakdown")
+                    continue
                 q = (f" p50={row['p50_ms']}ms p95={row['p95_ms']}ms "
                      f"p99={row['p99_ms']}ms" if "p50_ms" in row
                      else "")

@@ -657,6 +657,7 @@ from .search import cmd_search  # noqa: E402  (registers itself)
 from .ingest import cmd_ingest, cmd_export  # noqa: E402
 from .script import cmd_lua, cmd_wasm  # noqa: E402
 from .metrics import cmd_metrics, cmd_trace  # noqa: E402
+from .top import cmd_top  # noqa: E402
 from .supervise import cmd_supervise  # noqa: E402
 from .loadgen import cmd_loadgen  # noqa: E402
 from .lint import cmd_lint  # noqa: E402

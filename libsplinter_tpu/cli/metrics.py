@@ -19,6 +19,16 @@ trace id, key, wall ms, and the ordered stage event sequence
 wake->commit journey cross-process.  Clients opt a request in with
 engine/protocol.stamp_trace(store, key) — after set+label, before
 the bump, so a racing daemon can't service the row stampless.
+
+`trace show <id>` assembles the CROSS-LANE span tree for one trace id
+from the shared span ring (obs/spans.py) — per hop: lane, key,
+queue-wait vs service-time split, status, restart gap.  `trace
+export [<id>]` emits Chrome/Perfetto trace-event JSON for the whole
+ring (or one trace), loadable in ui.perfetto.dev / chrome://tracing.
+
+`metrics --history` renders the telemetry sampler's time-series
+rings (engine/telemetry.py) — per lane, per gauge sparklines of
+queue depth, shed counters, stage p99s — instead of the exposition.
 """
 from __future__ import annotations
 
@@ -33,7 +43,8 @@ from .main import CliError, command
 _HEARTBEATS = (("embedder", P.KEY_EMBED_STATS),
                ("completer", P.KEY_COMPLETE_STATS),
                ("searcher", P.KEY_SEARCH_STATS),
-               ("pipeliner", P.KEY_SCRIPT_STATS))
+               ("pipeliner", P.KEY_SCRIPT_STATS),
+               ("telemetry", P.KEY_TELEMETRY_STATS))
 _TRACE_KEYS = (("embedder", P.KEY_EMBED_TRACE),
                ("completer", P.KEY_COMPLETE_TRACE),
                ("searcher", P.KEY_SEARCH_TRACE),
@@ -52,9 +63,62 @@ def _read_json(store, key: str) -> dict | None:
     return snap if isinstance(snap, dict) else None
 
 
-@command("metrics", "metrics",
-         "Prometheus text exposition of store + daemon telemetry")
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals: list[float], width: int = 32) -> str:
+    """Unicode mini-chart of a gauge's ring (newest right)."""
+    vals = vals[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    return "".join(_SPARK[int((v - lo) / (hi - lo)
+                              * (len(_SPARK) - 1))] for v in vals)
+
+
+def render_history(store, out=None) -> int:
+    """`spt metrics --history`: the telemetry rings as per-gauge
+    sparklines.  Returns gauges rendered (0 = no sampler ran)."""
+    from ..engine.telemetry import SCRAPE_LANES, read_history
+
+    out = out if out is not None else sys.stdout
+    shown = 0
+    now = time.time()
+    for lane in SCRAPE_LANES:
+        rec = read_history(store, lane)
+        if rec is None:
+            continue
+        age = now - float(rec.get("ts", 0.0))
+        print(f"[{lane}] sampled every {rec.get('interval_s')}s, "
+              f"last {age:.1f}s ago", file=out)
+        for gauge, ring in sorted((rec.get("gauges") or {}).items()):
+            if not isinstance(ring, list) or not ring:
+                continue
+            vals = [float(p[1]) for p in ring if isinstance(p, list)
+                    and len(p) == 2]
+            if not vals:
+                continue
+            print(f"  {gauge:<24} last={vals[-1]:<10g} "
+                  f"min={min(vals):<10g} max={max(vals):<10g} "
+                  f"{sparkline(vals)}", file=out)
+            shown += 1
+    if not shown:
+        print("no telemetry history (run the sampler: `spt supervise "
+              "--lanes ...,telemetry` or `python -m "
+              "libsplinter_tpu.engine.telemetry --store ...`)",
+              file=out)
+    return shown
+
+
+@command("metrics", "metrics [--history]",
+         "Prometheus text exposition of store + daemon telemetry "
+         "(--history: the sampler's time-series rings instead)")
 def cmd_metrics(ses, args):
+    if args and args[0] == "--history":
+        render_history(ses.store)
+        return
     st = ses.store
     w = PromWriter()
 
@@ -91,6 +155,9 @@ def cmd_metrics(ses, args):
         disp = snap.pop("dispatch", None)  # PR-7 overlap gauges: their
         if isinstance(disp, dict):         # own (size-droppable)
             w.scalars(f"sptpu_{daemon}", disp)  # section, flat names
+        sp = snap.pop("spans_obs", None)  # span-capture accounting
+        if isinstance(sp, dict):          # (obs/spans.py), flat names
+            w.scalars(f"sptpu_{daemon}_spans", sp)
         verbs = snap.pop("verbs", None)  # pipeline lane: per-verb
         if isinstance(verbs, dict):      # dispatch counters
             for verb, n in verbs.items():
@@ -223,11 +290,70 @@ def cmd_metrics(ses, args):
     sys.stdout.write(w.render())
 
 
-@command("trace", "trace tail [N]",
-         "dump the daemons' flight recorders (last N traced requests)")
+def _parse_tid(s: str) -> int:
+    try:
+        return int(s, 0)          # 0x... or decimal
+    except ValueError:
+        raise CliError(f"bad trace id {s!r} (hex 0x... or decimal)") \
+            from None
+
+
+def _trace_show(ses, args) -> None:
+    from ..obs import spans as S
+
+    if not args:
+        raise CliError("usage: trace show <trace_id>")
+    tid = _parse_tid(args[0])
+    recs = S.collect_spans(ses.store, tid)
+    if not recs:
+        print(f"no spans for trace {tid:#x} (span capture needs a "
+              "stamped request — protocol.stamp_trace or `spt "
+              "loadgen --trace-sample p`; old spans rotate out of "
+              "the bounded ring)")
+        return
+    for line in S.render_tree(S.assemble_tree(recs)):
+        print(line)
+
+
+def _trace_export(ses, args) -> None:
+    from ..obs import spans as S
+
+    out_path = None
+    rest = []
+    it = iter(args)
+    for a in it:
+        if a == "--out":
+            try:
+                out_path = next(it)
+            except StopIteration:
+                raise CliError("--out requires a path") from None
+        else:
+            rest.append(a)
+    tid = _parse_tid(rest[0]) if rest else None
+    recs = S.collect_spans(ses.store, tid)
+    doc = S.to_chrome_trace(recs)
+    body = json.dumps(doc, indent=1)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(body)
+        print(f"wrote {len(recs)} spans to {out_path} "
+              "(load in ui.perfetto.dev or chrome://tracing)")
+    else:
+        print(body)
+
+
+@command("trace", "trace tail [N] | show <id> | export [<id>] "
+         "[--out FILE]",
+         "flight recorders (tail), the cross-lane span tree of one "
+         "trace (show), or Chrome/Perfetto trace-event JSON (export)")
 def cmd_trace(ses, args):
+    if args and args[0] == "show":
+        return _trace_show(ses, args[1:])
+    if args and args[0] == "export":
+        return _trace_export(ses, args[1:])
     if not args or args[0] != "tail":
-        raise CliError("usage: trace tail [N]")
+        raise CliError(
+            "usage: trace tail [N] | show <id> | export [<id>]")
     try:
         n = int(args[1]) if len(args) > 1 else 16
     except ValueError:
@@ -248,9 +374,18 @@ def cmd_trace(ses, args):
                 f"{name}={ms:.3f}ms" for name, ms in
                 rec.get("events", []))
             tid = rec.get("id", 0)
+            extra = ""
+            if rec.get("script"):     # pipeline-lane chain identity:
+                extra = f" script={rec['script']}"  # correlates with
+            if rec.get("span"):       # `spt trace show <id>`
+                extra += f" span={rec['span']:#x}"
+            if rec.get("verbs"):
+                extra += " verbs=" + ",".join(
+                    f"{v}:{c}" for v, c in sorted(
+                        rec["verbs"].items()))
             print(f"[{daemon}] id={tid:#x} pid={tid >> 24} "
                   f"key={rec.get('key')!r} wall={rec.get('wall_ms')}ms "
-                  f"{events}")
+                  f"{events}{extra}")
             shown += 1
     if not shown:
         print("no traced requests recorded (daemons publish their "

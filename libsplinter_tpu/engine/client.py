@@ -119,19 +119,26 @@ def wait_with_repulse(store, key: str, left_ms: float, check):
 
 
 def _stamp_qos(store, key: str, tenant: int,
-               deadline_ts: float | None) -> None:
-    """Tag a freshly-written request with its tenant and absolute
-    deadline (after set, before the bump — the stamp discipline)."""
+               deadline_ts: float | None, trace=None) -> None:
+    """Tag a freshly-written request with its tenant, absolute
+    deadline, and trace context (after set, before the bump — the
+    stamp discipline).  `trace` follows protocol.stamp_trace_ctx:
+    True = new root trace, an int trace id = a hop of that trace,
+    (trace_id, parent_span) = explicit tree placement — one trace id
+    then spans a whole client-chained pipeline across lanes."""
     if tenant:
         P.stamp_tenant(store, key, tenant)
     if deadline_ts is not None:
         P.stamp_deadline(store, key, deadline_ts)
+    if trace:
+        P.stamp_trace_ctx(store, key, trace)
 
 
 def submit_completion(store, key: str, prompt: str | bytes, *,
                       timeout_ms: float = 10_000,
                       tenant: int = 0,
                       deadline_ms: float | None = None,
+                      trace=None,
                       retry: bool = True):
     """The completer-lane client: write `prompt` to `key`, raise the
     INFER request, wait for READY.
@@ -153,7 +160,7 @@ def submit_completion(store, key: str, prompt: str | bytes, *,
         # previous completion/shed — left set, the wait loop below
         # would return the raw prompt instantly as the "completion"
         store.label_clear(key, P.LBL_READY | P.LBL_SERVICING)
-        _stamp_qos(store, key, tenant, deadline_ts)
+        _stamp_qos(store, key, tenant, deadline_ts, trace)
         store.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
         store.bump(key)
 
@@ -214,6 +221,7 @@ def submit_embed(store, key: str, text: str | bytes, *,
                  timeout_ms: float = 10_000,
                  tenant: int = 0,
                  deadline_ms: float | None = None,
+                 trace=None,
                  retry: bool = True):
     """The embed-lane client that was missing (`submit_search` and
     `submit_completion` exist): write `text` to `key`, raise the
@@ -236,7 +244,7 @@ def submit_embed(store, key: str, text: str | bytes, *,
         # over-long text — left set, a successful re-embed would
         # still classify as rejected
         store.label_clear(key, P.LBL_CTX_EXCEEDED)
-        _stamp_qos(store, key, tenant, deadline_ts)
+        _stamp_qos(store, key, tenant, deadline_ts, trace)
         store.label_or(key, P.LBL_EMBED_REQ | P.LBL_WAITING)
         store.bump(key)
 

@@ -40,6 +40,7 @@ from typing import Callable, Iterator
 
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
+from ..obs.spans import SpanWriter
 from ..store import Store
 from ..utils import faults
 from ..utils.faults import fault
@@ -224,6 +225,11 @@ class Completer:
         # (protocol.stamp_trace); batched/continuous paths aggregate
         # through the span histograms only
         self.recorder = FlightRecorder()
+        self.spans = SpanWriter(store, "completer")
+        # pending spans between _prepare and _finalize, keyed by the
+        # request key (every service path pairs the two); bounded by
+        # in-flight work, with a hard cap against pathological leaks
+        self._live_spans: dict[str, object] = {}
         self._trace_published = 0      # ring state last published
         self.generation = 0            # bumped at attach (restart marker)
         self._bid = -1
@@ -344,20 +350,33 @@ class Completer:
         label trifecta lands at READY — the client (engine/client.py)
         parses the record instead of burning its timeout."""
         st = self.store
+        span = None
         try:
             if st.epoch_at(idx) & 1:
                 return False          # writer active: next cycle
-            if not st.labels_at(idx) & P.LBL_INFER_REQ:
+            labels = st.labels_at(idx)
+            if not labels & P.LBL_INFER_REQ:
                 return False          # recycled since enumeration
             key = st.key_at(idx)
             if key is None:
                 return False
+            if labels & P.LBL_TRACED:
+                # the typed reject is this request's whole service:
+                # open + commit its span around the claim (before the
+                # payload write moves the epoch), then retire the
+                # stamp the span protocol left in place
+                span = self.spans.begin(idx, st.epoch_at(idx),
+                                        tenant=tenant)
+                P.consume_trace_stamp(st, idx)
             st.label_clear(key, P.LBL_INFER_REQ | P.LBL_WAITING)
             st.set(key, payload)
             st.label_or(key, P.LBL_READY)
             st.bump(key)
         except (KeyError, OSError):
             return False
+        self.spans.commit(span, status=(
+            P.ERR_DEADLINE if counter == "deadline_expired"
+            else P.ERR_OVERLOADED))
         P.clear_deadline(st, idx)
         setattr(self.stats, counter,
                 getattr(self.stats, counter) + 1)
@@ -544,13 +563,18 @@ class Completer:
 
         stamp = None
         if st.labels_at(idx) & P.LBL_TRACED:
-            # consumed even with tracing OFF (an instrumented client's
-            # stamps must not leak keys/labels against an untraced
-            # daemon) — only recorded when tracing is on
-            stamp = P.consume_trace_stamp(st, idx,
-                                          epoch=st.epoch_at(idx))
-            if not tracer.enabled:
-                stamp = None
+            # span begin consumes the stamp (the unstaged consume-
+            # early discipline — exactly the old consume semantics),
+            # and the PendingSpan carries the context to _finalize.
+            # Consumed even with tracing OFF; recorded only when on.
+            span = self.spans.begin(idx, st.epoch_at(idx),
+                                    tenant=P.read_tenant(
+                                        st.labels_at(idx)))
+            if span is not None:
+                if len(self._live_spans) > 1024:
+                    self._live_spans.clear()   # spans are best-effort
+                self._live_spans[key] = span
+                stamp = span.stamp if tracer.enabled else None
 
         # QoS accounting at the claim (the real admission moment):
         # tagged requests count per tenant, and a consumed deadline
@@ -579,7 +603,8 @@ class Completer:
         return key, rendered, t0, stamp
 
     def _finalize(self, key: str, t0: int, n_tok: int,
-                  truncated: bool, vanished: bool = False) -> None:
+                  truncated: bool, vanished: bool = False,
+                  stages: dict | None = None) -> None:
         """The per-key request tail: oom bookkeeping, ctime backfill
         with tick delta (splainference.cpp:282,383-387),
         SERVICING→READY flip.  A key deleted mid-request must fail
@@ -588,9 +613,11 @@ class Completer:
         a completion or a max_val truncation."""
         fault("completer.commit")
         st = self.store
+        span = self._live_spans.pop(key, None)
         if vanished:
             self.stats.vanished += 1
             self._debug(f"key {key!r} vanished mid-request")
+            self.spans.commit(span, status="error", stages=stages)
             return
         if truncated:
             self.stats.truncated += 1
@@ -606,7 +633,10 @@ class Completer:
         except (KeyError, OSError):
             self.stats.vanished += 1
             self._debug(f"key {key!r} vanished mid-request")
+            self.spans.commit(span, status="error", stages=stages)
             return
+        self.spans.commit(span, stages=stages,
+                          extra={"tokens": n_tok})
         self.stats.completions += 1
         self.stats.tokens += n_tok
         try:
@@ -1057,8 +1087,13 @@ class Completer:
                 res = self._flush(row["key"], row["pending"])
                 truncated = res == "full"
                 vanished = res == "gone"
+            stages = None
+            if row.get("spans"):
+                stages = {}
+                for name, ms in row["spans"]:
+                    stages[name] = stages.get(name, 0.0) + ms
             self._finalize(row["key"], row["t0"], row["n_tok"],
-                           truncated, vanished)
+                           truncated, vanished, stages=stages)
             if row.get("stamp") is not None \
                     and row.get("spans") is not None:
                 tid, ts = row["stamp"]
@@ -1320,7 +1355,8 @@ class Completer:
                     self._requeue_failed([idx])
         if n:
             self._maybe_demote_spec()
-        return n
+        self.spans.flush()            # oneshot drains land their
+        return n                      # spans; run() uses heartbeats
 
     # -- speculative degradation ------------------------------------------
 
@@ -1426,7 +1462,9 @@ class Completer:
         reference's __debug chatter; sidecar group-63 watch surfaces
         it).  SPTPU_TRACE=1 adds histogram-sourced INFER_STAGES
         quantiles, recorder accounting, and the slow log."""
-        payload = dataclasses.asdict(self.stats)
+        self.spans.flush()            # heartbeat cadence, off the
+        payload = dataclasses.asdict(self.stats)      # wake path
+        payload["spans_obs"] = self.spans.counters()
         payload["generation"] = self.generation
         # decode-overlap gauge: inflight_peak pinned here means the
         # chunk window saturates (sptpu_completer_inflight_depth)

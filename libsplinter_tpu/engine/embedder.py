@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
+from ..obs.spans import SpanWriter
 from ..store import Store
 from ..utils import faults
 from ..utils.faults import fault
@@ -255,6 +256,8 @@ class Embedder:
         # whose client stamped a trace id (protocol.stamp_trace);
         # published next to the heartbeat (KEY_EMBED_TRACE)
         self.recorder = FlightRecorder()
+        self.spans = SpanWriter(store, "embedder")
+        self._live_spans: list = []           # pending spans this drain
         self._trace_published = 0             # ring state last published
         self._stage_acc: dict | None = None   # live drain's stage sums
         self._traced_hits: list | None = None  # LBL_TRACED rows seen
@@ -684,7 +687,8 @@ class Embedder:
         self._pending.update(row.item for row in plan.deferred)
         return [row.item for row in plan.admit]
 
-    def _reject_row(self, idx: int) -> None:
+    def _reject_row(self, idx: int, status: str,
+                    tenant: int = 0) -> None:
         """Shared terminal-reject tail for deadline expiry and shed:
         ZERO the vector lane first — a re-embed request's slot still
         holds the PREVIOUS text's vector, and without the scrub a
@@ -696,6 +700,19 @@ class Embedder:
         st = self.store
         self._pending.discard(idx)
         P.clear_deadline(st, idx)
+        # a rejected request's trace context must not leak — and the
+        # reject IS the request's whole service, so it gets a typed
+        # span like every other lane's shed path (begin consumes the
+        # stamp; an untraced row costs one label test)
+        try:
+            if st.labels_at(idx) & P.LBL_TRACED:
+                self.spans.commit(
+                    self.spans.begin(idx, st.epoch_at(idx),
+                                     tenant=tenant),
+                    status=status)
+        except (KeyError, OSError):
+            pass
+        P.clear_span_stage(st, idx)
         try:
             st.vec_set_at(idx, np.zeros(st.vec_dim, np.float32))
             key = st.key_at(idx)
@@ -712,7 +729,7 @@ class Embedder:
         reads."""
         self.stats.deadline_expired += 1
         self.tenants.bump(tenant, "deadline_expired")
-        self._reject_row(idx)
+        self._reject_row(idx, P.ERR_DEADLINE, tenant)
 
     def _shed_row(self, idx: int, tenant: int) -> None:
         """High-water shed: unblock the row label-only (the embed slot
@@ -722,7 +739,7 @@ class Embedder:
         qos.retry_after_ms tell a monitoring client when to retry)."""
         self.stats.shed += 1
         self.tenants.bump(tenant, "shed")
-        self._reject_row(idx)
+        self._reject_row(idx, P.ERR_OVERLOADED, tenant)
 
     def process_rows(self, rows: list[int]) -> int:
         """Embed a set of candidate slot indices; returns committed count.
@@ -786,60 +803,61 @@ class Embedder:
 
     def _begin_trace(self, keep: list[int],
                      epochs: list[int]) -> list | None:
-        """Arm the drain's PIPELINE_STAGES accumulator and read the
-        trace stamps of LBL_TRACED rows the candidate filter flagged.
-        Disabled tracing costs one attribute check; enabled tracing
-        with no traced rows costs no store lookups at all.  Stamps are
+        """Arm the drain's PIPELINE_STAGES accumulator and open spans
+        for the LBL_TRACED rows the candidate filter flagged.  Span
+        capture is ALWAYS on (bounded by head sampling — only stamped
+        rows pay anything); the histogram tracer additionally arms
+        the stage accumulator when SPTPU_TRACE=1.  Stamps are
         epoch-checked against the gathered request: a stale stamp (a
         request serviced before its stamp landed) is consumed, never
-        attributed to this drain."""
+        attributed to this drain.  begin() consumes the stamp while
+        the slot is still this request's (the consume-early
+        discipline) and the span record buffers until the heartbeat-
+        cadence flush."""
         hits, self._traced_hits = self._traced_hits, None
-        if not tracer.enabled:
+        self._live_spans = []
+        if tracer.enabled:
+            acc = dict.fromkeys(P.PIPELINE_STAGES, 0.0)
+            # the drain stage: signal drain + candidate filter +
+            # seqlock gather — everything between the wake and the
+            # first tokenize (disjoint from the other stages; the
+            # WHOLE drain's wall, stages nested, is embed.drain_cycle)
+            if self._drain_t0 is not None:
+                acc["drain"] = \
+                    (time.perf_counter() - self._drain_t0) * 1e3
+                self._drain_t0 = None
+                tracer.record("embed.drain", acc["drain"])
+            self._stage_acc = acc
+        else:
             self._stage_acc = None
-            # shed stamps an instrumented client left for an untraced
-            # daemon — they would otherwise accumulate forever
-            for idx in (hits or ()):
-                P.consume_trace_stamp(self.store, idx)
-            return None
-        acc = dict.fromkeys(P.PIPELINE_STAGES, 0.0)
-        # the drain stage: signal drain + candidate filter + seqlock
-        # gather — everything between the wake and the first tokenize
-        # (disjoint from the other stages; the WHOLE drain's wall,
-        # stages nested, is the embed.drain_cycle span)
-        if self._drain_t0 is not None:
-            acc["drain"] = (time.perf_counter() - self._drain_t0) * 1e3
-            self._drain_t0 = None
-            tracer.record("embed.drain", acc["drain"])
-        self._stage_acc = acc
         traced = []
         if hits:
             kept = {idx: e for idx, e in zip(keep, epochs)}
             for idx in hits:
                 if idx not in kept:
                     continue          # torn/raced: retried next drain
-                # consume HERE, while the slot is still this
-                # request's: by drain end the client may have unset
-                # the key and a NEW request (with its own fresh
-                # stamp) may occupy the slot — mutating then would
-                # destroy the newcomer's stamp.  A stale/missing
-                # stamp sheds the phantom label the same way.
-                stamp = P.consume_trace_stamp(self.store, idx,
-                                              epoch=kept[idx])
-                if stamp is not None:
-                    try:
-                        key = self.store.key_at(idx)
-                    except (KeyError, OSError):
-                        key = None
-                    traced.append((key, stamp[0], stamp[1]))
+                span = self.spans.begin(
+                    idx, kept[idx],
+                    tenant=P.read_tenant(
+                        self._row_labels.get(idx, 0)))
+                if span is None:
+                    continue          # stale stamp: already shed
+                self._live_spans.append(span)
+                if tracer.enabled:
+                    traced.append((span.key, span.tid, span.t_queue))
         return traced
 
     def _end_trace(self, traced: list | None) -> None:
-        """Emit one flight-recorder record per traced request: the
-        drain's stage sums as an ordered wake->commit event sequence,
-        wall time measured from the client's stamp timestamp.  Pure
-        recording — every store mutation happened at _begin_trace,
-        when the slot still belonged to the traced request."""
+        """Commit the drain's spans and emit one flight-recorder
+        record per traced request: the drain's stage sums as an
+        ordered wake->commit event sequence, wall time measured from
+        the client's stamp timestamp."""
         acc, self._stage_acc = self._stage_acc, None
+        spans, self._live_spans = self._live_spans, []
+        stage_map = ({s: acc[s] for s in P.PIPELINE_STAGES}
+                     if acc is not None else None)
+        for span in spans:
+            self.spans.commit(span, stages=stage_map)
         if acc is None:
             return
         # e2e records for EVERY traced drain (not just stamped ones):
@@ -1040,15 +1058,22 @@ class Embedder:
                 return self.process_rows(sorted(rows))
 
     def run_once(self) -> int:
-        """One full drain cycle (--oneshot): dirty mask + label sweep."""
-        return self.drain(sweep=True)
+        """One full drain cycle (--oneshot): dirty mask + label sweep.
+        Buffered span records flush here (oneshot = drain to a
+        consistent observable state); the run loop flushes them on
+        the heartbeat cadence instead."""
+        n = self.drain(sweep=True)
+        self.spans.flush()
+        return n
 
     def publish_stats(self) -> None:
         """Heartbeat: JSON stats snapshot into the debug-labeled
         __embedder_stats key (observability counterpart of the
         reference's __debug channel; the sidecar's group-63 watch
         surfaces every update)."""
-        payload = {**dataclasses.asdict(self.stats),
+        self.spans.flush()            # heartbeat cadence, off the
+        payload = {**dataclasses.asdict(self.stats),  # wake path
+                   "spans_obs": self.spans.counters(),
                    "overlap_ratio": round(self.stats.overlap_ratio(), 4),
                    "generation": self.generation,
                    "pending": len(self._pending)}

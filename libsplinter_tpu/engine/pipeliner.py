@@ -52,6 +52,7 @@ import time
 
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
+from ..obs.spans import SpanWriter, sweep_span_stages
 from ..scripting.microlua import LuaCoroutine, LuaError, LuaTable
 from ..scripting.sandbox import (KILL_BUDGET, KILL_DEADLINE,
                                  ScriptBudget, compile_chunk,
@@ -119,11 +120,11 @@ class ScriptRun:
     """One admitted script's runtime state."""
 
     __slots__ = ("idx", "epoch", "key", "tenant", "deadline", "rt",
-                 "co", "await_", "verbs", "stages", "stamp",
-                 "t_start", "label")
+                 "co", "await_", "verbs", "verb_counts", "stages",
+                 "span", "t_start", "label")
 
     def __init__(self, idx, epoch, key, tenant, deadline, rt, co,
-                 stamp, label):
+                 span, label):
         self.idx = idx
         self.epoch = epoch
         self.key = key
@@ -133,10 +134,16 @@ class ScriptRun:
         self.co = co
         self.await_ = None
         self.verbs = 0
+        self.verb_counts: dict[str, int] = {}
         self.stages = dict.fromkeys(P.SCRIPT_STAGES, 0.0)
-        self.stamp = stamp           # (trace_id, client_wall_ts) | None
+        self.span = span             # obs.spans.PendingSpan | None
         self.t_start = time.perf_counter()
         self.label = label           # "inline" or the stored name
+
+    @property
+    def stamp(self):
+        """(trace_id, client_wall_ts) | None — the recorder's view."""
+        return self.span.stamp if self.span is not None else None
 
 
 class _Request:
@@ -220,6 +227,11 @@ class Pipeliner:
         self._parsed: dict[tuple[int, int], _Request] = {}
         self.generation = 0
         self.recorder = FlightRecorder()
+        # staged (crash recovery with attempt counts: scripts live
+        # whole chains) + eager (the pump is host orchestration, not
+        # a device wake path — spans land the moment a script ends)
+        self.spans = SpanWriter(store, "pipeliner", staged=True,
+                                eager=True)
         self._trace_published = 0
         self._bid = -1
         self._running = False
@@ -336,11 +348,15 @@ class Pipeliner:
             r = row.item
             if r.traced:
                 r.traced = False
-                stamp = P.consume_trace_stamp(self.store, r.idx,
-                                              epoch=r.epoch)
+                # span begin reads the stamp NON-destructively (it
+                # must survive a mid-chain crash so the restarted
+                # lane's re-run keeps the chain identity) and stages
+                # the pending span; the commit retires both
+                span = self.spans.begin(r.idx, r.epoch,
+                                        tenant=r.tenant)
             else:
-                stamp = None
-            row.stamp = stamp     # type: ignore[attr-defined]
+                span = None
+            row.span = span       # type: ignore[attr-defined]
         for row in plan.expired:
             r = row.item
             self._parsed.pop((r.idx, r.epoch), None)
@@ -348,6 +364,8 @@ class Pipeliner:
             self.tenants.bump(r.tenant, "deadline_expired")
             P.clear_deadline(self.store, r.idx)
             self._commit(r.idx, r.epoch, {"err": P.ERR_DEADLINE})
+            self.spans.commit(getattr(row, "span", None),
+                              status=P.ERR_DEADLINE)
         for row in plan.shed:
             r = row.item
             self._parsed.pop((r.idx, r.epoch), None)
@@ -356,6 +374,8 @@ class Pipeliner:
             P.clear_deadline(self.store, r.idx)
             self._commit(r.idx, r.epoch,
                          P.overloaded_record(self.qos.retry_after_ms))
+            self.spans.commit(getattr(row, "span", None),
+                              status=P.ERR_OVERLOADED)
         # deferral counts FIRST sights only: the memo re-offers a
         # deferred row every re-plan, which must not inflate the stat
         self.stats.deferred += sum(
@@ -367,11 +387,11 @@ class Pipeliner:
                 self.tenants.bump(r.tenant, "admitted")
             if r.deadline is not None:
                 P.clear_deadline(self.store, r.idx)
-            self._start(r, getattr(row, "stamp", None))
+            self._start(r, getattr(row, "span", None))
 
     # -- script lifecycle --------------------------------------------------
 
-    def _start(self, req: _Request, stamp) -> None:
+    def _start(self, req: _Request, span) -> None:
         """Parse stage: build the sandbox, compile the chunk, wrap it
         in the host coroutine, then run its first slice."""
         t0 = time.perf_counter()
@@ -383,7 +403,7 @@ class Pipeliner:
         try:
             rt = make_sandboxed_runtime(self.store, budget)
             run = ScriptRun(req.idx, req.epoch, key, req.tenant,
-                            req.deadline, rt, None, stamp, req.label)
+                            req.deadline, rt, None, span, req.label)
             self._overlay_verbs(rt, run)
             fn = compile_chunk(rt, req.src, chunk_name=req.label)
             arg = LuaTable({0: req.label})
@@ -393,6 +413,7 @@ class Pipeliner:
             run.co = LuaCoroutine(fn, rt)
         except LuaError as ex:
             self._fail(req.idx, req.epoch, f"parse: {ex}")
+            self.spans.commit(span, status=ERR_SCRIPT)
             return
         run.stages["parse"] = (time.perf_counter() - t0) * 1e3
         self.stats.scripts_started += 1
@@ -466,6 +487,10 @@ class Pipeliner:
         t0 = time.perf_counter()
         self._commit(run.idx, run.epoch, rec)
         run.stages["commit"] = (time.perf_counter() - t0) * 1e3
+        self.spans.commit(
+            run.span, status=err or "ok",
+            stages={s: run.stages[s] for s in P.SCRIPT_STAGES},
+            extra={"script": run.label, "verbs": run.verbs})
         self._record_trace(run)
         self._retire(run)
 
@@ -506,6 +531,7 @@ class Pipeliner:
         def guard(name: str) -> None:
             fault("pipeliner.verb")
             run.verbs += 1
+            run.verb_counts[name] = run.verb_counts.get(name, 0) + 1
             self.stats.verbs_total += 1
             self.verb_counts[name] = self.verb_counts.get(name, 0) + 1
             if run.verbs > rt.budget.max_verbs:
@@ -531,6 +557,15 @@ class Pipeliner:
                 P.stamp_tenant(st, key, run.tenant)
             if run.deadline is not None:
                 P.stamp_deadline(st, key, run.deadline)
+            _stamp_trace(key)
+
+        def _stamp_trace(key: str) -> None:
+            # trace-context propagation: every verb the script
+            # dispatches joins the REQUEST's trace, parented on the
+            # script's own span — one trace id spans the whole chain
+            if run.span is not None:
+                P.stamp_trace(st, key, trace_id=run.span.tid,
+                              parent=run.span.span)
 
         def submit_embed(key, text):
             guard("submit_embed")
@@ -554,6 +589,7 @@ class Pipeliner:
             idx = st.find_index(key)
             if run.tenant:
                 P.stamp_tenant(st, key, run.tenant)
+            _stamp_trace(key)
             st.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
             st.bump(key)
             return suspend(_Await("search", key, idx=idx, k=int(k)))
@@ -765,6 +801,9 @@ class Pipeliner:
                 except (KeyError, OSError):
                     pass
         self.stats.results_reaped += reaped
+        # the pending-span staging rows share the same reaper cadence
+        # (orphans: raced rewrites, crashed chains nobody re-drained)
+        sweep_span_stages(st, ttl_s=ttl_s, now=now)
         return reaped
 
     def _record_trace(self, run: ScriptRun) -> None:
@@ -778,13 +817,25 @@ class Pipeliner:
             tid, ts = run.stamp
             client_wall = ((time.time() - ts) * 1e3 if ts > 0
                            else wall)
-            self.recorder.record(
+            slot = self.recorder.record(
                 tid, run.key, client_wall,
                 [[s, round(run.stages[s], 3)]
                  for s in P.SCRIPT_STAGES])
+            # chain identity on the ring entry: the script name, its
+            # span id, and the per-verb dispatch counts — `spt trace
+            # tail` on the script lane correlates with `spt trace
+            # show <id>`'s span tree.  ALWAYS assigned: ring slots
+            # are REUSED dicts, and a stale key left by the previous
+            # occupant would attach phantom verbs to the wrong script
+            slot["script"] = run.label
+            slot["span"] = (run.span.span if run.span is not None
+                            else None)
+            slot["verbs"] = (dict(run.verb_counts)
+                             if run.verb_counts else None)
 
     def publish_stats(self) -> None:
         payload = {**dataclasses.asdict(self.stats),
+                   "spans_obs": self.spans.counters(),
                    "scripts_active": len(self.runs),
                    "max_scripts": self.max_scripts,
                    "generation": self.generation}
@@ -894,6 +945,7 @@ def submit_script(store: Store, key: str, *, script: str | None = None,
                   timeout_ms: float = 10_000,
                   tenant: int = 0,
                   deadline_ms: float | None = None,
+                  trace=None,
                   retry: bool = True):
     """Client side: submit a script request on `key` and wait for its
     result record.  Returns the parsed __pr_ record ({"ok": true,
@@ -918,7 +970,8 @@ def submit_script(store: Store, key: str, *, script: str | None = None,
             req["deadline"] = round(deadline_ts, 6)
         store.set(key, json.dumps(req))
         idx = store.find_index(key)
-        _stamp_qos(store, key, tenant, None)   # deadline rides JSON
+        _stamp_qos(store, key, tenant, None,   # deadline rides JSON
+                   trace)
         store.label_or(key, P.LBL_SCRIPT_REQ | P.LBL_WAITING)
         store.bump(key)
 

@@ -235,25 +235,68 @@ PROBE_BATCH_MAX_DEFAULT = 8
 
 # --- request trace ids ----------------------------------------------------
 # A client that wants its request's wake->commit journey reconstructed
-# stamps a trace id NEXT TO the request label: after set + label_or
-# (LBL_EMBED_REQ / LBL_INFER_REQ), ideally before the bump, it writes
-# "<trace_id>:<wall_ts>:<slot_epoch>" into the slot-indexed companion
-# key trace_stamp_key(idx).  The epoch field makes stamps
-# self-invalidating (a daemon discards a stamp whose epoch doesn't
-# match the request it gathered) — clients implementing the
-# convention by hand must include it or forfeit that protection.  The
-# servicing daemon (SPTPU_TRACE=1) consumes the stamp when it drains
-# the row, appends the request's stage events to its flight recorder
-# under the PIPELINE_STAGES names, and publishes the ring — so any
-# single request is reconstructable cross-process via `spt trace
-# tail`.  Ids are (pid << 24 | counter): unique across concurrent
-# clients without coordination, and the originating pid is
+# stamps a trace CONTEXT next to the request label: after set +
+# label_or (LBL_EMBED_REQ / LBL_INFER_REQ), ideally before the bump,
+# it writes "<trace_id>:<wall_ts>:<slot_epoch>[:<parent>:<span>]"
+# into the slot-indexed companion key trace_stamp_key(idx).  The
+# epoch field makes stamps self-invalidating (a daemon discards a
+# stamp whose epoch doesn't match the request it gathered) — clients
+# implementing the convention by hand must include it or forfeit that
+# protection.  The two trailing fields are the DISTRIBUTED-tracing
+# extension (PR 13): `parent` is the span id this request hangs
+# under in the trace tree (0 = root) and `span` is the id assigned to
+# THIS request's span — pre-assigned by the stamper so chained hops
+# (the pipeline lane's verbs, a client-side rag chain) share one
+# trace id across lanes while every hop stays addressable.  Legacy
+# 3-field stamps parse as parent=0, span=trace_id.  The servicing
+# daemon consumes the stamp when it COMMITS the row (not at drain —
+# the stamp must survive a mid-service crash so the restarted lane's
+# span still carries the chain identity), appends the request's stage
+# events to its flight recorder (SPTPU_TRACE=1) and commits a span
+# record into the shared span ring (obs/spans.py, always on) — so
+# any single chain is reconstructable cross-process via `spt trace
+# show <id>`.  Ids are (pid << 24 | counter): unique across
+# concurrent clients without coordination, and the originating pid is
 # recoverable (id >> 24).
 TRACE_STAMP_PREFIX = "__tr_"
+
+# pending-span staging rows (obs/spans.py): one per in-service traced
+# request, keyed by the REQUEST's slot index — the crash-surviving
+# half of the span protocol (a restarted lane recovers the chain
+# identity, the original queue-enter clock, and the attempt count
+# from here).  Orphans (slot epoch moved, or TTL) are swept by
+# shed_orphan_stamp's discard path and the lanes' heartbeat-cadence
+# sweeps, mirroring the __sr_ reaper.
+SPAN_STAGE_PREFIX = "__sp_"
+
+# the shared bounded span ring: committed span records land in
+# span_ring_key(head % ring size) slots, the head claimed atomically
+# through the BIGUINT counter key — multi-writer safe across all
+# four lanes, bounded by construction (old spans overwrite)
+SPAN_RING_PREFIX = "__span_"
+KEY_SPAN_HEAD = "__span_head"
+
+# telemetry-history rings (engine/telemetry.py): one per scraped
+# lane, fixed-size time series of the lane's heartbeat gauges —
+# the signal plane the elastic-lane scaling controller reads
+TELEMETRY_PREFIX = "__tele_"
+KEY_TELEMETRY_STATS = "__telemetry_stats"
 
 
 def trace_stamp_key(idx: int) -> str:
     return f"{TRACE_STAMP_PREFIX}{idx}"
+
+
+def span_stage_key(idx: int) -> str:
+    return f"{SPAN_STAGE_PREFIX}{idx}"
+
+
+def span_ring_key(i: int) -> str:
+    return f"{SPAN_RING_PREFIX}{i}"
+
+
+def telemetry_key(lane: str) -> str:
+    return f"{TELEMETRY_PREFIX}{lane}"
 
 
 _trace_counter = itertools.count(1)
@@ -263,12 +306,18 @@ def next_trace_id() -> int:
     return (os.getpid() << 24) | (next(_trace_counter) & 0xFFFFFF)
 
 
-def stamp_trace(store, key: str) -> int | None:
+def stamp_trace(store, key: str, *, trace_id: int | None = None,
+                parent: int = 0,
+                span: int | None = None) -> int | None:
     """Client-side: mark the pending request on `key` for flight
-    recording (best after set+label, before the bump — a daemon
-    racing the stamp then can't service the row stampless).  Returns
-    the trace id, or None when the stamp could not land (tracing must
-    never fail a request).
+    recording + span capture (best after set+label, before the bump —
+    a daemon racing the stamp then can't service the row stampless).
+    Bare `stamp_trace(store, key)` starts a NEW trace (span id ==
+    trace id, the root); passing `trace_id` (+ `parent`) joins an
+    existing one — the chained-hop form every client verb and the
+    pipeline lane's verbs use.  Returns the SPAN id assigned to this
+    request (== the trace id for a root stamp), or None when the
+    stamp could not land (tracing must never fail a request).
 
     LBL_TRACED on the request key is the cheap discovery signal: the
     daemon's candidate filter already reads every row's label word, so
@@ -280,34 +329,75 @@ def stamp_trace(store, key: str) -> int | None:
     it — and its seconds-old wall clock — to the wrong request."""
     try:
         idx = store.find_index(key)
-        tid = next_trace_id()
+        if trace_id is None:
+            tid = next_trace_id()
+            span = tid if span is None else span
+        else:
+            tid = int(trace_id)
+            span = next_trace_id() if span is None else span
         sk = trace_stamp_key(idx)
-        store.set(sk, f"{tid}:{time.time():.6f}:{store.epoch_at(idx)}")
+        store.set(sk, f"{tid}:{time.time():.6f}:{store.epoch_at(idx)}"
+                      f":{int(parent)}:{int(span)}")
         store.label_or(sk, LBL_DEBUG)
         store.label_or(key, LBL_TRACED)
-        return tid
+        return span
     except (KeyError, OSError):
         return None
 
 
-def read_trace_stamp(store, idx: int,
-                     epoch: int | None = None) -> tuple[int, float] | None:
-    """Daemon-side: (trace_id, client_wall_ts) for slot idx, or None.
-    With `epoch` given (the gathered request's epoch), a stamp from a
-    DIFFERENT epoch is stale: it is consumed (cleared) and None is
-    returned, so it can never corrupt a later request's record."""
+def stamp_trace_ctx(store, key: str, trace) -> int | None:
+    """Normalize the client verbs' `trace=` argument into a stamp:
+    `True` starts a fresh root trace; an int trace id stamps a hop of
+    that trace parented on its root; a `(trace_id, parent_span)`
+    tuple places the hop explicitly (the pipeline lane's verbs and
+    chained client calls use this).  Returns the hop's span id (or
+    None — tracing never fails a request)."""
+    if not trace:
+        return None
+    if trace is True:
+        return stamp_trace(store, key)
+    if isinstance(trace, tuple):
+        return stamp_trace(store, key, trace_id=trace[0],
+                           parent=trace[1])
+    return stamp_trace(store, key, trace_id=int(trace),
+                       parent=int(trace))
+
+
+def read_trace_ctx(store, idx: int, epoch: int | None = None
+                   ) -> tuple[int, float, int, int] | None:
+    """Daemon-side: (trace_id, client_wall_ts, parent_span, span_id)
+    for slot idx, or None.  With `epoch` given (the gathered
+    request's epoch), a stamp from a DIFFERENT epoch is stale: it is
+    consumed (cleared, label too) and None is returned, so it can
+    never corrupt a later request's record.  Legacy 3-field stamps
+    read as parent=0, span=trace_id."""
     try:
         raw = store.get(trace_stamp_key(idx)).rstrip(b"\0").decode()
         parts = raw.split(":")
         tid = int(parts[0])
         ts = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
         e_stamp = int(parts[2]) if len(parts) > 2 and parts[2] else None
+        parent = int(parts[3]) if len(parts) > 3 and parts[3] else 0
+        span = int(parts[4]) if len(parts) > 4 and parts[4] else tid
     except (KeyError, OSError, ValueError, IndexError):
         return None
     if epoch is not None and e_stamp is not None and e_stamp != epoch:
         clear_trace_stamp(store, idx)         # stale: consume, never
-        return None                           # attribute to this row
-    return tid, ts
+        try:                                  # attribute to this row —
+            key = store.key_at(idx)           # and retire the phantom
+            if key is not None:               # LBL_TRACED with it
+                store.label_clear(key, LBL_TRACED)
+        except (KeyError, OSError):
+            pass
+        return None
+    return tid, ts, parent, span
+
+
+def read_trace_stamp(store, idx: int,
+                     epoch: int | None = None) -> tuple[int, float] | None:
+    """Legacy 2-field view of read_trace_ctx: (trace_id, wall_ts)."""
+    ctx = read_trace_ctx(store, idx, epoch=epoch)
+    return None if ctx is None else (ctx[0], ctx[1])
 
 
 def clear_trace_stamp(store, idx: int) -> None:
@@ -576,18 +666,49 @@ _REQ_LABELS = (LBL_EMBED_REQ | LBL_INFER_REQ | LBL_SERVICING
                | LBL_SEARCH_REQ | LBL_SCRIPT_REQ)
 
 
+def clear_span_stage(store, idx: int) -> None:
+    """Retire slot idx's pending-span staging row.  Never raises."""
+    try:
+        store.unset(span_stage_key(idx))
+    except (KeyError, OSError):
+        pass
+
+
+def _span_stage_orphaned(store, tgt: int) -> bool:
+    """True when the staging row for slot `tgt` no longer belongs to
+    a pending request: the slot is gone, its epoch moved past the one
+    the span was staged under (a raced rewrite — the NEW occupant
+    will stage its own), or no daemon will ever commit it (no request
+    labels left).  Staging wire form (obs/spans.py):
+    "tid:span:parent:epoch:attempts:t_queue:gap_ms:ts"."""
+    try:
+        raw = store.get(span_stage_key(tgt)).rstrip(b"\0").decode()
+        e = int(raw.split(":")[3])
+    except (KeyError, OSError, ValueError, IndexError,
+            UnicodeDecodeError):
+        return True                   # unreadable staging: retire
+    if tgt >= store.nslots or store.key_at(tgt) is None:
+        return True
+    if store.epoch_at(tgt) != e:
+        return True
+    return not store.labels_at(tgt) & _REQ_LABELS
+
+
 def shed_orphan_stamp(store, idx: int, labels: int) -> bool:
     """Retire a trace stamp whose request is no longer pending, so a
     stamp that landed AFTER its request was serviced — with no
     follow-up request ever arriving — cannot leak its __tr_<idx> slot
     and LBL_TRACED forever.  Daemons call this from their discard
-    path for rows that carry TRACED or DEBUG labels; handles both the
-    stamped row itself and a freshly-written stamp slot (__tr_<n>)
-    surfacing through the dirty mask.  Returns True if something was
-    shed."""
+    path for rows that carry TRACED or DEBUG labels; handles the
+    stamped row itself, a freshly-written stamp slot (__tr_<n>)
+    surfacing through the dirty mask, and an orphaned pending-span
+    staging row (__sp_<n> whose request slot epoch moved or whose
+    labels cleared without a span commit — the raced-rewrite leak).
+    Returns True if something was shed."""
     shed = False
     if labels & LBL_TRACED and not labels & _REQ_LABELS:
         consume_trace_stamp(store, idx)
+        clear_span_stage(store, idx)
         shed = True
     if labels & LBL_DEADLINE and not labels & _REQ_LABELS:
         clear_deadline(store, idx)
@@ -612,6 +733,14 @@ def shed_orphan_stamp(store, idx: int, labels: int) -> bool:
                 if tl & flag and not tl & _REQ_LABELS:
                     retire(store, tgt)
                     return True
+        if key and key.startswith(SPAN_STAGE_PREFIX):
+            try:
+                tgt = int(key[len(SPAN_STAGE_PREFIX):])
+            except ValueError:
+                return False
+            if _span_stage_orphaned(store, tgt):
+                clear_span_stage(store, tgt)
+                return True
     return False
 
 

@@ -50,6 +50,7 @@ import numpy as np
 
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
+from ..obs.spans import SpanWriter, sweep_span_stages
 from ..store import Store
 from ..utils import faults
 from ..utils.faults import fault
@@ -145,7 +146,7 @@ class SearcherStats:
 
 class _Request:
     __slots__ = ("idx", "epoch", "k", "bloom", "fast", "qvec", "stamp",
-                 "tenant", "deadline", "traced")
+                 "tenant", "deadline", "traced", "span")
 
     def __init__(self, idx, epoch, k, bloom, fast, qvec, stamp,
                  tenant=0, deadline=None, traced=False):
@@ -158,10 +159,11 @@ class _Request:
         self.stamp = stamp       # (trace_id, client_wall_ts) | None
         self.tenant = tenant     # label-word tenant id (0 = untagged)
         self.deadline = deadline  # absolute wall-clock deadline | None
-        self.traced = traced     # LBL_TRACED seen at gather (stamp is
-                                 # consumed at ADMISSION, not gather —
-                                 # a deferred request keeps its stamp
+        self.traced = traced     # LBL_TRACED seen at gather (the span
+                                 # opens at ADMISSION, not gather — a
+                                 # deferred request keeps its stamp
                                  # for the drain that serves it)
+        self.span = None         # obs.spans.PendingSpan | None
 
 
 class Searcher:
@@ -222,6 +224,7 @@ class Searcher:
         self.stats = SearcherStats()
         self.generation = 0          # bumped at attach (restart marker)
         self.recorder = FlightRecorder()
+        self.spans = SpanWriter(store, "searcher")
         self._trace_published = 0
         self._stage_acc: dict | None = None
         self._bid = -1
@@ -349,21 +352,24 @@ class Searcher:
         cap = self.admit_cap if self.admit_cap else len(reqs)
         plan = self.qos.plan(
             [WaitingRow(r, r.tenant, r.deadline) for r in reqs], cap)
-        # trace stamps are consumed at the admission decision, not at
-        # gather: a DEFERRED request keeps its stamp (and LBL_TRACED)
-        # for the drain that actually serves it — consuming earlier
-        # lost the flight record of every request that waited a drain
+        # spans open at the admission decision, not at gather: a
+        # DEFERRED request keeps its stamp (and LBL_TRACED) for the
+        # drain that actually serves it.  begin() consumes the stamp
+        # (the consume-early discipline; span records buffer until
+        # the heartbeat-cadence flush).
         for row in (*plan.admit, *plan.expired, *plan.shed):
             r = row.item
             if r.traced:
-                r.stamp = P.consume_trace_stamp(self.store, r.idx,
-                                                epoch=r.epoch)
+                r.span = self.spans.begin(r.idx, r.epoch,
+                                          tenant=r.tenant)
+                r.stamp = r.span.stamp if r.span is not None else None
         for row in plan.expired:
             r = row.item
             self.tenants.bump(r.tenant, "deadline_expired")
             P.clear_deadline(self.store, r.idx)
             self._fail(r.idx, r.epoch, P.ERR_DEADLINE,
                        counter="deadline_expired")
+            self.spans.commit(r.span, status=P.ERR_DEADLINE)
         for row in plan.shed:
             r = row.item
             self.tenants.bump(r.tenant, "shed")
@@ -372,6 +378,7 @@ class Searcher:
             self._commit_result(
                 r.idx, r.epoch,
                 P.overloaded_record(self.qos.retry_after_ms))
+            self.spans.commit(r.span, status=P.ERR_OVERLOADED)
         self.stats.deferred += len(plan.deferred)
         self._had_deferred = bool(plan.deferred)
         for row in plan.admit:
@@ -389,6 +396,13 @@ class Searcher:
         failures share this path; `counter` says which)."""
         setattr(self.stats, counter, getattr(self.stats, counter) + 1)
         self._commit_result(idx, epoch, {"err": err})
+
+    def _fail_span(self, r: _Request) -> None:
+        """Commit a FAILED request's span with a typed status — a
+        trace tree must never render an error-recorded hop as ok —
+        and detach it so _end_trace cannot double-commit."""
+        span, r.span = r.span, None
+        self.spans.commit(span, status="error")
 
     # -- masks -------------------------------------------------------------
 
@@ -453,6 +467,7 @@ class Searcher:
                                        counter="req_failures")
                         except Exception:
                             pass      # store down too: retried next drain
+                        self._fail_span(r)
                     served = 0
         self._end_trace(reqs)
         self.stats.served += served
@@ -579,6 +594,7 @@ class Searcher:
                 self._fail(r.idx, r.epoch,
                            f"result commit failed: {ex}",
                            counter="req_failures")
+                self._fail_span(r)
         state["commit_ms"] += (time.perf_counter() - t2) * 1e3
 
     def _score_degraded(self, arr, chunk: list[_Request], q, mask,
@@ -635,6 +651,7 @@ class Searcher:
                                counter="req_failures")
                 except Exception:
                     pass          # store down too: retried next drain
+                self._fail_span(r)
         return s_out, i_out, ok
 
     # -- commit ------------------------------------------------------------
@@ -727,6 +744,13 @@ class Searcher:
 
     def _end_trace(self, reqs: list[_Request]) -> None:
         acc, self._stage_acc = self._stage_acc, None
+        stage_map = ({s: acc[s] for s in P.SEARCH_STAGES}
+                     if acc is not None else None)
+        # span commits run whether or not the histogram tracer is on:
+        # span capture is always-on, bounded by head sampling
+        for r in reqs:
+            if r.span is not None:
+                self.spans.commit(r.span, stages=stage_map)
         if acc is None:
             return
         stage_sum = sum(acc.values())
@@ -748,8 +772,11 @@ class Searcher:
     # -- daemon loop -------------------------------------------------------
 
     def run_once(self) -> int:
-        """One full drain (tests, --oneshot)."""
-        return self.drain()
+        """One full drain (tests, --oneshot).  Buffered span records
+        flush here; the run loop flushes on the heartbeat cadence."""
+        n = self.drain()
+        self.spans.flush()
+        return n
 
     def sweep_results(self, *, ttl_s: float = RESULT_TTL_S,
                       now: float | None = None) -> int:
@@ -798,6 +825,9 @@ class Searcher:
                 except (KeyError, OSError):
                     pass
         self.stats.results_reaped += reaped
+        # the pending-span staging rows share the same reaper cadence
+        # (orphans: raced rewrites, crashed drains nobody re-ran)
+        sweep_span_stages(st, ttl_s=ttl_s, now=now)
         return reaped
 
     def publish_stats(self) -> None:
@@ -806,7 +836,9 @@ class Searcher:
         renders the rest).  With tracing on, the SEARCH_STAGES
         quantiles and the flight-recorder ring ride along — same
         section contract as the other daemons."""
-        payload = {**dataclasses.asdict(self.stats),
+        self.spans.flush()            # heartbeat cadence, off the
+        payload = {**dataclasses.asdict(self.stats),  # wake path
+                   "spans_obs": self.spans.counters(),
                    "coalesce_ratio": round(
                        self.stats.coalesce_ratio(), 4),
                    "generation": self.generation,
@@ -927,6 +959,7 @@ def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
                   timeout_ms: int = 2000,
                   tenant: int = 0,
                   deadline_ms: float | None = None,
+                  trace=None,
                   retry: bool = True) -> dict | None:
     """Client side: turn `key` (whose vector lane already holds the
     embedded query) into a search request and wait for the daemon's
@@ -955,6 +988,8 @@ def submit_search(store: Store, key: str, k: int, *, bloom: int = 0,
         store.set(key, json.dumps(req))
         if tenant:
             P.stamp_tenant(store, key, tenant)
+        if trace:
+            P.stamp_trace_ctx(store, key, trace)
         store.label_or(key, P.LBL_SEARCH_REQ | P.LBL_WAITING)
         store.bump(key)
 

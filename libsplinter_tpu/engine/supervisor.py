@@ -66,6 +66,10 @@ LANES: dict[str, tuple[str, str]] = {
     # supervised restart costs milliseconds, not an XLA warmup
     "pipeliner": ("libsplinter_tpu.engine.pipeliner",
                   P.KEY_SCRIPT_STATS),
+    # the telemetry sampler (heartbeat-history rings): jax-free; its
+    # rings live in the STORE, so a restart resumes them intact
+    "telemetry": ("libsplinter_tpu.engine.telemetry",
+                  P.KEY_TELEMETRY_STATS),
 }
 
 

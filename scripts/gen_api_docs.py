@@ -357,6 +357,34 @@ In-process, `Tracer.render_prom()` serializes the live histograms as
 native prometheus histograms (cumulative `le` buckets, edges in ms)
 plus any counter groups passed in.  `make obs-check` pins the enabled
 record path's overhead < 3% vs disabled.
+
+### Cross-lane span records (`libsplinter_tpu/obs/spans.py`)
+
+Since PR 13 the trace stamp is a full TRACE CONTEXT —
+`"<trace_id>:<wall_ts>:<slot_epoch>:<parent_span>:<span_id>"` (legacy
+3-field stamps parse as `parent=0, span=trace_id`) — and every lane
+commits one **span record** per stamped request into a shared
+bounded ring in the store:
+
+| key | contents |
+|---|---|
+| `__span_<i>` | committed span records; slot claimed by atomically incrementing the `__span_head` BIGUINT, so the ring is multi-writer safe and bounded by construction (`span_ring_size` = nslots/8 clamped to [16, 128]) |
+| `__sp_<idx>` | pending-span STAGING row (staged lanes: the pipeliner) — crash recovery: a restarted lane recovers the chain identity, the original queue-enter clock, and the attempt count, so the committed span shows the restart gap.  Orphans (slot epoch moved, TTL) are swept on the heartbeat cadence and by `shed_orphan_stamp`'s discard path |
+
+Each record carries the trace id, span id + parent (the tree edges),
+lane, key, tenant, status (`ok` / typed error), the queue-enter /
+admit / commit wall clocks, and the **queue-wait vs service-time
+split** — with per-stage ms under the pinned `*_STAGES` names when
+`SPTPU_TRACE=1`.  Record commits BUFFER in the lane and flush on the
+heartbeat cadence, keeping the wake path inside the obs budget
+(`make trace-check` gates it).  Propagation: every client verb
+(`submit_embed` / `submit_search` / `submit_completion` /
+`submit_script`) takes `trace=` (True = new root, a trace id = a hop
+of that trace, `(trace_id, parent_span)` = explicit placement), and
+the pipeline lane stamps every verb a script dispatches with the
+script's own span as parent — ONE trace id spans a whole chain in
+both forms.  `spt trace show <id>` renders the assembled tree;
+`spt trace export` emits Chrome/Perfetto trace-event JSON.
 """,
     "system-keys-user-flags": """
 ## Supervision heartbeat keys (`libsplinter_tpu/engine/supervisor.py`)
@@ -460,6 +488,34 @@ flagged by `LBL_DEADLINE` on the request key, format
 `"<deadline_ts>:<slot_epoch>"` — the trace-stamp discipline: epoch
 self-invalidating, consumed at service, orphans shed).  Runbook:
 `docs/operations.md` §Multi-tenant QoS; harness: `spt loadgen`.
+
+### Telemetry-history keys (`libsplinter_tpu/engine/telemetry.py`)
+
+The telemetry sampler (supervisable lane `telemetry`, jax-free)
+scrapes every lane heartbeat on its cadence into fixed-size
+time-series rings stored IN the store — the signal plane the
+elastic-lane scaling controller reads, rendered by `spt top` and
+`spt metrics --history`:
+
+- `__tele_<lane>` — one ring key per scraped lane:
+  `{"v": 1, "lane": ..., "interval_s": ..., "n": samples,
+  "gauges": {name: [[ts, value], ...]}}`, each gauge bounded to
+  `--ring-len` samples (default 64; an oversized snapshot halves its
+  history until it fits `max_val`).  Gauges: `queue_depth` (measured
+  by label enumeration, never trusted from the heartbeat), `shed` /
+  `deferred` / `deadline_expired`, the lane's progress counter,
+  `pages_free` (completer), `p99_<stage>_ms` when tracing is on, and
+  `tenant<id>_admitted` / `tenant<id>_served_tokens`.
+- `__telemetry_stats` — the sampler's own heartbeat (samples,
+  lanes_seen, points, shrinks, generation) — supervised exactly like
+  the serving lanes, and because the rings live in the store a
+  restarted sampler RESUMES them (gauged by the restart test in
+  `make trace-check`).
+
+Every lane heartbeat additionally carries a `spans_obs` section
+(span-capture accounting: committed / recovered / dropped / pending —
+obs/spans.py; size-droppable like every optional section), rendered
+flat by `spt metrics` as `sptpu_<lane>_spans_*`.
 """,
 }
 

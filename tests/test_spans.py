@@ -1,0 +1,679 @@
+"""Cross-lane distributed-tracing tier (`make trace-check`): the
+trace-context stamp extension (trace id + parent span), the span-ring
+wire protocol (staging rows, crash recovery with restart-gap
+attribution, the atomically-claimed bounded ring), orphan sweeps (the
+`__sr_` reaper discipline — raced rewrites cannot leak staging rows),
+span-tree assembly parity across BOTH chain forms (client-chained
+verbs and a stored script in the pipeline lane), the Chrome/Perfetto
+export schema, loadgen head sampling, and the trace-through-chaos
+drill (a supervised mid-chain lane crash yields a complete tree with
+the restart gap visible, zero admitted loss)."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.client import (submit_completion,
+                                           submit_embed)
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.engine.embedder import Embedder
+from libsplinter_tpu.engine.pipeliner import Pipeliner, submit_script
+from libsplinter_tpu.engine.searcher import Searcher, submit_search
+from libsplinter_tpu.obs import spans as S
+from libsplinter_tpu.scripting.library import seed_library
+from libsplinter_tpu.utils import faults
+
+CHILD = os.path.join(os.path.dirname(__file__), "chaos_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------ trace-context stamps
+
+class TestTraceContext:
+    def test_root_stamp_roundtrip(self, store):
+        store.set("r", "req")
+        span = P.stamp_trace(store, "r")
+        idx = store.find_index("r")
+        ctx = P.read_trace_ctx(store, idx, epoch=store.epoch_at(idx))
+        assert ctx is not None
+        tid, ts, parent, sp = ctx
+        assert tid == span and sp == tid and parent == 0
+        assert ts > 0
+        # legacy 2-field view agrees
+        assert P.read_trace_stamp(store, idx) == (tid, ts)
+
+    def test_hop_stamp_joins_existing_trace(self, store):
+        store.set("h", "hop")
+        root = P.next_trace_id()
+        span = P.stamp_trace(store, "h", trace_id=root, parent=root)
+        idx = store.find_index("h")
+        tid, _, parent, sp = P.read_trace_ctx(store, idx)
+        assert tid == root and parent == root
+        assert sp == span and sp != root      # fresh span id per hop
+
+    def test_legacy_three_field_stamp_parses(self, store):
+        store.set("l", "old")
+        idx = store.find_index("l")
+        store.set(P.trace_stamp_key(idx),
+                  f"123456:1.5:{store.epoch_at(idx)}")
+        tid, ts, parent, sp = P.read_trace_ctx(
+            store, idx, epoch=store.epoch_at(idx))
+        assert (tid, ts, parent, sp) == (123456, 1.5, 0, 123456)
+
+    def test_stale_stamp_consumed_label_and_all(self, store):
+        store.set("s", "one")
+        P.stamp_trace(store, "s")
+        store.set("s", "two")              # epoch moves: stamp stale
+        idx = store.find_index("s")
+        assert P.read_trace_ctx(store, idx,
+                                epoch=store.epoch_at(idx)) is None
+        with pytest.raises(KeyError):
+            store.get(P.trace_stamp_key(idx))
+        assert not store.labels("s") & P.LBL_TRACED
+
+    def test_stamp_trace_ctx_forms(self, store):
+        store.set("c", "x")
+        assert P.stamp_trace_ctx(store, "c", None) is None
+        assert P.stamp_trace_ctx(store, "c", True) is not None
+        t = P.next_trace_id()
+        sp = P.stamp_trace_ctx(store, "c", (t, 7))
+        idx = store.find_index("c")
+        tid, _, parent, got = P.read_trace_ctx(store, idx)
+        assert (tid, parent, got) == (t, 7, sp)
+
+
+# ------------------------------------------------------ the SpanWriter
+
+class TestSpanWriter:
+    def test_unstaged_begin_consumes_commit_buffers_flush_lands(
+            self, store):
+        store.set("q", "req")
+        span_id = P.stamp_trace(store, "q")
+        idx = store.find_index("q")
+        w = S.SpanWriter(store, "searcher")
+        pend = w.begin(idx, store.epoch_at(idx), tenant=3)
+        assert pend is not None and pend.span == span_id
+        # consume-early: the stamp + label retired at begin
+        with pytest.raises(KeyError):
+            store.get(P.trace_stamp_key(idx))
+        assert not store.labels("q") & P.LBL_TRACED
+        assert w.commit(pend, stages={"wake": 0.1})
+        assert w.counters()["pending"] == 1
+        assert S.collect_spans(store, pend.tid) == []   # buffered
+        assert w.flush() == 1
+        recs = S.collect_spans(store, pend.tid)
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["lane"] == "searcher" and r["key"] == "q"
+        assert r["tenant"] == 3 and r["status"] == "ok"
+        assert r["queue_ms"] >= 0 and r["service_ms"] >= 0
+        assert r["stages"] == {"wake": 0.1}
+
+    def test_begin_without_stamp_returns_none(self, store):
+        store.set("n", "plain")
+        idx = store.find_index("n")
+        w = S.SpanWriter(store, "embedder")
+        assert w.begin(idx, store.epoch_at(idx)) is None
+
+    def test_staged_stamp_survives_until_commit(self, store):
+        store.set("p", "script")
+        P.stamp_trace(store, "p")
+        idx = store.find_index("p")
+        w = S.SpanWriter(store, "pipeliner", staged=True, eager=True)
+        pend = w.begin(idx, store.epoch_at(idx))
+        # consume-late: stamp AND staging row both live mid-service
+        assert store.get(P.trace_stamp_key(idx))
+        assert P.span_stage_key(idx) in store
+        w.commit(pend)
+        with pytest.raises(KeyError):
+            store.get(P.trace_stamp_key(idx))
+        assert P.span_stage_key(idx) not in store
+        assert len(S.collect_spans(store, pend.tid)) == 1  # eager
+
+    def test_crash_recovery_attempts_and_gap(self, store):
+        """A staged writer that died mid-service: the restarted
+        lane's begin() recovers the SAME span identity, bumps the
+        attempt count, and attributes the restart gap."""
+        store.set("x", "chain req")
+        P.stamp_trace(store, "x")
+        idx = store.find_index("x")
+        e = store.epoch_at(idx)
+        w1 = S.SpanWriter(store, "pipeliner", staged=True)
+        p1 = w1.begin(idx, e)
+        assert p1.attempts == 1
+        time.sleep(0.05)                    # the "crash" window
+        w2 = S.SpanWriter(store, "pipeliner", staged=True,
+                          eager=True)       # the restarted lane
+        p2 = w2.begin(idx, e)
+        assert w2.recovered == 1
+        assert p2.span == p1.span and p2.tid == p1.tid
+        assert p2.attempts == 2
+        assert p2.gap_ms >= 40.0
+        assert p2.t_queue == p1.t_queue     # original queue clock
+        w2.commit(p2)
+        rec = S.collect_spans(store, p2.tid)[0]
+        assert rec["attempts"] == 2 and rec["gap_ms"] >= 40.0
+
+    def test_ring_bounded_and_multiwriter(self, store):
+        n = S.span_ring_size(store)
+        w1 = S.SpanWriter(store, "embedder", eager=True)
+        w2 = S.SpanWriter(store, "searcher", eager=True)
+        for i in range(n + 10):
+            key = f"rb{i}"
+            store.set(key, "r")
+            P.stamp_trace(store, key)
+            idx = store.find_index(key)
+            w = w1 if i % 2 else w2
+            w.commit(w.begin(idx, store.epoch_at(idx)))
+        ring_keys = [k for k in store.list()
+                     if k.startswith(P.SPAN_RING_PREFIX)
+                     and k != P.KEY_SPAN_HEAD
+                     and k[len(P.SPAN_RING_PREFIX):].isdigit()]
+        assert len(ring_keys) <= n
+        # the newest spans survived the wrap
+        spans = S.collect_spans(store)
+        assert len(spans) == n
+        assert w1.committed + w2.committed == n + 10
+
+    def test_newcomers_stamp_not_destroyed_by_staged_commit(
+            self, store):
+        """Consume-late cleanup is content-gated: a client that
+        re-stamped the slot mid-service keeps its fresh stamp."""
+        store.set("z", "first")
+        P.stamp_trace(store, "z")
+        idx = store.find_index("z")
+        w = S.SpanWriter(store, "pipeliner", staged=True, eager=True)
+        pend = w.begin(idx, store.epoch_at(idx))
+        store.set("z", "second")            # client rewrote + re-
+        fresh = P.stamp_trace(store, "z")   # stamped mid-service
+        w.commit(pend)
+        tid, _, _, sp = P.read_trace_ctx(store, idx)
+        assert sp == fresh                  # newcomer's stamp intact
+
+
+# ------------------------------------------------------------- sweeps
+
+class TestSweeps:
+    def _stage(self, store, key: str) -> int:
+        store.set(key, "req")
+        P.stamp_trace(store, key)
+        idx = store.find_index(key)
+        w = S.SpanWriter(store, "pipeliner", staged=True)
+        assert w.begin(idx, store.epoch_at(idx)) is not None
+        assert P.span_stage_key(idx) in store
+        return idx
+
+    def test_sweep_retires_epoch_moved(self, store):
+        idx = self._stage(store, "sw1")
+        store.set("sw1", "rewritten")       # raced rewrite
+        assert S.sweep_span_stages(store) >= 1
+        assert P.span_stage_key(idx) not in store
+
+    def test_sweep_retires_ttl_expired(self, store):
+        idx = self._stage(store, "sw2")
+        assert S.sweep_span_stages(store) == 0   # fresh: kept
+        assert S.sweep_span_stages(
+            store, now=time.time() + S.STAGE_TTL_S + 1) >= 1
+        assert P.span_stage_key(idx) not in store
+
+    def test_sweep_retires_vanished_slot(self, store):
+        idx = self._stage(store, "sw3")
+        store.unset("sw3")
+        S.sweep_span_stages(store)
+        assert P.span_stage_key(idx) not in store
+
+    def test_shed_orphan_stamp_retires_span_stage(self, store):
+        """The lanes' dirty-mask discard path: a staging row whose
+        request slot epoch moved (or whose labels cleared without a
+        commit) is shed like an orphan trace stamp."""
+        idx = self._stage(store, "sh1")
+        store.set("sh1", "rewritten")
+        sk = P.span_stage_key(idx)
+        store.label_or(sk, P.LBL_DEBUG)     # surface via dirty mask
+        sidx = store.find_index(sk)
+        assert P.shed_orphan_stamp(store, sidx, store.labels_at(sidx))
+        assert sk not in store
+
+    def test_shed_orphan_keeps_pending_request_stage(self, store):
+        store.set("sh2", "req")
+        P.stamp_trace(store, "sh2")
+        store.label_or("sh2", P.LBL_SCRIPT_REQ)   # still pending
+        idx = store.find_index("sh2")
+        w = S.SpanWriter(store, "pipeliner", staged=True)
+        w.begin(idx, store.epoch_at(idx))
+        sk = P.span_stage_key(idx)
+        store.label_or(sk, P.LBL_DEBUG)
+        sidx = store.find_index(sk)
+        assert not P.shed_orphan_stamp(store, sidx,
+                                       store.labels_at(sidx))
+        assert sk in store                  # in-service: kept
+
+    def test_churn_raced_rewrites_cannot_leak(self, store):
+        """The satellite churn drill: scripts admitted (staged spans
+        written), then raced by client rewrites before they commit —
+        after the pump + the reaper cadence, no `__sp_` staging row
+        survives and the ring stays bounded."""
+        pl = Pipeliner(store)
+        pl.attach()
+        for i in range(24):
+            key = f"ch{i}"
+            store.set(key, json.dumps(
+                {"script": "splinter.sleep(0.2) return 1"}))
+            P.stamp_trace(store, key)
+            store.label_or(key, P.LBL_SCRIPT_REQ | P.LBL_WAITING)
+            store.bump(key)
+            pl.pump()                       # admit (stages the span)
+            store.set(key, f"raced rewrite {i}")   # client rewrites
+            pl.pump()                       # observes the race
+        # drain whatever re-parsed as garbage requests, then reap
+        pl.run_once(timeout_s=10)
+        pl.sweep_results()
+        leaked = [k for k in store.list()
+                  if k.startswith(P.SPAN_STAGE_PREFIX)]
+        assert leaked == [], leaked
+        ring = [k for k in store.list()
+                if k.startswith(P.SPAN_RING_PREFIX)
+                and k[len(P.SPAN_RING_PREFIX):].isdigit()]
+        assert len(ring) <= S.span_ring_size(store)
+
+
+# ----------------------------------------- typed statuses on rejects
+
+class TestTypedStatusSpans:
+    def test_embedder_shed_commits_typed_span(self, store):
+        """A shed/expired traced embed request still gets its span —
+        with the typed status — instead of silently vanishing from
+        the tree (every other lane already commits one)."""
+        emb = Embedder(store, encoder_fn=lambda ts: np.zeros(
+            (len(ts), store.vec_dim), np.float32), max_ctx=64)
+        emb.attach()
+        store.set("shed1", "text")
+        tid = P.stamp_trace(store, "shed1")
+        idx = store.find_index("shed1")
+        emb._shed_row(idx, tenant=2)
+        emb.spans.flush()
+        recs = S.collect_spans(store, tid)
+        assert len(recs) == 1
+        assert recs[0]["status"] == P.ERR_OVERLOADED
+        assert recs[0]["tenant"] == 2
+        with pytest.raises(KeyError):     # context retired with it
+            store.get(P.trace_stamp_key(idx))
+
+    def test_searcher_failed_request_span_not_ok(self, store):
+        """A request failed with an error record must not render as
+        an ok span in the tree."""
+        sr = Searcher(store, interpret=True)
+        sr.attach()
+        store.set("sq", json.dumps({"k": 2}))
+        v = np.zeros(store.vec_dim, np.float32)
+        v[0] = 1.0
+        store.vec_set("sq", v)
+        P.stamp_trace(store, "sq")
+        store.label_or("sq", P.LBL_SEARCH_REQ | P.LBL_WAITING)
+        # poison every scoring path: the request fails terminally
+        faults.arm("searcher.dispatch:raise@1-100")
+        tid = None
+        try:
+            sr.run_once()
+        finally:
+            faults.disarm()
+        sr.spans.flush()
+        recs = [r for r in S.collect_spans(store)
+                if r["lane"] == "searcher"]
+        assert recs, "no searcher span committed"
+        assert all(r["status"] != "ok" for r in recs), recs
+
+    def test_pipeliner_ring_slot_reuse_no_stale_verbs(self, store,
+                                                      monkeypatch):
+        """FlightRecorder slots are reused dicts: a verb-free script
+        landing in a slot whose previous occupant dispatched verbs
+        must not inherit phantom counts."""
+        from libsplinter_tpu.utils.trace import tracer
+
+        monkeypatch.setattr(tracer, "enabled", True)
+        pl = Pipeliner(store)
+        pl.recorder._ring = [None]        # capacity 1: instant reuse
+        pl.attach()
+        store.set("v1", json.dumps(
+            {"script": "splinter.sleep(0) return 1"}))
+        P.stamp_trace(store, "v1")
+        store.label_or("v1", P.LBL_SCRIPT_REQ)
+        store.bump("v1")
+        pl.run_once(timeout_s=5)
+        assert pl.recorder.tail(1)[0]["verbs"] == {"sleep": 1}
+        store.set("v2", json.dumps({"script": "return 2"}))
+        P.stamp_trace(store, "v2")
+        store.label_or("v2", P.LBL_SCRIPT_REQ)
+        store.bump("v2")
+        pl.run_once(timeout_s=5)
+        rec = pl.recorder.tail(1)[0]
+        assert rec["key"] == "v2"
+        assert not rec["verbs"], rec      # no phantom inheritance
+
+
+# ------------------------------------------------- assembly + export
+
+def _mkspan(tid, span, parent, lane, t_admit, **kw):
+    return {"tid": tid, "span": span, "parent": parent, "lane": lane,
+            "key": f"k{span}", "idx": span, "e": 2, "status": "ok",
+            "t_queue": t_admit - 0.001, "t_admit": t_admit,
+            "t_commit": t_admit + 0.01, "queue_ms": 1.0,
+            "service_ms": 10.0, "ts": t_admit + 0.01, **kw}
+
+
+class TestAssembly:
+    def test_tree_parent_links_and_sibling_order(self):
+        spans = [_mkspan(9, 1, 0, "pipeliner", 100.0),
+                 _mkspan(9, 3, 1, "searcher", 102.0),
+                 _mkspan(9, 2, 1, "embedder", 101.0)]
+        tree = S.assemble_tree(spans)
+        root = tree["root"]
+        assert root["span"]["lane"] == "pipeliner"
+        kids = [n["span"]["lane"] for n in root["children"]]
+        assert set(kids) == {"embedder", "searcher"}
+        text = "\n".join(S.render_tree(tree))
+        assert "queue=" in text and "service=" in text
+
+    def test_orphan_parents_hang_under_synthesized_root(self):
+        tid = 7
+        spans = [_mkspan(tid, 2, tid, "embedder", 1.0),
+                 _mkspan(tid, 3, tid, "searcher", 2.0)]
+        tree = S.assemble_tree(spans)
+        assert tree["root"]["span"] is None       # synthesized
+        assert len(tree["root"]["children"]) == 2
+
+    def test_chrome_export_schema(self):
+        spans = [_mkspan(5, 1, 0, "pipeliner", 100.0,
+                         stages={"exec": 1.0}),
+                 _mkspan(5, 2, 1, "embedder", 101.0)]
+        doc = S.to_chrome_trace(spans)
+        body = json.loads(json.dumps(doc))        # round-trips
+        assert body["displayTimeUnit"] == "ms"
+        evs = body["traceEvents"]
+        assert evs
+        for e in evs:
+            assert isinstance(e["name"], str)
+            assert e["ph"] in ("X", "M")
+            assert isinstance(e["pid"], int)
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], (int, float))
+                assert e["dur"] > 0
+        # one metadata event names each lane's process
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert {e["args"]["name"] for e in meta} == \
+            {"lane:pipeliner", "lane:embedder"}
+        # queue slices carry their own category
+        assert any(e.get("cat") == "queue" for e in evs)
+
+
+# ------------------------------------------- end-to-end chain trees
+
+def _stack(store, stop_after=90.0):
+    def enc(texts):
+        out = np.zeros((len(texts), store.vec_dim), np.float32)
+        for i, t in enumerate(texts):
+            out[i, hash(t) % store.vec_dim] = 1.0
+        return out
+
+    emb = Embedder(store, encoder_fn=enc, max_ctx=64)
+    sr = Searcher(store)
+    comp = Completer(store, generate_fn=lambda p: iter([b"answer"]),
+                     template="none")
+    pl = Pipeliner(store)
+    daemons = (emb, sr, comp, pl)
+    for d in daemons:
+        d.attach()
+    # short flush cadences so span records land promptly
+    ths = [threading.Thread(target=emb.run,
+                            kwargs=dict(idle_timeout_ms=10,
+                                        stop_after=stop_after,
+                                        sweep_interval_s=0.25),
+                            daemon=True),
+           threading.Thread(target=sr.run,
+                            kwargs=dict(idle_timeout_ms=10,
+                                        stop_after=stop_after,
+                                        heartbeat_interval_s=0.25),
+                            daemon=True),
+           threading.Thread(target=comp.run,
+                            kwargs=dict(idle_timeout_ms=10,
+                                        stop_after=stop_after),
+                            daemon=True),
+           threading.Thread(target=pl.run,
+                            kwargs=dict(idle_timeout_ms=10,
+                                        stop_after=stop_after),
+                            daemon=True)]
+    for t in ths:
+        t.start()
+    return daemons, ths
+
+
+def _seed_docs(store, n=8):
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        k = f"lgd{i}"
+        store.set(k, f"seed doc {i}")
+        v = rng.standard_normal(store.vec_dim).astype(np.float32)
+        store.vec_set(k, v / np.linalg.norm(v))
+
+
+def _await_lanes(store, tid, want, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        recs = S.collect_spans(store, tid)
+        if want <= {r["lane"] for r in recs}:
+            return recs
+        time.sleep(0.1)
+    return S.collect_spans(store, tid)
+
+
+class TestChainTrees:
+    def test_client_chained_trace_tree(self, store):
+        """Acceptance: ONE trace id spans the whole client-chained
+        rag flow — each hop a span with its queue/service split."""
+        daemons, ths = _stack(store)
+        _seed_docs(store)
+        try:
+            tid = P.next_trace_id()
+            assert submit_embed(store, "cd", "chain doc",
+                                trace=(tid, tid),
+                                timeout_ms=15_000) is True
+            store.set("cq", "scratch")
+            store.vec_set("cq", store.vec_get("cd"))
+            res = submit_search(store, "cq", 3, trace=(tid, tid),
+                                timeout_ms=15_000)
+            assert res and "keys" in res, res
+            out = submit_completion(store, "cc", "ctx: x",
+                                    trace=(tid, tid),
+                                    timeout_ms=15_000)
+            assert isinstance(out, bytes), out
+            recs = _await_lanes(
+                store, tid, {"embedder", "searcher", "completer"})
+            lanes = {r["lane"] for r in recs}
+            assert {"embedder", "searcher", "completer"} <= lanes, \
+                recs
+            for r in recs:
+                assert r["tid"] == tid
+                assert r["queue_ms"] >= 0 and r["service_ms"] >= 0
+                assert r["status"] == "ok"
+            tree = S.assemble_tree(recs)
+            # hops are siblings under the synthesized client root
+            assert len(tree["root"]["children"]) >= 3
+        finally:
+            for d in daemons:
+                d.stop()
+            for t in ths:
+                t.join(timeout=15)
+
+    def test_stored_script_trace_tree_and_cli(self, store, capsys,
+                                              monkeypatch):
+        """Acceptance: the SAME chain as a stored script yields one
+        tree rooted at the pipeliner's script span, verbs beneath it;
+        `spt trace show` renders it and `spt trace export` emits
+        loadable Chrome trace JSON."""
+        from libsplinter_tpu.cli.main import main
+
+        daemons, ths = _stack(store)
+        _seed_docs(store)
+        seed_library(store)
+        try:
+            tid = P.next_trace_id()
+            rec = submit_script(store, "screq", name="rag-churn",
+                                args=["sdoc", 1, 3],
+                                trace=(tid, 0), timeout_ms=30_000)
+            assert rec and rec.get("ok"), rec
+            recs = _await_lanes(
+                store, tid,
+                {"pipeliner", "embedder", "searcher", "completer"})
+            lanes = {r["lane"] for r in recs}
+            assert {"pipeliner", "embedder", "searcher",
+                    "completer"} <= lanes, recs
+            tree = S.assemble_tree(recs)
+            root = tree["root"]
+            assert root["span"]["lane"] == "pipeliner"
+            assert len(root["children"]) >= 3
+            script_span = root["span"]["span"]
+            for child in root["children"]:
+                assert child["span"]["parent"] == script_span
+
+            monkeypatch.setenv("SPTPU_DEFAULT_STORE", store.name)
+            monkeypatch.delenv("SPTPU_NS_PREFIX", raising=False)
+            assert main(["trace", "show", f"{tid:#x}"]) == 0
+            out = capsys.readouterr().out
+            assert "pipeliner" in out and "queue=" in out
+            assert main(["trace", "export", f"{tid:#x}"]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["traceEvents"]
+            names = {e["args"]["name"] for e in doc["traceEvents"]
+                     if e["ph"] == "M"}
+            assert "lane:pipeliner" in names
+        finally:
+            for d in daemons:
+                d.stop()
+            for t in ths:
+                t.join(timeout=15)
+
+    def test_loadgen_trace_sample_reports_slowest(self, store):
+        """Satellite: `--trace-sample p` stamps sampled arrivals and
+        the summary carries each tenant's slowest trace ids."""
+        from libsplinter_tpu.cli.loadgen import (LoadGenerator,
+                                                 TenantSpec)
+
+        daemons, ths = _stack(store)
+        try:
+            gen = LoadGenerator(
+                store, [TenantSpec(1, 12.0, deadline_ms=10_000)],
+                duration_s=1.2, corpus=8, seed=3,
+                mix={"embed": 1.0, "search": 1.0},
+                trace_sample=1.0)
+            rep = gen.run()
+            assert rep["ok"] >= 1, rep
+            slow = rep["per_tenant"]["1"]["slow_traces"]
+            assert 1 <= len(slow) <= 3
+            for row in slow:
+                assert row["trace"].startswith("0x")
+                assert row["ms"] > 0
+            # deterministic under seed: the sampled set replays
+            gen2 = LoadGenerator(
+                store, [TenantSpec(1, 12.0, deadline_ms=10_000)],
+                duration_s=1.2, corpus=8, seed=3,
+                mix={"embed": 1.0, "search": 1.0}, trace_sample=0.0)
+            rep2 = gen2.run()
+            assert "slow_traces" not in rep2["per_tenant"]["1"]
+        finally:
+            for d in daemons:
+                d.stop()
+            for t in ths:
+                t.join(timeout=15)
+
+
+# ------------------------------------------------ trace-through-chaos
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_trace_through_supervised_crash(store, monkeypatch):
+    """Satellite: a supervised mid-chain pipeliner crash
+    (`pipeliner.exec:crash@2` — after the embed hop resolves) still
+    yields a COMPLETE span tree for the traced script: the restarted
+    lane recovers the staged span, the script span shows attempts>=2
+    with the restart gap, every downstream hop is present, and the
+    admitted script is not lost (its result commits ok)."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    monkeypatch.setenv("SPTPU_FAULT", "pipeliner.exec:crash@2")
+    monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+
+    daemons, ths = _stack(store, stop_after=240.0)
+    daemons[-1].stop()                 # the SUPERVISED child serves
+    _seed_docs(store)
+    seed_library(store)
+
+    holder: dict = {}
+
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, CHILD, "pipeliner", store.name],
+            env=holder["sup"]._child_env(lane))
+
+    sup = Supervisor(store.name, lanes=("pipeliner",), spawn_fn=spawn,
+                     store=store, backoff_base_ms=100,
+                     backoff_max_ms=1500, breaker_threshold=8,
+                     breaker_window_s=120, startup_grace_s=300)
+    holder["sup"] = sup
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 240.0})
+    t.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if P.heartbeat_live(store, P.KEY_SCRIPT_STATS,
+                                max_age_s=30):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("pipeliner never came up under supervision")
+        tid = P.next_trace_id()
+        rec = submit_script(store, "chaosreq", name="rag-churn",
+                            args=["cdoc", 1, 3], trace=(tid, 0),
+                            timeout_ms=120_000)
+        # zero admitted-request loss: the re-run commits a result
+        assert rec is not None and rec.get("ok"), rec
+        assert sup.lanes["pipeliner"].restarts >= 1
+        recs = _await_lanes(
+            store, tid,
+            {"pipeliner", "embedder", "searcher", "completer"},
+            timeout_s=30.0)
+        lanes = {r["lane"] for r in recs}
+        assert {"pipeliner", "embedder", "searcher",
+                "completer"} <= lanes, recs
+        script = [r for r in recs if r["lane"] == "pipeliner"]
+        assert len(script) == 1, script
+        # the restart gap is visible on the affected span
+        assert script[0].get("attempts", 1) >= 2, script
+        assert script[0].get("gap_ms", 0) > 0, script
+        assert script[0]["status"] == "ok"
+        # and the tree is complete: verbs hang under the script span
+        tree = S.assemble_tree(recs)
+        assert tree["root"]["span"]["lane"] == "pipeliner"
+        assert len(tree["root"]["children"]) >= 3
+    finally:
+        sup.stop()
+        t.join(timeout=30)
+        sup.shutdown()
+        for d in daemons:
+            d.stop()
+        for th in ths:
+            th.join(timeout=15)

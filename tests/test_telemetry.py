@@ -1,0 +1,240 @@
+"""Telemetry-history tier (`make trace-check`): the sampler's
+fixed-size time-series rings (store-resident — they survive the
+sampler), queue depth measured from labels rather than trusted from
+heartbeats, per-gauge bounding + max_val degradation, supervised
+restart with rings intact, and the operator surfaces (`spt metrics
+--history`, `spt top --once`)."""
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.telemetry import (SCRAPE_LANES,
+                                              TelemetrySampler,
+                                              read_history)
+
+
+def _fake_heartbeat(store, key, **fields):
+    P.publish_heartbeat(store, key, dict(fields))
+
+
+class TestSampler:
+    def test_rings_accumulate_counters_and_queue_depth(self, store):
+        _fake_heartbeat(store, P.KEY_EMBED_STATS, shed=2, deferred=1,
+                        deadline_expired=0, embedded=42)
+        for i in range(3):
+            store.set(f"q{i}", "waiting")
+            store.label_or(f"q{i}", P.LBL_EMBED_REQ)
+        tel = TelemetrySampler(store, interval_s=0.1)
+        tel.attach()
+        assert tel.sample_once() >= 1
+        hist = read_history(store, "embedder")
+        assert hist is not None
+        g = hist["gauges"]
+        assert g["queue_depth"][-1][1] == 3.0     # measured, not told
+        assert g["shed"][-1][1] == 2.0
+        assert g["progress"][-1][1] == 42.0       # embedded
+        assert tel.stats.samples == 1
+        # every scrape lane gets a ring (gauge floor: queue_depth)
+        for lane in SCRAPE_LANES:
+            assert read_history(store, lane) is not None
+
+    def test_ring_len_bounded(self, store):
+        _fake_heartbeat(store, P.KEY_SEARCH_STATS, shed=0, served=1)
+        tel = TelemetrySampler(store, interval_s=0.1, ring_len=4)
+        tel.attach()
+        for k in range(10):
+            tel.sample_once(now=1000.0 + k)
+        g = read_history(store, "searcher")["gauges"]
+        assert len(g["queue_depth"]) == 4
+        assert g["queue_depth"][0][0] == 1006.0   # oldest retained
+
+    def test_stage_p99_and_tenant_gauges(self, store):
+        _fake_heartbeat(
+            store, P.KEY_SCRIPT_STATS, scripts_completed=5,
+            quantiles={"e2e": {"p99_ms": 12.5},
+                       "exec": {"p99_ms": 3.25}},
+            tenants={"1": {"admitted": 7, "served_tokens": 90}})
+        tel = TelemetrySampler(store)
+        tel.attach()
+        tel.sample_once()
+        g = read_history(store, "pipeliner")["gauges"]
+        assert g["p99_e2e_ms"][-1][1] == 12.5
+        assert g["p99_exec_ms"][-1][1] == 3.25
+        assert g["tenant1_admitted"][-1][1] == 7.0
+        assert g["tenant1_served_tokens"][-1][1] == 90.0
+
+    def test_restart_resumes_rings_in_store(self, store):
+        """The acceptance property: rings are STORE state — a new
+        sampler generation appends to the history the dead one
+        left."""
+        _fake_heartbeat(store, P.KEY_EMBED_STATS, embedded=1)
+        t1 = TelemetrySampler(store)
+        t1.attach()
+        for k in range(3):
+            t1.sample_once(now=2000.0 + k)
+        gen1 = t1.generation
+        del t1                                    # the "crash"
+        t2 = TelemetrySampler(store)
+        t2.attach()                               # the restart
+        assert t2.generation == gen1 + 1
+        t2.sample_once(now=2010.0)
+        ring = read_history(store, "embedder")["gauges"]["queue_depth"]
+        assert len(ring) == 4                     # 3 old + 1 new
+        assert ring[0][0] == 2000.0
+
+    def test_oversized_ring_shrinks_not_drops(self, tmp_path):
+        name = f"/spt-tele-{tmp_path.name}"
+        Store.unlink(name)
+        st = Store.create(name, nslots=64, max_val=256, vec_dim=0)
+        try:
+            _fake_heartbeat(st, P.KEY_EMBED_STATS, shed=1, deferred=2,
+                            deadline_expired=3, embedded=4)
+            tel = TelemetrySampler(st, ring_len=64)
+            tel.attach()
+            for k in range(40):
+                tel.sample_once(now=3000.0 + k)
+            hist = read_history(st, "embedder")
+            assert hist is not None               # still renders
+            assert tel.stats.shrinks > 0          # degraded, not lost
+            assert tel.stats.write_errors == 0
+            for ring in hist["gauges"].values():
+                assert 1 <= len(ring) < 64
+        finally:
+            st.close()
+            Store.unlink(name)
+
+    def test_sampler_heartbeat_publishes(self, store):
+        tel = TelemetrySampler(store)
+        tel.attach()
+        tel.sample_once()
+        tel.publish_stats()
+        snap = json.loads(
+            store.get(P.KEY_TELEMETRY_STATS).rstrip(b"\0"))
+        assert snap["samples"] == 1
+        assert snap["generation"] == tel.generation
+        assert snap["points"] > 0
+
+
+class TestOperatorSurfaces:
+    def _sampled(self, store, monkeypatch):
+        _fake_heartbeat(store, P.KEY_EMBED_STATS, shed=1, embedded=9)
+        _fake_heartbeat(store, P.KEY_SEARCH_STATS, shed=0, served=4)
+        tel = TelemetrySampler(store)
+        tel.attach()
+        for k in range(5):
+            tel.sample_once(now=4000.0 + k)
+        tel.publish_stats()
+        monkeypatch.setenv("SPTPU_DEFAULT_STORE", store.name)
+        monkeypatch.delenv("SPTPU_NS_PREFIX", raising=False)
+
+    def test_metrics_history_renders_gauges(self, store, capsys,
+                                            monkeypatch):
+        """Acceptance: `spt metrics --history` renders >= 2 gauges'
+        time series per (sampled) lane."""
+        from libsplinter_tpu.cli.main import main
+
+        self._sampled(store, monkeypatch)
+        assert main(["metrics", "--history"]) == 0
+        out = capsys.readouterr().out
+        for lane in ("embedder", "searcher"):
+            assert f"[{lane}]" in out
+        # per-lane gauge floor: queue_depth + at least one counter
+        assert out.count("queue_depth") >= 2
+        assert "shed" in out and "progress" in out
+        assert "last=" in out and "min=" in out
+
+    def test_metrics_exposition_covers_telemetry_lane(
+            self, store, capsys, monkeypatch):
+        from libsplinter_tpu.cli.main import main
+
+        self._sampled(store, monkeypatch)
+        assert main(["metrics"]) == 0
+        out = capsys.readouterr().out
+        assert "sptpu_telemetry_samples" in out
+        assert "sptpu_telemetry_points" in out
+
+    def test_top_once_renders_frame(self, store, capsys, monkeypatch):
+        from libsplinter_tpu.cli.main import main
+
+        self._sampled(store, monkeypatch)
+        assert main(["top", "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "spt top" in out
+        for lane in ("embedder", "searcher", "completer",
+                     "pipeliner"):
+            assert lane in out
+        assert "telemetry" in out
+        assert "queue" in out
+
+    def test_top_frames_loop(self, store, capsys, monkeypatch):
+        from libsplinter_tpu.cli.main import main
+
+        self._sampled(store, monkeypatch)
+        assert main(["top", "--frames", "2", "--interval",
+                     "0.05"]) == 0
+        assert capsys.readouterr().out.count("spt top") == 2
+
+
+class TestSupervised:
+    def test_registered_as_supervisable_lane(self):
+        from libsplinter_tpu.engine.supervisor import LANES
+
+        module, hb = LANES["telemetry"]
+        assert module == "libsplinter_tpu.engine.telemetry"
+        assert hb == P.KEY_TELEMETRY_STATS
+
+    @pytest.mark.slow
+    def test_supervised_restart_keeps_rings(self, store):
+        """Acceptance: kill the live sampler child mid-run — the
+        supervisor respawns it, the generation bumps, and the rings
+        keep growing from where the dead generation left them."""
+        from libsplinter_tpu.engine.supervisor import Supervisor
+
+        _fake_heartbeat(store, P.KEY_EMBED_STATS, embedded=1)
+
+        def spawn(lane):
+            return subprocess.Popen(
+                [sys.executable, "-m",
+                 "libsplinter_tpu.engine.telemetry",
+                 "--store", store.name, "--interval-s", "0.1"])
+
+        sup = Supervisor(store.name, lanes=("telemetry",),
+                         spawn_fn=spawn, store=store,
+                         backoff_base_ms=100, backoff_max_ms=1000,
+                         breaker_threshold=10, breaker_window_s=60,
+                         startup_grace_s=60, healthy_after_s=1.0)
+        t0 = time.monotonic()
+
+        def ring_len():
+            h = read_history(store, "embedder")
+            return len(h["gauges"]["queue_depth"]) if h else 0
+
+        try:
+            while ring_len() < 3 and time.monotonic() - t0 < 30:
+                sup.poll_once()
+                time.sleep(0.1)
+            assert ring_len() >= 3, "sampler never produced history"
+            n_before = ring_len()
+            gen_before = sup.lanes["telemetry"].generation
+            sup.lanes["telemetry"].proc.kill()    # the chaos moment
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                sup.poll_once()
+                if sup.lanes["telemetry"].generation > gen_before \
+                        and ring_len() > n_before:
+                    break
+                time.sleep(0.1)
+            assert sup.lanes["telemetry"].generation > gen_before
+            assert ring_len() > n_before          # rings intact AND
+            # the ring still starts with pre-crash samples (intact,
+            # not recreated) unless it wrapped
+            snap = json.loads(
+                store.get(P.KEY_TELEMETRY_STATS).rstrip(b"\0"))
+            assert snap["generation"] >= 2        # growing
+        finally:
+            sup.shutdown()

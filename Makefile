@@ -72,6 +72,16 @@
 #                   missing out_shardings pins, unseeded fault-path
 #                   randomness), then the splint test tier.
 #                   Non-zero exit on any unsuppressed finding.
+#   make prefix-check  cross-request prefix-sharing tier (fast,
+#                   CPU): refcount churn drill (zero leaks / double
+#                   frees, refcount-0 <=> free XOR tree-retained),
+#                   COW-vs-private byte-exact greedy decode (f32 +
+#                   int8, single-chip + tp=2), >= 4x rows per page
+#                   budget, LRU eviction + tenant quotas, mid-flight
+#                   joiner parity, loadgen --shared-prefix, and the
+#                   hot-vs-cold admission-to-first-token gate
+#                   (scripts/prefix_speedup_check.py, >= 5x on the
+#                   in-process CPU stack)
 #   make quant-check  quantized-KV tier (fast, CPU): int8-vs-f32
 #                   ragged paged-attention parity (interpret mode),
 #                   multi-query verify stack, quantize-on-commit /
@@ -116,6 +126,7 @@ check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/pipeline_latency_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/prefix_speedup_check.py
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -146,6 +157,11 @@ quant-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py -q \
 		-m "not slow"
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
+
+prefix-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_prefix_cache.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/prefix_speedup_check.py
 
 # no `native` dep: splint is stdlib-ast only and must be runnable
 # before (or without) any build step — the cheapest pre-commit gate
@@ -179,5 +195,6 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native quick check obs-check search-check decode-check \
-	chaos-check dispatch-check pod-check quant-check qos-check \
-	pipeline-check trace-check lint-check memcheck bench-cpu clean
+	chaos-check dispatch-check pod-check quant-check prefix-check \
+	qos-check pipeline-check trace-check lint-check memcheck \
+	bench-cpu clean

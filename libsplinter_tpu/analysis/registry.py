@@ -84,6 +84,16 @@ FAULT_SITE_DOCS: dict[str, str] = {
         "poisoned pages (`tests/chaos_child.py completer_quant`; "
         "`tests/test_crash_recovery.py::"
         "test_supervise_restores_quantized_commit_crash`)",
+    "completer.prefix_map":
+        "a prefix-cache HIT's table mapping only (continuous lane, "
+        "after the claim, before map_shared bumps any refcount): a "
+        "`crash` dies mid table-mapping with the request claimed — "
+        "pool, refcounts, and radix tree are host state that die "
+        "with the process, so the drill proves the restarted lane "
+        "rebuilds a clean pool with zero stranded refcounts and "
+        "re-serves the reclaimed request (`tests/chaos_child.py` "
+        "completer_prefix; `tests/test_prefix_cache.py::"
+        "test_supervised_prefix_map_crash_strands_nothing`)",
     "resident.ring_dispatch":
         "each resident multi-batch ring dispatch (embedder "
         "`--ring-depth`; a `raise` here degrades that ring to the "

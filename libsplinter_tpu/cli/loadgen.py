@@ -88,7 +88,20 @@ SCENARIOS: dict[str, Scenario] = {
                           script="multi-hop", lane="script"),
     "map-reduce": Scenario("map-reduce", "script",
                            script="map-reduce", lane="script"),
+    # complete-only arrivals where (by default) 90% of prompts draw
+    # from a small pool of long common prefixes — the reproducible
+    # hot-prefix mix the continuous lane's radix prefix cache
+    # (engine/prefix_cache.py) is measured against; the summary
+    # reports the completer's cache hit rate beside the per-tenant
+    # SLOs.  `--shared-prefix P:LEN` overrides the 0.9:192 default.
+    "shared-prefix": Scenario("shared-prefix", "complete",
+                              lane="complete"),
 }
+
+# shared-prefix scenario defaults: (fraction of arrivals drawing a
+# pooled prompt, pooled-prompt length in characters)
+SHARED_PREFIX_DEFAULT = (0.9, 192)
+SHARED_PREFIX_POOL = 4
 
 # terminal states a request can reach
 OK = "ok"               # served (within deadline unless counted late)
@@ -161,7 +174,8 @@ class LoadGenerator:
                  search_k: int = 4,
                  drain_s: float | None = None,
                  trace_sample: float = 0.0,
-                 prompt: str = "summarize: "):
+                 prompt: str = "summarize: ",
+                 shared_prefix: tuple[float, int] | None = None):
         if arrivals not in ("poisson", "fixed"):
             raise ValueError("arrivals must be poisson|fixed")
         if scenario is not None and scenario not in SCENARIOS:
@@ -199,6 +213,22 @@ class LoadGenerator:
         self.drain_s = drain_s if drain_s is not None \
             else max(2.0, 2 * max_dl / 1e3)
         self.prompt = prompt
+        # hot-prefix traffic shaping: with (frac, length) set, `frac`
+        # of complete-lane arrivals draw their WHOLE prompt from a
+        # small pool of `length`-char common prompts (deterministic
+        # content, seeded draw order — reruns produce the same mix),
+        # so prefix-cache behavior is reproducible; the rest stay
+        # unique.  The shared-prefix scenario defaults this on.
+        if shared_prefix is None and scenario == "shared-prefix":
+            shared_prefix = SHARED_PREFIX_DEFAULT
+        if shared_prefix is not None:
+            frac, plen = shared_prefix
+            if not 0.0 < frac <= 1.0 or plen < 1:
+                raise ValueError(
+                    "shared_prefix wants (fraction in (0,1], "
+                    "length >= 1)")
+        self.shared_prefix = shared_prefix
+        self._prefix_pool: list[str] = []
         self._n = 0
         # per-(tenant, lane) latency histograms — the PR 2 log-bucketed
         # quantile machinery, so p50/p95/p99 here and in the daemon
@@ -244,6 +274,24 @@ class LoadGenerator:
                 ** -max(self.zipf, 0.0)
             self._zipf_cdf = np.cumsum(w / w.sum())
         return int(np.searchsorted(self._zipf_cdf, self.rng.random()))
+
+    def _complete_prompt(self) -> str:
+        """One complete-lane prompt: a pooled hot-prefix prompt with
+        probability `shared_prefix[0]`, else a unique Zipf-doc one."""
+        sp = self.shared_prefix
+        if sp is not None and self.rng.random() < sp[0]:
+            if not self._prefix_pool:
+                frac, plen = sp
+                for i in range(SHARED_PREFIX_POOL):
+                    seed_txt = (f"system preamble {i}: you are a "
+                                f"careful assistant. context shard "
+                                f"{i} of the corpus follows. ")
+                    reps = -(-plen // len(seed_txt))
+                    self._prefix_pool.append(
+                        (seed_txt * reps)[:plen])
+            return self._prefix_pool[
+                self.rng.randrange(len(self._prefix_pool))]
+        return f"{self.prompt}document {self._zipf_doc()}"
 
     def _query_vec(self, doc_key: str) -> np.ndarray:
         st = self.store
@@ -354,8 +402,7 @@ class LoadGenerator:
             self._submit_search(
                 req, self._query_vec(f"lgd{self._zipf_doc()}"))
         elif lane == "complete":
-            self._submit_complete(
-                req, f"{self.prompt}document {self._zipf_doc()}")
+            self._submit_complete(req, self._complete_prompt())
         elif lane == "script":        # one server-side scripted chain
             req.doc_key = f"lgr{n}"
             req.key = f"lgp{n}"
@@ -609,7 +656,7 @@ class LoadGenerator:
                 {"trace": f"{tid:#x}", "ms": round(ms, 3),
                  "lane": lane}
                 for ms, tid, lane in sorted(rows, reverse=True)[:3]]
-        return {
+        rep = {
             "scenario": self.scenario or "mixed",
             "arrivals": self.arrivals,
             "duration_s": round(wall_s, 3),
@@ -620,6 +667,37 @@ class LoadGenerator:
             "goodput_ratio": round(totals[OK] / issued, 4)
             if issued else 0.0,
             "per_tenant": per_tenant,
+        }
+        pfx = self._prefix_cache_report()
+        if pfx is not None:
+            rep["prefix_cache"] = pfx
+        return rep
+
+    def _prefix_cache_report(self) -> dict | None:
+        """The completer's prefix-cache gauges as of its LAST
+        heartbeat (the generator only sees the store — counts lag by
+        at most one heartbeat interval).  None when no continuous
+        completer published them (cache off, dense lane, or no
+        completer at all)."""
+        try:
+            raw = self.store.get(P.KEY_COMPLETE_STATS)
+            snap = json.loads(raw.rstrip(b"\0"))
+        except (KeyError, OSError, ValueError):
+            return None
+        if not isinstance(snap, dict) or "prefix_hits" not in snap:
+            return None
+        hits = int(snap.get("prefix_hits", 0))
+        misses = int(snap.get("prefix_misses", 0))
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else 0.0,
+            "hit_tokens": snap.get("prefix_hit_tokens", 0),
+            "shared_pages": snap.get("prefix_shared_pages", 0),
+            "evictions": snap.get("prefix_evictions", 0),
+            "cow_copies": snap.get("prefix_cow_copies", 0),
+            "bytes_saved": snap.get("prefix_bytes_saved", 0),
         }
 
 
@@ -658,13 +736,17 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "[--mix embed:W,search:W,complete:W] "
          "[--arrivals poisson|fixed] [--zipf S] [--corpus N] "
          "[--seed N] [--scenario rag-churn|rag-churn-script|"
-         "agent-loop|multi-hop|map-reduce] [--k K] [--drain-s S] "
+         "agent-loop|multi-hop|map-reduce|shared-prefix] [--k K] "
+         "[--shared-prefix P:LEN] [--drain-s S] "
          "[--trace-sample P] [--slo-p99-ms MS] [--slo-goodput F] "
          "[--json]",
          "open-loop multi-tenant load generator with per-tenant "
          "p50/p95/p99, goodput vs shed, SLO pass/fail, and head-"
          "sampled tracing (--trace-sample: each tenant's slowest "
-         "trace ids land in the summary)")
+         "trace ids land in the summary; --shared-prefix P:LEN "
+         "draws that fraction of complete prompts from a pooled "
+         "hot-prefix set and the summary reports the completer's "
+         "prefix-cache hit rate)")
 def cmd_loadgen(ses, args):
     duration = 5.0
     rate = 20.0
@@ -679,6 +761,7 @@ def cmd_loadgen(ses, args):
     k = 4
     drain_s = None
     trace_sample = 0.0
+    shared_prefix = None
     slo_p99 = None
     slo_goodput = None
     as_json = False
@@ -726,6 +809,17 @@ def cmd_loadgen(ses, args):
             drain_s = float(val(a))
         elif a == "--trace-sample":
             trace_sample = float(val(a))
+        elif a == "--shared-prefix":
+            frac, sep, plen = val(a).partition(":")
+            if not sep:
+                raise CliError("--shared-prefix wants P:LEN (e.g. "
+                               "0.9:192)")
+            try:
+                shared_prefix = (float(frac), int(plen))
+            except ValueError:
+                raise CliError(
+                    "--shared-prefix wants P:LEN (fraction:chars)"
+                ) from None
         elif a == "--slo-p99-ms":
             slo_p99 = float(val(a))
         elif a == "--slo-goodput":
@@ -752,7 +846,8 @@ def cmd_loadgen(ses, args):
                             corpus=corpus, seed=seed,
                             scenario=scenario, search_k=k,
                             drain_s=drain_s,
-                            trace_sample=trace_sample)
+                            trace_sample=trace_sample,
+                            shared_prefix=shared_prefix)
     except ValueError as e:
         raise CliError(str(e)) from None
     report = gen.run()
@@ -772,6 +867,13 @@ def cmd_loadgen(ses, args):
               f"lost={report['lost']}")
         print(f"  goodput {report['goodput_rps']} req/s "
               f"({report['goodput_ratio']:.1%} of issued)")
+        pfx = report.get("prefix_cache")
+        if pfx:
+            print(f"  prefix cache: hit rate {pfx['hit_rate']:.1%} "
+                  f"({pfx['hits']} hits / {pfx['misses']} misses, "
+                  f"{pfx['shared_pages']} shared pages, "
+                  f"{pfx['cow_copies']} cow, "
+                  f"{pfx['bytes_saved'] / 1e6:.2f} MB saved)")
         for tenant, lanes in report["per_tenant"].items():
             for lane, row in lanes.items():
                 if lane == "slow_traces":

@@ -237,6 +237,22 @@ def cmd_metrics(ses, args):
                              mtype="counter",
                              help_="fault-injection site accounting "
                                    "(SPTPU_FAULT armed)")
+        for field in ("prefix_hits", "prefix_misses",
+                      "prefix_hit_tokens", "prefix_evictions",
+                      "prefix_cow_copies", "prefix_bytes_saved"):
+            # the continuous lane's prefix-sharing counters
+            # (engine/prefix_cache.py) — typed as counters so rate()
+            # works; the shared/evictable page residency next to them
+            # stays a gauge via the generic loop below
+            v = snap.pop(field, None)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.metric(f"sptpu_{daemon}_{field}", v,
+                         mtype="counter",
+                         help_="cross-request prefix cache: radix-"
+                               "tree hits/misses, tokens served from "
+                               "shared pages, LRU evictions, copy-on-"
+                               "write page copies, and KV bytes not "
+                               "re-prefilled")
         for field, v in snap.items():
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue

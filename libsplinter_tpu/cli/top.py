@@ -17,8 +17,10 @@ from .main import CliError, command
 from .metrics import _read_json, sparkline
 
 # gauges whose ring history earns a sparkline column, in preference
-# order (first two that exist render)
-_SPARK_GAUGES = ("queue_depth", "p99_e2e_ms", "shed", "progress")
+# order (first two that exist render; prefix_hits rides the completer
+# ring when the continuous lane's prefix cache is live)
+_SPARK_GAUGES = ("queue_depth", "prefix_hits", "p99_e2e_ms", "shed",
+                 "progress")
 
 
 def render_frame(store, out_lines: list[str]) -> None:

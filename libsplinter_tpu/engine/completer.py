@@ -47,7 +47,8 @@ from ..utils.faults import fault
 from ..utils.trace import tracer
 from . import protocol as P
 from .qos import (AdmissionController, TenantLedger, WaitingRow,
-                  parse_tenant_weights, prune_idle_counters)
+                  parse_tenant_quotas, parse_tenant_weights,
+                  prune_idle_counters)
 
 log = logging.getLogger("libsplinter_tpu.completer")
 
@@ -153,7 +154,11 @@ class Completer:
                  spec_min_acceptance: float = 0.2,
                  queue_high_water: int | None = None,
                  retry_after_ms: int | None = None,
-                 tenant_weights: dict[int, float] | None = None):
+                 tenant_weights: dict[int, float] | None = None,
+                 prefix_cache: bool = True,
+                 prefix_cache_pages: int | None = None,
+                 prefix_quotas: dict[int, int] | None = None,
+                 prefix_default_quota: int | None = None):
         self.store = store
         self.max_new = max_new_tokens
         self.flush_tokens = flush_tokens
@@ -212,6 +217,17 @@ class Completer:
         # can publish its size and the sweep can bound it — under
         # sustained shedding it would otherwise grow per denied key
         self._bp_memo: dict[int, tuple[int, int]] = {}
+        self._bp_memo_cap = 4096
+        # cross-request prefix sharing (engine/prefix_cache.py): the
+        # continuous lane's radix tree over the paged pool.  Built
+        # lazily with the pool (plain PagedKVCache only — the paired
+        # speculative pools don't share); pages_needed/backpressure
+        # then count only the uncached suffix of each admission.
+        self._prefix_enabled = bool(prefix_cache)
+        self._prefix_cache_pages = prefix_cache_pages
+        self._prefix_quotas = dict(prefix_quotas or {})
+        self._prefix_default_quota = prefix_default_quota
+        self.prefix_cache = None
         if template not in TEMPLATES:
             raise ValueError(
                 f"unknown chat template {template!r} (supported: "
@@ -425,7 +441,8 @@ class Completer:
         is gone (served, shed, or deadline-rejected).  Runs on the
         heartbeat cadence; under sustained shedding the memo would
         otherwise grow one entry per denied key forever.  A hard size
-        cap (oldest-first) backstops even a pathological store."""
+        cap (_bound_bp_memo, stale-first) backstops even a
+        pathological store."""
         st = self.store
         dropped = 0
         for idx, (e, _need) in list(self._bp_memo.items()):
@@ -437,7 +454,35 @@ class Completer:
             except (KeyError, OSError):
                 self._bp_memo.pop(idx, None)
                 dropped += 1
-        while len(self._bp_memo) > 4096:
+        return dropped + self._bound_bp_memo()
+
+    def _bound_bp_memo(self) -> int:
+        """Enforce the memo's hard size cap, evicting by SLOT-EPOCH
+        STALENESS first: an entry whose slot epoch moved (or whose
+        slot is gone) memoizes a request that no longer exists, while
+        a live entry — however old — is a denied request the memo
+        exists to keep cheap (evicting it re-pays render+tokenize on
+        every subsequent chunk).  The old oldest-insertion policy did
+        exactly that backwards: a long-lived denied request was the
+        FIRST thing dropped while freshly-stale newcomers survived.
+        Insertion-order eviction remains only as the final tiebreak
+        among live entries."""
+        over = len(self._bp_memo) - self._bp_memo_cap
+        if over <= 0:
+            return 0
+        st = self.store
+        dropped = 0
+        for idx, (e, _need) in list(self._bp_memo.items()):
+            if dropped >= over:
+                break
+            try:
+                stale = st.epoch_at(idx) != e
+            except (KeyError, OSError):
+                stale = True
+            if stale:
+                self._bp_memo.pop(idx, None)
+                dropped += 1
+        while len(self._bp_memo) > self._bp_memo_cap:
             self._bp_memo.pop(next(iter(self._bp_memo)))
             dropped += 1
         return dropped
@@ -831,6 +876,20 @@ class Completer:
             self._paged_cache = self._model.init_paged(
                 self.paged_batch_cap, page=self.page_size,
                 pool_pages=self.pool_pages, kv_dtype=self.kv_dtype)
+            cache = self._paged_cache
+            if self._prefix_enabled and hasattr(cache, "map_shared"):
+                # (re)bind the radix tree to THIS pool: a rebuilt
+                # pool (abort recovery, spec demotion) invalidates
+                # every cached page id, so attach() empties the tree
+                if self.prefix_cache is None:
+                    from .prefix_cache import PrefixCache
+                    self.prefix_cache = PrefixCache(
+                        self.page_size,
+                        max_pages=self._prefix_cache_pages,
+                        tenant_quotas=self._prefix_quotas,
+                        default_quota=self._prefix_default_quota)
+                self.prefix_cache.attach(cache)
+                cache.prefix_cache = self.prefix_cache
         return self._paged_cache
 
     def warmup_paged(self) -> None:
@@ -976,7 +1035,7 @@ class Completer:
                 memo = bp_memo.get(w_idx)
                 if memo is not None \
                         and memo[0] == st.epoch_at(w_idx) \
-                        and memo[1] > cache.free_pages:
+                        and memo[1] > cache.available_pages:
                     tenant, dl = self._qos_meta(w_idx)
                     if dl is not None and dl <= now_wall:
                         if self._terminal_reject(
@@ -987,13 +1046,14 @@ class Completer:
                 plannable.append(w_idx)
             n = 0
             traced = tracer.enabled
+            pc = getattr(cache, "prefix_cache", None)
             for idx in self._admit_waiting(plannable, len(free)):
                 if not free:
                     break
                 e = st.epoch_at(idx)
                 memo = bp_memo.get(idx)
                 if memo is not None and memo[0] == e:
-                    if memo[1] > cache.free_pages:
+                    if memo[1] > cache.available_pages:
                         continue      # still too big: skip the render
                     del bp_memo[idx]  # pool may fit now: peek fresh
                 # peek BEFORE claiming: a backpressured request stays
@@ -1004,12 +1064,46 @@ class Completer:
                     continue
                 ids = self._clip_context(tok_izer.encode(peek[1]),
                                          bucketed=True)
+                # radix-tree walk BEFORE the page math: every hit
+                # page is a page the pool does not need free — the
+                # admission reservation (and the backpressure memo)
+                # counts only the UNCACHED suffix, plus one page for
+                # the copy-on-write a fully cached prompt's replay
+                # append will take
+                hit_bids: list[int] = []
+                match = 0
+                if pc is not None and len(ids):
+                    hit_bids, match = pc.lookup(ids)
+                    if match == len(ids) and len(ids) < 2:
+                        # a fully-covered 1-token prompt would enter
+                        # at lengths 0 — the DEAD-row sentinel; serve
+                        # it as a miss (page size 1 is a test-only
+                        # geometry anyway)
+                        hit_bids, match = [], 0
+                full_cover = bool(hit_bids) and match == len(ids)
+                suffix = ids[match:]
+                reserve = 0
                 if len(ids):
-                    need = cache.pages_needed(worst_len(len(ids)))
-                    if need > cache.free_pages:
+                    reserve = min(worst_len(len(ids))
+                                  + (step if full_cover else 0),
+                                  cfg.max_len)
+                    need = (cache.pages_needed(reserve)
+                            - len(hit_bids)
+                            + (1 if full_cover else 0))
+                    # zero-ref hit pages count in available_pages as
+                    # reclaimable supply, but map_shared is about to
+                    # PIN them — they cannot also feed this row's new
+                    # allocations, so subtract them from the supply
+                    # side or a warm near-full pool would admit a row
+                    # whose ensure() then comes up short
+                    pinned = sum(1 for b in hit_bids
+                                 if cache.refcounts[b] == 0)
+                    if need > cache.available_pages - pinned:
                         self.stats.join_backpressure += 1
-                        bp_memo[idx] = (e, need)
+                        bp_memo[idx] = (e, need + pinned)
+                        self._bound_bp_memo()
                         continue      # pool full: next cycle retries
+                tenant, _dl = self._qos_meta(idx)
                 prep = self._prepare(idx, peek=peek)
                 if prep is None:
                     continue
@@ -1031,8 +1125,43 @@ class Completer:
                            "spans": ([] if traced and stamp is not None
                                      else None),
                            "wall0": time.perf_counter()}
-                cache.ensure(r, worst_len(len(ids)))
-                if getattr(cache, "quantized", False):
+                ta = time.perf_counter()
+                if hit_bids:
+                    # the chaos matrix crashes HERE (mid table-
+                    # mapping, after the claim): the restarted lane
+                    # rebuilds pool + tree from scratch, so a death
+                    # between refcount bumps can strand nothing
+                    fault("completer.prefix_map")
+                    cache.map_shared(r, hit_bids)
+                    cache.lengths[r] = (len(ids) - 1 if full_cover
+                                        else match)
+                    # hit/LRU recorded only now — a denied or raced
+                    # admission must not inflate the hit rate the
+                    # runbook triages on
+                    pc.commit_hit(ids, match)
+                    pc.stats.bytes_saved += \
+                        match * cache.kv_bytes_per_token()
+                    if tenant:
+                        self.tenants.bump(tenant, "prefix_hit_pages",
+                                          len(hit_bids))
+                elif pc is not None and len(ids):
+                    pc.note_miss()
+                if not cache.ensure(r, reserve):
+                    # defensive: the pinned-aware gate above makes
+                    # this unreachable, but a seated row WITHOUT its
+                    # reservation would strand mid-decode and abort
+                    # the whole batch — re-queue it instead
+                    cache.free_row(r)
+                    rows[r] = None
+                    free.insert(0, r)
+                    self._live_spans.pop(key, None)
+                    self.stats.join_backpressure += 1
+                    self._requeue_failed([idx])
+                    continue
+                if traced and hit_bids:
+                    span(rows[r], "prefix_hit",
+                         (time.perf_counter() - ta) * 1e3)
+                if getattr(cache, "quantized", False) and suffix:
                     # the quantized append/commit path: the commit
                     # scatter about to run quantizes the prompt's K/V
                     # into int8 pages (per-page scales) — the chaos
@@ -1040,20 +1169,50 @@ class Completer:
                     # commit death restarts clean with no poisoned
                     # pages (tests/chaos_child.py completer_quant)
                     fault("completer.kv_quant_commit")
-                ta = time.perf_counter()
-                logits = m.paged_prefill_row(
-                    cache, np.asarray(ids, np.int32), r)
-                tb = time.perf_counter()
-                # splint: ignore[SPL201] reason=the documented host "sample" stage (CONT_INFER_STAGES): one scalar draw per JOIN so the row's first token emits before the next chunk, not per decode step
-                t = int(m.sample(logits))
-                if traced:
-                    tc = time.perf_counter()
-                    span(rows[r], "join", (tb - ta) * 1e3)
-                    span(rows[r], "sample", (tc - tb) * 1e3)
-                emit(r, t)
-                if rows[r] is not None:
-                    fresh[r] = t      # host-side token: next dispatch
-                n += 1                # reads it over the device carry
+                if suffix:
+                    ta = time.perf_counter()
+                    if hit_bids:
+                        # uncached tail only, attending the mapped
+                        # prefix through the ragged paged kernel
+                        logits = m.paged_append_prefill(
+                            cache, np.asarray(suffix, np.int32), r)
+                    else:
+                        logits = m.paged_prefill_row(
+                            cache, np.asarray(ids, np.int32), r)
+                    tb = time.perf_counter()
+                    if pc is not None:
+                        # freshly committed full prompt pages join
+                        # the tree NOW, donor still live — the next
+                        # identical admission maps them even while
+                        # this row decodes
+                        ins = pc.insert(ids, cache, r, tenant)
+                        if ins and tenant:
+                            self.tenants.bump(
+                                tenant, "prefix_cached_pages", ins)
+                    # splint: ignore[SPL201] reason=the documented host "sample" stage (CONT_INFER_STAGES): one scalar draw per JOIN so the row's first token emits before the next chunk, not per decode step
+                    t = int(m.sample(logits))
+                    if traced:
+                        tc = time.perf_counter()
+                        span(rows[r], "join", (tb - ta) * 1e3)
+                        span(rows[r], "sample", (tc - tb) * 1e3)
+                    emit(r, t)
+                    if rows[r] is not None:
+                        fresh[r] = t  # host-side token: next dispatch
+                else:                 # reads it over the device carry
+                    # FULLY cached prompt: no prefill at all.  The
+                    # row enters at lengths = P-1 and the next decode
+                    # chunk replays the last prompt token into the
+                    # shared tail page's private copy; the chunk's
+                    # first sampled column is the row's first output
+                    # token, so the full budget stays dispatchable.
+                    # The COW runs EAGERLY here — the admission need
+                    # counted that page, and deferring the copy to
+                    # dispatch would let a later admission consume it
+                    # and strand this row mid-decode.
+                    m._cow_fixups(cache)
+                    rows[r]["disp_left"] = self.max_new
+                    fresh[r] = int(ids[-1])
+                n += 1
             return n
 
         def emit(r: int, t: int) -> None:
@@ -1291,6 +1450,11 @@ class Completer:
                 if rows[r] is not None:
                     finish(r)
             cache.reset()
+            if self.prefix_cache is not None:
+                # a stopped lane returns the WHOLE pool: cached pages
+                # are a warm-serving optimization, not a shutdown
+                # liability (the zero-leaked-pages contract)
+                self.prefix_cache.reclaim(cache.n_blocks)
 
     # -- drain loop --------------------------------------------------------
 
@@ -1515,6 +1679,33 @@ class Completer:
             payload["pages_free"] = self._paged_cache.free_pages
             payload["pages_used"] = self._paged_cache.used_pages
             payload["live_tokens"] = self._paged_cache.live_tokens()
+        pc = self.prefix_cache
+        if pc is not None:
+            # prefix-cache gauges (sptpu_completer_prefix_* in `spt
+            # metrics`; the telemetry lane rings prefix_hits and
+            # prefix_shared_pages, `spt top` sparklines them)
+            s = pc.stats
+            payload["prefix_hits"] = s.hits
+            payload["prefix_misses"] = s.misses
+            payload["prefix_hit_tokens"] = s.hit_tokens
+            payload["prefix_evictions"] = s.evictions
+            payload["prefix_shared_pages"] = pc.shared_pages()
+            payload["prefix_evictable"] = pc.evictable_count()
+            payload["prefix_cow_copies"] = s.cow_copies
+            payload["prefix_bytes_saved"] = s.bytes_saved
+            for t, pages in pc.tenant_pages().items():
+                # per-tenant cache residency beside the QoS ledger
+                # counters — the quota-pressure incident view.
+                # Untagged traffic (tenant 0) stays out: the tenants
+                # section is for tagged deployments (its residency is
+                # already prefix_shared_pages), and the convention is
+                # that untagged traffic never creates the section
+                if t:
+                    tenants.setdefault(
+                        str(t), {})["prefix_pages"] = pages
+            if tenants and "tenants" not in payload:
+                payload["tenants"] = tenants
+        if self._paged_cache is not None:
             # the pool's storage dtype + bytes MEASURED from the
             # placed device buffers (values + scales): `spt metrics`
             # renders sptpu_completer_kv_pool_info{kv_dtype=...} and
@@ -1705,6 +1896,25 @@ def main(argv: list[str] | None = None) -> int:
                     help="continuous batching: requests join/leave the "
                          "live batch at chunk boundaries instead of "
                          "waiting for whole drains (run_continuous)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable cross-request prefix sharing on "
+                         "the continuous lane (default on: shared "
+                         "prompt prefixes map refcounted pool pages "
+                         "into the joiner's block table instead of "
+                         "re-prefilling — engine/prefix_cache.py; "
+                         "the A/B knob scripts/prefix_speedup_check "
+                         "measures against)")
+    ap.add_argument("--prefix-cache-pages", type=int, default=None,
+                    help="global cap on pool pages the prefix cache "
+                         "may retain (default: unlimited — zero-ref "
+                         "cached pages are reclaimed LRU-first "
+                         "whenever the pool actually needs them)")
+    ap.add_argument("--prefix-quota", default=None,
+                    help="per-tenant prefix-cache page quotas, "
+                         "TENANT:PAGES[,TENANT:PAGES...] (unlisted "
+                         "tenants are unbounded; over-quota inserts "
+                         "evict the tenant's own zero-ref pages "
+                         "first, then skip)")
     ap.add_argument("--queue-high-water", type=int, default=None,
                     help="multi-tenant QoS: max waiting backlog — "
                          "overflow is claimed and READY-flipped with "
@@ -1820,7 +2030,11 @@ def main(argv: list[str] | None = None) -> int:
                      queue_high_water=args.queue_high_water,
                      retry_after_ms=args.retry_after_ms,
                      tenant_weights=parse_tenant_weights(
-                         args.tenant_weights))
+                         args.tenant_weights),
+                     prefix_cache=not args.no_prefix_cache,
+                     prefix_cache_pages=args.prefix_cache_pages,
+                     prefix_quotas=parse_tenant_quotas(
+                         args.prefix_quota))
     comp.attach()
     if args.warmup:
         t0 = time.monotonic()

@@ -1,0 +1,298 @@
+"""Host-side radix prefix cache over the block-paged KV pool.
+
+Millions of requests share the same system prompts, few-shot headers,
+and RAG boilerplate — and before this module every admission
+re-prefilled and re-committed identical pages: a 67 ms bucket-64
+prefill that could have been a host-side block-table write.  The
+block-paged pool (models/decoder.PagedKVCache) already reads
+exclusively through per-row block tables, so the ragged paged kernel
+(ops/paged_attention) serves SHARED pages with zero changes — all the
+sharing machinery is host-side:
+
+  - **Refcounted pages** (PagedKVCache.refcounts): block tables from
+    different rows point at the same full pages; a page returns to
+    the free list only when its refcount hits zero.
+  - **This tree**: full-page prefixes indexed by token ids, page
+    granular — node j of a chain holds the pool page with the K/V of
+    tokens [j*page, (j+1)*page) computed IN CONTEXT of the whole
+    prefix (K/V at position p depend on every token before p, so a
+    page is only reusable under the exact token prefix it was
+    computed under — hence a radix tree, not a flat page hash).
+  - **Copy-on-write** (PagedKVCache / CompletionModel._cow_fixups):
+    a decode append whose target page is shared (or tree-frozen)
+    copies the page first, so a writer never mutates a page another
+    row — or a future joiner — reads.  Tree pages are otherwise
+    FROZEN read-only; for int8 pools that means their per-page scales
+    never rescale, which *removes* the stale-scale hazard
+    quantize-on-commit pools otherwise carry.
+
+Lifecycle: pages enter the tree at admission (after the committing
+row's prefill), while the donor row is still live — a mid-flight
+joiner may map a prefix another row is actively decoding from (the
+donor's appends only ever touch pages past its prompt).  When every
+mapping row finishes, the page's refcount hits zero and it becomes
+EVICTABLE: it stays allocated (and instantly re-mappable) until the
+pool actually needs the page back, at which point eviction takes the
+least-recently-matched zero-ref chain tails first.  Per-tenant page
+quotas (engine/qos.py `parse_tenant_quotas`, surfaced through the
+tenant ledger in the completer heartbeat) bound how much of the pool
+any one tenant's prefixes may squat on.
+
+Invariants the churn drill (tests/test_prefix_cache.py) pins:
+refcount 0 <=> (free list membership XOR tree retention); no page is
+ever in the free list while a table or the tree references it; a
+row's mapped prefix path has monotonically non-increasing refcounts
+root -> tail (rows always map whole prefixes), so a zero-ref node's
+entire subtree is zero-ref and leaf-first eviction can always make
+progress.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+
+@dataclasses.dataclass
+class PrefixCacheStats:
+    """Counters the completer heartbeat publishes (prefix_* gauges in
+    `spt metrics`, ring history in the telemetry lane, sparklines in
+    `spt top`)."""
+
+    hits: int = 0             # admissions matching >= 1 full page
+    misses: int = 0           # admissions matching nothing
+    hit_tokens: int = 0       # prompt tokens served from the tree
+    inserts: int = 0          # pages registered
+    evictions: int = 0        # pages reclaimed for the free list
+    cow_copies: int = 0       # copy-on-write page copies
+    quota_rejects: int = 0    # inserts skipped: tenant over quota
+    bytes_saved: int = 0      # KV bytes not re-prefilled/committed
+
+
+class _Node:
+    __slots__ = ("toks", "bid", "parent", "children", "lru", "tenant")
+
+    def __init__(self, toks: tuple, bid: int, parent, tenant: int):
+        self.toks = toks            # this page's token ids (exact)
+        self.bid = bid              # pool block id holding its K/V
+        self.parent = parent        # _Node | None (root child)
+        self.children: dict[tuple, _Node] = {}
+        self.lru = 0                # last-matched clock tick
+        self.tenant = tenant
+
+
+class PrefixCache:
+    """One instance per continuous-batching completer, bound to its
+    pool via attach() (re-bound — and emptied — whenever the lane
+    rebuilds the pool: abort recovery, spec demotion).  All methods
+    are called from the single lane thread; there is no locking, by
+    the same single-owner contract as the pool's host scheduler."""
+
+    def __init__(self, page: int, *, max_pages: int | None = None,
+                 tenant_quotas: dict[int, int] | None = None,
+                 default_quota: int | None = None):
+        if page < 1:
+            raise ValueError("page must be >= 1")
+        self.page = page
+        self.max_pages = max_pages
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.default_quota = default_quota
+        self.stats = PrefixCacheStats()
+        self._cache = None            # the bound PagedKVCache
+        self._children: dict[tuple, _Node] = {}   # root level
+        self._by_bid: dict[int, _Node] = {}
+        self._tenant_pages: dict[int, int] = {}
+        self._clock = itertools.count(1)
+        # zero-ref tree pages, maintained INCREMENTALLY on the pool's
+        # refcount 0<->1 transitions (on_zero_ref / on_ref) — the
+        # admission path reads evictable_count per waiting request,
+        # so an O(tree) scan there would tax the lane thread
+        self._zero_ref = 0
+
+    # -- binding -----------------------------------------------------------
+
+    def attach(self, cache) -> None:
+        """Bind (or re-bind) to a pool.  The tree references pool
+        block ids, so a rebuilt pool invalidates every node — the old
+        pool's pages died with it and must not be returned anywhere."""
+        self._cache = cache
+        self._children = {}
+        self._by_bid = {}
+        self._tenant_pages = {}
+        self._zero_ref = 0
+
+    # -- lookup / mapping ---------------------------------------------------
+
+    def lookup(self, ids) -> tuple[list[int], int]:
+        """Walk the tree over `ids` at page granularity.  Returns
+        (matched block ids in prefix order, matched token count).
+        PURE: no stats, no LRU touch — a lookup whose admission is
+        then denied (backpressure, raced slot) must neither inflate
+        the hit rate the runbook triages on nor refresh LRU stamps
+        for a prefix that never got served.  The admitting caller
+        records the outcome via commit_hit() / note_miss()."""
+        page = self.page
+        n_full = len(ids) // page
+        bids: list[int] = []
+        cur = self._children
+        for j in range(n_full):
+            chunk = tuple(int(t) for t in ids[j * page:(j + 1) * page])
+            node = cur.get(chunk)
+            if node is None:
+                break
+            bids.append(node.bid)
+            cur = node.children
+        return bids, len(bids) * page
+
+    def commit_hit(self, ids, match: int) -> None:
+        """An admission actually mapped `match` tokens of `ids`: count
+        the hit and LRU-touch the served path (re-walk — match/page
+        node hops, cheap next to the admission it accompanies)."""
+        page = self.page
+        tick = next(self._clock)
+        cur = self._children
+        for j in range(match // page):
+            node = cur.get(tuple(int(t)
+                                 for t in ids[j * page:(j + 1) * page]))
+            if node is None:
+                break                  # evicted mid-admission: stale
+            node.lru = tick
+            cur = node.children
+        self.stats.hits += 1
+        self.stats.hit_tokens += match
+
+    def note_miss(self) -> None:
+        self.stats.misses += 1
+
+    # -- insertion ----------------------------------------------------------
+
+    def insert(self, ids, cache, row: int, tenant: int = 0) -> int:
+        """Register the FULL prompt pages of `row` (its table entries
+        for pages [0, len(ids)//page)) under their token prefix.
+        Pages already present are skipped (the hit path mapped them;
+        the row's own duplicates stay private).  Returns pages
+        inserted.  A page enters FROZEN: the pool will copy-on-write
+        before any append could touch it, and for int8 pools its
+        scale never rescales again."""
+        if cache is not self._cache:
+            return 0                  # stale pool: never adopt its ids
+        page = self.page
+        n_full = len(ids) // page
+        inserted = 0
+        parent = None
+        cur = self._children
+        tick = next(self._clock)
+        for j in range(n_full):
+            chunk = tuple(int(t) for t in ids[j * page:(j + 1) * page])
+            node = cur.get(chunk)
+            if node is None:
+                bid = int(cache.tables[row, j])
+                if bid == 0 or bid in self._by_bid:
+                    break             # trash / already-owned: stop
+                if not self._admit_page(tenant):
+                    break
+                node = _Node(chunk, bid, parent, tenant)
+                cur[chunk] = node
+                self._by_bid[bid] = node
+                self._tenant_pages[tenant] = \
+                    self._tenant_pages.get(tenant, 0) + 1
+                self.stats.inserts += 1
+                inserted += 1
+            node.lru = tick
+            parent = node
+            cur = node.children
+        return inserted
+
+    def _admit_page(self, tenant: int) -> bool:
+        """Quota + global-cap gate for one insert.  Over quota, the
+        tenant's own least-recent zero-ref tail evicts first; only
+        when the tenant has nothing reclaimable is the insert
+        skipped (quota_rejects)."""
+        quota = self.tenant_quotas.get(tenant, self.default_quota)
+        if quota is not None and \
+                self._tenant_pages.get(tenant, 0) >= quota:
+            if not self._evict_one(tenant=tenant):
+                self.stats.quota_rejects += 1
+                return False
+        if self.max_pages is not None and \
+                len(self._by_bid) >= self.max_pages:
+            if not self._evict_one():
+                return False
+        return True
+
+    # -- pool hooks (called by PagedKVCache) --------------------------------
+
+    def retains(self, bid: int) -> bool:
+        """True when the tree references `bid` — the pool asks on
+        every COW decision (a frozen page must never be appended
+        into, even at refcount 1)."""
+        return bid in self._by_bid
+
+    def on_zero_ref(self, bid: int) -> bool:
+        """The pool's refcount for `bid` just hit zero.  True = the
+        tree retains it (keep it OFF the free list; it is now
+        evictable), False = not ours, free normally."""
+        if bid in self._by_bid:
+            self._zero_ref += 1
+            return True
+        return False
+
+    def on_ref(self, bid: int) -> None:
+        """`bid` went 0 -> 1 references (a joiner mapped an evictable
+        page): it is pinned again, not reclaimable."""
+        if bid in self._by_bid:
+            self._zero_ref -= 1
+
+    def reclaim(self, n: int) -> int:
+        """Evict up to `n` least-recently-matched zero-ref pages back
+        to the pool's free list (leaf-first; evicting a tail exposes
+        its parent).  Returns pages actually reclaimed — the pool's
+        allocator calls this when its free list runs dry."""
+        done = 0
+        while done < n and self._evict_one():
+            done += 1
+        return done
+
+    def _evict_one(self, tenant: int | None = None) -> bool:
+        cache = self._cache
+        if cache is None:
+            return False
+        victim = None
+        for node in self._by_bid.values():
+            if node.children:
+                continue              # leaf-first (cascade exposes it)
+            if cache.refcounts[node.bid] != 0:
+                continue              # mapped by a live row
+            if tenant is not None and node.tenant != tenant:
+                continue
+            if victim is None or node.lru < victim.lru:
+                victim = node
+        if victim is None:
+            return False
+        siblings = (victim.parent.children if victim.parent is not None
+                    else self._children)
+        siblings.pop(victim.toks, None)
+        del self._by_bid[victim.bid]
+        self._tenant_pages[victim.tenant] = \
+            max(0, self._tenant_pages.get(victim.tenant, 0) - 1)
+        self._zero_ref -= 1            # victims are zero-ref by test
+        cache._free.append(victim.bid)
+        self.stats.evictions += 1
+        return True
+
+    # -- gauges -------------------------------------------------------------
+
+    def evictable_count(self) -> int:
+        """Zero-ref tree pages: reclaimable capacity the admission
+        path may count on top of the free list (a zero-ref node's
+        whole subtree is zero-ref — see the module invariants — so
+        every one of them is reachable by leaf-first eviction).
+        O(1): maintained incrementally on the pool's refcount
+        transitions; the churn drill pins it against a brute-force
+        recount."""
+        return self._zero_ref if self._cache is not None else 0
+
+    def shared_pages(self) -> int:
+        return len(self._by_bid)
+
+    def tenant_pages(self) -> dict[int, int]:
+        return {t: n for t, n in self._tenant_pages.items() if n}

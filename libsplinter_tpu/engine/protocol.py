@@ -178,8 +178,12 @@ INFER_STAGES = ("render", "generate", "commit")
 # append run.  A client-stamped request (stamp_trace) gets a
 # flight-recorder entry with its accumulated spans, so `spt trace
 # tail` reconstructs batched-lane requests too, not just the serial
-# path's.
-CONT_INFER_STAGES = ("join", "sample", "decode", "collect", "flush")
+# path's.  prefix_hit = the host-side radix walk + shared-page table
+# mapping of a prefix-cache hit (engine/prefix_cache.py) — its span
+# next to `join` is how `spt trace show` attributes first-token
+# latency to cache hits vs suffix prefill.
+CONT_INFER_STAGES = ("join", "sample", "decode", "collect", "flush",
+                     "prefix_hit")
 
 # the search daemon's per-drain decomposition: wake = signal to drain
 # entry (the coalescing window's scheduling cost); drain = request

@@ -92,6 +92,34 @@ def parse_tenant_weights(spec: str | None) -> dict[int, float] | None:
     return out or None
 
 
+def parse_tenant_quotas(spec: str | None) -> dict[int, int] | None:
+    """Parse a per-tenant PAGE-quota flag (`--prefix-quota "1:64,2:8"`
+    -> {1: 64, 2: 8}).  Same grammar as the weights flag, integer
+    values; unlisted tenants are unbounded.  The quotas bound how
+    much of the paged pool a tenant's cached prefixes may squat on
+    (engine/prefix_cache.py enforces them at insert, evicting the
+    tenant's own zero-ref pages first), and the per-tenant residency
+    rides the heartbeat's tenant ledger section as `prefix_pages` —
+    so a quota incident is visible in the same `spt metrics` series
+    as the admission counters."""
+    if not spec:
+        return None
+    out: dict[int, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        t, sep, q = part.partition(":")
+        if not sep:
+            raise ValueError(
+                f"tenant quota {part!r}: expected TENANT:PAGES")
+        out[int(t)] = int(q)
+        if out[int(t)] < 0:
+            raise ValueError(
+                f"tenant quota {part!r}: pages must be >= 0")
+    return out or None
+
+
 @dataclasses.dataclass
 class WaitingRow:
     """One waiting request as the admission policy sees it: an opaque

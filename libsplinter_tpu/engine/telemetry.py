@@ -56,7 +56,8 @@ PROGRESS_FIELDS = {"embedder": "embedded",
                    "completer": "completions",
                    "searcher": "served",
                    "pipeliner": "scripts_completed"}
-_EXTRA = {"completer": ("pages_free", "tokens"),
+_EXTRA = {"completer": ("pages_free", "tokens", "prefix_hits",
+                        "prefix_shared_pages"),
           "pipeliner": ("scripts_active",)}
 
 DEFAULT_INTERVAL_S = 2.0

@@ -290,10 +290,125 @@ class PagedKVCache:
         self.lengths = np.zeros((batch,), np.int32)
         self._free = list(range(self.n_blocks - 1, 0, -1))
         self._owned: list[list[int]] = [[] for _ in range(batch)]
+        # cross-request prefix sharing: per-page refcounts let block
+        # tables from different rows point at the same full pages —
+        # a page returns to the free list only at refcount zero.  The
+        # trash block 0 is never allocated and never counted.
+        # `prefix_cache` (engine/prefix_cache.PrefixCache, duck-typed
+        # via retains()/reclaim()) may additionally FREEZE pages:
+        # zero-ref frozen pages stay allocated (instantly re-mappable)
+        # until the allocator actually needs them back.
+        self.refcounts = np.zeros((self.n_blocks,), np.int64)
+        self.prefix_cache = None
+        self._ever_shared = False
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def available_pages(self) -> int:
+        """Free-list pages plus zero-ref prefix-cache pages the
+        allocator can reclaim on demand — the number admission
+        backpressure must compare against (free_pages alone would
+        deny joiners while a warm cache squats on reclaimable
+        pages)."""
+        pc = self.prefix_cache
+        extra = pc.evictable_count() if pc is not None else 0
+        return len(self._free) + extra
+
+    def _alloc_page(self) -> int:
+        """Pop one page (refcount 1), evicting zero-ref cached pages
+        LRU-first when the free list is dry.  Raises when the pool is
+        truly exhausted — callers gate on available_pages first."""
+        if not self._free:
+            pc = self.prefix_cache
+            if pc is None or not pc.reclaim(1):
+                raise RuntimeError("paged pool exhausted")
+        bid = self._free.pop()
+        self.refcounts[bid] = 1
+        return bid
+
+    def _decref(self, bid: int) -> None:
+        self.refcounts[bid] -= 1
+        if self.refcounts[bid] < 0:      # double-free: a scheduler bug
+            raise RuntimeError(f"page {bid} refcount underflow")
+        if self.refcounts[bid] == 0:
+            pc = self.prefix_cache
+            if pc is None or not pc.on_zero_ref(bid):
+                self._free.append(bid)
+            # else: the tree retains it — evictable, not free
+
+    def map_shared(self, row: int, bids: list[int]) -> None:
+        """Point `row`'s next table entries at already-committed
+        pages (refcount bump — no device work; the admission-time
+        'table write' that replaces a whole prefix prefill).  The
+        caller sets cache.lengths[row] to the token count the mapped
+        prefix covers."""
+        have = len(self._owned[row])
+        if have + len(bids) > self.pages_per_row:
+            raise ValueError("mapped prefix exceeds the row's table")
+        pc = self.prefix_cache
+        for i, bid in enumerate(bids):
+            bid = int(bid)
+            if bid <= 0 or bid >= self.n_blocks:
+                raise ValueError(f"bad shared page id {bid}")
+            self.refcounts[bid] += 1
+            if self.refcounts[bid] == 1 and pc is not None:
+                pc.on_ref(bid)         # evictable page pinned again
+            self._owned[row].append(bid)
+            self.tables[row, have + i] = bid
+        if bids:
+            self._ever_shared = True
+
+    def cow_targets(self) -> list[tuple[int, int]]:
+        """(row, page_index) pairs whose NEXT decode append would
+        write into a page some other reader holds — shared
+        (refcount > 1) or frozen in the prefix tree.  Only the page
+        containing position lengths[row] can qualify: shared pages
+        cover prompt prefixes only, and every later page was
+        privately allocated by ensure().  Cheap no-op for pools that
+        never shared a page."""
+        if not self._ever_shared and self.prefix_cache is None:
+            return []
+        out = []
+        pc = self.prefix_cache
+        for r in range(self.batch):
+            length = int(self.lengths[r])
+            if length <= 0:
+                continue
+            p_idx = min(length, self.cfg.max_len - 1) // self.page
+            if p_idx >= len(self._owned[r]):
+                continue              # contract violation elsewhere
+            bid = int(self.tables[r, p_idx])
+            if bid == 0:
+                continue
+            if self.refcounts[bid] > 1 or \
+                    (pc is not None and pc.retains(bid)):
+                out.append((r, p_idx))
+        return out
+
+    def commit_cow(self, row: int, p_idx: int, new_bid: int) -> None:
+        """Host half of a copy-on-write: swap the row's table entry to
+        the freshly copied private page and drop its reference on the
+        shared original (which stays alive for its other readers, or
+        for the tree)."""
+        old = int(self.tables[row, p_idx])
+        self._owned[row][p_idx] = new_bid
+        self.tables[row, p_idx] = new_bid
+        self._decref(old)
+        pc = self.prefix_cache
+        if pc is not None:
+            pc.stats.cow_copies += 1
+
+    def kv_bytes_per_token(self) -> int:
+        """KV bytes one token occupies across every layer (k + v) —
+        the factor behind the prefix cache's bytes_saved gauge."""
+        itemsize = np.dtype(
+            "int8" if self.quantized else
+            "float32" if self.kv_dtype == "f32" else "uint16").itemsize
+        return (self.cfg.layers * 2 * self.cfg.kv_heads
+                * self.cfg.head_dim * itemsize)
 
     @property
     def used_pages(self) -> int:
@@ -305,22 +420,29 @@ class PagedKVCache:
 
     def ensure(self, row: int, tokens: int) -> bool:
         """Grow row's table to cover `tokens`; False (nothing
-        allocated) when the pool cannot — admission backpressure."""
+        allocated) when the pool cannot — admission backpressure.
+        Pages the row already holds (allocated OR mapped shared)
+        count; new pages come off the free list, reclaiming zero-ref
+        prefix-cache pages when it runs dry."""
         need = self.pages_needed(tokens)
         have = len(self._owned[row])
         if need <= have:
             return True
-        if need - have > len(self._free):
+        if need - have > self.available_pages:
             return False
         for p in range(have, need):
-            bid = self._free.pop()
+            bid = self._alloc_page()
             self._owned[row].append(bid)
             self.tables[row, p] = bid
         return True
 
     def free_row(self, row: int) -> None:
-        """Return every page row owns to the pool (request finished)."""
-        self._free.extend(self._owned[row])
+        """Drop every page reference row holds (request finished):
+        refcounts decrement, and a page returns to the free list only
+        when its last reader lets go — unless the prefix tree retains
+        it, in which case it parks evictable instead."""
+        for bid in self._owned[row]:
+            self._decref(bid)
         self._owned[row] = []
         self.tables[row, :] = 0
         self.lengths[row] = 0
@@ -413,7 +535,7 @@ class CausalAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache_kv, pos, start=None, lengths=None,
-                 tables=None):
+                 tables=None, n_valid=None):
         """x: (B, S, H) chunk at cache slots pos..pos+S-1.
         cache_kv: (k, v) each (B, T, KH, D).  start: None, or (B,)
         left-pad offsets for batched serving — row r's real tokens
@@ -435,7 +557,13 @@ class CausalAttention(nn.Module):
         S > 1 is the speculative VERIFY stack (token t attends
         j < lengths[r] + 1 + t, causal across the stack, one kernel
         dispatch for all S positions).  pos/start are ignored on this
-        path."""
+        path.  `n_valid` (paged path only, traced scalar): appends of
+        stack positions s >= n_valid route to the trash block — the
+        suffix-prefill programs pad the stack to a bucket, and a pad
+        append landing in a real page would poison an int8 page's
+        monotonic scale (float pages merely hold garbage that decode
+        overwrites before any query attends it, but the quantized
+        rescale-on-append never forgets a max)."""
         cfg = self.cfg
         B, S, _ = x.shape
         D = cfg.head_dim
@@ -470,6 +598,8 @@ class CausalAttention(nn.Module):
                 app = rp[:, s]
                 bids = jnp.take_along_axis(
                     tables, (app // page)[:, None], axis=1)[:, 0]
+                if n_valid is not None:
+                    bids = jnp.where(jnp.int32(s) < n_valid, bids, 0)
                 offs = app % page
                 # dead rows (length 0 everywhere on the host) route to
                 # the trash block 0 via their zeroed table entries
@@ -538,11 +668,11 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache_kv, pos, start=None, lengths=None,
-                 tables=None):
+                 tables=None, n_valid=None):
         cfg = self.cfg
         a, cache_kv = CausalAttention(cfg, self.mesh, name="attn")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_attn")(x),
-            cache_kv, pos, start, lengths, tables)
+            cache_kv, pos, start, lengths, tables, n_valid)
         x = x + a
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_mlp")(x)
         if self.mlp_cls is not None:
@@ -564,7 +694,7 @@ class Decoder(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids, cache, pos, start=None, lengths=None,
-                 tables=None):
+                 tables=None, n_valid=None):
         """token_ids: (B, S) int32; cache: list of per-layer (k, v);
         pos: scalar int32 — cache slot of token_ids[:, 0]; start:
         optional (B,) left-pad offsets (batched serving — see
@@ -580,7 +710,7 @@ class Decoder(nn.Module):
             x, kv = DecoderLayer(cfg, self.mlp_cls, self.mesh,
                                  name=f"layer_{i}")(x, cache[i], pos,
                                                     start, lengths,
-                                                    tables)
+                                                    tables, n_valid)
             new_cache.append(kv)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="ln_out")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
@@ -665,8 +795,18 @@ class CompletionModel:
                  buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
                  params: Any = None, weights: str | None = None,
                  top_p: float = 0.9, temp: float = 0.7,
-                 module: Any = None, kv_dtype: str | None = None):
+                 module: Any = None, kv_dtype: str | None = None,
+                 suffix_buckets: tuple[int, ...] = (16, 64)):
         self.cfg = cfg
+        # pad buckets for paged_append_prefill's suffix stacks (the
+        # prefix-cache hit path): small on purpose — each program
+        # unrolls S sequential page appends per layer, so a bucket-
+        # 1024 variant would compile forever for a path whose whole
+        # point is that suffixes are short.  Longer suffixes loop the
+        # largest bucket.
+        self.suffix_buckets = tuple(sorted(
+            b for b in suffix_buckets if 0 < b < cfg.max_len)) or (
+            min(16, max(1, cfg.max_len - 1)),)
         # default paged-pool storage dtype for init_paged (None = the
         # model's native activation dtype); "int8" turns the whole
         # continuous lane quantized (--kv-dtype on the daemon)
@@ -1196,6 +1336,174 @@ class CompletionModel:
         cache.lengths[row] = P
         return np.asarray(logits[0, P - 1])
 
+    # -- prefix-shared serving (refcounted pages + COW) -------------------
+    #
+    # The radix prefix cache (engine/prefix_cache.py) turns a shared
+    # prompt prefix into a host-side table write: map_shared bumps
+    # refcounts, and only the UNCACHED suffix still runs a forward
+    # pass — through the programs below, which attend over the mapped
+    # pages via the same ragged paged kernel decode uses (the suffix's
+    # K/V depend on the whole prefix, so a dense scratch prefill
+    # cannot serve it).  A fully cached prompt prefills NOTHING: the
+    # row enters at lengths = P-1 and the first decode chunk replays
+    # the last prompt token — whose append lands inside the shared
+    # tail page and so triggers the copy-on-write below.
+
+    def _paged_suffix_program(self, sb: int, quantized: bool = False):
+        """One program appending a (1, sb) suffix stack into a row's
+        pages (positions lengths..lengths+n_valid-1; pad appends past
+        n_valid route to the trash block) and attending through the
+        ragged paged kernel — causal across the stack, over the
+        mapped prefix.  Returns the pools and the LAST VALID token's
+        logits for sampling the row's first output token."""
+        key = ("suffix", sb, quantized)
+        fn = self._paged_progs.get(key)
+        if fn is None:
+            module = self.module
+
+            if quantized:
+                def run(params, k_pools, v_pools, k_scales, v_scales,
+                        table, length, ids, n_valid):
+                    cache = list(zip(k_pools, v_pools, k_scales,
+                                     v_scales))
+                    logits, new_cache = module.apply(
+                        params, ids, cache, jnp.int32(0), None,
+                        length, table, n_valid)
+                    return ([c[0] for c in new_cache],
+                            [c[1] for c in new_cache],
+                            [c[2] for c in new_cache],
+                            [c[3] for c in new_cache],
+                            logits[0, n_valid - 1])
+
+                out_sh = self._paged_pool_out_shardings(
+                    2, 1, n_scale_lists=2)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw)
+            else:
+                def run(params, k_pools, v_pools, table, length, ids,
+                        n_valid):
+                    cache = list(zip(k_pools, v_pools))
+                    logits, new_cache = module.apply(
+                        params, ids, cache, jnp.int32(0), None,
+                        length, table, n_valid)
+                    return ([c[0] for c in new_cache],
+                            [c[1] for c in new_cache],
+                            logits[0, n_valid - 1])
+
+                out_sh = self._paged_pool_out_shardings(2, 1)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(1, 2), **kw)
+            self._paged_progs[key] = fn
+        return fn
+
+    def paged_append_prefill(self, cache: PagedKVCache, suffix_ids,
+                             row: int) -> np.ndarray:
+        """Prefill ONLY the uncached suffix of row's prompt, atop the
+        cache.lengths[row] tokens its table already maps (shared
+        prefix pages + any earlier suffix chunks).  Suffixes longer
+        than the largest suffix bucket loop it.  The caller has
+        ensure()d the row's worst case; a dry pool here is the same
+        contract violation paged_prefill_row raises on.  Returns the
+        last real token's logits (V,)."""
+        ids = np.asarray(suffix_ids, np.int32)
+        if ids.size == 0:
+            raise ValueError("empty suffix")
+        pos = int(cache.lengths[row])
+        if pos + ids.size >= self.cfg.max_len:
+            raise ValueError("suffix exceeds context window")
+        if not cache.ensure(row, pos + ids.size):
+            raise RuntimeError(
+                f"paged pool exhausted: row {row} suffix needs "
+                f"{cache.pages_needed(pos + ids.size)} pages")
+        table = cache.tables[row: row + 1]
+        logits = None
+        off = 0
+        while off < ids.size:
+            rem = ids.size - off
+            sb = next((b for b in self.suffix_buckets if b >= rem),
+                      self.suffix_buckets[-1])
+            n = min(rem, sb)
+            chunk = np.zeros((1, sb), np.int32)
+            chunk[0, :n] = ids[off: off + n]
+            args = (self.params, cache.k_pools, cache.v_pools)
+            if cache.quantized:
+                args += (cache.k_scales, cache.v_scales)
+            args += (jnp.asarray(table),
+                     jnp.asarray(cache.lengths[row: row + 1]),
+                     jnp.asarray(chunk), jnp.int32(n))
+            out = self._paged_suffix_program(sb, cache.quantized)(*args)
+            if cache.quantized:
+                kp, vp, ks, vs, logits = out
+                cache.k_scales, cache.v_scales = list(ks), list(vs)
+            else:
+                kp, vp, logits = out
+            cache.k_pools, cache.v_pools = list(kp), list(vp)
+            cache.lengths[row] += n
+            off += n
+        return np.asarray(logits)
+
+    def _cow_copy_program(self, quantized: bool = False):
+        """One program duplicating pool page `src` into `dst` across
+        every layer and side (+ the int8 scales) — the device half of
+        a copy-on-write, dispatched BEFORE the table swap so the
+        shared original is still intact when read."""
+        key = ("cow", quantized)
+        fn = self._paged_progs.get(key)
+        if fn is None:
+            if quantized:
+                def run(k_pools, v_pools, k_scales, v_scales, src,
+                        dst):
+                    return ([p.at[dst].set(p[src]) for p in k_pools],
+                            [p.at[dst].set(p[src]) for p in v_pools],
+                            [s.at[dst].set(s[src]) for s in k_scales],
+                            [s.at[dst].set(s[src]) for s in v_scales])
+
+                out_sh = self._paged_pool_out_shardings(
+                    2, 0, n_scale_lists=2)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw)
+            else:
+                def run(k_pools, v_pools, src, dst):
+                    return ([p.at[dst].set(p[src]) for p in k_pools],
+                            [p.at[dst].set(p[src]) for p in v_pools])
+
+                out_sh = self._paged_pool_out_shardings(2, 0)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = jax.jit(run, donate_argnums=(0, 1), **kw)
+            self._paged_progs[key] = fn
+        return fn
+
+    def _cow_fixups(self, cache) -> int:
+        """Copy-on-write pass before a decode dispatch: every row
+        whose next append would write into a shared or tree-frozen
+        page gets a private copy first, so a writer NEVER mutates a
+        page another row (or a future joiner walking the prefix tree)
+        reads.  In practice only a fully-cached prompt's replay
+        append ever qualifies — partial-hit rows append into their
+        privately prefilled tail — so this is one page copy per
+        full-cover admission, not a steady-state cost.  Returns pages
+        copied."""
+        targets = getattr(cache, "cow_targets", None)
+        if targets is None:
+            return 0
+        n = 0
+        for row, p_idx in targets():
+            src = int(cache.tables[row, p_idx])
+            dst = cache._alloc_page()
+            if cache.quantized:
+                kp, vp, ks, vs = self._cow_copy_program(True)(
+                    cache.k_pools, cache.v_pools, cache.k_scales,
+                    cache.v_scales, jnp.int32(src), jnp.int32(dst))
+                cache.k_scales, cache.v_scales = list(ks), list(vs)
+            else:
+                kp, vp = self._cow_copy_program(False)(
+                    cache.k_pools, cache.v_pools, jnp.int32(src),
+                    jnp.int32(dst))
+            cache.k_pools, cache.v_pools = list(kp), list(vp)
+            cache.commit_cow(row, p_idx, dst)
+            n += 1
+        return n
+
     def _paged_chunk_program(self, n: int, bp: int,
                              quantized: bool = False):
         """lax.scan of n paged decode steps for bp rows: append one
@@ -1327,6 +1635,10 @@ class CompletionModel:
                 raise RuntimeError(
                     f"paged pool exhausted mid-decode: row {r} "
                     f"(admission must reserve prompt + max_new)")
+        # copy-on-write BEFORE the tables snapshot below: a row whose
+        # first append this chunk targets a shared/frozen page decodes
+        # into its own private copy (prefix sharing's writer barrier)
+        self._cow_fixups(cache)
         toks = np.full((bp,), -1, np.int32)
         toks[: len(tokens)] = np.asarray(tokens, np.int32)
         if carry is None:
@@ -1383,6 +1695,32 @@ class CompletionModel:
                     cache, np.ones((cache.batch,), np.int32), chunk)
                 chunk_done = True
             cache.free_row(0)
+        # the prefix-cache hit path's programs (suffix stacks + the
+        # COW page copy) — a first cache hit at serve time must not
+        # pay a compile either.  Gated on an ATTACHED tree: a lane
+        # with sharing disabled never runs these, so warming them
+        # would only inflate startup
+        if getattr(cache, "prefix_cache", None) is not None:
+            quant = getattr(cache, "quantized", False)
+            for sb in self.suffix_buckets:
+                if sb + chunk >= self.cfg.max_len:
+                    break
+                self.paged_append_prefill(
+                    cache, np.ones((sb,), np.int32), 0)
+                cache.free_row(0)
+            src, dst = cache._alloc_page(), cache._alloc_page()
+            if quant:
+                kp, vp, ks, vs = self._cow_copy_program(True)(
+                    cache.k_pools, cache.v_pools, cache.k_scales,
+                    cache.v_scales, jnp.int32(src), jnp.int32(dst))
+                cache.k_scales, cache.v_scales = list(ks), list(vs)
+            else:
+                kp, vp = self._cow_copy_program(False)(
+                    cache.k_pools, cache.v_pools, jnp.int32(src),
+                    jnp.int32(dst))
+            cache.k_pools, cache.v_pools = list(kp), list(vp)
+            cache._decref(src)
+            cache._decref(dst)
 
     def compile_count(self) -> int:
         """Distinct XLA programs compiled across every program cache

@@ -192,6 +192,13 @@ class SpecPagedCache:
         return min(self.target.free_pages, self.draft.free_pages)
 
     @property
+    def available_pages(self) -> int:
+        # the admission gate (engine/completer): paired spec pools
+        # never attach a prefix cache, so available == free on both
+        return min(self.target.available_pages,
+                   self.draft.available_pages)
+
+    @property
     def used_pages(self) -> int:
         return self.target.used_pages
 

@@ -117,7 +117,9 @@ The completion lane behind `LBL_INFER_REQ` serves continuous batching
 | `k_pools` / `v_pools` | per layer `(n_blocks, kv_heads, page, head_dim)` global page pool |
 | `tables` | host `(batch, pages_per_row)` int32 block table — entry `(b, p)` holds row b's tokens `[p*page, (p+1)*page)` |
 | `lengths` | host `(batch,)` int32 per-row token counts (row b attends `j < lengths[b]`) |
-| `ensure(row, tokens)` / `free_row(row)` | page-granular alloc (all-or-nothing; False = backpressure) and immediate release |
+| `ensure(row, tokens)` / `free_row(row)` | page-granular alloc (all-or-nothing; False = backpressure) and per-page refcount release (a page frees only at refcount zero) |
+| `refcounts` / `map_shared(row, bids)` | cross-request prefix sharing (PR 14): tables from different rows point at the same full pages; `map_shared` is the refcount-bump table write that replaces a whole prefix prefill |
+| `available_pages` | free-list pages + zero-ref prefix-cache pages reclaimable on demand — what admission backpressure gates on |
 | `free_pages` / `used_pages` / `live_tokens()` | the pool gauges the completer heartbeat publishes (`sptpu_completer_pages_{free,used}`) |
 
 Block 0 is the reserved **trash block**: unallocated table entries
@@ -516,6 +518,30 @@ Every lane heartbeat additionally carries a `spans_obs` section
 (span-capture accounting: committed / recovered / dropped / pending —
 obs/spans.py; size-droppable like every optional section), rendered
 flat by `spt metrics` as `sptpu_<lane>_spans_*`.
+
+### Prefix-cache keys (`libsplinter_tpu/engine/prefix_cache.py`)
+
+A continuous completer with prefix sharing live (the default; off via
+`--no-prefix-cache`) extends `__completer_stats` with flat
+`prefix_*` gauges, rendered by `spt metrics` as typed counters
+(`sptpu_completer_prefix_*`) and ringed by the telemetry sampler
+(`prefix_hits` / `prefix_shared_pages` sparkline in `spt top`):
+
+| field | meaning |
+|---|---|
+| `prefix_hits` / `prefix_misses` | admissions that mapped ≥ 1 full shared page vs none |
+| `prefix_hit_tokens` | prompt tokens served from shared pages instead of prefill |
+| `prefix_shared_pages` / `prefix_evictable` | tree residency: total retained pages / the zero-ref subset reclaimable on demand (`available_pages = pages_free + prefix_evictable`) |
+| `prefix_evictions` | LRU reclaims back to the free list |
+| `prefix_cow_copies` | copy-on-write page copies (≈ one per fully-cached admission) |
+| `prefix_bytes_saved` | KV bytes not re-prefilled/committed |
+
+Per-tenant cache residency rides the `tenants` ledger section as
+`prefix_pages` (quota pressure: `--prefix-quota T:PAGES,...`), and
+traced admissions carry a `prefix_hit` stage span
+(`CONT_INFER_STAGES`) so `spt trace show` attributes first-token
+latency to the cache hit vs the suffix prefill.  Runbook:
+`docs/operations.md` §Prefix cache.
 """,
 }
 

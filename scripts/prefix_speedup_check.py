@@ -1,0 +1,142 @@
+"""CI gate: the radix prefix cache must actually collapse hot-prefix
+admission latency (`make prefix-check`).
+
+Serves the SAME long prompt repeatedly through one in-process
+continuous-batching completer (real tiny decoder, CPU) two ways:
+with the prefix cache DISABLED (every admission pays the full dense
+bucket prefill — the cold path) and ENABLED (the first admission
+warms the tree, every later one maps the shared pages and replays at
+most a page-tail — a host-side table write plus one decode chunk).
+The hot admission-to-first-token p50 must land >= 5x below the cold
+p50 — the CPU-stack floor of the ISSUE 14 / ROADMAP item 2 target
+(the >= 10x headline is the TPU ledger row, where the dense prefill
+the hot path skips is far more expensive relative to a table write).
+
+Both runs also assert byte-identical greedy output, so the speedup
+can never be bought with a correctness regression.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from libsplinter_tpu import Store  # noqa: E402
+from libsplinter_tpu.engine import protocol as P  # noqa: E402
+from libsplinter_tpu.engine.completer import Completer  # noqa: E402
+from libsplinter_tpu.models.decoder import (CompletionModel,  # noqa: E402
+                                            DecoderConfig)
+
+REQUIRED_SPEEDUP = 5.0
+PAGE = 32
+# 33 pages of prompt (+ BOS): long enough that the cold dense bucket
+# prefill dwarfs scheduling noise, short enough that CPU CI stays
+# fast.  chars = pages*PAGE - 1 because the byte tokenizer prepends
+# BOS — the repeated prompt must land exactly on a page boundary so
+# the hot path is the pure map + replay (zero prefill) form.
+PROMPT = ("retrieval context: " * 70)[: 33 * PAGE - 1]
+TRIALS = 6
+
+
+def first_token_ms(st, comp_key: str, prompt: str) -> float:
+    """Submit one completion and clock submit -> first streamed byte
+    (the completer claims the slot by overwriting it with the
+    rendered prompt, so 'first token' is value growth past it)."""
+    st.set(comp_key, prompt)
+    rendered_len = len(prompt.encode())
+    t0 = time.perf_counter()
+    st.label_or(comp_key, P.LBL_INFER_REQ | P.LBL_WAITING)
+    st.bump(comp_key)
+    deadline = t0 + 60.0
+    while time.perf_counter() < deadline:
+        try:
+            if st.value_len(comp_key) > rendered_len:
+                return (time.perf_counter() - t0) * 1e3
+        except KeyError:
+            pass
+        time.sleep(0.0002)
+    raise SystemExit(f"request {comp_key} never streamed a token")
+
+
+def run_lane(tag: str, enable_cache: bool) -> tuple[list[float], list[bytes]]:
+    name = f"/spt-pfxchk-{tag}-{os.getpid()}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=256, max_val=8192, vec_dim=8)
+    lat: list[float] = []
+    outs: list[bytes] = []
+    try:
+        cfg = DecoderConfig.tiny(max_len=2048)
+        model = CompletionModel(cfg, buckets=(1088,), temp=0.0,
+                                seed=1, suffix_buckets=(16,))
+        # a tight pool matters on CPU: buffer donation is a no-op
+        # there, so every dispatch COPIES the pools — an oversized
+        # pool taxes the hot path (one chunk) far more than the cold
+        # one (one big prefill), understating the real win
+        comp = Completer(st, model=model, max_new_tokens=6,
+                         flush_tokens=1, template="none", batch_cap=4,
+                         page_size=PAGE, pool_pages=110,
+                         inflight_depth=1,
+                         prefix_cache=enable_cache)
+        comp.attach()
+        comp.warmup_paged()           # no compiles inside the clock
+        th = threading.Thread(
+            target=comp.run_continuous,
+            kwargs=dict(idle_timeout_ms=5, stop_after=180.0),
+            daemon=True)
+        th.start()
+        time.sleep(0.1)
+        # one unmeasured warmer: with the cache on it seeds the tree,
+        # with it off it equalizes any store/lane warmup bias
+        first_token_ms(st, f"{tag}/warm", PROMPT)
+        for i in range(TRIALS):
+            key = f"{tag}/{i}"
+            lat.append(first_token_ms(st, key, PROMPT))
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    not st.labels(key) & P.LBL_READY:
+                time.sleep(0.001)
+            assert st.labels(key) & P.LBL_READY, f"{key} never READY"
+            outs.append(st.get(key).rstrip(b"\0"))
+        if enable_cache:
+            s = comp.prefix_cache.stats
+            assert s.hits >= TRIALS, \
+                f"hot run missed the cache: {s}"
+        comp.stop()
+        th.join(timeout=20)
+    finally:
+        st.close()
+        Store.unlink(name)
+    return lat, outs
+
+
+def main() -> int:
+    cold, cold_out = run_lane("cold", enable_cache=False)
+    hot, hot_out = run_lane("hot", enable_cache=True)
+    assert cold_out == hot_out, (
+        "prefix-shared output diverged from the cache-disabled path:\n"
+        f"  cold: {cold_out[0]!r}\n  hot:  {hot_out[0]!r}")
+    cold_p50 = float(np.median(cold))
+    hot_p50 = float(np.median(hot))
+    speedup = cold_p50 / hot_p50 if hot_p50 > 0 else float("inf")
+    print(f"admission-to-first-token p50: cache-disabled "
+          f"{cold_p50:.2f} ms, hot prefix {hot_p50:.2f} ms "
+          f"({speedup:.1f}x; gate >= {REQUIRED_SPEEDUP:g}x)")
+    if speedup < REQUIRED_SPEEDUP:
+        print("FAIL: the prefix cache did not beat the cold path by "
+              "the required margin")
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,9 +76,40 @@ def main() -> int:
 
         cfg = DecoderConfig.tiny(dtype=jnp.float32)
         model = CompletionModel(cfg, buckets=(16,), temp=0.0, seed=1)
+        # prefix cache OFF: this drill's clean-pool assertion reads
+        # pages_used == 0 on the LIVE lane, and warm-cache retention
+        # would legitimately hold prompt pages (the prefix+crash
+        # composition has its own drill, completer_prefix below)
         comp = Completer(st, model=model, max_new_tokens=8,
                          flush_tokens=4, template="none", batch_cap=2,
-                         page_size=16, kv_dtype="int8")
+                         page_size=16, kv_dtype="int8",
+                         prefix_cache=False)
+        comp.attach()
+        comp.run_continuous(
+            idle_timeout_ms=20,
+            stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
+        print(f"completions={comp.stats.completions}", flush=True)
+    elif role == "completer_prefix":
+        # the prefix-sharing continuous lane at tiny geometry: the
+        # completer.prefix_map fault site fires on a prefix-cache HIT
+        # right before map_shared bumps any refcount, so a crash here
+        # dies with a claimed request mid table-mapping — pool,
+        # refcounts, and radix tree all die with the process, and the
+        # drill proves the restarted lane rebuilds a clean pool
+        # (zero stranded refcounts) and re-serves the reclaimed
+        # request from a cold tree
+        import jax.numpy as jnp
+
+        from libsplinter_tpu.engine.completer import Completer
+        from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                    DecoderConfig)
+
+        cfg = DecoderConfig.tiny(dtype=jnp.float32)
+        model = CompletionModel(cfg, buckets=(32,), temp=0.0, seed=1,
+                                suffix_buckets=(8,))
+        comp = Completer(st, model=model, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=4,
+                         page_size=8)
         comp.attach()
         comp.run_continuous(
             idle_timeout_ms=20,

@@ -82,6 +82,16 @@
 #                   hot-vs-cold admission-to-first-token gate
 #                   (scripts/prefix_speedup_check.py, >= 5x on the
 #                   in-process CPU stack)
+#   make scale-check  elastic-lane tier (fast, CPU): stripe-map
+#                   protocol + striped replica groups (R=2 byte-
+#                   identical to R=1, no double-claims, no orphans
+#                   across a re-stripe), supervisor replica sets +
+#                   scale-down drain/reclaim, autoscaler hysteresis
+#                   (no flapping on oscillating input), loadgen rate
+#                   profiles, then the in-process 1x->4x->1x rate-
+#                   step gate (scripts/scale_step_check.py: replicas
+#                   follow the step, zero admitted-request loss
+#                   through scale-up AND scale-down)
 #   make quant-check  quantized-KV tier (fast, CPU): int8-vs-f32
 #                   ragged paged-attention parity (interpret mode),
 #                   multi-query verify stack, quantize-on-commit /
@@ -127,6 +137,7 @@ check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/qos_fairness_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/pipeline_latency_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/prefix_speedup_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/scale_step_check.py
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -152,6 +163,11 @@ dispatch-check: native
 pod-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sharded_paged.py \
 		tests/test_sharded_decode.py -q -m "not slow"
+
+scale-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/scale_step_check.py
 
 quant-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py -q \
@@ -196,5 +212,5 @@ clean:
 
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check prefix-check \
-	qos-check pipeline-check trace-check lint-check memcheck \
-	bench-cpu clean
+	qos-check pipeline-check trace-check lint-check scale-check \
+	memcheck bench-cpu clean

@@ -116,6 +116,22 @@ FAULT_SITE_DOCS: dict[str, str] = {
         "before the downstream submit",
     "supervisor.poll":
         "each supervision step",
+    "supervisor.retire":
+        "the scale-down drain's first move (elastic lanes): fires as "
+        "a replica's stripes are marked CLOSED, before the "
+        "epoch-bumped map write — a `raise` aborts that poll step "
+        "(run()'s step firewall contains it, the replica set stays "
+        "as it was), and the chaos drill crash-kills the RETIRING "
+        "replica instead, proving the post-reap straggler reclaim "
+        "strands nothing (`tests/test_elastic.py`)",
+    "autoscaler.decide":
+        "each lane's decision step in the scaling controller "
+        "(engine/autoscaler.py), before the telemetry rings are "
+        "read: a `raise` fails one control cycle (the run loop's "
+        "firewall continues; targets keep their last value), a "
+        "`crash` kills the controller mid-decision — the supervised "
+        "restart resumes from the live policy + targets "
+        "(`tests/test_elastic.py`)",
     "store.set":
         "the store binding's `set` write op",
     "store.append":
@@ -159,6 +175,12 @@ class ProtocolRegistry:
     stages: dict[str, tuple[str, ...]]     # *_STAGES tuples
     keys: dict[str, str]                   # KEY_*  well-known keys
     prefixes: dict[str, str]               # *_PREFIX companion-key pfx
+    # elastic lanes: the replica heartbeat-key suffix convention
+    # (protocol.REPLICA_SUFFIX — "<KEY_*_STATS><suffix><N>").  Its
+    # presence obligates readers: SPL105 requires `spt metrics` to
+    # discover replica-suffixed keys via the protocol helper instead
+    # of the one-key-per-lane read.
+    replica_suffix: str = ""
 
     def masks(self) -> dict[str, int]:
         """name -> mask for every label AND field."""
@@ -262,6 +284,7 @@ def extract_registry(path: str | None = None,
     stages: dict[str, tuple[str, ...]] = {}
     keys: dict[str, str] = {}
     prefixes: dict[str, str] = {}
+    replica_suffix = ""
 
     for node in tree.body:
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
@@ -289,9 +312,12 @@ def extract_registry(path: str | None = None,
             keys[name] = value
         elif name.endswith("_PREFIX") and isinstance(value, str):
             prefixes[name] = value
+        elif name == "REPLICA_SUFFIX" and isinstance(value, str):
+            replica_suffix = value
     return ProtocolRegistry(path=path, labels=labels, fields=fields,
                             bit_indices=bit_indices, stages=stages,
-                            keys=keys, prefixes=prefixes)
+                            keys=keys, prefixes=prefixes,
+                            replica_suffix=replica_suffix)
 
 
 # --- fault-site discovery -------------------------------------------------

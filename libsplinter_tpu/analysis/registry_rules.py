@@ -229,8 +229,10 @@ _METRICS_RELPATH = "libsplinter_tpu/cli/metrics.py"
 
 @rule("SPL105", "registry", "metrics/heartbeat key drift",
       "`spt metrics` must read heartbeat store keys via protocol "
-      "constants only, and must render every published "
-      "`KEY_*_STATS` / `KEY_*_TRACE` key")
+      "constants only, must render every published `KEY_*_STATS` / "
+      "`KEY_*_TRACE` key, and — when the protocol defines a replica "
+      "suffix — must discover replica-suffixed heartbeat keys via "
+      "the protocol helper, never a one-key-per-lane read")
 def check_metrics_backing(ctx: Context) -> list[Finding]:
     sf = ctx.files.get(_METRICS_RELPATH)
     if sf is None or sf.tree is None:
@@ -239,10 +241,15 @@ def check_metrics_backing(ctx: Context) -> list[Finding]:
     out = []
     key_values = set(reg.keys.values())
     referenced: set[str] = set()
+    helpers: set[str] = set()
     for node in ast.walk(sf.tree):
         if isinstance(node, ast.Attribute) and \
                 node.attr.startswith("KEY_"):
             referenced.add(node.attr)
+        if isinstance(node, ast.Attribute):
+            helpers.add(node.attr)
+        elif isinstance(node, ast.Name):
+            helpers.add(node.id)
         if isinstance(node, ast.Constant) and \
                 isinstance(node.value, str) and \
                 node.value.startswith("__"):
@@ -265,6 +272,16 @@ def check_metrics_backing(ctx: Context) -> list[Finding]:
                 f"published heartbeat key {name} "
                 f"({reg.keys[name]}) is never rendered by spt "
                 f"metrics — operators cannot see that lane"))
+    if getattr(reg, "replica_suffix", "") \
+            and not helpers & {"replica_heartbeat_keys",
+                               "replica_heartbeat_map"}:
+        out.append(Finding(
+            _METRICS_RELPATH, 1, "SPL105",
+            "protocol defines a replica heartbeat-key suffix "
+            f"({reg.replica_suffix!r}) but spt metrics never calls "
+            "replica_heartbeat_keys()/replica_heartbeat_map() — a "
+            "scaled lane's extra replicas would be invisible (stale "
+            "one-key-per-lane read)"))
     return out
 
 

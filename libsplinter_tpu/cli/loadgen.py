@@ -139,10 +139,38 @@ class TenantSpec:
         return t
 
 
+def parse_rate_profile(spec: str) -> list[tuple[float, float]]:
+    """`--rate-profile 1x:10,8x:20,1x:10` -> [(mult, dur_s), ...]:
+    a piecewise-constant schedule of offered-rate multipliers over
+    the open-loop clock (the elastic-lane proof harness: step the
+    rate, watch replicas follow).  The trailing `x` is optional."""
+    out: list[tuple[float, float]] = []
+    for part in spec.split(","):
+        mult_s, sep, dur_s = part.strip().partition(":")
+        if not sep:
+            raise ValueError(
+                f"rate profile wants MULTx:SECONDS[,...], got "
+                f"{part.strip()!r}")
+        if mult_s.endswith(("x", "X")):
+            mult_s = mult_s[:-1]
+        try:
+            mult, dur = float(mult_s), float(dur_s)
+        except ValueError:
+            raise ValueError(
+                f"rate profile wants MULTx:SECONDS[,...], got "
+                f"{part.strip()!r}") from None
+        if mult <= 0 or dur <= 0:
+            raise ValueError("rate profile wants mult > 0, dur > 0")
+        out.append((mult, dur))
+    if not out:
+        raise ValueError("empty rate profile")
+    return out
+
+
 class _Req:
     __slots__ = ("lane", "tenant", "key", "t_submit", "deadline_ts",
                  "state", "stage", "doc_key", "query_key", "hits",
-                 "tid", "hops")
+                 "tid", "hops", "phase")
 
     def __init__(self, lane, tenant, key, t_submit, deadline_ts):
         self.lane = lane
@@ -157,6 +185,7 @@ class _Req:
         self.hits = []
         self.tid = 0                 # head-sampled trace id (0 = off)
         self.hops = 0                # trace hops stamped so far
+        self.phase = 0               # rate-profile phase index
 
 
 class LoadGenerator:
@@ -175,7 +204,9 @@ class LoadGenerator:
                  drain_s: float | None = None,
                  trace_sample: float = 0.0,
                  prompt: str = "summarize: ",
-                 shared_prefix: tuple[float, int] | None = None):
+                 shared_prefix: tuple[float, int] | None = None,
+                 rate_profile: list[tuple[float, float]]
+                 | None = None):
         if arrivals not in ("poisson", "fixed"):
             raise ValueError("arrivals must be poisson|fixed")
         if scenario is not None and scenario not in SCENARIOS:
@@ -229,7 +260,20 @@ class LoadGenerator:
                     "length >= 1)")
         self.shared_prefix = shared_prefix
         self._prefix_pool: list[str] = []
+        # piecewise rate-step schedule (parse_rate_profile): phase p
+        # multiplies every tenant's arrival rate by rate_profile[p][0]
+        # for rate_profile[p][1] seconds; duration_s becomes the
+        # profile's total, and the report gains a per-phase section
+        # (seeded like everything else — reruns step identically)
+        self.rate_profile = list(rate_profile) if rate_profile \
+            else None
+        if self.rate_profile:
+            self.duration_s = sum(d for _, d in self.rate_profile)
         self._n = 0
+        # per-phase accounting (rate profiles): state counts and an
+        # exact-latency list per phase index
+        self.phase_counts: dict[int, dict[str, int]] = {}
+        self.phase_ms: dict[int, list[float]] = {}
         # per-(tenant, lane) latency histograms — the PR 2 log-bucketed
         # quantile machinery, so p50/p95/p99 here and in the daemon
         # heartbeats come from the same estimator
@@ -374,7 +418,7 @@ class LoadGenerator:
         st.label_or(req.key, P.LBL_SCRIPT_REQ | P.LBL_WAITING)
         st.bump(req.key)
 
-    def _issue(self, tenant: TenantSpec) -> _Req:
+    def _issue(self, tenant: TenantSpec, phase: int = 0) -> _Req:
         self._n += 1
         n = self._n
         deadline_ts = (time.time() + tenant.deadline_ms / 1e3
@@ -392,6 +436,7 @@ class LoadGenerator:
                     break
         req = _Req(lane, tenant.tenant, f"lg{lane[0]}{n}",
                    time.monotonic(), deadline_ts)
+        req.phase = phase
         if self.trace_sample and \
                 self.rng.random() < self.trace_sample:
             req.tid = P.next_trace_id()
@@ -548,10 +593,15 @@ class LoadGenerator:
         self.counts.setdefault(key, {})
         self.counts[key][req.state] = \
             self.counts[key].get(req.state, 0) + 1
+        if self.rate_profile:
+            pc = self.phase_counts.setdefault(req.phase, {})
+            pc[req.state] = pc.get(req.state, 0) + 1
         if req.state in (OK, OK_LATE):
             ms = (time.monotonic() - req.t_submit) * 1e3
             self.hists.setdefault(key, LogHistogram()).record(ms)
             self.raw_ms.setdefault(key, []).append(ms)
+            if self.rate_profile:
+                self.phase_ms.setdefault(req.phase, []).append(ms)
             if req.tid:
                 self.traced_done.setdefault(req.tenant, []).append(
                     (ms, req.tid, lane))
@@ -565,20 +615,38 @@ class LoadGenerator:
 
     # -- the run -----------------------------------------------------------
 
-    def _schedule(self) -> list[tuple[float, TenantSpec]]:
+    def _phase_at(self, when: float) -> int:
+        """The rate-profile phase covering offset `when` (0 with no
+        profile)."""
+        if not self.rate_profile:
+            return 0
+        acc = 0.0
+        for p, (_m, dur) in enumerate(self.rate_profile):
+            acc += dur
+            if when < acc:
+                return p
+        return len(self.rate_profile) - 1
+
+    def _schedule(self) -> list[tuple[float, TenantSpec, int]]:
         """Precompute every arrival's offset: open loop means the
-        clock, not the server, decides when requests exist."""
-        out: list[tuple[float, TenantSpec]] = []
+        clock, not the server, decides when requests exist.  With a
+        rate profile, each phase multiplies every tenant's rate —
+        gaps are drawn at the LIVE phase's rate, so the offered load
+        steps exactly at the phase boundaries."""
+        out: list[tuple[float, TenantSpec, int]] = []
         for t in self.tenants:
             when = 0.0
             while True:
+                mult = (self.rate_profile[self._phase_at(when)][0]
+                        if self.rate_profile else 1.0)
+                rate = t.rate * mult
                 if self.arrivals == "poisson":
-                    when += self.rng.expovariate(t.rate)
+                    when += self.rng.expovariate(rate)
                 else:
-                    when += 1.0 / t.rate
+                    when += 1.0 / rate
                 if when >= self.duration_s:
                     break
-                out.append((when, t))
+                out.append((when, t, self._phase_at(when)))
         out.sort(key=lambda x: x[0])
         return out
 
@@ -593,7 +661,8 @@ class LoadGenerator:
         while True:
             now = time.monotonic()
             while i < len(schedule) and schedule[i][0] <= now - t0:
-                outstanding.append(self._issue(schedule[i][1]))
+                outstanding.append(self._issue(schedule[i][1],
+                                               schedule[i][2]))
                 i += 1
             still: list[_Req] = []
             for req in outstanding:
@@ -671,7 +740,30 @@ class LoadGenerator:
         pfx = self._prefix_cache_report()
         if pfx is not None:
             rep["prefix_cache"] = pfx
+        if self.rate_profile:
+            rep["rate_profile"] = self._phase_report()
         return rep
+
+    def _phase_report(self) -> list[dict]:
+        """Per-phase goodput + exact p50/p99 for a rate-profile run
+        (exact percentiles from raw latencies — the log-histogram's
+        ~19%-wide buckets are too coarse to judge a step response)."""
+        out = []
+        for p, (mult, dur) in enumerate(self.rate_profile or []):
+            counts = dict(self.phase_counts.get(p, {}))
+            issued = sum(counts.values())
+            ok = counts.get(OK, 0)
+            row = {"phase": p, "mult": mult, "dur_s": dur,
+                   "issued": issued, **counts,
+                   "goodput_ratio": round(ok / issued, 4)
+                   if issued else 0.0}
+            ms = sorted(self.phase_ms.get(p, []))
+            if ms:
+                row["p50_ms"] = round(ms[len(ms) // 2], 3)
+                row["p99_ms"] = round(
+                    ms[min(len(ms) - 1, int(len(ms) * 0.99))], 3)
+            out.append(row)
+        return out
 
     def _prefix_cache_report(self) -> dict | None:
         """The completer's prefix-cache gauges as of its LAST
@@ -737,7 +829,8 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "[--arrivals poisson|fixed] [--zipf S] [--corpus N] "
          "[--seed N] [--scenario rag-churn|rag-churn-script|"
          "agent-loop|multi-hop|map-reduce|shared-prefix] [--k K] "
-         "[--shared-prefix P:LEN] [--drain-s S] "
+         "[--shared-prefix P:LEN] [--rate-profile 1x:10,8x:20,"
+         "1x:10] [--drain-s S] "
          "[--trace-sample P] [--slo-p99-ms MS] [--slo-goodput F] "
          "[--json]",
          "open-loop multi-tenant load generator with per-tenant "
@@ -746,7 +839,10 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "trace ids land in the summary; --shared-prefix P:LEN "
          "draws that fraction of complete prompts from a pooled "
          "hot-prefix set and the summary reports the completer's "
-         "prefix-cache hit rate)")
+         "prefix-cache hit rate; --rate-profile steps the offered "
+         "rate piecewise over the open-loop clock — the elastic-"
+         "lane proof harness — with per-phase goodput/p99 in the "
+         "summary)")
 def cmd_loadgen(ses, args):
     duration = 5.0
     rate = 20.0
@@ -762,6 +858,7 @@ def cmd_loadgen(ses, args):
     drain_s = None
     trace_sample = 0.0
     shared_prefix = None
+    rate_profile = None
     slo_p99 = None
     slo_goodput = None
     as_json = False
@@ -820,6 +917,11 @@ def cmd_loadgen(ses, args):
                 raise CliError(
                     "--shared-prefix wants P:LEN (fraction:chars)"
                 ) from None
+        elif a == "--rate-profile":
+            try:
+                rate_profile = parse_rate_profile(val(a))
+            except ValueError as e:
+                raise CliError(str(e)) from None
         elif a == "--slo-p99-ms":
             slo_p99 = float(val(a))
         elif a == "--slo-goodput":
@@ -847,7 +949,8 @@ def cmd_loadgen(ses, args):
                             scenario=scenario, search_k=k,
                             drain_s=drain_s,
                             trace_sample=trace_sample,
-                            shared_prefix=shared_prefix)
+                            shared_prefix=shared_prefix,
+                            rate_profile=rate_profile)
     except ValueError as e:
         raise CliError(str(e)) from None
     report = gen.run()
@@ -867,6 +970,15 @@ def cmd_loadgen(ses, args):
               f"lost={report['lost']}")
         print(f"  goodput {report['goodput_rps']} req/s "
               f"({report['goodput_ratio']:.1%} of issued)")
+        for row in report.get("rate_profile", []):
+            q = (f" p50={row['p50_ms']}ms p99={row['p99_ms']}ms"
+                 if "p50_ms" in row else "")
+            cnt = " ".join(f"{s}={row[s]}" for s in
+                           (OK, OK_LATE, SHED, EXPIRED, ERROR,
+                            UNSERVED, LOST) if row.get(s))
+            print(f"  phase {row['phase']} ({row['mult']:g}x for "
+                  f"{row['dur_s']:g}s): {row['issued']} issued, "
+                  f"goodput {row['goodput_ratio']:.1%} {cnt}{q}")
         pfx = report.get("prefix_cache")
         if pfx:
             print(f"  prefix cache: hit rate {pfx['hit_rate']:.1%} "

@@ -499,12 +499,20 @@ def cmd_health(ses, args):
     print(f"store          {h.used_slots}/{st.nslots} slots, "
           f"global epoch {h.global_epoch}")
     # heartbeat keys are daemon-owned well-known names: NOT namespaced
-    # (the daemons write the literal protocol constants)
-    for label, key in (("embedder", P.KEY_EMBED_STATS),
-                       ("completer", P.KEY_COMPLETE_STATS),
-                       ("searcher", P.KEY_SEARCH_STATS),
-                       ("pipeliner", P.KEY_SCRIPT_STATS),
-                       ("supervisor", P.KEY_SUPERVISOR_STATS)):
+    # (the daemons write the literal protocol constants); scaled
+    # lanes add replica-suffixed keys, discovered per protocol
+    lanes_hb = (("embedder", P.KEY_EMBED_STATS),
+                ("completer", P.KEY_COMPLETE_STATS),
+                ("searcher", P.KEY_SEARCH_STATS),
+                ("pipeliner", P.KEY_SCRIPT_STATS))
+    disc = P.replica_heartbeat_map(st, [k for _, k in lanes_hb])
+    rows = []
+    for label, key in lanes_hb:
+        for r, rkey in disc[key]:
+            rows.append((label if r == 0 else f"{label}.r{r}", rkey))
+    rows.append(("autoscaler", P.KEY_AUTOSCALER_STATS))
+    rows.append(("supervisor", P.KEY_SUPERVISOR_STATS))
+    for label, key in rows:
         try:
             raw = st.get(key)
         except KeyError:
@@ -533,11 +541,22 @@ def cmd_health(ses, args):
                           f"total={s['total_ms']}ms max={s['max_ms']}ms")
             if lanes:
                 for name, ln in lanes.items():
+                    if not isinstance(ln, dict):
+                        continue
+                    if "state" not in ln:     # autoscaler decision
+                        print(f"    {name:<11} target_r="   # rows
+                              f"{ln.get('target')} "
+                              f"pressure={ln.get('pressure')} "
+                              f"({ln.get('reason')})")
+                        continue
+                    extra = (f" r={ln['r']}" if ln.get("r", 1) > 1
+                             else "")
                     print(f"    {name:<11} {ln.get('state', '?'):<9}"
                           f" pid={ln.get('pid')} "
                           f"gen={ln.get('generation')} "
                           f"restarts={ln.get('restarts')} "
-                          f"breaker_opens={ln.get('breaker_opens')}")
+                          f"breaker_opens={ln.get('breaker_opens')}"
+                          f"{extra}")
         except (ValueError, AttributeError, TypeError, KeyError):
             print(f"{label:<14} unparseable heartbeat")
     live_bids = [b for b in st.bid_table() if b.pid and b.live]
@@ -662,6 +681,7 @@ from .supervise import cmd_supervise  # noqa: E402
 from .loadgen import cmd_loadgen  # noqa: E402
 from .lint import cmd_lint  # noqa: E402
 from .pipeline import cmd_pipeline  # noqa: E402
+from .scale import cmd_scale  # noqa: E402
 
 
 # ------------------------------------------------------------------- REPL

@@ -44,11 +44,28 @@ _HEARTBEATS = (("embedder", P.KEY_EMBED_STATS),
                ("completer", P.KEY_COMPLETE_STATS),
                ("searcher", P.KEY_SEARCH_STATS),
                ("pipeliner", P.KEY_SCRIPT_STATS),
-               ("telemetry", P.KEY_TELEMETRY_STATS))
+               ("telemetry", P.KEY_TELEMETRY_STATS),
+               ("autoscaler", P.KEY_AUTOSCALER_STATS))
 _TRACE_KEYS = (("embedder", P.KEY_EMBED_TRACE),
                ("completer", P.KEY_COMPLETE_TRACE),
                ("searcher", P.KEY_SEARCH_TRACE),
                ("pipeliner", P.KEY_SCRIPT_TRACE))
+
+
+def _heartbeat_rows(store) -> list[tuple[str, str]]:
+    """The heartbeat keys to render: every base key plus any
+    replica-suffixed keys a scaled lane published (discovered via
+    protocol.replica_heartbeat_keys / replica_heartbeat_map in ONE
+    debug-label enumeration, never hardcoded) — a scaled lane shows
+    one exposition block per replica, replica 0 under the classic
+    daemon name, replica N as `<daemon>_rN`."""
+    disc = P.replica_heartbeat_map(store,
+                                   [b for _, b in _HEARTBEATS])
+    rows: list[tuple[str, str]] = []
+    for daemon, base in _HEARTBEATS:
+        for r, key in disc[base]:
+            rows.append((daemon if r == 0 else f"{daemon}_r{r}", key))
+    return rows
 
 
 def _read_json(store, key: str) -> dict | None:
@@ -136,7 +153,7 @@ def cmd_metrics(ses, args):
     w.metric("sptpu_store_last_failure_epoch", h.last_failure_epoch)
 
     now = time.time()
-    for daemon, key in _HEARTBEATS:
+    for daemon, key in _heartbeat_rows(st):
         snap = _read_json(st, key)
         if snap is None:
             continue
@@ -158,6 +175,27 @@ def cmd_metrics(ses, args):
         sp = snap.pop("spans_obs", None)  # span-capture accounting
         if isinstance(sp, dict):          # (obs/spans.py), flat names
             w.scalars(f"sptpu_{daemon}_spans", sp)
+        stripe = snap.pop("stripe", None)  # elastic lanes: the
+        if isinstance(stripe, dict):       # replica's stripe view
+            w.scalars(f"sptpu_{daemon}_stripe", stripe)
+        ctl_lanes = snap.pop("lanes", None)  # autoscaler: per-lane
+        if isinstance(ctl_lanes, dict):      # decision state
+            for lane_name, row in ctl_lanes.items():
+                if not isinstance(row, dict):
+                    continue
+                lab_l = {"lane": str(lane_name)}
+                for field in ("target", "pressure", "up_streak",
+                              "down_streak"):
+                    v = row.get(field)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        w.metric(f"sptpu_{daemon}_lane_{field}", v,
+                                 lab_l,
+                                 help_="scaling-controller per-lane "
+                                       "state (engine/autoscaler.py: "
+                                       "target replica count, queue "
+                                       "pressure, hysteresis streaks)")
+        snap.pop("history", None)  # decision log: `spt scale status`
         verbs = snap.pop("verbs", None)  # pipeline lane: per-verb
         if isinstance(verbs, dict):      # dispatch counters
             for verb, n in verbs.items():
@@ -277,6 +315,11 @@ def cmd_metrics(ses, args):
                      {"daemon": "supervisor"})
         w.metric("sptpu_supervisor_polls", snap.get("polls", 0),
                  mtype="counter")
+        w.metric("sptpu_supervisor_retired",
+                 snap.get("retired", 0), mtype="counter",
+                 help_="replicas drained and reaped by scale-down")
+        w.metric("sptpu_supervisor_scale_events",
+                 snap.get("scale_events", 0), mtype="counter")
         for lane_name, ln in (snap.get("lanes") or {}).items():
             if not isinstance(ln, dict):
                 continue
@@ -298,6 +341,15 @@ def cmd_metrics(ses, args):
                              else "counter"))
             w.metric("sptpu_supervisor_lane_backoff_ms",
                      ln.get("backoff_ms", 0), lab)
+            if "r" in ln:
+                # elastic lanes: the ACTIVE replica count the
+                # supervisor is running (the autoscaler's target is
+                # sptpu_autoscaler_lane_target — divergence beyond
+                # one poll means scaling is stuck)
+                w.metric("sptpu_supervisor_lane_replicas",
+                         ln.get("r", 1), lab,
+                         help_="active (non-retiring) replicas in "
+                               "the lane's striped replica set")
 
     lane = ses._lane                  # only if a search staged one
     if lane is not None:
@@ -376,7 +428,13 @@ def cmd_trace(ses, args):
         raise CliError("usage: trace tail [N] (N must be an integer)")
     st = ses.store
     shown = 0
-    for daemon, key in _TRACE_KEYS:
+    # replica-suffixed rings included (a scaled lane's extra
+    # replicas publish their own flight recorders)
+    disc = P.replica_heartbeat_map(st, [b for _, b in _TRACE_KEYS])
+    rows = [(d if r == 0 else f"{d}.r{r}", key)
+            for d, base in _TRACE_KEYS
+            for r, key in disc[base]]
+    for daemon, key in rows:
         snap = _read_json(st, key)
         recs = (snap or {}).get("trace") or []
         age = time.time() - snap["ts"] if snap and "ts" in snap else 0

@@ -3,11 +3,13 @@
 query-coalescing search daemon (searcher.py), and the pipeline lane
 (pipeliner.py — server-side scripted chains in a sandboxed Lua host),
 sharing one coordination contract (protocol.py) and supervised as
-child processes by supervisor.py (crash restart + circuit breaker)."""
+replica sets of child processes by supervisor.py (crash restart +
+circuit breaker + striped elastic scaling, replica counts driven by
+autoscaler.py off the telemetry rings)."""
 from . import protocol
 
 __all__ = ["protocol", "Searcher", "daemon_live", "submit_search",
-           "Supervisor"]
+           "Supervisor", "AutoScaler"]
 
 _SEARCHER_API = ("Searcher", "daemon_live", "submit_search")
 
@@ -22,4 +24,7 @@ def __getattr__(name):
     if name == "Supervisor":
         from . import supervisor
         return supervisor.Supervisor
+    if name == "AutoScaler":
+        from . import autoscaler
+        return autoscaler.AutoScaler
     raise AttributeError(name)

@@ -131,6 +131,10 @@ class CompleterStats:
     # -- K-deep decode overlap (engine/resident.py): un-awaited paged
     # decode chunks held while the host emits/admits ----------------
     inflight_peak: int = 0
+    # mid-decode deadline aborts (continuous lane): rows whose
+    # deadline expired at a chunk edge, retired with the typed
+    # DEADLINE_EXPIRED record and their pages freed immediately
+    killed_mid_decode: int = 0
 
 
 class Completer:
@@ -158,8 +162,19 @@ class Completer:
                  prefix_cache: bool = True,
                  prefix_cache_pages: int | None = None,
                  prefix_quotas: dict[int, int] | None = None,
-                 prefix_default_quota: int | None = None):
+                 prefix_default_quota: int | None = None,
+                 replica: int = 0):
         self.store = store
+        # elastic lanes (protocol.StripeView): replica r drains only
+        # its own slot-index stripe; stranded-SERVICING reclaim is
+        # stripe-scoped too, so a restarted replica can never steal a
+        # live peer's in-flight rows
+        self.replica = int(replica)
+        self.stripes = P.StripeView(store, "completer", self.replica)
+        self._hb_key = P.replica_stats_key(P.KEY_COMPLETE_STATS,
+                                           self.replica)
+        self._trace_key = P.replica_stats_key(P.KEY_COMPLETE_TRACE,
+                                              self.replica)
         self.max_new = max_new_tokens
         self.flush_tokens = flush_tokens
         self.rebid_tokens = rebid_tokens
@@ -281,21 +296,34 @@ class Completer:
             st.bus_init()
         else:
             st.bus_open()
-        self.generation = P.bump_generation(st, P.KEY_COMPLETE_STATS)
+        self.generation = P.bump_generation(st, self._hb_key)
         self._reclaim_stranded()
 
     def _reclaim_stranded(self) -> int:
         """Crash recovery: a daemon that died mid-completion leaves
         its key in SERVICING — no label watch fires for it again, so
-        without this it is wedged forever.  The completion lane has
-        one owner (the supervisor's invariant), so at attach every
-        SERVICING row is a previous generation's stranded request:
-        flip it back to WAITING and let the cold-start drain re-serve
-        it (the client sees a restarted stream, same as the
-        reference's crash story)."""
+        without this it is wedged forever.  Each stripe has ONE owner
+        (the supervisor's invariant, per-replica under elastic
+        lanes), so at attach every SERVICING row in OUR stripes is a
+        previous generation's stranded request: flip it back to
+        WAITING and let the cold-start drain re-serve it (the client
+        sees a restarted stream, same as the reference's crash
+        story).  Rows outside our stripes belong to live peer
+        replicas mid-service — never touched; a permanently-dead
+        replica's rows are the supervisor's straggler reclaim.
+
+        Known bound (mirrors Supervisor._reclaim_closed's): a live
+        peer's claim that predates a re-stripe can sit in OUR
+        current stripes and would be re-queued here as stranded —
+        the window needs an in-flight request to span a stripe
+        promotion AND our own crash+respawn; claim-owner stamping
+        is the follow-up that would close it."""
         st = self.store
+        self.stripes.refresh()
         n = 0
         for idx in st.enumerate_indices(P.LBL_SERVICING):
+            if not self.stripes.owns(idx):
+                continue
             key = st.key_at(idx)
             if key is None:
                 continue
@@ -953,6 +981,7 @@ class Completer:
         deadline = (time.monotonic() + stop_after) if stop_after else None
         last = st.signal_count(self.group)
         next_beat = time.monotonic() + 2.0
+        self.publish_stats()          # the attach-complete signal
 
         rows: list[dict | None] = [None] * B
         # K-deep chunk window (engine/resident.py discipline): up to
@@ -1016,7 +1045,9 @@ class Completer:
             free = [r for r in range(B) if rows[r] is None]
             if not free:
                 return 0
-            waiting = list(st.enumerate_indices(P.LBL_INFER_REQ))
+            self.stripes.refresh()    # admission IS this lane's drain
+            waiting = [i for i in st.enumerate_indices(P.LBL_INFER_REQ)
+                       if self.stripes.owns(int(i))]
             if not waiting:
                 return 0
             # multi-tenant admission before any render: fair order
@@ -1115,6 +1146,10 @@ class Completer:
                 rows[r] = {"key": key, "t0": t0, "n_tok": 0,
                            "pending": b"", "remaining": self.max_new,
                            "stamp": stamp,
+                           # deadline retained for the chunk-edge
+                           # mid-decode abort (the __dl_ stamp itself
+                           # was consumed at the claim)
+                           "deadline": _dl, "tenant": tenant,
                            # serial: the lagged-collect guard (a chunk
                            # in flight across this row's re-seat must
                            # never emit into the newcomer); disp_left:
@@ -1264,6 +1299,43 @@ class Completer:
             rows[r] = None
             fresh[r] = -1
 
+        def kill_expired() -> int:
+            """Mid-decode deadline aborts (PR 10's standing debt):
+            at each chunk edge, a live row whose deadline passed is
+            retired with the typed DEADLINE_EXPIRED record, its pages
+            freed immediately (refcount-aware — shared prefix pages
+            just drop one reference), and its batch slot reopened.
+            An expired row must stop consuming pool and slots NOW —
+            lagged in-flight chunks are serial-guarded, so their
+            tokens for the dead row evaporate."""
+            now_wall = time.time()
+            n = 0
+            for r in range(B):
+                row = rows[r]
+                if row is None or not row.get("deadline") \
+                        or row["deadline"] > now_wall:
+                    continue
+                key = row["key"]
+                span_rec = self._live_spans.pop(key, None)
+                try:
+                    st.label_clear(key, P.LBL_SERVICING)
+                    st.set(key, P.DEADLINE_EXPIRED_DIAGNOSTIC)
+                    st.label_or(key, P.LBL_READY)
+                    st.bump(key)
+                except (KeyError, OSError):
+                    pass
+                self.spans.commit(span_rec, status=P.ERR_DEADLINE)
+                cache.free_row(r)     # pool pages back NOW
+                rows[r] = None
+                fresh[r] = -1
+                self.stats.killed_mid_decode += 1
+                self.stats.deadline_expired += 1
+                if row.get("tenant"):
+                    self.tenants.bump(row["tenant"],
+                                      "deadline_expired")
+                n += 1
+            return n
+
         def collect(entry) -> None:
             """Resolve one in-flight chunk: force the block (the one
             device->host transfer per chunk) and emit its columns to
@@ -1356,6 +1428,14 @@ class Completer:
                                 "continuous lane adopted the demoted "
                                 "(plain) model")
                         if admit() == 0:
+                            if self.replica \
+                                    and self.stripes.poll_retired():
+                                # scale-down drain: stripes closed,
+                                # nothing live, window drained — exit
+                                # cleanly and let the supervisor reap
+                                self._debug(
+                                    "replica destriped — retiring")
+                                break
                             got = st.signal_wait(
                                 self.group, last,
                                 timeout_ms=idle_timeout_ms)
@@ -1368,6 +1448,8 @@ class Completer:
                         admit()       # joiners enter at ANY time —
                         # even with chunks in flight: the serial guard
                         # keeps lagged collects out of re-seated rows
+
+                    kill_expired()    # chunk-edge deadline aborts
 
                     # per-row edges: a row without window room for the
                     # next chunk, or whose whole token budget is
@@ -1465,7 +1547,9 @@ class Completer:
         batch_cap through one left-padded decode each; a custom
         generate_fn serves serially (its contract is one prompt)."""
         st = self.store
-        idxs = list(st.enumerate_indices(P.LBL_INFER_REQ))
+        self.stripes.refresh()        # a re-stripe lands HERE, at the
+        idxs = [i for i in st.enumerate_indices(P.LBL_INFER_REQ)
+                if self.stripes.owns(int(i))]   # drain boundary
         if not idxs:
             self._had_deferred = False    # nothing waiting: the
             return 0                      # redrain loop must end
@@ -1630,6 +1714,13 @@ class Completer:
         payload = dataclasses.asdict(self.stats)      # wake path
         payload["spans_obs"] = self.spans.counters()
         payload["generation"] = self.generation
+        if self.replica or self.stripes.epoch:
+            payload["replica"] = self.replica
+            payload["stripe"] = self.stripes.snapshot()
+        if not self.stats.killed_mid_decode \
+                and self._paged_cache is None:
+            payload.pop("killed_mid_decode", None)  # dense lane:
+                                                    # dead gauge
         # decode-overlap gauge: inflight_peak pinned here means the
         # chunk window saturates (sptpu_completer_inflight_depth)
         payload["inflight_depth"] = self.inflight_depth
@@ -1728,10 +1819,10 @@ class Completer:
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "infer.")
-        P.publish_heartbeat(self.store, P.KEY_COMPLETE_STATS, payload)
+        P.publish_heartbeat(self.store, self._hb_key, payload)
         if tracer.enabled:
             self._trace_published = P.maybe_publish_trace_ring(
-                self.store, P.KEY_COMPLETE_TRACE, self.recorder,
+                self.store, self._trace_key, self.recorder,
                 self._trace_published)
 
     def run(self, *, idle_timeout_ms: int = 100,
@@ -1740,6 +1831,7 @@ class Completer:
         last = self.store.signal_count(self.group)
         deadline = (time.monotonic() + stop_after) if stop_after else None
         next_sweep = time.monotonic() + 2.0
+        self.publish_stats()          # the attach-complete signal
         self.run_once()               # cold start
         while self._running:
             got = self.store.signal_wait(self.group, last,
@@ -1770,6 +1862,13 @@ class Completer:
                 if do_sweep:
                     self._sweep_bp_memo()
                     self.publish_stats()
+                    if self.replica and self.stripes.poll_retired():
+                        # scale-down drain: the drains above finished
+                        # in-flight work; exit and let the supervisor
+                        # reap us
+                        log.info("replica %d destriped — retiring",
+                                 self.replica)
+                        break
             except Exception as ex:
                 self.stats.faults += 1
                 log.exception("run loop cycle failed; continuing")
@@ -1799,6 +1898,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--temp", type=float, default=0.7)
     ap.add_argument("--top-p", type=float, default=0.9)
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--replica", type=int, default=0,
+                    help="striped replica index (elastic lanes): "
+                         "drain only the stripes the lane's stripe "
+                         "map assigns this replica; heartbeat "
+                         "publishes replica-suffixed "
+                         "(__completer_stats.rN)")
     ap.add_argument("--weights",
                     help="decoder checkpoint: .safetensors (HF llama "
                          "naming) or .gguf (llama.cpp naming; geometry "
@@ -2034,7 +2139,8 @@ def main(argv: list[str] | None = None) -> int:
                      prefix_cache=not args.no_prefix_cache,
                      prefix_cache_pages=args.prefix_cache_pages,
                      prefix_quotas=parse_tenant_quotas(
-                         args.prefix_quota))
+                         args.prefix_quota),
+                     replica=args.replica)
     comp.attach()
     if args.warmup:
         t0 = time.monotonic()

@@ -220,12 +220,24 @@ class Embedder:
                  admit_cap: int | None = None,
                  queue_high_water: int | None = None,
                  retry_after_ms: int | None = None,
-                 tenant_weights: dict[int, float] | None = None):
+                 tenant_weights: dict[int, float] | None = None,
+                 replica: int = 0):
         self.store = store
         self.max_ctx = max_ctx
         self.vector_training = vector_training
         self.group = group
         self.batch_cap = batch_cap
+        # elastic lanes (protocol.StripeView): replica r of a striped
+        # group drains only its own slot-index stripe — the map is
+        # store state, re-read at each drain, so a supervisor
+        # re-stripe lands at the next drain boundary.  replica 0 with
+        # no map is the classic single-process deployment.
+        self.replica = int(replica)
+        self.stripes = P.StripeView(store, "embedder", self.replica)
+        self._hb_key = P.replica_stats_key(P.KEY_EMBED_STATS,
+                                           self.replica)
+        self._trace_key = P.replica_stats_key(P.KEY_EMBED_TRACE,
+                                              self.replica)
         self._inflight_override = inflight_depth
         self._ring_override = ring_depth
         # drains at or below this size take the latency short-circuit
@@ -311,7 +323,7 @@ class Embedder:
             st.bus_init()
         else:
             st.bus_open()
-        self.generation = P.bump_generation(st, P.KEY_EMBED_STATS)
+        self.generation = P.bump_generation(st, self._hb_key)
         self._baseline_existing()
         # cold start: pre-existing requests enter the pending set once
         # (reference drains pre-existing WAITING keys on startup,
@@ -481,6 +493,9 @@ class Embedder:
                     # the stamp slot) — shed it or it leaks forever
                     P.shed_orphan_stamp(st, idx, labels)
                 continue
+            if not self.stripes.owns(idx):
+                continue              # a peer replica's stripe: stays
+                                      # pending, ours after a re-stripe
             self._row_labels[idx] = labels    # tenant/deadline for QoS
             e = st.epoch_at(idx)
             if e & 1:
@@ -1037,10 +1052,17 @@ class Embedder:
         self._drain_t0 = time.perf_counter() if tracer.enabled else None
         with tracer.span("embed.drain_cycle"):
             fault("embedder.drain")
-            bits = st.drain_dirty()
+            self.stripes.refresh()    # a re-stripe lands HERE, at the
+            bits = st.drain_dirty()   # drain boundary
             rows = set(st.dirty_to_indices(bits))
             rows.update(self._pending)
-            if sweep:
+            if sweep or self.stripes.epoch or self.replica:
+                # striped deployments sweep EVERY drain: drain_dirty
+                # is fetch-and-clear store-global, so a peer replica's
+                # drain eats the dirty bits for rows in OUR stripes —
+                # without the label walk those rows would wait out the
+                # 10s reconcile cadence (the searcher pays the same
+                # enumeration every drain)
                 rows.update(st.enumerate_indices(P.LBL_EMBED_REQ))
             if self._bid >= 0:
                 try:
@@ -1077,6 +1099,9 @@ class Embedder:
                    "overlap_ratio": round(self.stats.overlap_ratio(), 4),
                    "generation": self.generation,
                    "pending": len(self._pending)}
+        if self.replica or self.stripes.epoch:
+            payload["replica"] = self.replica
+            payload["stripe"] = self.stripes.snapshot()
         # dispatch-overlap gauges ride their own SECTION so a tiny
         # store's max_val drops them (publish_heartbeat's size
         # degradation) instead of losing the whole heartbeat; `spt
@@ -1119,12 +1144,12 @@ class Embedder:
             # `spt metrics` consume (true percentiles, never means)
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "embed.")
-        P.publish_heartbeat(self.store, P.KEY_EMBED_STATS, payload)
+        P.publish_heartbeat(self.store, self._hb_key, payload)
         if tracer.enabled:
             # the flight-recorder ring rides its own key so `spt trace
             # tail` reconstructs individual requests cross-process
             self._trace_published = P.maybe_publish_trace_ring(
-                self.store, P.KEY_EMBED_TRACE, self.recorder,
+                self.store, self._trace_key, self.recorder,
                 self._trace_published)
 
     def run(self, *, idle_timeout_ms: int = 100,
@@ -1136,6 +1161,11 @@ class Embedder:
         last = self.store.signal_count(self.group)
         deadline = (time.monotonic() + stop_after) if stop_after else None
         next_sweep = time.monotonic() + sweep_interval_s
+        next_retire_check = 0.0
+        # first heartbeat NOW, not a sweep interval away: it is the
+        # attach-complete signal the supervisor's scale-up promotion
+        # (and every liveness probe) waits on
+        self.publish_stats()
         while self._running:
             got = self.store.signal_wait(self.group, last,
                                          timeout_ms=idle_timeout_ms)
@@ -1170,6 +1200,18 @@ class Embedder:
                     self.drain(sweep=True)
                 if do_sweep:
                     self.publish_stats()
+                if self.replica and now >= next_retire_check:
+                    # scale-down drain (own 1s cadence — the sweep
+                    # interval is slower than the supervisor's drain
+                    # deadline): the supervisor closed our stripes;
+                    # the drains above finished any in-flight work,
+                    # so exit cleanly and let it reap us
+                    next_retire_check = now + 1.0
+                    if self.stripes.poll_retired():
+                        log.info("replica %d destriped — retiring",
+                                 self.replica)
+                        self.publish_stats()
+                        break
             except Exception:
                 self.stats.drain_faults += 1
                 log.exception("run loop cycle failed; continuing")
@@ -1247,6 +1289,14 @@ def main(argv: list[str] | None = None) -> int:
                          "futures held before the host blocks on the "
                          "oldest (default 2)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--replica", type=int, default=0,
+                    help="striped replica index (elastic lanes): "
+                         "drain only the slot-index stripes the "
+                         "lane's stripe map assigns this replica; "
+                         "heartbeat publishes replica-suffixed "
+                         "(__embedder_stats.rN).  The supervisor "
+                         "passes this — replica 0 is the classic "
+                         "single-process deployment")
     ap.add_argument("--admit-cap", type=int, default=None,
                     help="multi-tenant QoS: max rows embedded per "
                          "drain (fairness granularity; backlog stays "
@@ -1312,7 +1362,8 @@ def main(argv: list[str] | None = None) -> int:
                    queue_high_water=args.queue_high_water,
                    retry_after_ms=args.retry_after_ms,
                    tenant_weights=parse_tenant_weights(
-                       args.tenant_weights))
+                       args.tenant_weights),
+                   replica=args.replica)
     emb.attach()
     if args.warmup:
         t0 = time.monotonic()

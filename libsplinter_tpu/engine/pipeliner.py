@@ -194,9 +194,21 @@ class Pipeliner:
                  max_verbs: int | None = None,
                  queue_high_water: int | None = None,
                  retry_after_ms: int | None = None,
-                 tenant_weights: dict[int, float] | None = None):
+                 tenant_weights: dict[int, float] | None = None,
+                 replica: int = 0):
         self.store = store
         self.group = group
+        # elastic lanes (protocol.StripeView): replica r gathers only
+        # its own slot-index stripe; in-flight scripts keep their
+        # request label SET while executing, so closed stripes during
+        # a scale-down drain are what keeps a survivor from re-running
+        # a retiring replica's live chains
+        self.replica = int(replica)
+        self.stripes = P.StripeView(store, "pipeliner", self.replica)
+        self._hb_key = P.replica_stats_key(P.KEY_SCRIPT_STATS,
+                                           self.replica)
+        self._trace_key = P.replica_stats_key(P.KEY_SCRIPT_TRACE,
+                                              self.replica)
         # max_scripts is the lane's admit cap: the concurrency bound
         # (each in-flight script pins one sandbox + one host
         # coroutine thread) and the fairness granularity in one knob
@@ -250,16 +262,20 @@ class Pipeliner:
             st.bus_init()
         else:
             st.bus_open()
-        self.generation = P.bump_generation(st, P.KEY_SCRIPT_STATS)
+        self.generation = P.bump_generation(st, self._hb_key)
 
     # -- request gathering -------------------------------------------------
 
     def _gather(self) -> list[_Request]:
         st = self.store
-        rows = st.enumerate_indices(P.LBL_SCRIPT_REQ)
+        self.stripes.refresh()        # a re-stripe lands HERE, at the
+        rows = st.enumerate_indices(P.LBL_SCRIPT_REQ)  # gather boundary
         out: list[_Request] = []
         for idx in rows:
             idx = int(idx)
+            if not self.stripes.owns(idx) and idx not in self.runs:
+                continue              # a peer replica's stripe (rows
+                                      # WE are executing stay ours)
             e = st.epoch_at(idx)
             live = self.runs.get(idx)
             if live is not None:
@@ -839,6 +855,9 @@ class Pipeliner:
                    "scripts_active": len(self.runs),
                    "max_scripts": self.max_scripts,
                    "generation": self.generation}
+        if self.replica or self.stripes.epoch:
+            payload["replica"] = self.replica
+            payload["stripe"] = self.stripes.snapshot()
         if self.verb_counts:
             # per-verb dispatch counters: `spt metrics` renders one
             # sptpu_pipeliner_verb_<name> series per verb
@@ -858,10 +877,10 @@ class Pipeliner:
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "script.")
-        P.publish_heartbeat(self.store, P.KEY_SCRIPT_STATS, payload)
+        P.publish_heartbeat(self.store, self._hb_key, payload)
         if tracer.enabled:
             self._trace_published = P.maybe_publish_trace_ring(
-                self.store, P.KEY_SCRIPT_TRACE, self.recorder,
+                self.store, self._trace_key, self.recorder,
                 self._trace_published)
 
     # -- daemon loop -------------------------------------------------------
@@ -879,6 +898,7 @@ class Pipeliner:
         deadline = (time.monotonic() + stop_after) if stop_after \
             else None
         next_beat = 0.0
+        next_retire_check = 0.0
         re_gather = False
         while self._running:
             try:
@@ -912,6 +932,17 @@ class Pipeliner:
                     self.sweep_results()
                     self.publish_stats()
                     next_beat = now + heartbeat_interval_s
+                if self.replica and not self.runs \
+                        and now >= next_retire_check:
+                    # scale-down drain: stripes closed, every live
+                    # chain committed — exit and let the supervisor
+                    # reap us
+                    next_retire_check = now + 1.0
+                    if self.stripes.poll_retired():
+                        log.info("replica %d destriped — retiring",
+                                 self.replica)
+                        self.publish_stats()
+                        break
             except Exception:
                 log.exception("run loop cycle failed; continuing")
                 now = time.monotonic()
@@ -1037,6 +1068,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="per-tenant fair-share weights, "
                          "TENANT:W[,TENANT:W...]")
     ap.add_argument("--idle-timeout-ms", type=int, default=50)
+    ap.add_argument("--replica", type=int, default=0,
+                    help="striped replica index (elastic lanes): "
+                         "gather only the stripes the lane's stripe "
+                         "map assigns this replica; heartbeat "
+                         "publishes replica-suffixed "
+                         "(__pipeliner_stats.rN)")
     ap.add_argument("--seed-library", action="store_true",
                     help="store the built-in scenario scripts "
                          "(rag-churn / agent-loop / multi-hop / "
@@ -1053,7 +1090,8 @@ def main(argv: list[str] | None = None) -> int:
                    queue_high_water=args.queue_high_water,
                    retry_after_ms=args.retry_after_ms,
                    tenant_weights=parse_tenant_weights(
-                       args.tenant_weights))
+                       args.tenant_weights),
+                   replica=args.replica)
     pl.attach()
     if args.seed_library:
         from ..scripting.library import seed_library

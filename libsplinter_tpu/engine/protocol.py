@@ -9,6 +9,7 @@ import itertools
 import json
 import os
 import time
+from collections.abc import Sequence
 
 from .. import _native as N
 
@@ -286,6 +287,43 @@ KEY_SPAN_HEAD = "__span_head"
 TELEMETRY_PREFIX = "__tele_"
 KEY_TELEMETRY_STATS = "__telemetry_stats"
 
+# --- elastic lanes: striped replica groups --------------------------------
+# A lane may run R replicas behind the SAME label-routing protocol.
+# Replicas never coordinate directly: each one drains only its own
+# disjoint STRIPE of the request space (a request's stripe is its
+# slot index modulo the stripe width — the slot index is what the
+# label-word enumeration already hands every drain, the way bloom
+# groups partition search candidates), so two replicas can never race
+# a claim.  The stripe map is STORE state under stripe_map_key(lane):
+# a re-stripe is one epoch-bumped table write that in-flight replicas
+# pick up at their next drain — between the write and the pick-up a
+# request is at worst serviced by the OLD owner (still exclusive), so
+# no request is ever orphaned between stripe owners.  Stripes with
+# owner -1 are CLOSED: no replica claims new work from them (the
+# supervisor's scale-down drain protocol parks a retiring replica's
+# stripes closed until the straggler reclaim re-assigns them).
+STRIPE_MAP_PREFIX = "__stripe_"
+DEFAULT_STRIPE_WIDTH = 16
+# replica-suffixed heartbeat keys: replica 0 keeps the canonical
+# KEY_*_STATS name (every existing liveness probe and dashboard reads
+# it unchanged), replica N > 0 publishes under "<base>.rN" — `spt
+# top` / `spt metrics` / telemetry discover the suffixed keys via
+# replica_heartbeat_keys() instead of a hardcoded one-key read
+REPLICA_SUFFIX = ".r"
+# the scaling controller's wiring (engine/autoscaler.py): the
+# supervisor writes the policy (per-lane min:max bounds + controller
+# knobs) once at startup, the controller (or `spt scale set`) writes
+# desired replica counts into PER-LANE target keys
+# (__scale_tgt_<lane> — one writer owns one lane's key at a time, so
+# the autoscaler acting on lane A can never clobber an operator's
+# concurrent manual hold on lane B the way a shared read-modify-write
+# JSON map could), and the supervisor applies them — spawn on
+# scale-up, drain-protocol retire on scale-down.  All plain JSON
+# store keys, so `spt scale status` is nothing but reads.
+KEY_SCALE_POLICY = "__scale_policy"
+SCALE_TARGET_PREFIX = "__scale_tgt_"
+KEY_AUTOSCALER_STATS = "__autoscaler_stats"
+
 
 def trace_stamp_key(idx: int) -> str:
     return f"{TRACE_STAMP_PREFIX}{idx}"
@@ -301,6 +339,282 @@ def span_ring_key(i: int) -> str:
 
 def telemetry_key(lane: str) -> str:
     return f"{TELEMETRY_PREFIX}{lane}"
+
+
+def stripe_map_key(lane: str) -> str:
+    return f"{STRIPE_MAP_PREFIX}{lane}"
+
+
+def stripe_of(idx: int, width: int = DEFAULT_STRIPE_WIDTH) -> int:
+    """The stripe a request belongs to: its slot index modulo the
+    stripe width.  Deterministic, uniform, and derived from the one
+    thing every drain already holds for every candidate row."""
+    return int(idx) % max(1, int(width))
+
+
+def replica_stats_key(base: str, replica: int = 0) -> str:
+    """Replica r's heartbeat/trace key: the canonical `base` for
+    replica 0, `base.rN` for N > 0."""
+    r = int(replica)
+    return base if r <= 0 else f"{base}{REPLICA_SUFFIX}{r}"
+
+
+def parse_replica_key(key: str, base: str) -> int | None:
+    """Inverse of replica_stats_key: the replica index, or None when
+    `key` is not a replica key of `base`."""
+    if key == base:
+        return 0
+    pfx = base + REPLICA_SUFFIX
+    if not key.startswith(pfx):
+        return None
+    try:
+        r = int(key[len(pfx):])
+    except ValueError:
+        return None
+    return r if r > 0 else None
+
+
+def replica_heartbeat_map(store, bases: Sequence[str]
+                          ) -> dict[str, list[tuple[int, str]]]:
+    """Discover every lane's heartbeat keys in ONE debug-label
+    enumeration: {base: [(replica, key), ...]} sorted by replica,
+    each list always starting with (0, base).  Suffixed keys are
+    found through the bloom prefilter (every heartbeat is
+    LBL_DEBUG-labeled), never a per-base key walk — a multi-lane
+    render (`spt top` frame, `spt metrics`, a telemetry tick) pays
+    one scan, and a scaled lane's extra replicas appear in every
+    reader automatically."""
+    found: dict[str, dict[int, str]] = {b: {0: b} for b in bases}
+    try:
+        keys = store.enumerate_keys(LBL_DEBUG)
+    except (KeyError, OSError):
+        keys = []
+    for k in keys:
+        for b in bases:
+            r = parse_replica_key(k, b)
+            if r:
+                found[b][r] = k
+                break
+    return {b: sorted(m.items()) for b, m in found.items()}
+
+
+def replica_heartbeat_keys(store, base: str) -> list[tuple[int, str]]:
+    """One lane's heartbeat keys: [(replica, key), ...] — the
+    single-base view of replica_heartbeat_map."""
+    return replica_heartbeat_map(store, (base,))[base]
+
+
+def default_stripe_owners(replicas: Sequence[int] | int,
+                          width: int = DEFAULT_STRIPE_WIDTH
+                          ) -> dict[int, list[int]]:
+    """Round-robin the stripes over the given replica ids (or over
+    0..R-1 for an int): every stripe owned, ownership disjoint."""
+    ids = (list(range(replicas)) if isinstance(replicas, int)
+           else sorted(set(int(r) for r in replicas)))
+    if not ids:
+        return {}
+    out: dict[int, list[int]] = {r: [] for r in ids}
+    for s in range(max(1, int(width))):
+        out[ids[s % len(ids)]].append(s)
+    return out
+
+
+def read_stripe_map(store, lane: str) -> dict | None:
+    """The lane's live stripe map, or None (no map = the single-
+    replica deployment: replica 0 owns everything).  Shape:
+    {"v": 1, "epoch": E, "width": W,
+     "owners": {"<replica>": [stripe, ...]}, "closed": [stripe, ...],
+     "pending": {"<replica>": [stripe, ...]}}
+    `pending` lists the planned shares of replicas mid scale-up
+    handoff: those replicas own NOTHING yet (the incumbents keep
+    serving the planned stripes until the promotion write), but they
+    are NOT retired — the retire signal is being in neither `owners`
+    nor `pending`."""
+    try:
+        rec = json.loads(store.get(stripe_map_key(lane)).rstrip(b"\0"))
+    except (KeyError, OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or rec.get("v") != 1:
+        return None
+    return rec
+
+
+def write_stripe_map(store, lane: str,
+                     owners: dict[int, list[int]], *,
+                     width: int = DEFAULT_STRIPE_WIDTH,
+                     closed: Sequence[int] = (),
+                     pending: dict[int, Sequence[int]]
+                     | None = None) -> int:
+    """Commit a re-stripe: ONE epoch-bumped table write in-flight
+    replicas pick up at their next drain.  Returns the new epoch.
+    Never raises — a failed write leaves the previous map standing
+    (still a consistent, fully-owned assignment)."""
+    prev = read_stripe_map(store, lane)
+    epoch = int(prev.get("epoch", 0)) + 1 if prev else 1
+    rec = {"v": 1, "epoch": epoch, "width": max(1, int(width)),
+           "owners": {str(int(r)): sorted(int(s) for s in ss)
+                      for r, ss in owners.items()},
+           "closed": sorted(int(s) for s in closed),
+           "ts": time.time()}
+    if pending:
+        rec["pending"] = {str(int(r)): sorted(int(s) for s in ss)
+                          for r, ss in pending.items() if ss}
+    try:
+        store.set(stripe_map_key(lane), json.dumps(rec))
+    except (KeyError, OSError):
+        return int(prev.get("epoch", 0)) if prev else 0
+    return epoch
+
+
+def clear_stripe_map(store, lane: str) -> None:
+    """Drop the lane back to the single-replica default (replica 0
+    owns everything).  Never raises."""
+    try:
+        store.unset(stripe_map_key(lane))
+    except (KeyError, OSError):
+        pass
+
+
+class StripeView:
+    """A replica's cached view of its lane's stripe map — the one
+    stripe-filter every drain shares.  refresh() re-reads the map (a
+    drain-entry call: the map is one tiny JSON key, and picking up a
+    re-stripe at the NEXT drain is exactly the handoff contract);
+    owns(idx) is the candidate filter; `retired` goes True when a
+    live map assigns this replica nothing (the supervisor's scale-
+    down signal — the replica finishes in-flight work and exits).
+
+    With NO map in the store, replica 0 owns every stripe (the
+    pre-elastic single-process deployment, byte-identical behavior)
+    and a replica > 0 owns NOTHING — a mis-started extra replica
+    without a map must never double-serve."""
+
+    def __init__(self, store, lane: str, replica: int = 0):
+        self.store = store
+        self.lane = lane
+        self.replica = int(replica)
+        self.epoch = 0
+        self.width = DEFAULT_STRIPE_WIDTH
+        self._stripes: frozenset[int] | None = (
+            None if self.replica == 0 else frozenset())
+        self._have_map = False
+        self._pending = False         # scale-up handoff in progress
+
+    def refresh(self) -> None:
+        rec = read_stripe_map(self.store, self.lane)
+        if rec is None:
+            self._have_map = False
+            self.epoch = 0
+            self.width = DEFAULT_STRIPE_WIDTH
+            self._stripes = (None if self.replica == 0
+                             else frozenset())
+            self._pending = False
+            return
+        self._have_map = True
+        self.epoch = int(rec.get("epoch", 0))
+        self.width = max(1, int(rec.get("width",
+                                        DEFAULT_STRIPE_WIDTH)))
+        owners = rec.get("owners")
+        mine = () if not isinstance(owners, dict) else \
+            owners.get(str(self.replica), ())
+        self._stripes = frozenset(int(s) for s in mine)
+        pend = rec.get("pending")
+        self._pending = bool(
+            isinstance(pend, dict)
+            and pend.get(str(self.replica)))
+
+    def owns(self, idx: int) -> bool:
+        if self._stripes is None:
+            return True
+        return stripe_of(idx, self.width) in self._stripes
+
+    @property
+    def retired(self) -> bool:
+        """True when a live stripe map lists this replica NEITHER as
+        an owner NOR as pending — the drain signal: stop claiming,
+        finish in-flight, exit.  A PENDING replica (scale-up handoff:
+        its share parks closed until the supervisor sees its first
+        heartbeat) owns nothing yet but is absolutely not retired.
+        Replica 0 never retires (it is the canonical replica the
+        liveness probes read)."""
+        return (self.replica > 0 and self._have_map
+                and not self._stripes and not self._pending)
+
+    def poll_retired(self) -> bool:
+        """Force-refresh, then answer `retired` — the run loops'
+        heartbeat-cadence check."""
+        self.refresh()
+        return self.retired
+
+    def snapshot(self) -> dict:
+        """The heartbeat's `stripe` section."""
+        return {"replica": self.replica, "epoch": self.epoch,
+                "width": self.width,
+                "stripes": (-1 if self._stripes is None
+                            else len(self._stripes))}
+
+
+def scale_target_key(lane: str) -> str:
+    return f"{SCALE_TARGET_PREFIX}{lane}"
+
+
+def read_scale_target(store, lane: str) -> dict | None:
+    """One lane's desired replica count: {"r": N, "src":
+    "auto"|"manual", "ts": ...}, or None."""
+    try:
+        rec = json.loads(
+            store.get(scale_target_key(lane)).rstrip(b"\0"))
+    except (KeyError, OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) and "r" in rec else None
+
+
+def read_scale_targets(store) -> dict[str, dict]:
+    """Every lane's desired replica count: {lane: {"r": N, "src":
+    "auto"|"manual", "ts": ...}}.  Written by the autoscaler and
+    `spt scale set` (one PER-LANE key each — no shared-map
+    read-modify-write to race), applied by the supervisor."""
+    out: dict[str, dict] = {}
+    try:
+        keys = [k for k in store.list()
+                if k.startswith(SCALE_TARGET_PREFIX)]
+    except (KeyError, OSError):
+        return out
+    for k in keys:
+        lane = k[len(SCALE_TARGET_PREFIX):]
+        rec = read_scale_target(store, lane)
+        if rec is not None:
+            out[lane] = rec
+    return out
+
+
+def write_scale_target(store, lane: str, r: int | None, *,
+                       src: str = "manual") -> None:
+    """Set (or with r=None clear) one lane's desired replica count —
+    one whole-key write to the lane's OWN target key, so concurrent
+    writers of different lanes can never lose each other's entries.
+    A "manual" entry is a HOLD: the autoscaler leaves that lane alone
+    until `spt scale set <lane>=auto` clears it.  Never raises."""
+    try:
+        if r is None:
+            store.unset(scale_target_key(lane))
+        else:
+            store.set(scale_target_key(lane), json.dumps(
+                {"v": 1, "r": max(1, int(r)), "src": src,
+                 "ts": round(time.time(), 3)}))
+    except (KeyError, OSError):
+        pass
+
+
+def read_scale_policy(store) -> dict | None:
+    """The supervisor-published scaling policy: {"lanes": {lane:
+    {"min": m, "max": M}}, "interval_s": ..., "up_threshold": ...,
+    "down_threshold": ..., "cooldown_s": ...}."""
+    try:
+        rec = json.loads(store.get(KEY_SCALE_POLICY).rstrip(b"\0"))
+    except (KeyError, OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
 
 
 _trace_counter = itertools.count(1)

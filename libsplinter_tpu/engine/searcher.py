@@ -182,11 +182,22 @@ class Searcher:
                  admit_cap: int | None = None,
                  queue_high_water: int | None = None,
                  retry_after_ms: int | None = None,
-                 tenant_weights: dict[int, float] | None = None):
+                 tenant_weights: dict[int, float] | None = None,
+                 replica: int = 0):
         from ..ops import StagedLane
 
         self.store = store
         self.group = group
+        # elastic lanes (protocol.StripeView): replica r drains only
+        # its own slot-index stripe of the request space; the map is
+        # store state re-read at each drain, so a supervisor
+        # re-stripe lands at the next drain boundary
+        self.replica = int(replica)
+        self.stripes = P.StripeView(store, "searcher", self.replica)
+        self._hb_key = P.replica_stats_key(P.KEY_SEARCH_STATS,
+                                           self.replica)
+        self._trace_key = P.replica_stats_key(P.KEY_SEARCH_TRACE,
+                                              self.replica)
         self.use_pallas = use_pallas
         self.mxu_bf16 = mxu_bf16
         self.fused = fused
@@ -221,6 +232,7 @@ class Searcher:
         self.tenants = TenantLedger()
         self._had_deferred = False
         self.lane = lane or StagedLane(store)
+        self._all_req_rows: list[int] = []
         self.stats = SearcherStats()
         self.generation = 0          # bumped at attach (restart marker)
         self.recorder = FlightRecorder()
@@ -246,7 +258,7 @@ class Searcher:
             st.bus_init()
         else:
             st.bus_open()
-        self.generation = P.bump_generation(st, P.KEY_SEARCH_STATS)
+        self.generation = P.bump_generation(st, self._hb_key)
 
     def warmup(self, ks: Sequence[int] = (10, 64)) -> None:
         """Pre-compile the QB-bucketed top-k programs against the live
@@ -290,7 +302,13 @@ class Searcher:
         succeed, so retrying would spin)."""
         fault("searcher.gather")
         st = self.store
-        rows = st.enumerate_indices(P.LBL_SEARCH_REQ)
+        self.stripes.refresh()        # a re-stripe lands HERE, at the
+        rows = st.enumerate_indices(P.LBL_SEARCH_REQ)  # drain boundary
+        # the UNfiltered enumeration doubles as this drain's
+        # request-scratch mask input (_mask_for): a peer replica's
+        # pending request rows hold query vectors too
+        self._all_req_rows = [int(i) for i in rows]
+        rows = [i for i in rows if self.stripes.owns(int(i))]
         if not rows:
             return []
         out: list[_Request] = []
@@ -411,9 +429,19 @@ class Searcher:
         protocol.candidate_mask definition); every CURRENT request row
         is masked out of every group (request slots hold query vectors
         — without this, concurrent similar queries would surface each
-        other's scratch rows at the top)."""
+        other's scratch rows at the top).  The WHOLE enumeration the
+        drain's gather captured (_all_req_rows) — not just this
+        batch's rows — is what gets masked: under striped replicas a
+        peer's still-pending request rows are request scratch too,
+        and masking only our own stripe would make R=2 results
+        diverge from R=1 (caught by tests/test_elastic.py).  Reusing
+        the gather's enumeration costs no extra label scan per bloom
+        group."""
         mask = P.candidate_mask(self.store, bloom)
         mask[req_rows] = 0.0
+        pending = getattr(self, "_all_req_rows", None)
+        if pending:
+            mask[np.asarray(pending, np.int64)] = 0.0
         return mask
 
     # -- the drain ---------------------------------------------------------
@@ -847,6 +875,9 @@ class Searcher:
                    # --inflight-depth for more dispatch amortization)
                    "inflight_depth": self.inflight_depth,
                    "lane": self.lane.counters()}
+        if self.replica or self.stripes.epoch:
+            payload["replica"] = self.replica
+            payload["stripe"] = self.stripes.snapshot()
         if self.admit_cap or self.qos.high_water is not None:
             payload["qos"] = {
                 "admit_cap": self.admit_cap or 0,
@@ -867,10 +898,10 @@ class Searcher:
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "search.")
-        P.publish_heartbeat(self.store, P.KEY_SEARCH_STATS, payload)
+        P.publish_heartbeat(self.store, self._hb_key, payload)
         if tracer.enabled:
             self._trace_published = P.maybe_publish_trace_ring(
-                self.store, P.KEY_SEARCH_TRACE, self.recorder,
+                self.store, self._trace_key, self.recorder,
                 self._trace_published)
 
     def run(self, *, idle_timeout_ms: int = 100,
@@ -885,6 +916,7 @@ class Searcher:
         last = st.signal_count(self.group)
         deadline = (time.monotonic() + stop_after) if stop_after else None
         next_beat = 0.0                       # publish immediately
+        next_retire_check = 0.0
         while self._running:
             got = st.signal_wait(self.group, last,
                                  timeout_ms=idle_timeout_ms)
@@ -929,6 +961,16 @@ class Searcher:
                     self.sweep_results()
                     self.publish_stats()
                     next_beat = now + heartbeat_interval_s
+                if self.replica and now >= next_retire_check:
+                    # scale-down drain: stripes closed by the
+                    # supervisor; the drain above finished in-flight
+                    # work, so exit cleanly and let it reap us
+                    next_retire_check = now + 1.0
+                    if self.stripes.poll_retired():
+                        log.info("replica %d destriped — retiring",
+                                 self.replica)
+                        self.publish_stats()
+                        break
             except Exception:
                 self.stats.drain_faults += 1
                 log.exception("run loop cycle failed; continuing")
@@ -1039,6 +1081,12 @@ def main(argv: list[str] | None = None) -> int:
                          "select+commit resolves (1 = fetch in "
                          "dispatch order, the pre-overlap behavior)")
     ap.add_argument("--idle-timeout-ms", type=int, default=100)
+    ap.add_argument("--replica", type=int, default=0,
+                    help="striped replica index (elastic lanes): "
+                         "drain only the stripes the lane's stripe "
+                         "map assigns this replica; heartbeat "
+                         "publishes replica-suffixed "
+                         "(__searcher_stats.rN)")
     ap.add_argument("--admit-cap", type=int, default=None,
                     help="multi-tenant QoS: max requests serviced per "
                          "drain (the fairness granularity; backlog "
@@ -1074,7 +1122,8 @@ def main(argv: list[str] | None = None) -> int:
                   queue_high_water=args.queue_high_water,
                   retry_after_ms=args.retry_after_ms,
                   tenant_weights=parse_tenant_weights(
-                      args.tenant_weights))
+                      args.tenant_weights),
+                  replica=args.replica)
     sr.attach()
     if args.warmup:
         t0 = time.monotonic()

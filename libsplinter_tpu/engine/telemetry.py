@@ -102,43 +102,78 @@ class TelemetrySampler:
             return None
         return snap if isinstance(snap, dict) else None
 
-    def _gauges_for(self, lane: str, snap: dict | None) -> dict:
+    def _read_lane_snaps(self, base: str,
+                         disc: dict | None = None) -> list[dict]:
+        """Every replica heartbeat of a lane (elastic lanes publish
+        replica-suffixed keys — base, base.r1, ...), in replica
+        order.  `disc` is a shared replica_heartbeat_map result so
+        one tick pays one discovery enumeration."""
+        rows = (disc or P.replica_heartbeat_map(
+            self.store, (base,)))[base]
+        out = []
+        for _r, key in rows:
+            snap = self._read_heartbeat(key)
+            if snap is not None:
+                out.append(snap)
+        return out
+
+    def _gauges_for(self, lane: str,
+                    snaps: list[dict] | dict | None) -> dict:
         """One tick's gauge values for a lane.  queue_depth is always
-        measured (label enumeration — the store is the truth, a stale
-        heartbeat is not); the rest come from the heartbeat when one
-        exists."""
+        measured (label enumeration over the WHOLE lane — the store
+        is the truth, a stale heartbeat is not, and under striped
+        replicas no single replica's view covers the queue); the rest
+        come from the replica heartbeats when any exist — counters
+        and progress SUM across replicas, stage p99s take the worst
+        replica, and a `replicas` gauge counts live publishers so the
+        controller and `spt top` can see R move."""
         _, label = SCRAPE_LANES[lane]
         out: dict[str, float] = {
             "queue_depth": float(len(
                 self.store.enumerate_indices(label)))}
-        if snap is None:
+        if isinstance(snaps, dict):
+            snaps = [snaps]
+        if not snaps:
             return out
-        for g in _COUNTER_GAUGES + _EXTRA.get(lane, ()):
-            v = snap.get(g)
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                out[g] = float(v)
+        live = sum(1 for s in snaps
+                   if not isinstance(s.get("pid"), int)
+                   or P.pid_alive(s["pid"]))
+        if len(snaps) > 1 or any("replica" in s for s in snaps):
+            out["replicas"] = float(live)
         prog = PROGRESS_FIELDS.get(lane)
-        if prog is not None and isinstance(snap.get(prog),
-                                           (int, float)):
-            out["progress"] = float(snap[prog])
-        # stage p99s (tracing on): e2e + every published stage — the
-        # quantiles section carries prefix-stripped stage names
-        q = snap.get("quantiles")
-        if isinstance(q, dict):
-            for stage, row in q.items():
-                if isinstance(row, dict) and "p99_ms" in row:
-                    out[f"p99_{stage}_ms"] = float(row["p99_ms"])
-        # per-tenant goodput inputs (admitted is the open-loop
-        # admission truth; served_tokens where the lane meters tokens)
-        tenants = snap.get("tenants")
-        if isinstance(tenants, dict):
-            for t, row in tenants.items():
-                if not isinstance(row, dict):
-                    continue
-                for f in ("admitted", "served_tokens"):
-                    v = row.get(f)
-                    if isinstance(v, (int, float)):
-                        out[f"tenant{t}_{f}"] = float(v)
+        for snap in snaps:
+            for g in _COUNTER_GAUGES + _EXTRA.get(lane, ()):
+                v = snap.get(g)
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    out[g] = out.get(g, 0.0) + float(v)
+            if prog is not None and isinstance(snap.get(prog),
+                                               (int, float)):
+                out["progress"] = out.get("progress", 0.0) \
+                    + float(snap[prog])
+            # stage p99s (tracing on): e2e + every published stage —
+            # the quantiles section carries prefix-stripped stage
+            # names; across replicas the WORST p99 is the SLO truth
+            q = snap.get("quantiles")
+            if isinstance(q, dict):
+                for stage, row in q.items():
+                    if isinstance(row, dict) and "p99_ms" in row:
+                        k = f"p99_{stage}_ms"
+                        out[k] = max(out.get(k, 0.0),
+                                     float(row["p99_ms"]))
+            # per-tenant goodput inputs (admitted is the open-loop
+            # admission truth; served_tokens where the lane meters
+            # tokens)
+            tenants = snap.get("tenants")
+            if isinstance(tenants, dict):
+                for t, row in tenants.items():
+                    if not isinstance(row, dict):
+                        continue
+                    for f in ("admitted", "served_tokens"):
+                        v = row.get(f)
+                        if isinstance(v, (int, float)):
+                            k = f"tenant{t}_{f}"
+                            out[k] = out.get(k, 0.0) + float(v)
         return out
 
     def _append(self, lane: str, gauges: dict, now: float) -> None:
@@ -188,12 +223,14 @@ class TelemetrySampler:
         """One tick over every scrape lane; returns lanes sampled."""
         now = time.time() if now is None else now
         seen = 0
+        disc = P.replica_heartbeat_map(
+            self.store, [hb for hb, _ in SCRAPE_LANES.values()])
         for lane, (hb_key, _) in SCRAPE_LANES.items():
             try:
-                snap = self._read_heartbeat(hb_key)
-                if snap is not None:
+                snaps = self._read_lane_snaps(hb_key, disc)
+                if snaps:
                     seen += 1
-                self._append(lane, self._gauges_for(lane, snap), now)
+                self._append(lane, self._gauges_for(lane, snaps), now)
             except Exception:        # telemetry must never wedge: a
                 log.exception("sampling %s failed; continuing", lane)
         self.stats.samples += 1

@@ -542,6 +542,52 @@ traced admissions carry a `prefix_hit` stage span
 (`CONT_INFER_STAGES`) so `spt trace show` attributes first-token
 latency to the cache hit vs the suffix prefill.  Runbook:
 `docs/operations.md` §Prefix cache.
+
+### Elastic-lane keys (`libsplinter_tpu/engine/protocol.py`, `engine/autoscaler.py`)
+
+Striped replica groups + the scaling controller keep their entire
+control plane in the store (runbook: `docs/operations.md` §Elastic
+lanes):
+
+- `__stripe_<lane>` — the lane's stripe map: `{"v": 1, "epoch": E,
+  "width": W, "owners": {"<replica>": [stripe, ...]},
+  "closed": [...], "pending": {"<replica>": [...]}}`.  A request's
+  stripe is its slot index mod `width`; replicas re-read the map at
+  every drain (`protocol.StripeView`), so one epoch-bumped write
+  re-stripes the lane with no orphaned requests.  `closed` stripes
+  are claimed by NOBODY (a retiring replica's parked share during
+  the deadline-bounded scale-down drain); `pending` lists the
+  planned shares of spawning replicas — the incumbents keep serving
+  those until the first-heartbeat promotion (the two-phase scale-up
+  handoff), and being listed there is how a pending replica knows
+  it is not retired.  No map = replica 0 owns everything (the
+  classic single-process deployment).
+- replica-suffixed heartbeats — replica N > 0 publishes
+  `__<lane>_stats.rN` / `__<lane>_trace.rN`
+  (`protocol.replica_stats_key`); readers discover them via
+  `protocol.replica_heartbeat_keys` (`spt top` renders one row per
+  replica + a lane aggregate; `spt metrics` exposes replica blocks
+  as `sptpu_<lane>_rN_*`; splint SPL105 enforces the discovery).
+  Each replica heartbeat carries `replica` + a `stripe` section
+  (epoch / width / owned-stripe count).
+- `__scale_policy` — supervisor-published bounds + controller knobs:
+  `{"lanes": {lane: {"min": m, "max": M}}, "up_threshold": ...,
+  "down_threshold": ..., "cooldown_s": ..., "interval_s": ...}`.
+- `__scale_tgt_<lane>` — one desired-count key per lane: `{"r": N,
+  "src": "auto"|"manual", "ts": ...}` (per-lane keys: no shared
+  read-modify-write map for concurrent writers to race) — written
+  by the autoscaler
+  (`src=auto`) or `spt scale set` (`src=manual` = a hold the
+  controller respects), applied by the supervisor's poll.
+- `__autoscaler_stats` — the controller heartbeat: decision
+  counters (ticks / scale_ups / scale_downs / holds), per-lane
+  `{target, pressure, reason, up_streak, down_streak}`, and a
+  bounded decision `history` (`spt scale status` renders it;
+  `spt metrics` exposes `sptpu_autoscaler_lane_*`).
+- `__supervisor_stats` lane sections gain `r` (active replicas),
+  optional `scale_min`/`scale_max`, per-replica `replicas`
+  subsections, and the supervisor totals gain `retired` +
+  `scale_events`.
 """,
 }
 

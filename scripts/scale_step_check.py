@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""CI gate: the elastic-lane rate-step response, in-process.
+
+Drives the WHOLE control loop on one CPU — striped embedder replicas
+(thread-backed children under the real Supervisor), the real
+TelemetrySampler and AutoScaler, and `spt loadgen`'s open-loop
+rate-profile harness — through a 1x -> 4x -> 1x offered-rate step,
+and asserts ROADMAP item 4's target at smoke scale:
+
+  - the replica set FOLLOWS the step: >= 2 replicas live during the
+    4x phase, back to the 1-replica floor after the load drops;
+  - ZERO admitted-request loss through scale-up AND scale-down
+    (loadgen's `lost` classification counts claimed-but-never-
+    completed requests — the drain protocol's contract);
+  - the backlog clears: the run ends with (almost) nothing unserved.
+
+The embedder children run a deliberately slow encoder (a fixed sleep
+per batch) with a small admit cap, so one replica saturates below
+the 4x offered rate — scaling is the only way the system tracks.
+
+Run: JAX_PLATFORMS=cpu python scripts/scale_step_check.py
+(make scale-check wires it into make check).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from libsplinter_tpu import Store  # noqa: E402
+from libsplinter_tpu.cli.loadgen import (LoadGenerator,  # noqa: E402
+                                         TenantSpec)
+from libsplinter_tpu.engine import protocol as P  # noqa: E402
+from libsplinter_tpu.engine.autoscaler import AutoScaler  # noqa: E402
+from libsplinter_tpu.engine.embedder import Embedder  # noqa: E402
+from libsplinter_tpu.engine.supervisor import Supervisor  # noqa: E402
+from libsplinter_tpu.engine.telemetry import (  # noqa: E402
+    TelemetrySampler)
+
+STORE = f"/spt-scale-check-{os.getpid()}"
+RATE = 16.0                       # 1x offered rate (req/s)
+PROFILE = [(1.0, 2.0), (4.0, 4.0), (1.0, 2.0)]
+ENCODE_SLEEP_S = 0.15             # per encode batch: the capacity wall
+ADMIT_CAP = 8                     # rows per drain (throughput ~53/s)
+
+
+class _ThreadChild:
+    """A 'process' the Supervisor can own that is really an Embedder
+    thread — the in-process stand-in for `--replica N` children, so
+    the gate runs the REAL supervisor scale/retire machinery without
+    paying a jax import per replica."""
+
+    def __init__(self, store_name: str, replica: int):
+        st = Store.open(store_name)
+
+        def enc(texts):
+            time.sleep(ENCODE_SLEEP_S)
+            return np.full((len(texts), st.vec_dim), 0.25 + replica,
+                           np.float32)
+
+        self._emb = Embedder(st, encoder_fn=enc, max_ctx=128,
+                             admit_cap=ADMIT_CAP, replica=replica)
+        self._emb.attach()
+        self.pid = os.getpid()
+        self._th = threading.Thread(
+            target=self._emb.run,
+            kwargs=dict(idle_timeout_ms=20), daemon=True)
+        self._th.start()
+
+    def poll(self):
+        return None if self._th.is_alive() else 0
+
+    def terminate(self):
+        self._emb.stop()
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        self._th.join(timeout)
+        return 0
+
+
+def main() -> int:
+    Store.unlink(STORE)
+    store = Store.create(STORE, nslots=512, max_val=4096, vec_dim=8)
+    stop = threading.Event()
+    r_history: list[int] = []
+    try:
+        sup = Supervisor(
+            STORE, lanes=("embedder",), store=store,
+            scale={"embedder": (1, 3)},
+            scale_knobs={"up_threshold": 8.0, "down_threshold": 1.0,
+                         "cooldown_s": 1.0, "interval_s": 0.25},
+            drain_deadline_s=2.0,
+            spawn_fn=lambda lane: _ThreadChild(STORE, lane.replica))
+        tel = TelemetrySampler(store, interval_s=0.2)
+        ctl = AutoScaler(store, interval_s=0.25, up_consecutive=2,
+                         down_consecutive=8)
+
+        def sup_loop():
+            while not stop.is_set():
+                try:
+                    sup.poll_once()
+                    r_history.append(
+                        len(sup._active_ids("embedder")))
+                except Exception:
+                    pass
+                time.sleep(0.1)
+
+        def tel_loop():
+            while not stop.is_set():
+                try:
+                    tel.sample_once()
+                except Exception:
+                    pass
+                time.sleep(0.2)
+
+        def ctl_loop():
+            while not stop.is_set():
+                try:
+                    ctl.decide_once()
+                    ctl.publish_stats()
+                except Exception:
+                    pass
+                time.sleep(0.25)
+
+        threads = [threading.Thread(target=f, daemon=True)
+                   for f in (sup_loop, tel_loop, ctl_loop)]
+        for th in threads:
+            th.start()
+        # wait for replica 0 to serve
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if P.heartbeat_live(store, P.KEY_EMBED_STATS,
+                                max_age_s=5):
+                break
+            time.sleep(0.1)
+        else:
+            print("FAIL: replica 0 never published a heartbeat")
+            return 1
+
+        gen = LoadGenerator(store, [TenantSpec(tenant=1, rate=RATE)],
+                            mix={"embed": 1.0}, arrivals="poisson",
+                            seed=11, corpus=8, drain_s=4.0,
+                            rate_profile=PROFILE)
+        report = gen.run()
+
+        # scale-down convergence: give the controller the idle run it
+        # needs (down_consecutive * interval + cooldown + drain)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if r_history and r_history[-1] == 1:
+                break
+            time.sleep(0.2)
+        peak_r = max(r_history) if r_history else 0
+        final_r = r_history[-1] if r_history else 0
+        stop.set()
+        for th in threads:
+            th.join(timeout=3)
+        sup.shutdown()
+
+        phases = report.get("rate_profile", [])
+        print(f"scale_step_check: issued={report['issued']} "
+              f"ok={report['ok']} lost={report['lost']} "
+              f"shed={report['shed']} unserved={report['unserved']} "
+              f"peak_r={peak_r} final_r={final_r}")
+        for row in phases:
+            print(f"  phase {row['phase']} ({row['mult']:g}x): "
+                  f"issued={row['issued']} "
+                  f"goodput={row['goodput_ratio']:.1%} "
+                  f"p50={row.get('p50_ms', '—')}ms")
+        ups = ctl.stats.scale_ups
+        downs = ctl.stats.scale_downs
+        print(f"  autoscaler: ups={ups} downs={downs} "
+              f"ticks={ctl.stats.ticks}; supervisor "
+              f"retired={sup.retired}")
+
+        fails = []
+        if report["lost"]:
+            fails.append(f"{report['lost']} admitted requests LOST "
+                         "(zero-loss contract)")
+        if report["shed"]:
+            fails.append(f"{report['shed']} shed (no high-water set "
+                         "— nothing should shed)")
+        if peak_r < 2:
+            fails.append(f"replica set never scaled up (peak {peak_r}"
+                         " — the 4x phase must exceed one replica)")
+        if final_r != 1:
+            fails.append(f"scale-down never converged (final r = "
+                         f"{final_r})")
+        if report["unserved"] > max(4, report["issued"] // 20):
+            fails.append(f"{report['unserved']} unserved after the "
+                         "drain window — the scaled set failed to "
+                         "clear the backlog")
+        if fails:
+            print("scale_step_check: FAIL — " + "; ".join(fails))
+            return 1
+        print("scale_step_check: PASS — replica set tracked the "
+              "1x->4x->1x step with zero admitted loss")
+        return 0
+    finally:
+        stop.set()
+        store.close()
+        Store.unlink(STORE)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

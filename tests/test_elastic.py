@@ -1,0 +1,950 @@
+"""Elastic lanes: the stripe-map protocol, striped replica groups
+(R=2 byte-identical to R=1, no double-claims, no orphans across a
+re-stripe), the supervisor's replica sets + scale-down drain
+protocol + straggler reclaim, the autoscaler's hysteresis (no
+flapping on oscillating input), telemetry queue-depth under stripes,
+loadgen rate profiles, and mid-decode deadline aborts.  `make
+scale-check` runs the fast tier of this file + the in-process
+rate-step gate (scripts/scale_step_check.py)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.autoscaler import AutoScaler
+from libsplinter_tpu.engine.embedder import Embedder
+from libsplinter_tpu.engine.searcher import Searcher
+from libsplinter_tpu.engine.supervisor import (LANES, LaneSpec,
+                                               Supervisor,
+                                               parse_scale_spec)
+
+
+@pytest.fixture
+def store():
+    name = f"/spt-el-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    st = Store.create(name, nslots=128, max_val=4096, vec_dim=8)
+    yield st
+    st.close()
+    Store.unlink(name)
+
+
+# ------------------------------------------------- stripe protocol
+
+class TestStripeProtocol:
+    def test_map_roundtrip_and_epoch_bump(self, store):
+        owners = {0: [0, 2, 4], 1: [1, 3, 5]}
+        e1 = P.write_stripe_map(store, "embedder", owners, width=6)
+        rec = P.read_stripe_map(store, "embedder")
+        assert e1 == 1 and rec["epoch"] == 1 and rec["width"] == 6
+        assert rec["owners"] == {"0": [0, 2, 4], "1": [1, 3, 5]}
+        assert rec["closed"] == []
+        e2 = P.write_stripe_map(store, "embedder", {0: [0, 1, 2]},
+                                width=6, closed=[3, 4, 5])
+        assert e2 == 2
+        rec = P.read_stripe_map(store, "embedder")
+        assert rec["epoch"] == 2 and rec["closed"] == [3, 4, 5]
+        P.clear_stripe_map(store, "embedder")
+        assert P.read_stripe_map(store, "embedder") is None
+
+    def test_default_owners_disjoint_and_covering(self):
+        for r in (1, 2, 3, 5, 8):
+            owners = P.default_stripe_owners(r, 16)
+            seen = [s for ss in owners.values() for s in ss]
+            assert sorted(seen) == list(range(16))   # cover, disjoint
+            assert set(owners) == set(range(r))
+            sizes = [len(ss) for ss in owners.values()]
+            assert max(sizes) - min(sizes) <= 1      # balanced
+
+    def test_replica_key_roundtrip(self):
+        base = P.KEY_EMBED_STATS
+        assert P.replica_stats_key(base, 0) == base
+        assert P.replica_stats_key(base, 2) == f"{base}.r2"
+        assert P.parse_replica_key(base, base) == 0
+        assert P.parse_replica_key(f"{base}.r3", base) == 3
+        assert P.parse_replica_key(f"{base}.rx", base) is None
+        assert P.parse_replica_key("__other", base) is None
+
+    def test_replica_heartbeat_discovery(self, store):
+        base = P.KEY_SEARCH_STATS
+        P.publish_heartbeat(store, base, {"served": 1})
+        P.publish_heartbeat(store, P.replica_stats_key(base, 2),
+                            {"served": 2})
+        P.publish_heartbeat(store, P.replica_stats_key(base, 1),
+                            {"served": 3})
+        keys = P.replica_heartbeat_keys(store, base)
+        assert keys == [(0, base), (1, f"{base}.r1"),
+                        (2, f"{base}.r2")]
+
+    def test_stripe_view_fallbacks_and_retire(self, store):
+        v0 = P.StripeView(store, "searcher", 0)
+        v1 = P.StripeView(store, "searcher", 1)
+        v0.refresh(), v1.refresh()
+        # no map: replica 0 owns everything, replica 1 owns NOTHING
+        assert all(v0.owns(i) for i in range(40))
+        assert not any(v1.owns(i) for i in range(40))
+        assert not v0.retired and not v1.retired
+        P.write_stripe_map(store, "searcher",
+                           P.default_stripe_owners(2, 16), width=16)
+        v0.refresh(), v1.refresh()
+        for i in range(40):
+            assert v0.owns(i) != v1.owns(i)      # disjoint, covering
+        # retire signal: a live map assigning replica 1 nothing
+        P.write_stripe_map(store, "searcher", {0: list(range(16))},
+                           width=16)
+        assert v1.poll_retired()
+        assert not v0.poll_retired()             # replica 0 never
+
+    def test_scale_targets_roundtrip(self, store):
+        assert P.read_scale_targets(store) == {}
+        P.write_scale_target(store, "embedder", 3, src="auto")
+        P.write_scale_target(store, "searcher", 2, src="manual")
+        t = P.read_scale_targets(store)
+        assert t["embedder"]["r"] == 3 and t["embedder"]["src"] == "auto"
+        assert t["searcher"]["src"] == "manual"
+        P.write_scale_target(store, "searcher", None)
+        assert "searcher" not in P.read_scale_targets(store)
+
+    def test_parse_scale_spec(self):
+        assert parse_scale_spec(["embedder=1:4"]) == {
+            "embedder": (1, 4)}
+        assert parse_scale_spec(["searcher=3"]) == {
+            "searcher": (1, 3)}
+        for bad in ("embedder", "embedder=", "embedder=4:1",
+                    "embedder=0:4", "=1:2",
+                    "embeder=1:4",        # typo'd lane: fail at PARSE
+                    "telemetry=1:2"):     # unscalable lane
+            with pytest.raises(ValueError):
+                parse_scale_spec([bad])
+
+
+# ------------------------------------------- striped replica groups
+
+def _mk_embedder(store, replica, served):
+    def enc(texts):
+        served.extend(texts)
+        # deterministic pure function of the text: byte-identical
+        # across any replica assignment
+        return np.array([[float(len(t) % 7 + 1)] * store.vec_dim
+                         for t in texts], np.float32)
+    return Embedder(store, encoder_fn=enc, max_ctx=64,
+                    replica=replica)
+
+
+def _submit_embeds(store, n):
+    keys = [f"doc{i}" for i in range(n)]
+    for i, k in enumerate(keys):
+        store.set(k, f"text number {i} with tail {'x' * (i % 5)}")
+        store.label_or(k, P.LBL_EMBED_REQ | P.LBL_WAITING)
+        store.bump(k)
+    return keys
+
+
+class TestStripedReplicas:
+    def test_two_embedder_replicas_disjoint_and_byte_identical(
+            self, store):
+        """R=2 serves the same request set as R=1, byte-identical,
+        with every request embedded EXACTLY once (no double-claims:
+        the encoder call log is the claim log)."""
+        P.write_stripe_map(store, "embedder",
+                           P.default_stripe_owners(2, 16))
+        served0, served1 = [], []
+        e0 = _mk_embedder(store, 0, served0)
+        e1 = _mk_embedder(store, 1, served1)
+        e0.attach(), e1.attach()
+        keys = _submit_embeds(store, 24)
+        texts = {store.get(k).rstrip(b"\0").decode() for k in keys}
+        for _ in range(4):
+            e0.run_once(), e1.run_once()
+        assert not store.enumerate_indices(P.LBL_EMBED_REQ)
+        # exactly-once: the union is the request set, no overlap
+        assert set(served0) | set(served1) == texts
+        assert len(served0) + len(served1) == len(texts)
+        assert served0 and served1       # both replicas actually drained
+        # byte-identical to the single-replica deployment
+        vecs = {k: store.vec_get(k).copy() for k in keys}
+        for k in keys:
+            t = store.get(k).rstrip(b"\0").decode()
+            want = np.full(store.vec_dim, float(len(t) % 7 + 1),
+                           np.float32)
+            assert np.array_equal(vecs[k], want)
+        # replica heartbeats land suffixed
+        e0.publish_stats(), e1.publish_stats()
+        assert P.KEY_EMBED_STATS in store
+        assert f"{P.KEY_EMBED_STATS}.r1" in store
+        snap1 = json.loads(
+            store.get(f"{P.KEY_EMBED_STATS}.r1").rstrip(b"\0"))
+        assert snap1["replica"] == 1
+        assert snap1["stripe"]["stripes"] == 8
+
+    def test_two_searcher_replicas_identical_to_single(self, store):
+        """R=2 searchers answer every request with the same hits a
+        single searcher produces, each request serviced by exactly
+        one replica."""
+        rng = np.random.default_rng(3)
+        docs = rng.normal(size=(32, store.vec_dim)).astype(np.float32)
+        for i in range(32):
+            store.set(f"doc/{i}", f"text {i}")
+            store.vec_set(f"doc/{i}", docs[i])
+            # bloom-scoped corpus: the candidate set is the labeled
+            # docs, independent of how drains slice the request set
+            store.label_or(f"doc/{i}", P.LBL_CHUNK)
+        qs = rng.normal(size=(10, store.vec_dim)).astype(np.float32)
+        keys = [f"q{i}" for i in range(10)]
+
+        def submit_all(st):
+            for k, q in zip(keys, qs):
+                st.set(k, json.dumps({"k": 4, "bloom": P.LBL_CHUNK}))
+                st.vec_set(k, q)
+                st.label_or(k, P.LBL_SEARCH_REQ | P.LBL_WAITING)
+                st.bump(k)
+
+        # reference: one unstriped searcher on an identical store
+        submit_all(store)
+        ref = Searcher(store)
+        ref.attach()
+        assert ref.run_once() == 10
+        want = {}
+        for k in keys:
+            idx = store.find_index(k)
+            want[k] = json.loads(store.get(
+                P.search_result_key(idx)).rstrip(b"\0"))["keys"]
+            store.unset(P.search_result_key(idx))
+        # striped pair re-serves the same set
+        P.write_stripe_map(store, "searcher",
+                           P.default_stripe_owners(2, 16))
+        submit_all(store)
+        s0 = Searcher(store, replica=0)
+        s1 = Searcher(store, replica=1)
+        s0.attach(), s1.attach()
+        n0 = s0.run_once()
+        n1 = s1.run_once()
+        assert n0 + n1 == 10 and n0 and n1       # disjoint split
+        assert not store.enumerate_indices(P.LBL_SEARCH_REQ)
+        for k in keys:
+            got = json.loads(store.get(P.search_result_key(
+                store.find_index(k))).rstrip(b"\0"))["keys"]
+            assert got == want[k]
+
+    def test_restripe_epoch_bump_leaves_no_orphans(self, store):
+        """The handoff contract: requests parked in a replica's
+        stripes are picked up by the NEW owner at its next drain
+        after the epoch-bumped map write — zero orphaned WAITING
+        rows."""
+        served = []
+        emb = _mk_embedder(store, 0, served)
+        emb.attach()
+        # everything assigned to (absent) replica 1: replica 0 drains
+        # nothing
+        P.write_stripe_map(store, "embedder",
+                           {1: list(range(16))}, width=16)
+        _submit_embeds(store, 12)
+        assert emb.run_once() == 0
+        assert len(store.enumerate_indices(P.LBL_EMBED_REQ)) == 12
+        # the re-stripe: replica 0 takes over at its NEXT drain
+        e = P.write_stripe_map(store, "embedder",
+                               {0: list(range(16))}, width=16)
+        assert e == 2
+        emb.run_once()
+        assert not store.enumerate_indices(P.LBL_EMBED_REQ)
+        assert len(served) == 12                 # all exactly once
+
+    def test_telemetry_queue_depth_counts_whole_lane(self, store):
+        """The satellite guarantee: queue depth is measured by label
+        enumeration over the WHOLE lane — a striped deployment must
+        never ring one replica's share as the lane queue."""
+        from libsplinter_tpu.engine.telemetry import TelemetrySampler
+
+        P.write_stripe_map(store, "embedder",
+                           P.default_stripe_owners(2, 16))
+        _submit_embeds(store, 17)
+        # replica heartbeats: counters SUM, replicas gauge counts
+        P.publish_heartbeat(store, P.KEY_EMBED_STATS,
+                            {"embedded": 5, "shed": 1, "replica": 0})
+        P.publish_heartbeat(store, f"{P.KEY_EMBED_STATS}.r1",
+                            {"embedded": 7, "shed": 2, "replica": 1})
+        tel = TelemetrySampler(store, interval_s=0.1)
+        tel.sample_once()
+        rec = json.loads(store.get(
+            P.telemetry_key("embedder")).rstrip(b"\0"))
+        g = rec["gauges"]
+        assert g["queue_depth"][-1][1] == 17.0   # whole lane
+        assert g["progress"][-1][1] == 12.0      # summed replicas
+        assert g["shed"][-1][1] == 3.0
+        assert g["replicas"][-1][1] == 2.0
+
+
+# ------------------------------------- supervisor replica scaling
+
+def _sleeper():
+    import subprocess
+    import sys
+
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"])
+    return spawn
+
+
+@pytest.mark.chaos
+class TestSupervisorScaling:
+    def test_lane_spec_replica_ceilings(self):
+        assert isinstance(LANES["embedder"], LaneSpec)
+        assert LANES["embedder"].max_replicas > 1
+        assert LANES["telemetry"].max_replicas == 1
+        assert LANES["autoscaler"].module == \
+            "libsplinter_tpu.engine.autoscaler"
+
+    def test_scale_up_spawns_and_stripes(self, store):
+        sup = Supervisor(store.name, lanes=("embedder",),
+                         spawn_fn=_sleeper(), store=store,
+                         scale={"embedder": (1, 4)},
+                         scale_knobs={"up_threshold": 4.0})
+        try:
+            # policy published for the controller
+            pol = P.read_scale_policy(store)
+            assert pol["lanes"]["embedder"] == {"min": 1, "max": 4}
+            assert pol["up_threshold"] == 4.0
+            P.write_scale_target(store, "embedder", 3, src="manual")
+            sup.poll_once()
+            assert sorted(sup.replicas["embedder"]) == [0, 1, 2]
+            for r, ln in sup.replicas["embedder"].items():
+                assert ln.pid and ln.replica == r
+            # scale-up phase 1: the new replicas are PENDING — the
+            # incumbents keep serving their planned shares (full
+            # coverage through the child startup; an attach that
+            # owned stripes could steal an incumbent's in-flight
+            # rows)
+            rec = P.read_stripe_map(store, "embedder")
+            assert set(rec["owners"]) == {"0"}
+            assert rec["closed"] == []
+            assert set(rec["pending"]) == {"1", "2"}
+            owned = {s for ss in rec["owners"].values() for s in ss}
+            assert owned == set(range(rec["width"]))   # no hole
+            # phase 2: heartbeats land -> promotion -> full cover
+            for r in (1, 2):
+                P.publish_heartbeat(
+                    store, P.replica_stats_key(P.KEY_EMBED_STATS, r),
+                    {"embedded": 0})
+            sup.poll_once()
+            rec = P.read_stripe_map(store, "embedder")
+            seen = sorted(s for ss in rec["owners"].values()
+                          for s in ss)
+            assert seen == list(range(rec["width"]))  # full cover
+            assert set(rec["owners"]) == {"0", "1", "2"}
+            assert rec["closed"] == []
+            snap = json.loads(store.get(
+                P.KEY_SUPERVISOR_STATS).rstrip(b"\0"))
+            assert snap["lanes"]["embedder"]["r"] == 3
+            assert "1" in snap["lanes"]["embedder"]["replicas"]
+            # a target past the bounds clamps
+            P.write_scale_target(store, "embedder", 99, src="manual")
+            sup.poll_once()
+            assert len(sup.replicas["embedder"]) == 4
+        finally:
+            sup.shutdown()
+
+    def test_scale_down_drain_then_reap(self, store):
+        sup = Supervisor(store.name, lanes=("embedder",),
+                         spawn_fn=_sleeper(), store=store,
+                         scale={"embedder": (1, 4)},
+                         drain_deadline_s=0.3)
+        try:
+            P.write_scale_target(store, "embedder", 3, src="manual")
+            sup.poll_once()
+            assert len(sup.replicas["embedder"]) == 3
+            for r in (1, 2):          # promote: first heartbeats
+                P.publish_heartbeat(
+                    store, P.replica_stats_key(P.KEY_EMBED_STATS, r),
+                    {"embedded": 0})
+            sup.poll_once()
+            rec = P.read_stripe_map(store, "embedder")
+            assert set(rec["owners"]) == {"0", "1", "2"}
+            P.write_scale_target(store, "embedder", 1, src="manual")
+            sup.poll_once()
+            # phase 1: both extra replicas draining, stripes CLOSED
+            retiring = [ln for ln in
+                        sup.replicas["embedder"].values()
+                        if ln.retiring]
+            assert len(retiring) == 2
+            rec = P.read_stripe_map(store, "embedder")
+            closed = set(rec["closed"])
+            assert closed                    # parked, owned by nobody
+            owned = {s for ss in rec["owners"].values() for s in ss}
+            assert owned | closed == set(range(rec["width"]))
+            assert not owned & closed
+            # sleeper children never exit on their own: the drain
+            # deadline reaps them
+            deadline = time.monotonic() + 10
+            while len(sup.replicas["embedder"]) > 1 \
+                    and time.monotonic() < deadline:
+                sup.poll_once()
+                time.sleep(0.05)
+            assert sorted(sup.replicas["embedder"]) == [0]
+            assert sup.retired == 2
+            # back to the single-replica default: map cleared
+            assert P.read_stripe_map(store, "embedder") is None
+        finally:
+            sup.shutdown()
+
+    def test_reclaim_strands_nothing_on_crash_mid_scale_down(
+            self, store):
+        """The chaos drill's core invariant at unit scale: a replica
+        crash-KILLED mid-scale-down (in-flight SERVICING row, drain
+        incomplete) still strands nothing — the supervisor's
+        straggler reclaim re-queues the row for the survivors."""
+        sup = Supervisor(store.name, lanes=("completer",),
+                         spawn_fn=_sleeper(), store=store,
+                         scale={"completer": (1, 4)},
+                         drain_deadline_s=5.0)
+        try:
+            P.write_scale_target(store, "completer", 2, src="manual")
+            sup.poll_once()
+            # promote r1 (its first heartbeat): the parked share
+            # becomes its own
+            P.publish_heartbeat(
+                store, P.replica_stats_key(P.KEY_COMPLETE_STATS, 1),
+                {"completions": 0})
+            sup.poll_once()
+            rec = P.read_stripe_map(store, "completer")
+            r1_stripes = set(rec["owners"]["1"])
+            # a request claimed (SERVICING) by replica 1, mid-stream
+            key = None
+            for i in range(64):
+                store.set(f"k{i}", "prompt")
+                idx = store.find_index(f"k{i}")
+                if P.stripe_of(idx, rec["width"]) in r1_stripes:
+                    key = f"k{i}"
+                    break
+                store.unset(f"k{i}")
+            assert key is not None
+            store.label_or(key, P.LBL_SERVICING)
+            # scale down; then crash-kill the RETIRING replica before
+            # it drains
+            P.write_scale_target(store, "completer", 1, src="manual")
+            sup.poll_once()
+            ln = next(ln for ln in sup.replicas["completer"].values()
+                      if ln.retiring)
+            ln.proc.kill()
+            deadline = time.monotonic() + 10
+            while len(sup.replicas["completer"]) > 1 \
+                    and time.monotonic() < deadline:
+                sup.poll_once()
+                time.sleep(0.05)
+            assert sorted(sup.replicas["completer"]) == [0]
+            labels = store.labels(key)
+            assert not labels & P.LBL_SERVICING
+            assert labels & P.LBL_INFER_REQ      # re-queued, not lost
+            assert labels & P.LBL_WAITING
+        finally:
+            sup.shutdown()
+
+    def test_retire_fault_site_live_and_survivable(self, store):
+        """`supervisor.retire` chaos reachability (splint SPL104):
+        the fault raises out of poll_once on its hit window — run()'s
+        step firewall is the production containment — and the next
+        step retires normally."""
+        from libsplinter_tpu.utils import faults
+
+        sup = Supervisor(store.name, lanes=("embedder",),
+                         spawn_fn=_sleeper(), store=store,
+                         scale={"embedder": (1, 3)},
+                         drain_deadline_s=0.1)
+        faults.arm("supervisor.retire:raise@1")
+        try:
+            P.write_scale_target(store, "embedder", 2, src="manual")
+            sup.poll_once()
+            assert len(sup.replicas["embedder"]) == 2
+            P.write_scale_target(store, "embedder", 1, src="manual")
+            with pytest.raises(faults.FaultInjected):
+                sup.poll_once()
+            sup.poll_once()              # window passed: retire runs
+            assert any(ln.retiring or ln.replica == 0
+                       for ln in sup.replicas["embedder"].values())
+            deadline = time.monotonic() + 10
+            while len(sup.replicas["embedder"]) > 1 \
+                    and time.monotonic() < deadline:
+                sup.poll_once()
+                time.sleep(0.05)
+            assert sorted(sup.replicas["embedder"]) == [0]
+        finally:
+            faults.disarm()
+            sup.shutdown()
+
+
+# ------------------------------------------------- the autoscaler
+
+_ring_ticks = iter(range(1, 1_000_000))
+
+
+def _ring(store, lane, queue_vals, shed_vals=None):
+    # every write is a FRESH sampler tick (distinct point ts): the
+    # controller's stale-sample guard refuses to re-count a point
+    base = float(next(_ring_ticks)) * 100.0
+    gauges = {"queue_depth": [[base + i, float(v)]
+                              for i, v in enumerate(queue_vals)]}
+    if shed_vals is not None:
+        gauges["shed"] = [[base + i, float(v)]
+                          for i, v in enumerate(shed_vals)]
+    store.set(P.telemetry_key(lane), json.dumps(
+        {"v": 1, "lane": lane, "interval_s": 0.1, "n": 1,
+         "ts": time.time(), "gauges": gauges}))
+
+
+def _policy(store, lane="embedder", lo=1, hi=4):
+    store.set(P.KEY_SCALE_POLICY, json.dumps(
+        {"v": 1, "lanes": {lane: {"min": lo, "max": hi}}}))
+
+
+def _sup_stats(store, lane="embedder", r=1):
+    P.publish_heartbeat(store, P.KEY_SUPERVISOR_STATS,
+                        {"polls": 1, "lanes": {lane: {
+                            "state": "running", "r": r}}})
+
+
+class TestAutoscaler:
+    def test_scale_up_sizes_to_backlog_in_one_action(self, store):
+        _policy(store)
+        _sup_stats(store, r=1)
+        ctl = AutoScaler(store, up_threshold=8.0, up_consecutive=2,
+                         cooldown_s=0.0)
+        _ring(store, "embedder", [32.0])
+        assert ctl.decide_once(0.0) == 0     # streak 1: not yet
+        _ring(store, "embedder", [32.0])     # a fresh sampler tick
+        assert ctl.decide_once(1.0) == 1     # sustained: act
+        tgt = P.read_scale_targets(store)["embedder"]
+        assert tgt["r"] == 4 and tgt["src"] == "auto"  # ceil(32/8)
+        assert ctl.stats.scale_ups == 1
+
+    def test_no_flap_on_oscillating_input(self, store):
+        """The hysteresis acceptance: a queue oscillating between
+        pressure and idle every sample never moves the target."""
+        _policy(store)
+        _sup_stats(store, r=2)
+        ctl = AutoScaler(store, up_threshold=8.0, down_threshold=1.0,
+                         up_consecutive=2, down_consecutive=3,
+                         cooldown_s=0.0)
+        for i in range(12):
+            _ring(store, "embedder",
+                  [40.0 if i % 2 == 0 else 0.0])
+            ctl.decide_once(float(i))
+        assert ctl.stats.decisions == 0
+        assert "embedder" not in P.read_scale_targets(store)
+
+    def test_scale_down_slow_with_cooldown(self, store):
+        _policy(store)
+        _sup_stats(store, r=3)
+        ctl = AutoScaler(store, up_threshold=8.0, down_threshold=1.0,
+                         down_consecutive=3, cooldown_s=100.0)
+        for i in range(8):
+            _ring(store, "embedder", [0.0])
+            ctl.decide_once(float(i))
+        # one step down only (by ONE replica), then cooldown holds
+        assert ctl.stats.scale_downs == 1
+        assert P.read_scale_targets(store)["embedder"]["r"] == 2
+
+    def test_stale_sample_never_recounted(self, store):
+        """A controller ticking FASTER than the sampler must not
+        turn one pressured telemetry point into a consecutive run —
+        the streaks pause until a fresh sample lands."""
+        _policy(store)
+        _sup_stats(store, r=1)
+        ctl = AutoScaler(store, up_threshold=8.0, up_consecutive=2,
+                         cooldown_s=0.0)
+        _ring(store, "embedder", [64.0])     # ONE pressured sample
+        for i in range(6):                   # re-read 6x: no action
+            assert ctl.decide_once(float(i)) == 0
+        assert ctl.stats.decisions == 0
+        _ring(store, "embedder", [64.0])     # the SECOND real sample
+        assert ctl.decide_once(7.0) == 1     # now it is sustained
+
+    def test_shed_movement_votes_up(self, store):
+        _policy(store)
+        _sup_stats(store, r=1)
+        ctl = AutoScaler(store, up_threshold=100.0,  # queue never
+                         up_consecutive=2, cooldown_s=0.0)
+        _ring(store, "embedder", [2.0], shed_vals=[0.0])
+        ctl.decide_once(0.0)
+        _ring(store, "embedder", [2.0], shed_vals=[5.0])
+        ctl.decide_once(1.0)
+        _ring(store, "embedder", [2.0], shed_vals=[9.0])
+        assert ctl.decide_once(2.0) == 1     # shed slope = overload
+        assert P.read_scale_targets(store)["embedder"]["r"] == 2
+
+    def test_manual_hold_respected(self, store):
+        _policy(store)
+        _sup_stats(store, r=1)
+        P.write_scale_target(store, "embedder", 2, src="manual")
+        ctl = AutoScaler(store, up_threshold=1.0, up_consecutive=1,
+                         cooldown_s=0.0)
+        _ring(store, "embedder", [50.0])
+        for i in range(3):
+            ctl.decide_once(float(i))
+        assert ctl.stats.holds == 3
+        assert P.read_scale_targets(store)["embedder"]["src"] == \
+            "manual"
+
+    def test_policy_floor_lifts_idle_lane(self, store):
+        _policy(store, lo=2, hi=4)
+        _sup_stats(store, r=1)
+        ctl = AutoScaler(store, cooldown_s=0.0)
+        _ring(store, "embedder", [0.0])
+        assert ctl.decide_once(0.0) == 1
+        assert P.read_scale_targets(store)["embedder"]["r"] == 2
+
+    def test_no_telemetry_no_action(self, store):
+        _policy(store)
+        _sup_stats(store, r=1)
+        ctl = AutoScaler(store)
+        assert ctl.decide_once(0.0) == 0
+        assert ctl.stats.no_data == 1
+
+    @pytest.mark.chaos
+    def test_decide_fault_site_live(self, store):
+        """`autoscaler.decide` chaos reachability (splint SPL104)."""
+        from libsplinter_tpu.utils import faults
+
+        _policy(store)
+        ctl = AutoScaler(store)
+        faults.arm("autoscaler.decide:raise@1")
+        try:
+            with pytest.raises(faults.FaultInjected):
+                ctl.decide_once(0.0)
+            ctl.decide_once(1.0)         # window passed: cycle runs
+        finally:
+            faults.disarm()
+
+    def test_heartbeat_and_scale_status(self, store, capsys):
+        _policy(store)
+        _sup_stats(store, r=1)
+        ctl = AutoScaler(store, up_threshold=8.0, up_consecutive=1,
+                         cooldown_s=0.0)
+        _ring(store, "embedder", [32.0])
+        ctl.attach()
+        ctl.decide_once(0.0)
+        ctl.publish_stats()
+        snap = json.loads(store.get(
+            P.KEY_AUTOSCALER_STATS).rstrip(b"\0"))
+        assert snap["lanes"]["embedder"]["target"] == 4
+        assert snap["history"]
+        from libsplinter_tpu.cli.main import COMMANDS, Session
+        ses = Session(store.name)
+        try:
+            COMMANDS["scale"][0](ses, ["status"])
+            out = capsys.readouterr().out
+            assert "embedder" in out and "1:4" in out
+            # manual override + clear
+            COMMANDS["scale"][0](ses, ["set", "embedder=2"])
+            tgt = P.read_scale_targets(store)["embedder"]
+            assert tgt["r"] == 2 and tgt["src"] == "manual"
+            COMMANDS["scale"][0](ses, ["set", "embedder=auto"])
+            assert "embedder" not in P.read_scale_targets(store)
+        finally:
+            ses.close()
+
+
+# --------------------------------------- replica operator surfaces
+
+class TestReplicaSurfaces:
+    def test_metrics_renders_replica_blocks(self, store, capsys):
+        P.publish_heartbeat(store, P.KEY_EMBED_STATS,
+                            {"embedded": 4, "replica": 0})
+        P.publish_heartbeat(store, f"{P.KEY_EMBED_STATS}.r1",
+                            {"embedded": 6, "replica": 1,
+                             "stripe": {"replica": 1, "epoch": 2,
+                                        "width": 16, "stripes": 8}})
+        from libsplinter_tpu.cli.main import COMMANDS, Session
+        ses = Session(store.name)
+        try:
+            COMMANDS["metrics"][0](ses, [])
+        finally:
+            ses.close()
+        out = capsys.readouterr().out
+        assert "sptpu_embedder_embedded 4" in out
+        assert "sptpu_embedder_r1_embedded 6" in out
+        assert "sptpu_embedder_r1_stripe_stripes 8" in out
+
+    def test_top_shows_replica_rows_and_dead_marker(self, store,
+                                                    capsys):
+        P.publish_heartbeat(store, P.KEY_EMBED_STATS, {"embedded": 4})
+        # a DEAD replica: pid that cannot exist
+        store.set(f"{P.KEY_EMBED_STATS}.r1", json.dumps(
+            {"ts": time.time(), "pid": 2 ** 22 + 12345,
+             "embedded": 6}))
+        store.label_or(f"{P.KEY_EMBED_STATS}.r1", P.LBL_DEBUG)
+        from libsplinter_tpu.cli.main import COMMANDS, Session
+        ses = Session(store.name)
+        try:
+            COMMANDS["top"][0](ses, ["--once"])
+        finally:
+            ses.close()
+        out = capsys.readouterr().out
+        assert "1/2up" in out                    # lane aggregate
+        assert "├r0" in out and "├r1" in out     # per-replica rows
+        assert "[DEAD" in out                    # not a stale merge
+        assert " 10 " in out or "10" in out      # summed progress
+
+    def test_health_lists_replicas(self, store, capsys):
+        P.publish_heartbeat(store, P.KEY_SEARCH_STATS, {"served": 1})
+        P.publish_heartbeat(store, f"{P.KEY_SEARCH_STATS}.r2",
+                            {"served": 2})
+        from libsplinter_tpu.cli.main import COMMANDS, Session
+        ses = Session(store.name)
+        try:
+            COMMANDS["health"][0](ses, [])
+        finally:
+            ses.close()
+        out = capsys.readouterr().out
+        assert "searcher.r2" in out
+
+
+# ------------------------------------------- loadgen rate profiles
+
+class TestRateProfile:
+    def test_parse(self):
+        from libsplinter_tpu.cli.loadgen import parse_rate_profile
+
+        assert parse_rate_profile("1x:10,8x:20,1x:10") == [
+            (1.0, 10.0), (8.0, 20.0), (1.0, 10.0)]
+        assert parse_rate_profile("2:5") == [(2.0, 5.0)]
+        for bad in ("", "1x", "x:5", "1x:0", "-1x:5"):
+            with pytest.raises(ValueError):
+                parse_rate_profile(bad)
+
+    def test_schedule_steps_rate_deterministically(self, store):
+        from libsplinter_tpu.cli.loadgen import (LoadGenerator,
+                                                 TenantSpec)
+
+        gen = LoadGenerator(
+            store, [TenantSpec(tenant=1, rate=10.0)],
+            arrivals="fixed", seed=7,
+            rate_profile=[(1.0, 1.0), (4.0, 1.0), (1.0, 1.0)])
+        assert gen.duration_s == 3.0
+        sched = gen._schedule()
+        by_phase: dict[int, int] = {}
+        for when, _t, phase in sched:
+            assert phase == gen._phase_at(when)
+            by_phase[phase] = by_phase.get(phase, 0) + 1
+        # fixed arrivals: ~10 in phase 0, ~40 in phase 1, ~10 in 2
+        assert 8 <= by_phase[0] <= 12
+        assert 35 <= by_phase[1] <= 44
+        assert 8 <= by_phase.get(2, 0) <= 12
+        # seeded determinism
+        gen2 = LoadGenerator(
+            store, [TenantSpec(tenant=1, rate=10.0)],
+            arrivals="fixed", seed=7,
+            rate_profile=[(1.0, 1.0), (4.0, 1.0), (1.0, 1.0)])
+        assert [w for w, _, _ in gen2._schedule()] == \
+            [w for w, _, _ in sched]
+
+    def test_report_carries_per_phase_sections(self, store):
+        """A short un-served run still reports per-phase issue
+        counts (everything lands unserved — no daemons)."""
+        from libsplinter_tpu.cli.loadgen import (LoadGenerator,
+                                                 TenantSpec)
+
+        gen = LoadGenerator(
+            store, [TenantSpec(tenant=1, rate=30.0)],
+            mix={"embed": 1.0}, arrivals="fixed", seed=1,
+            drain_s=0.1,
+            rate_profile=[(1.0, 0.3), (4.0, 0.3)])
+        rep = gen.run()
+        rows = rep["rate_profile"]
+        assert [r["phase"] for r in rows] == [0, 1]
+        assert rows[1]["issued"] > rows[0]["issued"] * 2
+        assert sum(r["issued"] for r in rows) == rep["issued"]
+
+
+# ---------------------------- the supervised full-stack chaos drill
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestSupervisedScaleDrill:
+    def test_scale_up_down_with_crash_kill_strands_nothing(
+            self, store):
+        """The tentpole's proof at full supervision: real pipeliner
+        children (jax-free — restarts cost ms) scale 1 -> 3 under
+        load, then back to 1 — and a replica is crash-KILLED mid-
+        scale-down while holding in-flight scripts.  The supervisor's
+        drain protocol + straggler reclaim must leave EVERY admitted
+        request with a terminal result: zero loss through scale-up
+        AND scale-down."""
+        import signal
+        import threading
+
+        sup = Supervisor(store.name, lanes=("pipeliner",),
+                         scale={"pipeliner": (1, 3)},
+                         drain_deadline_s=6.0,
+                         startup_grace_s=60, store=store)
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    sup.poll_once()
+                except Exception:
+                    pass
+                time.sleep(0.1)
+
+        th = threading.Thread(target=pump, daemon=True)
+        th.start()
+        submitted: list[str] = []
+        n = 0
+
+        def submit(count, sleep_s=0.02):
+            nonlocal n
+            for _ in range(count):
+                n += 1
+                key = f"job{n}"
+                store.set(key, json.dumps({
+                    "script": f"splinter.sleep({sleep_s}) "
+                              f"return {n}"}))
+                store.label_or(key, P.LBL_SCRIPT_REQ | P.LBL_WAITING)
+                store.bump(key)
+                submitted.append(key)
+
+        def live_replicas():
+            return [r for r, ln in sup.replicas["pipeliner"].items()
+                    if not ln.retiring and ln.pid
+                     and P.pid_alive(ln.pid)]
+
+        def wait_for(cond, timeout=30.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.1)
+            return False
+
+        try:
+            from libsplinter_tpu.engine.pipeliner import daemon_live
+            assert wait_for(lambda: daemon_live(store)), \
+                "replica 0 never came up"
+            submit(6)                         # 1x phase
+            # scale UP under load
+            P.write_scale_target(store, "pipeliner", 3, src="manual")
+            assert wait_for(lambda: len(live_replicas()) == 3), \
+                "scale-up never reached 3 replicas"
+            submit(36, sleep_s=0.05)          # 8x burst
+            time.sleep(0.4)                   # replicas mid-flight
+            # scale DOWN with work outstanding...
+            P.write_scale_target(store, "pipeliner", 1, src="manual")
+            assert wait_for(lambda: any(
+                ln.retiring for ln in
+                sup.replicas["pipeliner"].values()), 15), \
+                "no replica entered the drain protocol"
+            # ...and crash-kill one RETIRING replica mid-drain
+            victim = next(ln for ln in
+                          sup.replicas["pipeliner"].values()
+                          if ln.retiring)
+            os.kill(victim.pid, signal.SIGKILL)
+            submit(6)                         # back to 1x
+            assert wait_for(
+                lambda: sorted(sup.replicas["pipeliner"]) == [0],
+                40), "scale-down never converged to replica 0"
+            # ZERO admitted loss: every request reaches a terminal
+            # ok record (crash-stranded scripts re-run on replica 0
+            # — LBL_SCRIPT_REQ stays set through execution)
+            def all_done():
+                for k in submitted:
+                    if store.labels(k) & P.LBL_SCRIPT_REQ:
+                        return False
+                return True
+            assert wait_for(all_done, 60), "requests still pending"
+            lost = []
+            for k in submitted:
+                try:
+                    rec = json.loads(store.get(P.script_result_key(
+                        store.find_index(k))).rstrip(b"\0"))
+                except (KeyError, OSError, ValueError):
+                    lost.append(k)
+                    continue
+                if not rec.get("ok"):
+                    lost.append((k, rec))
+            assert not lost, f"admitted requests lost: {lost[:5]}"
+            # the books balance: supervisor retired both replicas
+            assert sup.retired == 2
+            assert P.read_stripe_map(store, "pipeliner") is None
+            # retired replicas take their suffixed heartbeat keys
+            # with them — `spt top` must not render [DEAD] ghosts
+            assert f"{P.KEY_SCRIPT_STATS}.r1" not in store
+            assert f"{P.KEY_SCRIPT_STATS}.r2" not in store
+            assert P.replica_heartbeat_keys(
+                store, P.KEY_SCRIPT_STATS) == [(0, P.KEY_SCRIPT_STATS)]
+        finally:
+            stop.set()
+            th.join(timeout=5)
+            sup.shutdown()
+
+
+# --------------------------------- mid-decode deadline aborts
+
+@pytest.mark.slow
+class TestMidDecodeDeadline:
+    def test_expired_row_killed_at_chunk_edge(self, tmp_path):
+        """A row whose deadline passes mid-decode is retired with the
+        typed DEADLINE_EXPIRED record, its pages return to the pool
+        immediately, and killed_mid_decode counts it — an expired row
+        must stop consuming pool and batch slots."""
+        import threading
+
+        import jax.numpy as jnp
+
+        from libsplinter_tpu.engine.completer import Completer
+        from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                    DecoderConfig)
+
+        name = f"/spt-mdk-{tmp_path.name}"
+        Store.unlink(name)
+        st = Store.create(name, nslots=128, max_val=4096, vec_dim=8)
+        try:
+            model = CompletionModel(
+                DecoderConfig.tiny(max_len=128, dtype=jnp.float32))
+            comp = Completer(st, model=model, max_new_tokens=110,
+                             flush_tokens=1, template="none",
+                             batch_cap=4, page_size=16)
+            comp.warmup_paged()       # no serve-time compiles: the
+            # deadline below must expire in DECODE, not in a compile
+            key, slow = "req-dl", "req-slow"
+            st.set(key, "a prompt that will outlive its deadline")
+            st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+            assert P.stamp_deadline(st, key, time.time() + 0.12)
+            st.bump(key)
+            st.set(slow, "sibling without a deadline")
+            st.label_or(slow, P.LBL_INFER_REQ | P.LBL_WAITING)
+            st.bump(slow)
+            th = threading.Thread(
+                target=comp.run_continuous,
+                kwargs=dict(idle_timeout_ms=20, stop_after=30.0),
+                daemon=True)
+            th.start()
+            deadline = time.time() + 25
+            while time.time() < deadline:
+                if st.labels(key) & P.LBL_READY \
+                        and st.labels(slow) & P.LBL_READY:
+                    break
+                time.sleep(0.05)
+            comp.stop()
+            th.join(timeout=30)
+            assert st.labels(key) & P.LBL_READY
+            rec = P.parse_error_payload(st.get(key))
+            assert rec is not None and rec["err"] == P.ERR_DEADLINE
+            assert comp.stats.killed_mid_decode >= 1
+            # the sibling (no deadline) streamed to completion
+            assert st.labels(slow) & P.LBL_READY
+            assert P.parse_error_payload(st.get(slow)) is None
+            # pages freed: nothing live once both rows closed
+            assert comp._paged_cache.used_pages == 0
+            comp.publish_stats()
+            snap = json.loads(st.get(
+                P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+            assert snap["killed_mid_decode"] >= 1
+        finally:
+            st.close()
+            Store.unlink(name)

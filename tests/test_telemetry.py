@@ -184,9 +184,10 @@ class TestSupervised:
     def test_registered_as_supervisable_lane(self):
         from libsplinter_tpu.engine.supervisor import LANES
 
-        module, hb = LANES["telemetry"]
-        assert module == "libsplinter_tpu.engine.telemetry"
-        assert hb == P.KEY_TELEMETRY_STATS
+        spec = LANES["telemetry"]
+        assert spec.module == "libsplinter_tpu.engine.telemetry"
+        assert spec.heartbeat_key == P.KEY_TELEMETRY_STATS
+        assert spec.max_replicas == 1    # the sampler never stripes
 
     @pytest.mark.slow
     def test_supervised_restart_keeps_rings(self, store):

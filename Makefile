@@ -100,6 +100,13 @@
 #                   pool-bytes gate (int8 == 1/2 bf16 == 1/4 f32,
 #                   measured from placed buffers;
 #                   scripts/quant_pool_bytes_check.py)
+#   make compile-check  device-time/compile-attribution tier (fast,
+#                   CPU): devtime registry + compile-ring unit tests,
+#                   then the post-warmup no-recompile gate over the
+#                   pod-sharded paged drill in both directions —
+#                   clean passes, a seeded out_shardings drop is
+#                   caught by program name + shapes key
+#                   (scripts/compile_gate_check.py)
 #   make clean
 #
 # Parity: the reference's `configure` + shim Makefile + bigbang.sh
@@ -138,6 +145,8 @@ check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/pipeline_latency_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/prefix_speedup_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/scale_step_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py --seed-recompile
 	$(PY) -m pytest tests/ -q -m "not chaos"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
@@ -195,6 +204,15 @@ trace-check: native
 		tests/test_telemetry.py -q -m "not slow and not chaos"
 	$(PY) scripts/obs_overhead_check.py
 
+# the post-warmup no-recompile gate (obs/devtime.py compile ledger)
+# over the pod-sharded paged drill, both directions: clean must pass,
+# the seeded out_shardings drop must be CAUGHT by name + shapes key
+compile-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_devtime.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py --seed-recompile
+
 pipeline-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pipeliner.py -q \
 		-m "not slow and not chaos"
@@ -213,4 +231,4 @@ clean:
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check prefix-check \
 	qos-check pipeline-check trace-check lint-check scale-check \
-	memcheck bench-cpu clean
+	compile-check memcheck bench-cpu clean

@@ -118,6 +118,17 @@ def append_ledger(rec: dict, *, stamp: bool = True) -> dict:
     except Exception:
         pass
     try:
+        # devtime attribution columns (PR 17): runtime-cause compile
+        # count so far (a non-zero here poisons the perf claim the
+        # same way armed faults do) and the device-ms share of wall —
+        # how much of this run the accelerator was actually working
+        from libsplinter_tpu.obs.devtime import DEVTIME
+        rec.setdefault("compile_events", DEVTIME.compile_events())
+        rec.setdefault("device_ms_share",
+                       round(DEVTIME.device_ms_share(), 4))
+    except Exception:
+        pass
+    try:
         with open(RESULTS_LOG, "a") as f:
             f.write(json.dumps(rec) + "\n")
             f.flush()

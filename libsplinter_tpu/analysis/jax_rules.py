@@ -315,6 +315,92 @@ def check_jit_out_shardings(ctx: Context) -> list[Finding]:
     return uniq
 
 
+# --- SPL205: unregistered hot-path program --------------------------------
+
+# the devtime attribution plane (obs/devtime.py) only sees programs
+# that were wrapped by DEVTIME.register(); these are the trees where
+# hot-path programs are built
+_SPL205_PREFIXES = ("libsplinter_tpu/models/", "libsplinter_tpu/ops/")
+
+
+def _mentions_devtime(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == "DEVTIME":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "register":
+            return True
+    return False
+
+
+def _jit_target(call: ast.Call) -> bool:
+    """True when `call` builds a jit program: `jax.jit(f, ...)` or the
+    `partial(jax.jit, ...)` decorator idiom."""
+    name = _dotted(call.func)
+    if name in ("jax.jit", "jit"):
+        return True
+    if name in ("functools.partial", "partial") and call.args and \
+            _dotted(call.args[0]) in ("jax.jit", "jit"):
+        return True
+    return False
+
+
+def _calls_with_scopes(stmt: ast.AST):
+    """Yield (call, enclosing-function-stack) for every Call under
+    `stmt`.  A call in a decorator_list counts as inside the function
+    it decorates — registering the decorated program covers it."""
+    def rec(node, stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node]
+        if isinstance(node, ast.Call):
+            yield node, stack
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child, stack)
+    yield from rec(stmt, [])
+
+
+@rule("SPL205", "dispatch", "hot-path program not registered with "
+      "the devtime plane",
+      "a `jax.jit` program (or module-level `pl.pallas_call`) built "
+      "under models/ or ops/ must pass through `DEVTIME.register()` "
+      "in an enclosing scope — unregistered programs are invisible "
+      "to the compile ledger, so the post-warmup no-recompile gate "
+      "cannot vouch for them")
+def check_unregistered_program(ctx: Context) -> list[Finding]:
+    out = []
+    for rel, sf in ctx.engine_files():
+        if not rel.startswith(_SPL205_PREFIXES):
+            continue
+        for stmt in sf.tree.body:
+            for call, stack in _calls_with_scopes(stmt):
+                name = _dotted(call.func)
+                if _jit_target(call):
+                    if any(_mentions_devtime(fn) for fn in stack):
+                        continue      # registered (or a register
+                    #                   helper) somewhere in scope
+                    if not stack and _mentions_devtime(stmt):
+                        continue      # module-level register idiom
+                    where = (f"in {stack[-1].name}()" if stack
+                             else "at module level")
+                    out.append(Finding(
+                        rel, call.lineno, "SPL205",
+                        f"jax.jit {where} is not wrapped by "
+                        f"DEVTIME.register() — the compile ledger "
+                        f"and device-time spans cannot attribute "
+                        f"this program"))
+                elif name.endswith("pallas_call") and not stack:
+                    # inside a function the kernel is an internal of
+                    # whatever jit program calls it; a module-level
+                    # pallas_call is a dispatchable program of its own
+                    out.append(Finding(
+                        rel, call.lineno, "SPL205",
+                        f"module-level pallas_call "
+                        f"({name or 'pallas_call'}) is not wrapped "
+                        f"by DEVTIME.register() — register the "
+                        f"program that dispatches it (or this one) "
+                        f"so compiles are attributed"))
+    return out
+
+
 # --- SPL204: unseeded randomness in fault paths ---------------------------
 
 

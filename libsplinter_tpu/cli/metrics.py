@@ -263,6 +263,33 @@ def cmd_metrics(ses, args):
                                    "(admitted / shed / "
                                    "deadline_expired / served_tokens "
                                    "— engine/qos.py TenantLedger)")
+        devtime = snap.pop("devtime", None)  # named-program device
+        if isinstance(devtime, dict):        # windows + compile ledger
+            for prog, row in devtime.items():
+                if not isinstance(row, dict):
+                    continue
+                lab_p = {"daemon": daemon, "program": str(prog)}
+                for field in ("n", "compiles", "runtime_compiles"):
+                    v = row.get(field)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        w.metric(f"sptpu_{daemon}_devtime_{field}",
+                                 v, lab_p, mtype="counter",
+                                 help_="named-program device windows "
+                                       "observed / compile events "
+                                       "(obs/devtime.py; "
+                                       "runtime_compiles must stay 0 "
+                                       "after warmup)")
+                for field in ("p50_ms", "p99_ms"):
+                    v = row.get(field)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        w.metric(f"sptpu_{daemon}_devtime_{field}",
+                                 v, lab_p,
+                                 help_="dispatch->collect wall "
+                                       "quantiles per named program "
+                                       "(ms; device window, zero new "
+                                       "host syncs)")
         flt = snap.pop("faults", None)  # armed SPTPU_FAULT accounting
         if isinstance(flt, dict):
             for site, counts in flt.items():
@@ -399,12 +426,16 @@ def _trace_export(ses, args) -> None:
             rest.append(a)
     tid = _parse_tid(rest[0]) if rest else None
     recs = S.collect_spans(ses.store, tid)
-    doc = S.to_chrome_trace(recs)
+    # compile events ride their own instant track beside the spans
+    from ..obs.devtime import collect_compile_events
+    compiles = collect_compile_events(ses.store)
+    doc = S.to_chrome_trace(recs, compile_events=compiles)
     body = json.dumps(doc, indent=1)
     if out_path:
         with open(out_path, "w") as f:
             f.write(body)
-        print(f"wrote {len(recs)} spans to {out_path} "
+        print(f"wrote {len(recs)} spans + {len(compiles)} compile "
+              f"events to {out_path} "
               "(load in ui.perfetto.dev or chrome://tracing)")
     else:
         print(body)

@@ -19,7 +19,8 @@ from .metrics import _read_json, sparkline
 # gauges whose ring history earns a sparkline column, in preference
 # order (first two that exist render; prefix_hits rides the completer
 # ring when the continuous lane's prefix cache is live)
-_SPARK_GAUGES = ("queue_depth", "prefix_hits", "p99_e2e_ms", "shed",
+_SPARK_GAUGES = ("queue_depth", "pool_mb", "prefix_hits",
+                 "p99_e2e_ms", "shed",
                  "progress")
 
 

@@ -39,6 +39,7 @@ from collections import deque
 from typing import Callable, Iterator
 
 from .. import _native as N
+from ..obs.devtime import DEVTIME
 from ..obs.recorder import FlightRecorder
 from ..obs.spans import SpanWriter
 from ..store import Store
@@ -262,6 +263,10 @@ class Completer:
         # in-flight work, with a hard cap against pathological leaks
         self._live_spans: dict[str, object] = {}
         self._trace_published = 0      # ring state last published
+        # HBM watermarks: pool-occupancy high-water sampled at chunk
+        # edges + heartbeats, reset only at attach (generation scope)
+        self._pages_used_peak = 0
+        self._pool_mb_peak = 0.0
         self.generation = 0            # bumped at attach (restart marker)
         self._bid = -1
         self._running = False
@@ -297,6 +302,9 @@ class Completer:
         else:
             st.bus_open()
         self.generation = P.bump_generation(st, self._hb_key)
+        # compile events ledgered from here carry this generation —
+        # a restart's re-warmup is distinguishable in the ring
+        DEVTIME.generation = max(DEVTIME.generation, self.generation)
         self._reclaim_stranded()
 
     def _reclaim_stranded(self) -> int:
@@ -687,6 +695,25 @@ class Completer:
         fault("completer.commit")
         st = self.store
         span = self._live_spans.pop(key, None)
+        # the request's device window (dispatch->collect wall across
+        # its decode chunks) — drain-scoped, SpanWriter.commit
+        device_ms = DEVTIME.take_lane_ms("completer")
+        if span is None and stages:
+            # tail-based retention: a slow request that carried no
+            # trace stamp still keeps full INFER_STAGES detail — one
+            # `tail: true` span, slow-log-resolvable by trace id
+            thr = self.recorder.slow_threshold_ms()
+            wall = sum(stages.values())
+            if thr is not None and wall > thr:
+                tid = self.spans.tail_span(
+                    key, wall, stages=stages,
+                    extra={"tokens": n_tok},
+                    device_ms=device_ms if device_ms > 0 else None)
+                if tid is not None:
+                    self.recorder.record(
+                        tid, key, wall,
+                        [[n, round(float(ms), 3)]
+                         for n, ms in stages.items()])
         if vanished:
             self.stats.vanished += 1
             self._debug(f"key {key!r} vanished mid-request")
@@ -709,7 +736,9 @@ class Completer:
             self.spans.commit(span, status="error", stages=stages)
             return
         self.spans.commit(span, stages=stages,
-                          extra={"tokens": n_tok})
+                          extra={"tokens": n_tok},
+                          device_ms=device_ms if device_ms > 0
+                          else None)
         self.stats.completions += 1
         self.stats.tokens += n_tok
         try:
@@ -1345,6 +1374,12 @@ class Completer:
             pend, live = entry
             tc0 = time.perf_counter()
             blk = pend.block()
+            # pool-occupancy high-water: chunk edges see the peak
+            # (prefills landed, nothing freed yet) — heartbeats alone
+            # would miss short bursts
+            used = cache.used_pages
+            if used > self._pages_used_peak:
+                self._pages_used_peak = used
             if tracer.enabled:
                 # collect = the host's blocked wait on the chunk; the
                 # decode span now measures only the (async) dispatch
@@ -1770,6 +1805,9 @@ class Completer:
             payload["pages_free"] = self._paged_cache.free_pages
             payload["pages_used"] = self._paged_cache.used_pages
             payload["live_tokens"] = self._paged_cache.live_tokens()
+            if self._paged_cache.used_pages > self._pages_used_peak:
+                self._pages_used_peak = self._paged_cache.used_pages
+            payload["pages_used_peak"] = self._pages_used_peak
         pc = self.prefix_cache
         if pc is not None:
             # prefix-cache gauges (sptpu_completer_prefix_* in `spt
@@ -1807,6 +1845,11 @@ class Completer:
                 payload["kv_dtype"] = kvd
             try:
                 payload["pool_mb"] = self._paged_cache.device_mb()
+                if payload["pool_mb"] > self._pool_mb_peak:
+                    self._pool_mb_peak = payload["pool_mb"]
+                # HBM high-water across pool swaps (abort recovery
+                # re-allocates; a restart resets with the generation)
+                payload["pool_mb_peak"] = round(self._pool_mb_peak, 3)
             except Exception:
                 pass
             if mesh is not None and int(mesh.shape.get("tp", 1)) > 1:
@@ -1816,6 +1859,11 @@ class Completer:
                     payload["pages_shard"] = shards
         if faults.armed():
             payload["faults"] = faults.stats()
+        payload["compile_events"] = DEVTIME.compile_events("completer")
+        devtime = DEVTIME.heartbeat_section("completer")
+        if devtime:
+            payload["devtime"] = devtime
+        DEVTIME.flush(self.store)
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "infer.")

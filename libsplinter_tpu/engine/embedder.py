@@ -38,6 +38,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from .. import _native as N
+from ..obs.devtime import DEVTIME
 from ..obs.recorder import FlightRecorder
 from ..obs.spans import SpanWriter
 from ..store import Store
@@ -324,6 +325,9 @@ class Embedder:
         else:
             st.bus_open()
         self.generation = P.bump_generation(st, self._hb_key)
+        # compile events ledgered from here carry this generation —
+        # a restart's re-warmup is distinguishable in the ring
+        DEVTIME.generation = max(DEVTIME.generation, self.generation)
         self._baseline_existing()
         # cold start: pre-existing requests enter the pending set once
         # (reference drains pre-existing WAITING keys on startup,
@@ -871,8 +875,13 @@ class Embedder:
         spans, self._live_spans = self._live_spans, []
         stage_map = ({s: acc[s] for s in P.PIPELINE_STAGES}
                      if acc is not None else None)
-        for span in spans:
-            self.spans.commit(span, stages=stage_map)
+        # the drain's device window (dispatch->collect wall across all
+        # its encode programs) rides the FIRST committed span —
+        # drain-scoped attribution, see SpanWriter.commit
+        device_ms = DEVTIME.take_lane_ms("embedder")
+        for i, span in enumerate(spans):
+            self.spans.commit(span, stages=stage_map,
+                              device_ms=device_ms if i == 0 else None)
         if acc is None:
             return
         # e2e records for EVERY traced drain (not just stamped ones):
@@ -881,6 +890,22 @@ class Embedder:
         # comparing different workloads
         stage_sum = sum(acc.values())
         tracer.record("embed.e2e", stage_sum)
+        if not spans:
+            # tail-based retention: a drain past the slow threshold
+            # whose requests carried no trace stamp still keeps full
+            # stage detail — one synthesized `tail: true` span, and a
+            # recorder entry under the same trace id so the slow log
+            # resolves via `spt trace show`
+            thr = self.recorder.slow_threshold_ms()
+            if thr is not None and stage_sum > thr:
+                tid = self.spans.tail_span(
+                    "<drain>", stage_sum, stages=stage_map,
+                    device_ms=device_ms if device_ms > 0 else None)
+                if tid is not None:
+                    self.recorder.record(
+                        tid, "<drain>", stage_sum,
+                        [[s, round(acc[s], 3)]
+                         for s in P.PIPELINE_STAGES])
         if not traced:
             return
         now_wall = time.time()
@@ -1136,6 +1161,15 @@ class Embedder:
         model = getattr(self, "_model", None)
         if model is not None and hasattr(model, "compile_count"):
             payload["compile_count"] = model.compile_count()
+        # device-time & compile attribution: runtime-cause compile
+        # count (must stay 0 after warmup) + per-program device
+        # quantiles; the buffered ledger lands in the __compile_<i>
+        # ring on the same cadence
+        payload["compile_events"] = DEVTIME.compile_events("embedder")
+        devtime = DEVTIME.heartbeat_section("embedder")
+        if devtime:
+            payload["devtime"] = devtime
+        DEVTIME.flush(self.store)
         for k in ("device_wait_ms", "overlap_ms", "commit_host_ms"):
             payload[k] = round(payload[k], 3)
         if tracer.enabled:

@@ -52,6 +52,7 @@ import time
 
 from .. import _native as N
 from ..obs.recorder import FlightRecorder
+from ..obs.devtime import DEVTIME
 from ..obs.spans import SpanWriter, sweep_span_stages
 from ..scripting.microlua import LuaCoroutine, LuaError, LuaTable
 from ..scripting.sandbox import (KILL_BUDGET, KILL_DEADLINE,
@@ -874,6 +875,10 @@ class Pipeliner:
             payload, bool(self.qos.high_water is not None or tenants))
         if faults.armed():
             payload["faults"] = faults.stats()
+        # the pipeliner dispatches no jitted programs of its own, but
+        # in-process co-located lanes may have buffered ledger events
+        # — flush on the same heartbeat cadence as every other lane
+        DEVTIME.flush(self.store)
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "script.")

@@ -281,6 +281,17 @@ SPAN_STAGE_PREFIX = "__sp_"
 SPAN_RING_PREFIX = "__span_"
 KEY_SPAN_HEAD = "__span_head"
 
+# the compile-event ring (obs/devtime.py): the named-program
+# registry's ledger of jit compile events — {program, lane,
+# shapes_key, duration_ms, generation, cause} records land in
+# compile_ring_key(head % ring size) slots under the span ring's
+# slot-claim discipline (atomic BIGUINT head, bounded by
+# construction).  `spt trace export` hangs these on their own
+# Perfetto track; scripts/compile_gate_check.py asserts the ring
+# holds zero runtime-cause events after warmup.
+COMPILE_RING_PREFIX = "__compile_"
+KEY_COMPILE_HEAD = "__compile_head"
+
 # telemetry-history rings (engine/telemetry.py): one per scraped
 # lane, fixed-size time series of the lane's heartbeat gauges —
 # the signal plane the elastic-lane scaling controller reads
@@ -335,6 +346,10 @@ def span_stage_key(idx: int) -> str:
 
 def span_ring_key(i: int) -> str:
     return f"{SPAN_RING_PREFIX}{i}"
+
+
+def compile_ring_key(i: int) -> str:
+    return f"{COMPILE_RING_PREFIX}{i}"
 
 
 def telemetry_key(lane: str) -> str:

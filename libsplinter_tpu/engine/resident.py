@@ -189,16 +189,19 @@ class RingResult:
     re-dispatch, not a failed drain."""
 
     __slots__ = ("_out", "_host", "_release", "_convert", "_retry",
-                 "_err", "n_valid")
+                 "_err", "_mark", "n_valid")
 
     def __init__(self, out, n_valid: int, *, release=None,
-                 convert=_wire_to_f32, retry=None):
+                 convert=_wire_to_f32, retry=None, mark=None):
         self._out = out
         self._host: np.ndarray | None = None
         self._release = release
         self._convert = convert
         self._retry = retry           # (slot_i, n) -> (n, ...) f32
         self._err: Exception | None = None
+        self._mark = mark             # devtime DispatchMark: closed at
+        # the fetch — the collect point that already exists, so the
+        # device window costs no new host sync
         self.n_valid = n_valid
 
     def is_ready(self) -> bool:
@@ -223,6 +226,9 @@ class RingResult:
                 self._release = None
                 raise
             self._host = host
+            mark, self._mark = self._mark, None
+            if mark is not None:
+                mark.close()
             out, self._out = self._out, None
             rel, self._release = self._release, None
             if rel is not None:

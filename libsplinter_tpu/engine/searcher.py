@@ -49,6 +49,7 @@ from typing import Sequence
 import numpy as np
 
 from .. import _native as N
+from ..obs.devtime import DEVTIME
 from ..obs.recorder import FlightRecorder
 from ..obs.spans import SpanWriter, sweep_span_stages
 from ..store import Store
@@ -259,6 +260,9 @@ class Searcher:
         else:
             st.bus_open()
         self.generation = P.bump_generation(st, self._hb_key)
+        # compile events ledgered from here carry this generation —
+        # a restart's re-warmup is distinguishable in the ring
+        DEVTIME.generation = max(DEVTIME.generation, self.generation)
 
     def warmup(self, ks: Sequence[int] = (10, 64)) -> None:
         """Pre-compile the QB-bucketed top-k programs against the live
@@ -271,18 +275,21 @@ class Searcher:
         serving request ever hits.  The defaults cover the CLI's
         limit-10 fetch (bucket 64 -> k_fetch 128) and direct k<=12
         API requests (k_fetch 16)."""
-        arr = self.lane.refresh()
-        d = self.store.vec_dim
-        mask = np.ones(self.store.nslots, np.float32)
-        for k in ks:
-            k_fetch = min(_k_bucket(k + K_CUSHION), self.store.nslots)
-            # both precision variants: a --fast client's first request
-            # must not stall a whole coalesced drain on a fresh compile
-            for fast in (False, True):
-                fn = self._program(k_fetch, mxu_bf16=fast)
-                for qb in QB_BUCKETS:
-                    fn(arr, np.zeros((qb, d), np.float32), mask,
-                       self.lane.norms)
+        with DEVTIME.warmup_phase():
+            arr = self.lane.refresh()
+            d = self.store.vec_dim
+            mask = np.ones(self.store.nslots, np.float32)
+            for k in ks:
+                k_fetch = min(_k_bucket(k + K_CUSHION),
+                              self.store.nslots)
+                # both precision variants: a --fast client's first
+                # request must not stall a whole coalesced drain on a
+                # fresh compile
+                for fast in (False, True):
+                    fn = self._program(k_fetch, mxu_bf16=fast)
+                    for qb in QB_BUCKETS:
+                        fn(arr, np.zeros((qb, d), np.float32), mask,
+                           self.lane.norms)
 
     def _program(self, k_fetch: int, mxu_bf16: bool = False):
         from ..ops.similarity import topk_program
@@ -774,15 +781,36 @@ class Searcher:
         acc, self._stage_acc = self._stage_acc, None
         stage_map = ({s: acc[s] for s in P.SEARCH_STAGES}
                      if acc is not None else None)
+        # the drain's device window rides the first committed span
+        # (drain-scoped attribution, SpanWriter.commit)
+        device_ms = DEVTIME.take_lane_ms("searcher")
+        committed = 0
         # span commits run whether or not the histogram tracer is on:
         # span capture is always-on, bounded by head sampling
         for r in reqs:
             if r.span is not None:
-                self.spans.commit(r.span, stages=stage_map)
+                self.spans.commit(
+                    r.span, stages=stage_map,
+                    device_ms=device_ms if committed == 0 else None)
+                committed += 1
         if acc is None:
             return
         stage_sum = sum(acc.values())
         tracer.record("search.e2e", stage_sum)
+        if not committed:
+            # tail-based retention: slow unstamped drains keep full
+            # SEARCH_STAGES detail (one `tail: true` span + a slow-log
+            # entry resolvable via `spt trace show`)
+            thr = self.recorder.slow_threshold_ms()
+            if thr is not None and stage_sum > thr:
+                tid = self.spans.tail_span(
+                    "<drain>", stage_sum, stages=stage_map,
+                    device_ms=device_ms if device_ms > 0 else None)
+                if tid is not None:
+                    self.recorder.record(
+                        tid, "<drain>", stage_sum,
+                        [[s, round(acc[s], 3)]
+                         for s in P.SEARCH_STAGES])
         now_wall = time.time()
         events = [[s, round(acc[s], 3)] for s in P.SEARCH_STAGES]
         for r in reqs:
@@ -895,6 +923,11 @@ class Searcher:
                           or tenants))
         if faults.armed():
             payload["faults"] = faults.stats()
+        payload["compile_events"] = DEVTIME.compile_events("searcher")
+        devtime = DEVTIME.heartbeat_section("searcher")
+        if devtime:
+            payload["devtime"] = devtime
+        DEVTIME.flush(self.store)
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
                                     "search.")

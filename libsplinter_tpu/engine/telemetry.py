@@ -57,7 +57,11 @@ PROGRESS_FIELDS = {"embedder": "embedded",
                    "searcher": "served",
                    "pipeliner": "scripts_completed"}
 _EXTRA = {"completer": ("pages_free", "tokens", "prefix_hits",
-                        "prefix_shared_pages"),
+                        "prefix_shared_pages", "pool_mb",
+                        "pool_mb_peak", "pages_used_peak",
+                        "compile_events"),
+          "embedder": ("compile_count", "compile_events"),
+          "searcher": ("compile_events",),
           "pipeliner": ("scripts_active",)}
 
 DEFAULT_INTERVAL_S = 2.0

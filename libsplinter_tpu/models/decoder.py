@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any
 
 import jax
@@ -31,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from ..obs.devtime import DEVTIME
 from .encoder import _apply_rotary, _rotary_angles  # shared rotary math
 
 
@@ -169,6 +171,7 @@ def _sharded_zeros_prog(shape, dtype, sharding):
     continuous lane rebuilds its pool on abort recovery, and a fresh
     jit wrapper per construction would retrace the (trivial) program
     on that hot path."""
+    # splint: ignore[SPL205] reason=cold-path pool creation (abort recovery), not a serving dispatch
     return jax.jit(lambda: jnp.zeros(shape, dtype),
                    out_shardings=sharding)
 
@@ -484,12 +487,14 @@ class PendingChunk:
     transfer per chunk) and transposes to the (batch, n) shape the
     sync path returns."""
 
-    __slots__ = ("_out", "last", "n")
+    __slots__ = ("_out", "last", "n", "_mark")
 
-    def __init__(self, out, last, n: int):
+    def __init__(self, out, last, n: int, mark=None):
         self._out = out
         self.last = last
         self.n = n
+        self._mark = mark             # devtime DispatchMark: closed at
+        # block() — the collect point that already exists
 
     def is_ready(self) -> bool:
         try:
@@ -498,7 +503,11 @@ class PendingChunk:
             return True
 
     def block(self) -> np.ndarray:
-        return np.asarray(self._out).T                 # (batch, n)
+        host = np.asarray(self._out).T                 # (batch, n)
+        mark, self._mark = self._mark, None
+        if mark is not None:
+            mark.close()
+        return host
 
 
 class RMSNorm(nn.Module):
@@ -744,11 +753,16 @@ def _sample_graph(rng, logits, top_p: float, temp: float):
     return order[choice].astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("top_p", "temp"))
-def sample_top_p(rng, logits, *, top_p: float = 0.9, temp: float = 0.7):
+def _sample_top_p_impl(rng, logits, *, top_p: float = 0.9,
+                       temp: float = 0.7):
     """The reference's sampler chain (splainference.cpp:272-279),
     jit-compiled for one-off host-side sampling."""
     return _sample_graph(rng, logits, top_p, temp)
+
+
+sample_top_p = DEVTIME.register(
+    "completer.sample",
+    jax.jit(_sample_top_p_impl, static_argnames=("top_p", "temp")))
 
 
 def _sample_rows(rng, logits, top_p: float, temp: float):
@@ -760,12 +774,17 @@ def _sample_rows(rng, logits, top_p: float, temp: float):
         subs, logits)
 
 
-@functools.partial(jax.jit, static_argnames=("top_p", "temp"))
-def sample_top_p_batch(rng, logits, *, top_p: float = 0.9,
-                       temp: float = 0.7):
+def _sample_top_p_batch_impl(rng, logits, *, top_p: float = 0.9,
+                             temp: float = 0.7):
     """Batched sampler: logits (B, V) -> (B,) ids in ONE dispatch
     (B separate sample_top_p calls would pay B device round trips)."""
     return _sample_rows(rng, logits, top_p, temp)
+
+
+sample_top_p_batch = DEVTIME.register(
+    "completer.sample_batch",
+    jax.jit(_sample_top_p_batch_impl,
+            static_argnames=("top_p", "temp")))
 
 
 # ------------------------------------------------------------- front end
@@ -839,7 +858,8 @@ class CompletionModel:
                 jnp.zeros((1, self.buckets[0]), jnp.int32), cache,
                 jnp.int32(0))
         self.params = params
-        self._fn = jax.jit(self.module.apply)
+        self._fn = DEVTIME.register("completer.trunk",
+                                    jax.jit(self.module.apply))
         self._rng = jax.random.PRNGKey(seed + 1)
         self._cache = None
         self._pos = 0
@@ -946,7 +966,8 @@ class CompletionModel:
                     step, (cache, pos, rng, toks), None, length=n)
                 return cache, out                  # out: (n, bp)
 
-            fn = jax.jit(run, donate_argnums=(1,))
+            fn = DEVTIME.register("completer.chunk",
+                                  jax.jit(run, donate_argnums=(1,)))
             self._chunk_progs[key] = fn
             # bound the cache: per-request sampler settings must not
             # retain every stale compiled program for process lifetime —
@@ -1101,7 +1122,8 @@ class CompletionModel:
                     for (bk, bv), (rk, rv) in zip(batch_cache, row_cache)]
                 return new_cache, logits[0, b - 1]
 
-            fn = jax.jit(run, donate_argnums=(1,))
+            fn = DEVTIME.register("completer.join",
+                                  jax.jit(run, donate_argnums=(1,)))
             self._join_progs[b] = fn
         return fn
 
@@ -1184,6 +1206,12 @@ class CompletionModel:
         without it the first serve-time call after warmup sees
         GSPMD-chosen output shardings that hash differently from the
         explicitly placed fresh pools and silently recompiles."""
+        # seeded-recompile drill (scripts/compile_gate_check.py
+        # --seed-recompile): dropping the pin reproduces the exact
+        # PR 8 failure class the compile ledger exists to catch — the
+        # gate must then FAIL naming the program and its shapes key
+        if os.environ.get("SPTPU_SEED_RECOMPILE") == "1":
+            return None
         sh = self._pool_sharding()
         if sh is None:
             return None
@@ -1275,7 +1303,9 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(
                     2, 0, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw)
+                fn = DEVTIME.register(
+                    "completer.paged_commit",
+                    jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw))
             else:
                 def run(k_pools, v_pools, dense, bids):
                     outk, outv = [], []
@@ -1287,7 +1317,9 @@ class CompletionModel:
 
                 out_sh = self._paged_pool_out_shardings(2, 0)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(0, 1), **kw)
+                fn = DEVTIME.register(
+                    "completer.paged_commit",
+                    jax.jit(run, donate_argnums=(0, 1), **kw))
             self._paged_progs[key] = fn
         return fn
 
@@ -1378,7 +1410,9 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(
                     2, 1, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw)
+                fn = DEVTIME.register(
+                    "completer.suffix_prefill",
+                    jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw))
             else:
                 def run(params, k_pools, v_pools, table, length, ids,
                         n_valid):
@@ -1392,7 +1426,9 @@ class CompletionModel:
 
                 out_sh = self._paged_pool_out_shardings(2, 1)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(1, 2), **kw)
+                fn = DEVTIME.register(
+                    "completer.suffix_prefill",
+                    jax.jit(run, donate_argnums=(1, 2), **kw))
             self._paged_progs[key] = fn
         return fn
 
@@ -1461,7 +1497,9 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(
                     2, 0, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw)
+                fn = DEVTIME.register(
+                    "completer.cow_copy",
+                    jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw))
             else:
                 def run(k_pools, v_pools, src, dst):
                     return ([p.at[dst].set(p[src]) for p in k_pools],
@@ -1469,7 +1507,9 @@ class CompletionModel:
 
                 out_sh = self._paged_pool_out_shardings(2, 0)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(0, 1), **kw)
+                fn = DEVTIME.register(
+                    "completer.cow_copy",
+                    jax.jit(run, donate_argnums=(0, 1), **kw))
             self._paged_progs[key] = fn
         return fn
 
@@ -1560,7 +1600,9 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(
                     2, 2, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw)
+                fn = DEVTIME.register(
+                    "completer.paged_chunk",
+                    jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw))
             else:
                 def run(params, k_pools, v_pools, tables, lengths, rng,
                         fresh, fresh_mask, carry):
@@ -1587,7 +1629,9 @@ class CompletionModel:
 
                 out_sh = self._paged_pool_out_shardings(2, 2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
-                fn = jax.jit(run, donate_argnums=(1, 2), **kw)
+                fn = DEVTIME.register(
+                    "completer.paged_chunk",
+                    jax.jit(run, donate_argnums=(1, 2), **kw))
             self._paged_progs[key] = fn
             if len(self._paged_progs) > 24:
                 cur = (self.top_p, self.temp)
@@ -1666,7 +1710,9 @@ class CompletionModel:
         live = cache.lengths > 0
         cache.lengths[live] = np.minimum(cache.lengths[live] + n,
                                          self.cfg.max_len)
-        return PendingChunk(out, last, n)
+        return PendingChunk(out, last, n,
+                            mark=DEVTIME.take_mark(
+                                "completer.paged_chunk"))
 
     def warmup_paged(self, cache: PagedKVCache, chunk: int = 8,
                      max_prompt: int | None = None) -> None:
@@ -1680,6 +1726,11 @@ class CompletionModel:
         bucket above bucket_for(max_prompt), so warming the ones past
         it — including the max_len bucket, the slowest compile —
         would only inflate startup for dead programs."""
+        with DEVTIME.warmup_phase():
+            self._warmup_paged_impl(cache, chunk, max_prompt)
+
+    def _warmup_paged_impl(self, cache: PagedKVCache, chunk: int,
+                           max_prompt: int | None) -> None:
         chunk_done = False
         cap = (self.bucket_for(max_prompt) if max_prompt is not None
                else self.buckets[-1])
@@ -1734,6 +1785,7 @@ class CompletionModel:
                + list(self._paged_progs.values()))
         total = 0
         for f in fns:
+            f = getattr(f, "__wrapped__", f)   # devtime wrapper
             try:
                 total += int(f._cache_size())
             except Exception:   # private jax API: absence isn't an error
@@ -1772,6 +1824,10 @@ class CompletionModel:
         decode program; batch > 1 additionally compiles the batched
         serving shapes (prefill_batch + batched chunk program) under
         the same window guard."""
+        with DEVTIME.warmup_phase():
+            self._warmup_impl(chunk, batch)
+
+    def _warmup_impl(self, chunk: int, batch: int) -> None:
         for b in self.buckets:
             self.prefill(np.ones((max(1, b - 1),), np.int32))
             self.decode_one(1)

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from ..obs.devtime import DEVTIME
+
 
 @dataclasses.dataclass(frozen=True)
 class EncoderConfig:
@@ -225,11 +227,13 @@ class PendingEmbeddings:
     host does other work; materialize() blocks for the result.  The
     batch may have been padded — only the first `n` rows are real."""
 
-    __slots__ = ("_out", "n")
+    __slots__ = ("_out", "n", "_mark")
 
-    def __init__(self, out, n: int):
+    def __init__(self, out, n: int, mark=None):
         self._out = out
         self.n = n
+        self._mark = mark             # devtime DispatchMark: closed at
+        # materialize — the collect point that already exists
 
     def is_ready(self) -> bool:
         """True when materialize() will not block: the device compute
@@ -254,7 +258,11 @@ class PendingEmbeddings:
         # ring slot views apply the identical conversion).
         from ..engine.resident import _wire_to_f32
 
-        return _wire_to_f32(np.asarray(self._out)[: self.n])
+        host = _wire_to_f32(np.asarray(self._out)[: self.n])
+        mark, self._mark = self._mark, None
+        if mark is not None:
+            mark.close()
+        return host
 
 
 def _batch_pad(n: int) -> int:
@@ -335,7 +343,7 @@ class EmbeddingModel:
 
         self._fwd = fwd               # the ring program re-traces THIS
         self._wire = wire             # (same graph -> same numerics)
-        self._fn = jax.jit(fwd)
+        self._fn = DEVTIME.register("embedder.encode", jax.jit(fwd))
         self._ring_fn = None          # resident multi-batch program
         self._ring_pool: dict = {}    # (depth, B) -> spare out buffers
 
@@ -349,9 +357,12 @@ class EmbeddingModel:
         geometry escapes the bucket set and is paying jit compiles on
         the wake path."""
         try:
-            n = int(self._fn._cache_size())
+            fn = getattr(self._fn, "__wrapped__", self._fn)
+            n = int(fn._cache_size())
             if self._ring_fn is not None:
-                n += int(self._ring_fn._cache_size())
+                rf = getattr(self._ring_fn, "__wrapped__",
+                             self._ring_fn)
+                n += int(rf._cache_size())
             return n
         except Exception:      # private jax API: absence is not an error
             return -1
@@ -384,7 +395,9 @@ class EmbeddingModel:
                 [lengths, np.zeros(bpad - n, lengths.dtype)])
         out = self._fn(self.params, jnp.asarray(token_ids),
                        jnp.asarray(lengths.astype(np.int32)))
-        return PendingEmbeddings(out, n)
+        return PendingEmbeddings(out, n,
+                                 mark=DEVTIME.take_mark(
+                                     "embedder.encode"))
 
     def encode_ids(self, token_ids: np.ndarray,
                    lengths: np.ndarray) -> np.ndarray:
@@ -418,7 +431,8 @@ class EmbeddingModel:
                     lambda c: c[0] < n, body, (jnp.int32(0), out_ring))
                 return acc
 
-            self._ring_fn = jax.jit(run, donate_argnums=(4,))
+            self._ring_fn = DEVTIME.register(
+                "embedder.ring", jax.jit(run, donate_argnums=(4,)))
         return self._ring_fn
 
     def encode_ring_async(self, ids_ring: np.ndarray,
@@ -449,7 +463,8 @@ class EmbeddingModel:
             jnp.asarray(lens_ring.astype(np.int32)),
             jnp.int32(n_valid), out)
         return RingResult(res, n_valid, release=pool.append,
-                          retry=retry)
+                          retry=retry,
+                          mark=DEVTIME.take_mark("embedder.ring"))
 
     def warmup_ring(self, depth: int, batch: int,
                     buckets: tuple[int, ...] | None = None) -> None:
@@ -461,19 +476,21 @@ class EmbeddingModel:
         if depth <= 1:
             return
         bpad = _batch_pad(batch)
-        for b in buckets or self.buckets:
-            ids = np.zeros((depth, bpad, b), np.int32)
-            lens = np.zeros((depth, bpad), np.int32)
-            lens[0, :] = b
-            self.encode_ring_async(ids, lens, 1).materialize_host()
+        with DEVTIME.warmup_phase():
+            for b in buckets or self.buckets:
+                ids = np.zeros((depth, bpad, b), np.int32)
+                lens = np.zeros((depth, bpad), np.int32)
+                lens[0, :] = b
+                self.encode_ring_async(ids, lens, 1).materialize_host()
 
     def warmup(self, batch_sizes: tuple[int, ...] = (8,)) -> None:
         """Pre-compile each (batch, bucket) program off the hot path."""
-        for bsz in batch_sizes:
-            for b in self.buckets:
-                ids = np.zeros((bsz, b), np.int32)
-                lens = np.full((bsz,), b, np.int32)
-                self.encode_ids(ids, lens)
+        with DEVTIME.warmup_phase():
+            for bsz in batch_sizes:
+                for b in self.buckets:
+                    ids = np.zeros((bsz, b), np.int32)
+                    lens = np.full((bsz,), b, np.int32)
+                    self.encode_ids(ids, lens)
 
 
 def read_safetensors_f32(path: str) -> dict[str, np.ndarray]:

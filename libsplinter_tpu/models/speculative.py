@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.devtime import DEVTIME
 from .decoder import CompletionModel, Decoder, _nucleus_logits
 
 
@@ -392,7 +393,8 @@ class SpeculativeCompletionModel:
             out = jnp.where(idx == n_acc, final, out)
             return tcache, dcache, rng, out, n_acc + 1
 
-        fn = jax.jit(run, donate_argnums=(2, 3))
+        fn = DEVTIME.register("completer.spec_step",
+                              jax.jit(run, donate_argnums=(2, 3)))
         self._progs[key] = fn
         if len(self._progs) > 8:
             cur = (self.target.top_p, self.target.temp)
@@ -508,7 +510,8 @@ class SpeculativeCompletionModel:
             return (unzip_cache(tcache), unzip_cache(dcache), out,
                     n_valid)
 
-        fn = jax.jit(run, donate_argnums=(2, 3))
+        fn = DEVTIME.register("completer.spec_paged_step",
+                              jax.jit(run, donate_argnums=(2, 3)))
         self._progs[key] = fn
         if len(self._progs) > 8:
             cur = (self.target.top_p, self.target.temp)
@@ -574,7 +577,11 @@ class SpeculativeCompletionModel:
             sub, jnp.asarray(col, jnp.int32))
         self._store_pools(cache.target, t_pools)
         self._store_pools(cache.draft, d_pools)
-        return np.asarray(out), np.asarray(n_valid)
+        host = np.asarray(out), np.asarray(n_valid)
+        mark = DEVTIME.take_mark("completer.spec_paged_step")
+        if mark is not None:
+            mark.close()    # np.asarray above IS the collect point
+        return host
 
     def _plain_step(self, cache: SpecPagedCache, col: np.ndarray,
                     freeze: list[int]):
@@ -686,6 +693,11 @@ class SpeculativeCompletionModel:
         window-edge fallback) AND the fused spec step, against the
         SAME pool geometry run_continuous will serve with —
         compile_count stays flat across join/finish/join cycles."""
+        with DEVTIME.warmup_phase():
+            self._warmup_paged_spec(cache, chunk, max_prompt)
+
+    def _warmup_paged_spec(self, cache: SpecPagedCache, chunk: int,
+                           max_prompt: int | None) -> None:
         self.target.warmup_paged(cache.target, chunk=chunk,
                                  max_prompt=max_prompt)
         self.draft.warmup_paged(cache.draft, chunk=chunk,
@@ -721,6 +733,7 @@ class SpeculativeCompletionModel:
             return -1
         total = t + d
         for f in self._progs.values():
+            f = getattr(f, "__wrapped__", f)   # devtime wrapper
             try:
                 total += int(f._cache_size())
             except Exception:
@@ -760,6 +773,9 @@ class SpeculativeCompletionModel:
                 jnp.int32(t._pos), sub, jnp.int32(int(tok)))
             out = np.asarray(out)
             n_valid = int(n_valid)
+            mark = DEVTIME.take_mark("completer.spec_step")
+            if mark is not None:
+                mark.close()    # int(n_valid) was the collect point
             # both caches hold rows written beyond the accepted
             # history; parking pos at the accepted end makes them
             # unreachable until overwritten (decoder.py prefill note)
@@ -783,11 +799,12 @@ class SpeculativeCompletionModel:
         generation); further prompt buckets compile on first use and
         persist in the XLA cache.  `chunk` accepted for surface
         compatibility with CompletionModel.warmup."""
-        n = min(8, self.cfg.max_len - self.gamma - 3)
-        ids = np.ones((max(1, n),), np.int32)
-        for _ in self.generate_tokens(ids, self.gamma + 1):
-            pass
-        self.reset()
+        with DEVTIME.warmup_phase():
+            n = min(8, self.cfg.max_len - self.gamma - 3)
+            ids = np.ones((max(1, n),), np.int32)
+            for _ in self.generate_tokens(ids, self.gamma + 1):
+                pass
+            self.reset()
 
     @property
     def acceptance_rate(self) -> float:

@@ -12,12 +12,22 @@ TPU port adds on top of the heartbeat keys:
   prom      Prometheus text exposition for all of the above plus
             daemon counters, StagedLane chunk accounting, and store
             header diagnostics (`spt metrics`)
+  devtime   the named-program registry: per-program device windows
+            (dispatch->collect, zero new host syncs) and the compile
+            ledger (`__compile_<i>` ring) — device-time & compile
+            attribution for every jitted hot program
+  spans     cross-lane span records + the shared span ring (v3 adds
+            the device_ms/dispatch_queue split beside queue/service)
 
 Everything here is host-side Python with no jax dependency, safe to
 import from daemons, the CLI, and tests alike.
 """
+from .devtime import DEVTIME, DevtimeRegistry, close_mark, \
+    collect_compile_events
 from .hist import LogHistogram
 from .prom import PromWriter
 from .recorder import FlightRecorder
 
-__all__ = ["LogHistogram", "FlightRecorder", "PromWriter"]
+__all__ = ["LogHistogram", "FlightRecorder", "PromWriter",
+           "DEVTIME", "DevtimeRegistry", "close_mark",
+           "collect_compile_events"]

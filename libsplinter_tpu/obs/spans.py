@@ -226,12 +226,18 @@ class SpanWriter:
 
     def commit(self, pend: PendingSpan | None, *, status: str = OK,
                stages: dict | None = None,
-               extra: dict | None = None) -> bool:
+               extra: dict | None = None,
+               device_ms: float | None = None) -> bool:
         """Finalize one span: build the record (buffered for flush),
         retire the staging row, and retire the trace stamp +
         LBL_TRACED on the request key while the stamp is still OURS.
         `stages` is the lane's per-stage ms map (the pinned *_STAGES
-        vocabulary) when stage tracing was on."""
+        vocabulary) when stage tracing was on.  `device_ms` is the
+        drain's device window (DEVTIME.take_lane_ms) — drain-scoped:
+        the whole batch's dispatch->collect wall is attributed to the
+        traced span(s) that rode it, so it may exceed this one span's
+        service slice under heavy batching (a ceiling, never an
+        undercount)."""
         if pend is None:
             return False
         st = self.store
@@ -243,7 +249,7 @@ class SpanWriter:
                 pass
         # the record itself is BUILT at flush time — the wake path
         # pays only this append and (staged lanes only) the cleanup
-        self._buf.append((pend, status, stages, extra, now))
+        self._buf.append((pend, status, stages, extra, now, device_ms))
         if self.staged:
             # consume-late cleanup: the staging row retires; the
             # stamp + label only while the stamp is still OURS
@@ -263,10 +269,38 @@ class SpanWriter:
             self.flush()
         return True
 
+    def tail_span(self, key, wall_ms: float, *, status: str = OK,
+                  stages: dict | None = None,
+                  extra: dict | None = None,
+                  device_ms: float | None = None,
+                  tenant: int = 0) -> int | None:
+        """Tail-based retention: synthesize a span for a SLOW request
+        that carried no trace stamp — the slow log keeps full stage
+        detail for SLO violators even when head sampling skipped them.
+        Allocates a fresh trace id (returned so the recorder's slow
+        entry resolves via `spt trace show <id>`); the record carries
+        `tail: true` and a service window covering the measured wall.
+        Never raises (tracing must never fail a request)."""
+        try:
+            tid = P.next_trace_id()
+        except Exception:
+            return None
+        now = time.time()
+        pend = PendingSpan(-1, 0, key, tid, 0, tid, 0.0,
+                           now - max(wall_ms, 0.0) / 1e3,
+                           tenant=tenant)
+        ex = {"tail": True}
+        if extra:
+            ex.update(extra)
+        self._buf.append((pend, status, stages, ex, now, device_ms))
+        if self.eager or len(self._buf) >= self.max_buffer:
+            self.flush()
+        return tid
+
     @staticmethod
     def _build(lane: str, pend: PendingSpan, status: str,
                stages: dict | None, extra: dict | None,
-               now: float) -> dict:
+               now: float, device_ms: float | None = None) -> dict:
         queue_ms = max(now - pend.t_queue, 0.0) * 1e3 \
             if pend.t_queue > 0 else 0.0
         service_ms = max(now - pend.t_admit, 0.0) * 1e3
@@ -284,6 +318,13 @@ class SpanWriter:
                "queue_ms": round(queue_ms, 3),
                "service_ms": round(service_ms, 3),
                "ts": round(now, 3)}
+        if device_ms is not None and device_ms > 0:
+            # schema v3: host service decomposes into dispatch_queue
+            # (host-side work before/around the device window) and
+            # device_ms (dispatch->collect wall, drain-scoped)
+            rec["device_ms"] = round(device_ms, 3)
+            rec["dispatch_queue"] = round(
+                max(service_ms - device_ms, 0.0), 3)
         if pend.tenant:
             rec["tenant"] = pend.tenant
         if pend.attempts > 1:
@@ -307,9 +348,9 @@ class SpanWriter:
         buf, self._buf = self._buf, []
         st = self.store
         landed = 0
-        for pend, status, stages, extra, now in buf:
+        for pend, status, stages, extra, now, device_ms in buf:
             rec = self._build(self.lane, pend, status, stages, extra,
-                              now)
+                              now, device_ms)
             slot = self._claim_ring_slot()
             ok = False
             if slot is not None:
@@ -464,6 +505,12 @@ def render_tree(tree: dict) -> list[str]:
                     f"queue={s.get('queue_ms', 0)}ms "
                     f"service={s.get('service_ms', 0)}ms "
                     f"status={s.get('status')}")
+            if s.get("device_ms") is not None:
+                line += (f" device={s['device_ms']}ms "
+                         f"dispatch_queue="
+                         f"{s.get('dispatch_queue', 0)}ms")
+            if s.get("tail"):
+                line += " tail"
             if s.get("attempts", 1) > 1:
                 line += (f" attempts={s['attempts']} "
                          f"restart_gap={s.get('gap_ms', 0)}ms")
@@ -487,16 +534,28 @@ def render_tree(tree: dict) -> list[str]:
 
 _LANE_PIDS = {"client": 1, "embedder": 2, "searcher": 3,
               "completer": 4, "pipeliner": 5, "telemetry": 6}
+# device tracks render as their own "processes" beside the host lanes
+# (pid = lane pid + _DEVICE_PID_OFFSET, named "device:<lane>"); the
+# compile-event instants get one dedicated track of their own
+_DEVICE_PID_OFFSET = 10
+_COMPILE_PID = 90
 
 
-def to_chrome_trace(spans: list[dict]) -> dict:
+def to_chrome_trace(spans: list[dict],
+                    compile_events: list[dict] | None = None) -> dict:
     """Chrome/Perfetto trace-event JSON for a set of spans (one trace
     or the whole ring): per span one `X` (complete) slice for the
     service window plus one for the queue wait, grouped into one
     "process" per lane with `M` metadata naming it — load the output
-    straight into ui.perfetto.dev or chrome://tracing."""
+    straight into ui.perfetto.dev or chrome://tracing.  Spans carrying
+    the v3 `device_ms` split additionally emit a device slice on the
+    lane's `device:<lane>` track (placed at the tail of the service
+    window — dispatch_queue first, then the device window); compile
+    ledger records (obs/devtime.py) land as `i` instants on the
+    dedicated compile track."""
     events: list[dict] = []
     lanes_seen: set[str] = set()
+    device_lanes: set[str] = set()
     for s in spans:
         lane = str(s.get("lane", "?"))
         pid = _LANE_PIDS.get(lane, 99)
@@ -524,10 +583,44 @@ def to_chrome_trace(spans: list[dict]) -> dict:
             "ph": "X", "ts": round(t_admit * 1e6, 1),
             "dur": round(max(service_ms, 0.001) * 1e3, 1),
             "pid": pid, "tid": tid & 0xFFFFFF, "args": args})
+        device_ms = float(s.get("device_ms", 0.0))
+        if device_ms > 0:
+            device_lanes.add(lane)
+            # the device window closes the service slice: host-side
+            # dispatch_queue first, then dispatch->collect
+            t_dev = t_admit + max(service_ms - device_ms, 0.0) / 1e3
+            events.append({
+                "name": f"device {s.get('key')}", "cat": "device",
+                "ph": "X", "ts": round(t_dev * 1e6, 1),
+                "dur": round(max(device_ms, 0.001) * 1e3, 1),
+                "pid": pid + _DEVICE_PID_OFFSET,
+                "tid": tid & 0xFFFFFF, "args": args})
+    for ev in compile_events or ():
+        events.append({
+            "name": f"compile {ev.get('program', '?')}",
+            "cat": "compile", "ph": "i", "s": "p",
+            "ts": round(float(ev.get("ts", 0.0)) * 1e6, 1),
+            "pid": _COMPILE_PID, "tid": 0,
+            "args": {"program": str(ev.get("program", "?")),
+                     "lane": str(ev.get("lane", "?")),
+                     "shapes_key": str(ev.get("shapes_key", "?")),
+                     "duration_ms": float(ev.get("duration_ms", 0.0)),
+                     "generation": int(ev.get("generation", 0)),
+                     "cause": str(ev.get("cause", "?"))}})
     for lane in sorted(lanes_seen):
         events.append({"name": "process_name", "ph": "M",
                        "pid": _LANE_PIDS.get(lane, 99), "tid": 0,
                        "args": {"name": f"lane:{lane}"}})
+    for lane in sorted(device_lanes):
+        events.append({"name": "process_name", "ph": "M",
+                       "pid": (_LANE_PIDS.get(lane, 99)
+                               + _DEVICE_PID_OFFSET), "tid": 0,
+                       "args": {"name": f"device:{lane}"}})
+    if compile_events:
+        events.append({"name": "process_name", "ph": "M",
+                       "pid": _COMPILE_PID, "tid": 0,
+                       "args": {"name": "compiles"}})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"generator": "spt trace export",
-                          "spans": len(spans)}}
+                          "spans": len(spans),
+                          "compile_events": len(compile_events or ())}}

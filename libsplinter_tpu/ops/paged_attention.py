@@ -227,6 +227,7 @@ def _pallas_call(q4, k_pool, v_pool, scalars, *, interpret: bool,
     )(*scalars, q4, k_pool, v_pool)
 
 
+# splint: ignore[SPL205] reason=runs inside the registered paged programs (completer.paged_chunk / completer.suffix_prefill); the outer program is the attribution point
 @functools.partial(jax.jit, static_argnames=("interpret", "q_tokens"))
 def _paged_pallas(q4, k_pool, v_pool, tables, lengths, *,
                   interpret: bool, q_tokens: int):
@@ -238,6 +239,7 @@ def _paged_pallas(q4, k_pool, v_pool, tables, lengths, *,
                         quantized=False)
 
 
+# splint: ignore[SPL205] reason=runs inside the registered paged programs (quantized pools); the outer program is the attribution point
 @functools.partial(jax.jit, static_argnames=("interpret", "q_tokens"))
 def _paged_pallas_quant(q4, k_pool, v_pool, k_scales, v_scales,
                         tables, lengths, *, interpret: bool,

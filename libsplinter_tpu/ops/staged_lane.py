@@ -34,6 +34,7 @@ import os
 
 import numpy as np
 
+from ..obs.devtime import DEVTIME
 from ..store import Store
 
 # Update sizes are padded up to one of these bucket sizes so the scatter
@@ -95,7 +96,6 @@ def _advise_dontneed(view: np.ndarray) -> None:
 def _chunk_update_fn():
     jax = _get_jax()
 
-    @functools.partial(jax.jit, donate_argnums=0)
     def upd(arr, vals, start):
         # vals may arrive in a narrower wire dtype (f16): the device
         # lane stays f32, so the upcast happens on-device where it is
@@ -103,7 +103,12 @@ def _chunk_update_fn():
         return jax.lax.dynamic_update_slice(
             arr, vals.astype(arr.dtype), (start, 0))
 
-    return upd
+    # ledger-only registration: the donated in-place result has no
+    # host collect point (the scatter pipelines under the next
+    # gather), so no device window is taken — compile events still
+    # attribute to searcher.stage_update
+    return DEVTIME.register("searcher.stage_update",
+                            jax.jit(upd, donate_argnums=0))
 
 
 def _get_jax():

@@ -1,0 +1,150 @@
+"""compile-check: the post-warmup no-recompile gate.
+
+Runs the pod-sharded paged serving drill (the PR 8 warmup-coverage
+shape: dp=4 x tp=2 on the 8-device CPU mesh — join, decode, free,
+re-join at a DIFFERENT prompt length, decode again) under the devtime
+compile ledger (obs/devtime.py) and asserts ZERO runtime-cause
+compile events: warmup must cover the whole serve-time signature, so
+a serve-time jit cache growth is a silent-recompile regression — the
+class SPL203 guards statically, gated here dynamically.
+
+On failure the verdict names each guilty program and the shapes key
+that missed warmup — the two facts the fix needs (which program, and
+which signature to add to warmup).
+
+`--seed-recompile` is the gate's own failure drill: it arms
+`SPTPU_SEED_RECOMPILE=1` (models/decoder.py drops the paged-pool
+`out_shardings` pin, resurrecting the PR 8 bug on purpose) and the
+script exits 0 only if the gate CAUGHT it — a runtime-cause event
+naming a completer program with a shapes key, surfaced both
+in-process and through the `__compile_<i>` store ring.  A gate that
+cannot fail is not a gate; `make compile-check` runs both directions.
+
+Exit 0 and a JSON line on success (either direction); exit 1 with the
+guilty programs when the gate's verdict is wrong.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# 8 host devices BEFORE jax import — the dp=4 x tp=2 mesh drill
+# (tests/chaos_child.py discipline)
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                _flags)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8").strip()
+
+SEEDED = "--seed-recompile" in sys.argv[1:]
+if SEEDED:
+    os.environ["SPTPU_SEED_RECOMPILE"] = "1"
+
+import numpy as np  # noqa: E402
+
+
+def serve_drill():
+    """Warmup, then the join/decode/free/re-join serve cycle — every
+    dispatch a continuous-lane drain would issue, at two different
+    prompt lengths so bucket selection is exercised."""
+    import jax.numpy as jnp
+
+    from libsplinter_tpu.models.decoder import DecoderConfig
+    from libsplinter_tpu.parallel.mesh import make_mesh
+    from libsplinter_tpu.parallel.serve import ShardedCompletionModel
+
+    cfg = DecoderConfig.tiny(dtype=jnp.float32)
+    mesh = make_mesh(dp=4, tp=2)
+    m = ShardedCompletionModel(cfg, mesh, buckets=(16, 32),
+                               temp=0.0, seed=1)
+    cache = m.init_paged(4, page=16)
+    m.warmup_paged(cache, chunk=4, max_prompt=30)
+
+    lg = m.paged_prefill_row(cache, np.ones((7,), np.int32), 0)
+    m.sample(lg)
+    m.paged_decode_chunk(cache, np.array([1, 0, 0, 0], np.int32), 4)
+    cache.free_row(0)
+    lg = m.paged_prefill_row(cache, np.ones((20,), np.int32), 1)
+    m.sample(lg)
+    m.paged_decode_chunk(cache, np.array([0, 2, 0, 0], np.int32), 4)
+
+
+def main() -> int:
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.obs.devtime import (DEVTIME,
+                                             collect_compile_events)
+
+    if os.environ.get("SPTPU_DEVTIME") == "0":
+        print("compile-check FAILED: SPTPU_DEVTIME=0 — the gate "
+              "cannot see compiles with the ledger disabled",
+              file=sys.stderr)
+        return 1
+    serve_drill()
+
+    # the in-process verdict ...
+    pending = DEVTIME.pending_events()
+    runtime = [e for e in pending if e["cause"] == "runtime"]
+    n_runtime = DEVTIME.compile_events()
+    # ... and the cross-process one: flush through the store ring and
+    # read it back the way `spt trace export` / an operator would
+    name = f"/spt-compilegate-{os.getpid()}"
+    Store.unlink(name)
+    st = Store.create(name, nslots=256, max_val=1024, vec_dim=8)
+    try:
+        DEVTIME.flush(st)
+        ringed = [e for e in collect_compile_events(st)
+                  if e["cause"] == "runtime"]
+    finally:
+        st.close()
+        Store.unlink(name)
+
+    guilty = sorted({(e["program"], e["shapes_key"])
+                     for e in runtime})
+    rec = {"metric": "post_warmup_compile_events",
+           "value": n_runtime,
+           "seeded": SEEDED,
+           "warmup_events": len(pending) - len(runtime),
+           "guilty": [{"program": p, "shapes_key": k}
+                      for p, k in guilty]}
+
+    if not SEEDED:
+        rec["ok"] = n_runtime == 0
+        print(json.dumps(rec), flush=True)
+        if n_runtime:
+            for p, k in guilty:
+                print(f"compile-check FAILED: {p} recompiled after "
+                      f"warmup for shapes {k} — add the signature "
+                      f"to warmup (or pin out_shardings)",
+                      file=sys.stderr)
+            return 1
+        return 0
+
+    # seeded self-test: the gate MUST have fired, naming a completer
+    # program with a shapes key, visible through the ring too
+    caught = (n_runtime > 0
+              and any(p.startswith("completer.") and k
+                      for p, k in guilty)
+              and any(e["program"].startswith("completer.")
+                      for e in ringed))
+    rec["ok"] = caught
+    print(json.dumps(rec), flush=True)
+    if not caught:
+        print(f"compile-check FAILED: seeded out_shardings drop was "
+              f"NOT caught (runtime events={n_runtime}, "
+              f"ring events={len(ringed)}) — the gate is blind",
+              file=sys.stderr)
+        return 1
+    for p, k in guilty:
+        print(f"compile-check seeded drill: caught {p} "
+              f"recompiling for shapes {k} (as intended)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

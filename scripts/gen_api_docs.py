@@ -387,6 +387,49 @@ the pipeline lane stamps every verb a script dispatches with the
 script's own span as parent — ONE trace id spans a whole chain in
 both forms.  `spt trace show <id>` renders the assembled tree;
 `spt trace export` emits Chrome/Perfetto trace-event JSON.
+
+### Device-time & compile attribution (`libsplinter_tpu/obs/devtime.py`)
+
+Every jitted hot program registers with the process-global `DEVTIME`
+registry under a stable `lane.program` name (`embedder.encode`,
+`completer.paged_chunk`, `searcher.topk`, ...; splint SPL205 fails an
+unregistered one).  Registration wraps the program with two probes,
+both piggybacking on work the lane already does — **zero new host
+syncs** (SPL201 stays the law; `SPTPU_DEVTIME=0` is the kill
+switch, and warmup dispatches never open device windows):
+
+- **the compile ledger** — a jit cache-size growth across a call is a
+  compile event: `{program, lane, shapes_key, duration_ms,
+  generation, cause: warmup|runtime}`, buffered in-process and
+  flushed on the heartbeat cadence into the `__compile_<i>` store
+  ring (span-ring slot-claim discipline).  `spt trace export` renders
+  the events as instants on their own Perfetto track; the post-warmup
+  **no-recompile gate** (`scripts/compile_gate_check.py`, `make
+  compile-check`) asserts the runtime-cause count stays ZERO across a
+  serve drill and names the guilty program + shapes key when it
+  doesn't (`SPTPU_SEED_RECOMPILE=1` seeds the drill for the gate's
+  own failure test).
+- **device windows** — dispatch→collect wall time per named program,
+  closed at the lane's EXISTING collect point (`PendingChunk.block`,
+  `materialize_host`, the top-k `device_get`).  Spans gain
+  `device_ms` and `dispatch_queue` (= `service_ms - device_ms`)
+  beside the queue/service split — "slow because device" vs "slow
+  because the lane sat on it" is now readable per request — and each
+  lane heartbeat gains a `devtime` section (per program `{n,
+  compiles, runtime_compiles, p50_ms, p99_ms}`, rendered as
+  `sptpu_<lane>_devtime_*{program=...}`).  The bench ledger rows
+  carry `compile_events` + `device_ms_share`.
+
+HBM watermarks ride the completer heartbeat beside the live gauges:
+`pool_mb_peak` (measured placed-buffer MB high-water) and
+`pages_used_peak` (page-occupancy high-water, sampled at
+chunk-collect edges so a between-heartbeats spike still shows).
+
+**Tail-based retention**: a request or drain that exceeds the slow
+threshold keeps its full `*_STAGES` breakdown even when the client
+never stamped a trace id — the lane allocates a trace id at commit
+time (`tail: true` on the span), so every slow-log entry resolves
+through `spt trace show`.
 """,
     "system-keys-user-flags": """
 ## Supervision heartbeat keys (`libsplinter_tpu/engine/supervisor.py`)
@@ -506,7 +549,10 @@ elastic-lane scaling controller reads, rendered by `spt top` and
   history until it fits `max_val`).  Gauges: `queue_depth` (measured
   by label enumeration, never trusted from the heartbeat), `shed` /
   `deferred` / `deadline_expired`, the lane's progress counter,
-  `pages_free` (completer), `p99_<stage>_ms` when tracing is on, and
+  `pages_free` / `pool_mb` / `pool_mb_peak` / `pages_used_peak`
+  (completer HBM watermarks), `compile_events` (the devtime plane's
+  runtime-recompile count — a non-flat ring is the silent-recompile
+  alarm), `p99_<stage>_ms` when tracing is on, and
   `tenant<id>_admitted` / `tenant<id>_served_tokens`.
 - `__telemetry_stats` — the sampler's own heartbeat (samples,
   lanes_seen, points, shrinks, generation) — supervised exactly like
@@ -518,6 +564,31 @@ Every lane heartbeat additionally carries a `spans_obs` section
 (span-capture accounting: committed / recovered / dropped / pending —
 obs/spans.py; size-droppable like every optional section), rendered
 flat by `spt metrics` as `sptpu_<lane>_spans_*`.
+
+### Compile-ledger keys (`libsplinter_tpu/obs/devtime.py`)
+
+The device-time plane commits compile events into a bounded store
+ring, claimed exactly like the span ring:
+
+- `__compile_<i>` — committed compile-event records: `{"v": 1,
+  "program": "lane.name", "lane": ..., "shapes_key": ...,
+  "duration_ms": ..., "generation": G, "cause":
+  "warmup"|"runtime", "ts": ..., "pid": ...}`.  Ring size =
+  `span_ring_size` (nslots/8 in [16, 128]); events buffer in the
+  lane and flush on the heartbeat cadence.
+- `__compile_head` — the ring's atomically-incremented BIGUINT
+  claim counter (multi-writer safe; replicas of an elastic lane
+  share the one ring, their events distinguished by `pid` +
+  `generation`).
+
+`spt trace export` merges the ring into the Perfetto document as
+instant events on a dedicated track; `collect_compile_events(store)`
+is the programmatic reader; `scripts/compile_gate_check.py` is the
+CI gate that fails on any post-warmup `cause: "runtime"` event.  The
+`generation` field is synced from the lane's supervision generation
+at attach, so a restart is visible as a generation bump in the ring
+— warmup compiles of the NEW process never masquerade as serve-time
+recompiles of the old one.
 
 ### Prefix-cache keys (`libsplinter_tpu/engine/prefix_cache.py`)
 

@@ -160,6 +160,33 @@ def main() -> int:
         tracer.reset()
         sampler_pct = (min(withs) / min(base) - 1.0) * 100.0
         assert sam.stats.samples > 0, "sampler never ticked"
+
+        # ---- phase 3: the devtime plane (PR 17) must fit the same
+        # budget.  Two embedders over the same store — one whose
+        # encoder is DEVTIME-registered (a dispatch mark opened and
+        # closed per encode, the ledger cache-size probes, the lane
+        # device-ms accumulator the drain's span commit pops) vs the
+        # plain stub — interleaved and min-compared like phase 1.
+        from libsplinter_tpu.obs.devtime import DEVTIME
+
+        emb_dt = Embedder(st, encoder_fn=DEVTIME.register(
+            "embedder.encode", encoder), max_ctx=512)
+        emb_dt.attach()
+        tracer.enabled = True
+        drain_once(st, emb_dt, True)          # warm untimed
+        gc.collect()
+        gc.disable()
+        try:
+            plain, marked = [], []
+            for _ in range(max(REPS // 2, 20)):
+                plain.append(drain_once(st, emb, True))
+                marked.append(drain_once(st, emb_dt, True))
+        finally:
+            gc.enable()
+        tracer.reset()
+        devtime_pct = (min(marked) / min(plain) - 1.0) * 100.0
+        assert DEVTIME.compile_events() == 0, \
+            "stub encoder cannot compile"
     finally:
         tracer.enabled = os.environ.get("SPTPU_TRACE") == "1"
         st.close()
@@ -176,17 +203,21 @@ def main() -> int:
     sampler_inconclusive = (sampler_pct >= BUDGET
                             and sampler_pct - null_pct < BUDGET)
     sampler_ok = sampler_pct < BUDGET or sampler_inconclusive
+    devtime_inconclusive = (devtime_pct >= BUDGET
+                            and devtime_pct - null_pct < BUDGET)
+    devtime_ok = devtime_pct < BUDGET or devtime_inconclusive
     rec = {"metric": "obs_record_overhead_pct",
            "value": round(overhead_pct, 2),
            "budget_pct": BUDGET,
            "noise_floor_pct": round(null_pct, 2),
            "disabled_ms": round(off, 3), "enabled_ms": round(on, 3),
            "sampler_overhead_pct": round(sampler_pct, 2),
+           "devtime_overhead_pct": round(devtime_pct, 2),
            "keys_per_drain": KEYS, "reps": REPS,
            "rounds_run": rounds_run,
            "ok": (overhead_pct < BUDGET or inconclusive)
-           and sampler_ok}
-    if inconclusive or sampler_inconclusive:
+           and sampler_ok and devtime_ok}
+    if inconclusive or sampler_inconclusive or devtime_inconclusive:
         rec["inconclusive"] = True
     print(json.dumps(rec), flush=True)
     if inconclusive:
@@ -199,6 +230,10 @@ def main() -> int:
         print(f"obs-check sampler arm INCONCLUSIVE: apparent "
               f"{sampler_pct:.2f}% vs noise floor {null_pct:.2f}%",
               file=sys.stderr)
+    if devtime_inconclusive:
+        print(f"obs-check devtime arm INCONCLUSIVE: apparent "
+              f"{devtime_pct:.2f}% vs noise floor {null_pct:.2f}%",
+              file=sys.stderr)
     if not rec["ok"]:
         if overhead_pct >= BUDGET and not inconclusive:
             print(f"obs-check FAILED: tracing overhead "
@@ -210,6 +245,11 @@ def main() -> int:
                   f"adds {sampler_pct:.2f}% >= {BUDGET}% to the "
                   f"serving drain (it must stay off the wake path)",
                   file=sys.stderr)
+        if not devtime_ok:
+            print(f"obs-check FAILED: the devtime mark/ledger path "
+                  f"adds {devtime_pct:.2f}% >= {BUDGET}% to the "
+                  f"serving drain (SPL201's zero-new-syncs bargain "
+                  f"includes staying cheap)", file=sys.stderr)
         return 1
     return 0
 

@@ -82,6 +82,17 @@
 #                   hot-vs-cold admission-to-first-token gate
 #                   (scripts/prefix_speedup_check.py, >= 5x on the
 #                   in-process CPU stack)
+#   make disagg-check  disaggregated prefill/decode tier (fast,
+#                   CPU): PrefillLane + DecodeLane on one store,
+#                   driven through loadgen's prefill-burst scenario —
+#                   the decode floor's inter-chunk p99 under a 10x
+#                   prefill rate step must stay within 1.2x of the
+#                   prefill-idle baseline (plus a small absolute
+#                   slack), with zero admitted loss and the page
+#                   handoff running the real wire export/import path
+#                   (scripts/disagg_check.py) + the test_disagg.py
+#                   fast tier (byte-exactness vs the unified
+#                   completer, handoff crash drills both directions)
 #   make scale-check  elastic-lane tier (fast, CPU): stripe-map
 #                   protocol + striped replica groups (R=2 byte-
 #                   identical to R=1, no double-claims, no orphans
@@ -145,6 +156,7 @@ check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/pipeline_latency_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/prefix_speedup_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/scale_step_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/disagg_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py --seed-recompile
 	$(PY) -m pytest tests/ -q -m "not chaos"
@@ -177,6 +189,11 @@ scale-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic.py -q \
 		-m "not slow and not chaos"
 	JAX_PLATFORMS=cpu $(PY) scripts/scale_step_check.py
+
+disagg-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_disagg.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/disagg_check.py
 
 quant-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py -q \
@@ -231,4 +248,4 @@ clean:
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check prefix-check \
 	qos-check pipeline-check trace-check lint-check scale-check \
-	compile-check memcheck bench-cpu clean
+	disagg-check compile-check memcheck bench-cpu clean

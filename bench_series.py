@@ -82,8 +82,8 @@ TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
 
 ALL_PHASES = ("embed", "embed_sweep", "profile", "dispatch", "kernels",
               "search", "restage", "decode", "decode_quant",
-              "multichip", "loadgen", "prefix", "decode_daemon",
-              "store_ops")
+              "multichip", "loadgen", "prefix", "disagg",
+              "decode_daemon", "store_ops")
 
 # conservative floor (seconds) a phase needs to be worth starting;
 # compile costs dominate these on a cold .xla_cache
@@ -91,8 +91,8 @@ PHASE_MIN_S = {"embed": 0, "embed_sweep": 120, "profile": 90,
                "dispatch": 20,
                "kernels": 120, "search": 150, "restage": 180,
                "decode": 180, "decode_quant": 150, "multichip": 120,
-               "loadgen": 60, "prefix": 90, "decode_daemon": 120,
-               "store_ops": 15}
+               "loadgen": 60, "prefix": 90, "disagg": 90,
+               "decode_daemon": 120, "store_ops": 15}
 
 
 def log(*a):
@@ -2127,6 +2127,166 @@ def phase_prefix(ctx: SeriesCtx) -> dict:
     return ctx.record(rec)
 
 
+def phase_disagg(ctx: SeriesCtx) -> dict:
+    """Disaggregated prefill/decode lanes (ISSUE 18): the same
+    prefill-burst workload (steady decode floor + a prompt-heavy rate
+    step) is served twice — once by a unified continuous completer,
+    once by the split PrefillLane + DecodeLane pair — and the decode
+    floor's inter-chunk p99 during the burst phase is ledgered for
+    both (the split/unified ratio IS the disaggregation win: prefill
+    bubbles stop landing inside decode token gaps).  A post-drain
+    probe on the quiet split stack times DECODE_READY -> adoption
+    (the page-handoff hop itself), and the row carries both lanes'
+    heartbeat counters (handoffs, wire MB, refills).  The store uses
+    max_val=16384 so the real wire-page export/import path is what
+    gets measured, not the re-prefill fallback.  Off-TPU rows carry
+    the LOUD cpu_smoke label.  Env: DISAGG_RATE (per-class req/s,
+    default 3), DISAGG_PROFILE (default 1x:3,8x:5,1x:3)."""
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.cli.loadgen import (LoadGenerator, TenantSpec,
+                                             parse_rate_profile)
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.completer import Completer
+    from libsplinter_tpu.engine.disagg import DecodeLane, PrefillLane
+    from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                DecoderConfig)
+
+    rate = float(os.environ.get("DISAGG_RATE", "3"))
+    prof = parse_rate_profile(
+        os.environ.get("DISAGG_PROFILE", "1x:3,8x:5,1x:3"))
+    burst_phase = max(range(len(prof)), key=lambda p: prof[p][0])
+
+    # one model for both modes: identical buckets, zero recompiles
+    # between the unified and split runs
+    dcfg = DecoderConfig.tiny(dtype=jnp.float32)
+    model = CompletionModel(dcfg, buckets=(32,), temp=0.0, seed=1,
+                            suffix_buckets=(8,))
+    KW = dict(max_new_tokens=10, flush_tokens=2, template="none",
+              batch_cap=4, page_size=8)
+    duration = sum(d for _, d in prof)
+
+    def probe_handoff(st, key: str) -> float | None:
+        """Time the DECODE_READY -> adopted (SERVICING re-raised) hop
+        for one quiet request; None when the window was too short to
+        observe (adoption faster than the poll resolution)."""
+        st.set(key, f"probe {key}")
+        st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+        st.bump(key)
+        t_ho = None
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            lb = st.labels(key)
+            now = time.perf_counter()
+            if lb & P.LBL_DECODE_READY:
+                if lb & P.LBL_SERVICING:
+                    # adopted: only a valid sample if we saw the bare
+                    # DECODE_READY window first
+                    return (now - t_ho) * 1e3 if t_ho is not None \
+                        else None
+                if t_ho is None:
+                    t_ho = now
+            if lb & P.LBL_READY:
+                return None
+            time.sleep(0.0002)
+        raise RuntimeError(f"{key} never handed off")
+
+    def run_mode(tag: str, split: bool) -> tuple[dict, dict]:
+        name = _bench_store_name(f"disagg-{tag}")
+        Store.unlink(name)
+        st = Store.create(name, nslots=1024, max_val=16384, vec_dim=8)
+        daemons: list = []
+        ths: list = []
+        stats: dict = {}
+        try:
+            if split:
+                daemons = [PrefillLane(st, model=model, **KW),
+                           DecodeLane(st, model=model, **KW)]
+            else:
+                daemons = [Completer(st, model=model, **KW)]
+            for d in daemons:
+                d.attach()
+            ths = [threading.Thread(
+                target=d.run_continuous,
+                kwargs=dict(idle_timeout_ms=10,
+                            stop_after=duration + 90), daemon=True)
+                for d in daemons]
+            for t in ths:
+                t.start()
+            gen = LoadGenerator(st, [TenantSpec(1, rate,
+                                                deadline_ms=30_000)],
+                                duration_s=duration,
+                                scenario="prefill-burst",
+                                rate_profile=prof, corpus=32, seed=7,
+                                drain_s=45.0)
+            rep = gen.run()
+            if split:
+                # post-drain, quiet lanes: time the handoff hop itself
+                samples = [probe_handoff(st, f"__probe/{i}")
+                           for i in range(5)]
+                samples = [s for s in samples if s is not None]
+                stats["handoff_ms"] = samples
+                stats["prefill"] = dict(daemons[0]._lane_stats)
+                stats["decode"] = dict(daemons[1]._lane_stats)
+            return rep, stats
+        finally:
+            for d in daemons:
+                d.stop()
+            for t in ths:
+                t.join(timeout=30)
+            st.close()
+            Store.unlink(name)
+
+    def floor_p99(rep: dict, phase: int) -> float | None:
+        for row in rep.get("prefill_burst", []):
+            if row.get("phase") == phase:
+                return row.get("decode-floor", {}).get(
+                    "interchunk_p99_ms")
+        return None
+
+    rep_u, _ = run_mode("unified", split=False)
+    rep_s, lane_stats = run_mode("split", split=True)
+
+    u99 = floor_p99(rep_u, burst_phase)
+    s99 = floor_p99(rep_s, burst_phase)
+    idle99 = floor_p99(rep_s, 0)
+    ho = sorted(lane_stats.get("handoff_ms", []))
+    rec = {
+        "metric": "disagg_decode_p99",
+        "backend": ctx.backend,
+        "offered_rps_per_class": rate,
+        "profile": [[m, d] for m, d in prof],
+        "burst_phase": burst_phase,
+        "unified_burst_interchunk_p99_ms": u99,
+        "split_burst_interchunk_p99_ms": s99,
+        "split_vs_unified": round(s99 / u99, 3)
+        if u99 and s99 else None,
+        "split_idle_interchunk_p99_ms": idle99,
+        "handoff_p50_ms": round(float(np.median(ho)), 3)
+        if ho else None,
+        "handoff_samples": len(ho),
+        "lane_stats": {k: lane_stats.get(k) for k in
+                       ("prefill", "decode")},
+        "detail": {"unified_burst": rep_u.get("prefill_burst"),
+                   "split_burst": rep_s.get("prefill_burst")},
+    }
+    if ctx.backend != "tpu":
+        # tiny models on host CPU: a mechanism smoke, not the decode
+        # isolation claim — label it so no before/after compare ever
+        # mistakes it for chip evidence
+        rec["label"] = "cpu_smoke"
+    log(f"disagg: burst-phase floor inter-chunk p99 unified "
+        f"{u99} ms -> split {s99} ms (ratio "
+        f"{rec['split_vs_unified']}); handoff p50 "
+        f"{rec['handoff_p50_ms']} ms over {len(ho)} probes; "
+        f"prefill {lane_stats.get('prefill')}")
+    return ctx.record(rec)
+
+
 def phase_decode_daemon(ctx: SeriesCtx) -> dict:
     """Completion-daemon e2e latency + continuous serving.  Runs LAST:
     this phase (completer e2e) is the only one that ever hung on-chip
@@ -2347,6 +2507,7 @@ PHASE_FNS = {
     "multichip": phase_multichip,
     "loadgen": phase_loadgen,
     "prefix": phase_prefix,
+    "disagg": phase_disagg,
     "decode_daemon": phase_decode_daemon,
     "store_ops": phase_store_ops,
 }

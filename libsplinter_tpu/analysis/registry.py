@@ -114,6 +114,27 @@ FAULT_SITE_DOCS: dict[str, str] = {
         "each async splinter verb a script dispatches "
         "(submit_embed / submit_search / submit_completion / sleep), "
         "before the downstream submit",
+    "prefill.handoff":
+        "the disaggregated PREFILL lane's page-ownership transfer "
+        "(engine/disagg.py): fires after the row's KV pages and "
+        "first sampled token are written to the `__ho_<idx>` wire "
+        "keys but BEFORE the handoff record that makes them visible "
+        "— a `crash` dies with the row SERVICING and half a handoff "
+        "on the wire, proving the stripe-scoped reclaim (lane "
+        "attach, or the supervisor's post-reap sweep) drops the "
+        "orphan wire keys and re-queues the request to WAITING with "
+        "zero loss (`tests/chaos_child.py prefill_lane`; "
+        "`tests/test_disagg.py`)",
+    "decode.adopt":
+        "the disaggregated DECODE lane's row adoption (engine/"
+        "disagg.py): fires after the DECODE_READY row is claimed "
+        "(SERVICING set) but before its wire pages are imported "
+        "into the decode pool — a `crash` dies holding an adopted "
+        "row, proving recovery rolls it BACK to bare DECODE_READY "
+        "truncated to the record's prompt length for a surviving "
+        "replica to re-adopt from the carry token "
+        "(`tests/chaos_child.py decode_lane`; "
+        "`tests/test_disagg.py`)",
     "supervisor.poll":
         "each supervision step",
     "supervisor.retire":

@@ -96,6 +96,17 @@ SCENARIOS: dict[str, Scenario] = {
     # SLOs.  `--shared-prefix P:LEN` overrides the 0.9:192 default.
     "shared-prefix": Scenario("shared-prefix", "complete",
                               lane="complete"),
+    # complete-only arrivals in TWO traffic classes: a steady
+    # decode floor (tenant 1: short prompts, full-length
+    # completions — inter-chunk latency is its SLO) under a
+    # piecewise prefill-heavy burst (tenant 2: long unique prompts,
+    # rate stepped by --rate-profile; the floor tenant's rate is
+    # NOT stepped).  The report carries TTFT p50/p99 and
+    # inter-chunk p99 per phase per class — the disaggregated
+    # prefill/decode lanes' proof harness (a unified lane's decode
+    # p99 degrades with the burst; split lanes hold it flat).
+    "prefill-burst": Scenario("prefill-burst", "prefill-burst",
+                              lane="complete"),
 }
 
 # shared-prefix scenario defaults: (fraction of arrivals drawing a
@@ -170,7 +181,8 @@ def parse_rate_profile(spec: str) -> list[tuple[float, float]]:
 class _Req:
     __slots__ = ("lane", "tenant", "key", "t_submit", "deadline_ts",
                  "state", "stage", "doc_key", "query_key", "hits",
-                 "tid", "hops", "phase")
+                 "tid", "hops", "phase", "sub_len", "last_len",
+                 "ttft_ms", "t_lastchunk", "gaps")
 
     def __init__(self, lane, tenant, key, t_submit, deadline_ts):
         self.lane = lane
@@ -186,6 +198,13 @@ class _Req:
         self.tid = 0                 # head-sampled trace id (0 = off)
         self.hops = 0                # trace hops stamped so far
         self.phase = 0               # rate-profile phase index
+        # streaming-progress probes (prefill-burst scenario): value
+        # growth past the submitted prompt marks token flushes
+        self.sub_len = None          # value_len at submit (prompt)
+        self.last_len = None         # newest observed value_len
+        self.ttft_ms = None          # first flush after submit
+        self.t_lastchunk = None      # monotonic time of last flush
+        self.gaps = []               # inter-chunk gaps (ms)
 
 
 class LoadGenerator:
@@ -269,6 +288,27 @@ class LoadGenerator:
             else None
         if self.rate_profile:
             self.duration_s = sum(d for _, d in self.rate_profile)
+        # prefill-burst scenario wiring: a default burst schedule, a
+        # second (burst) tenant when only one was given, and the
+        # floor-tenant marker _schedule consults (the floor's rate is
+        # never stepped — the burst rides the profile alone)
+        self._floor_tenant: int | None = None
+        self.burst_metrics: dict[tuple[int, str],
+                                 dict[str, list[float]]] = {}
+        if self._scen is not None \
+                and self._scen.kind == "prefill-burst":
+            if self.rate_profile is None:
+                self.rate_profile = parse_rate_profile(
+                    "1x:4,10x:6,1x:4")
+                self.duration_s = sum(
+                    d for _, d in self.rate_profile)
+            if len(self.tenants) == 1:
+                t0 = self.tenants[0]
+                self.tenants = [t0, TenantSpec(
+                    tenant=min(P.MAX_TENANT, t0.tenant + 1),
+                    rate=t0.rate, deadline_ms=t0.deadline_ms,
+                    weight=t0.weight)]
+            self._floor_tenant = self.tenants[0].tenant
         self._n = 0
         # per-phase accounting (rate profiles): state counts and an
         # exact-latency list per phase index
@@ -447,7 +487,24 @@ class LoadGenerator:
             self._submit_search(
                 req, self._query_vec(f"lgd{self._zipf_doc()}"))
         elif lane == "complete":
-            self._submit_complete(req, self._complete_prompt())
+            if self._floor_tenant is not None:
+                # prefill-burst classes: the floor's short prompt is
+                # decode-bound (full max_new completion), the burst's
+                # long UNIQUE prompt is prefill-bound (no prefix
+                # cache hit can absorb it); the class rides req.lane
+                # so the report splits them without new plumbing
+                if tenant.tenant == self._floor_tenant:
+                    req.lane = "decode-floor"
+                    prompt = f"floor {n} go"
+                else:
+                    req.lane = "prefill-burst"
+                    prompt = (f"analyze shard {n}: "
+                              + f"ctx{n % 97} " * 48)
+                self._submit_complete(req, prompt)
+                req.sub_len = self.store.value_len(req.key)
+                req.last_len = req.sub_len
+            else:
+                self._submit_complete(req, self._complete_prompt())
         elif lane == "script":        # one server-side scripted chain
             req.doc_key = f"lgr{n}"
             req.key = f"lgp{n}"
@@ -545,6 +602,8 @@ class LoadGenerator:
             consume_result(self.store, req.key)
             return True
         # complete lane
+        if req.sub_len is not None:
+            self._chunk_probe(req)
         if not labels & P.LBL_READY:
             return False
         rec = None
@@ -560,6 +619,31 @@ class LoadGenerator:
                          else ERROR)
             return True
         return self._advance(req)
+
+    def _chunk_probe(self, req: _Req) -> None:
+        """Streaming-progress probe (prefill-burst): every value_len
+        growth past the last observation is a token flush — the first
+        one is TTFT, the rest accumulate inter-chunk gaps.  Flush
+        granularity (--flush-tokens) is part of what's measured: the
+        client-visible chunk cadence IS the streaming SLO."""
+        try:
+            vl = self.store.value_len(req.key)
+        except (KeyError, OSError):
+            return
+        if vl <= (self.last_len_of(req)):
+            return
+        now = time.monotonic()
+        if req.ttft_ms is None:
+            req.ttft_ms = (now - req.t_submit) * 1e3
+        elif req.t_lastchunk is not None:
+            req.gaps.append((now - req.t_lastchunk) * 1e3)
+        req.t_lastchunk = now
+        req.last_len = vl
+
+    @staticmethod
+    def last_len_of(req: _Req) -> int:
+        return req.last_len if req.last_len is not None \
+            else (req.sub_len or 0)
 
     def _advance(self, req: _Req) -> bool:
         """One stage done: terminal for plain lanes, next stage for the
@@ -605,6 +689,12 @@ class LoadGenerator:
             if req.tid:
                 self.traced_done.setdefault(req.tenant, []).append(
                     (ms, req.tid, lane))
+            if req.sub_len is not None:
+                m = self.burst_metrics.setdefault(
+                    (req.phase, lane), {"ttft": [], "gaps": []})
+                if req.ttft_ms is not None:
+                    m["ttft"].append(req.ttft_ms)
+                m["gaps"].extend(req.gaps)
         # recycle terminal keys so a long run cannot exhaust slots
         for k in (req.key, req.doc_key, req.query_key):
             if k and req.state != LOST:
@@ -635,10 +725,14 @@ class LoadGenerator:
         steps exactly at the phase boundaries."""
         out: list[tuple[float, TenantSpec, int]] = []
         for t in self.tenants:
+            # prefill-burst: the decode-floor tenant's rate is steady
+            # by construction — only the burst tenant steps
+            steady = (self._floor_tenant is not None
+                      and t.tenant == self._floor_tenant)
             when = 0.0
             while True:
                 mult = (self.rate_profile[self._phase_at(when)][0]
-                        if self.rate_profile else 1.0)
+                        if self.rate_profile and not steady else 1.0)
                 rate = t.rate * mult
                 if self.arrivals == "poisson":
                     when += self.rng.expovariate(rate)
@@ -742,7 +836,42 @@ class LoadGenerator:
             rep["prefix_cache"] = pfx
         if self.rate_profile:
             rep["rate_profile"] = self._phase_report()
+        if self._floor_tenant is not None:
+            rep["prefill_burst"] = self._burst_report()
         return rep
+
+    @staticmethod
+    def _exact_pct(ms: list[float], q: float) -> float:
+        s = sorted(ms)
+        return round(s[min(len(s) - 1, int(len(s) * q))], 3)
+
+    def _burst_report(self) -> list[dict]:
+        """Per-phase, per-class streaming quantiles for the
+        prefill-burst scenario: the decode floor's inter-chunk p99
+        across the burst phases IS the disaggregation proof (flat
+        under split lanes, degraded under a unified one), and the
+        burst class's TTFT shows what the prefill queue is doing."""
+        out = []
+        for p, (mult, dur) in enumerate(self.rate_profile or []):
+            row: dict = {"phase": p, "mult": mult, "dur_s": dur}
+            for cls in ("decode-floor", "prefill-burst"):
+                m = self.burst_metrics.get((p, cls))
+                if not m:
+                    continue
+                sect: dict = {"n": len(m["ttft"])}
+                if m["ttft"]:
+                    sect["ttft_p50_ms"] = self._exact_pct(
+                        m["ttft"], 0.5)
+                    sect["ttft_p99_ms"] = self._exact_pct(
+                        m["ttft"], 0.99)
+                if m["gaps"]:
+                    sect["interchunk_p50_ms"] = self._exact_pct(
+                        m["gaps"], 0.5)
+                    sect["interchunk_p99_ms"] = self._exact_pct(
+                        m["gaps"], 0.99)
+                row[cls] = sect
+            out.append(row)
+        return out
 
     def _phase_report(self) -> list[dict]:
         """Per-phase goodput + exact p50/p99 for a rate-profile run
@@ -828,7 +957,8 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "[--mix embed:W,search:W,complete:W] "
          "[--arrivals poisson|fixed] [--zipf S] [--corpus N] "
          "[--seed N] [--scenario rag-churn|rag-churn-script|"
-         "agent-loop|multi-hop|map-reduce|shared-prefix] [--k K] "
+         "agent-loop|multi-hop|map-reduce|shared-prefix|"
+         "prefill-burst] [--k K] "
          "[--shared-prefix P:LEN] [--rate-profile 1x:10,8x:20,"
          "1x:10] [--drain-s S] "
          "[--trace-sample P] [--slo-p99-ms MS] [--slo-goodput F] "
@@ -842,7 +972,10 @@ def evaluate_slo(report: dict, *, p99_ms: float | None = None,
          "prefix-cache hit rate; --rate-profile steps the offered "
          "rate piecewise over the open-loop clock — the elastic-"
          "lane proof harness — with per-phase goodput/p99 in the "
-         "summary)")
+         "summary; --scenario prefill-burst runs a steady decode-"
+         "floor tenant under a rate-stepped prefill-heavy burst "
+         "tenant and reports TTFT p50/p99 + inter-chunk p99 per "
+         "phase per class — the disaggregated-lane harness)")
 def cmd_loadgen(ses, args):
     duration = 5.0
     rate = 20.0
@@ -979,6 +1112,24 @@ def cmd_loadgen(ses, args):
             print(f"  phase {row['phase']} ({row['mult']:g}x for "
                   f"{row['dur_s']:g}s): {row['issued']} issued, "
                   f"goodput {row['goodput_ratio']:.1%} {cnt}{q}")
+        for row in report.get("prefill_burst", []):
+            parts = []
+            for cls in ("decode-floor", "prefill-burst"):
+                sect = row.get(cls)
+                if not sect:
+                    continue
+                bits = [f"{cls} n={sect['n']}"]
+                if "ttft_p50_ms" in sect:
+                    bits.append(f"ttft p50={sect['ttft_p50_ms']}ms "
+                                f"p99={sect['ttft_p99_ms']}ms")
+                if "interchunk_p99_ms" in sect:
+                    bits.append(
+                        f"interchunk p99="
+                        f"{sect['interchunk_p99_ms']}ms")
+                parts.append(" ".join(bits))
+            print(f"  burst phase {row['phase']} "
+                  f"({row['mult']:g}x for {row['dur_s']:g}s): "
+                  + " | ".join(parts or ["no completions"]))
         pfx = report.get("prefix_cache")
         if pfx:
             print(f"  prefix cache: hit rate {pfx['hit_rate']:.1%} "

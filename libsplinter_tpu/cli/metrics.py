@@ -45,7 +45,9 @@ _HEARTBEATS = (("embedder", P.KEY_EMBED_STATS),
                ("searcher", P.KEY_SEARCH_STATS),
                ("pipeliner", P.KEY_SCRIPT_STATS),
                ("telemetry", P.KEY_TELEMETRY_STATS),
-               ("autoscaler", P.KEY_AUTOSCALER_STATS))
+               ("autoscaler", P.KEY_AUTOSCALER_STATS),
+               ("prefill", P.KEY_PREFILL_STATS),
+               ("decode", P.KEY_DECODE_STATS))
 _TRACE_KEYS = (("embedder", P.KEY_EMBED_TRACE),
                ("completer", P.KEY_COMPLETE_TRACE),
                ("searcher", P.KEY_SEARCH_TRACE),

@@ -29,6 +29,19 @@ flapping replica set is worse than a slightly lazy one:
   - a `manual` target entry (`spt scale set`) is a
     hold: the controller leaves that lane alone until it is cleared
     back to auto.
+
+Per-lane SIGNAL selection (the disaggregated lanes, PR 18): the
+policy's per-lane `signal` field picks what pressure means.  `queue`
+(the default) is the classic queue-depth-per-replica read above.
+`pool` rates the lane by its paged-pool occupancy gauge instead —
+the decode lane's backlog is KV residency of adopted rows, not queue
+depth (a decode replica near pool exhaustion refuses adoption long
+before any queue forms), so occupancy >= POOL_UP_THRESHOLD votes
+scale-up one replica at a time and sustained occupancy below
+POOL_DOWN_THRESHOLD votes scale-down.  This is how `spt supervise
+--scale prefill=1:4 --scale decode=1:4` scales the two lanes
+INDEPENDENTLY: a prefill burst moves queue pressure, not pool
+occupancy, and vice versa.
 """
 from __future__ import annotations
 
@@ -52,6 +65,11 @@ DEFAULT_DOWN_THRESHOLD = 1.0    # queue depth per replica
 DEFAULT_UP_CONSECUTIVE = 2
 DEFAULT_DOWN_CONSECUTIVE = 5
 DEFAULT_COOLDOWN_S = 6.0
+# the `pool` signal's hysteresis band (occupancy fractions, 0..1):
+# adoption backpressure starts well before 1.0, so the up vote fires
+# at 80% and the lane is only surrendered once sustained below 30%
+POOL_UP_THRESHOLD = 0.80
+POOL_DOWN_THRESHOLD = 0.30
 
 
 @dataclasses.dataclass
@@ -102,6 +120,8 @@ class AutoScaler:
         self.cooldown_s = max(0.0, cooldown_s)
         self.stats = AutoScalerStats()
         self.lanes: dict[str, _LaneCtl] = {}
+        # lane -> scaling signal ("queue"|"pool"), from the policy
+        self.signals: dict[str, str] = {}
         # decision history: [ts, lane, from_r, to_r, reason] rows the
         # heartbeat publishes (and `spt scale status` renders) — the
         # flap/stuck triage read
@@ -146,6 +166,9 @@ class AutoScaler:
             except (TypeError, ValueError):
                 continue
             out[lane] = (lo, hi)
+            sig = b.get("signal")
+            self.signals[lane] = (sig if sig in ("queue", "pool")
+                                  else "queue")
         return out
 
     def _live_r(self, lane: str) -> int:
@@ -182,13 +205,22 @@ class AutoScaler:
                     queue_depth: float | None,
                     shed: float | None, live_r: int,
                     now_mono: float,
-                    sample_ts: float | None = None) -> int | None:
+                    sample_ts: float | None = None,
+                    signal: str = "queue") -> int | None:
         """One lane's hysteresis step.  Returns a NEW target replica
         count, or None (no action).  Pure against its inputs so the
         flapping unit tests can drive synthetic series.  `sample_ts`
         is the ring point's timestamp: a point already counted
         advances NO streak (a controller ticking faster than the
-        sampler must not turn one sample into a consecutive run)."""
+        sampler must not turn one sample into a consecutive run).
+
+        `signal="pool"` reinterprets `queue_depth` as the lane's
+        paged-pool occupancy fraction (0..1): the hysteresis band is
+        the POOL_* constants, the fraction is NOT divided by the
+        replica count (each replica owns its own pool; the telemetry
+        gauge is already the fleet-worst view), and scale-up steps by
+        ONE replica — occupancy says the pool is full, not how many
+        replicas the backlog is worth."""
         ctl = self.lanes.setdefault(lane, _LaneCtl())
         lo, hi = bounds
         if queue_depth is None:
@@ -200,16 +232,22 @@ class AutoScaler:
                 ctl.reason = "awaiting fresh telemetry"
                 return None           # streaks pause, never re-count
             ctl.last_sample_ts = sample_ts
-        pressure = queue_depth / max(1, live_r)
+        pooled = signal == "pool"
+        if pooled:
+            pressure = float(queue_depth)
+            up_thr, down_thr = POOL_UP_THRESHOLD, POOL_DOWN_THRESHOLD
+        else:
+            pressure = queue_depth / max(1, live_r)
+            up_thr, down_thr = self.up_threshold, self.down_threshold
         ctl.pressure = round(pressure, 3)
         shed_moved = (shed is not None and ctl.last_shed is not None
                       and shed > ctl.last_shed)
         if shed is not None:
             ctl.last_shed = shed
-        if pressure >= self.up_threshold or shed_moved:
+        if pressure >= up_thr or shed_moved:
             ctl.up_streak += 1
             ctl.down_streak = 0
-        elif pressure < self.down_threshold:
+        elif pressure < down_thr:
             ctl.down_streak += 1
             ctl.up_streak = 0
         else:
@@ -222,15 +260,18 @@ class AutoScaler:
                        < self.cooldown_s)
         if ctl.up_streak >= self.up_consecutive and not in_cooldown:
             # scale-up sizes to the backlog in ONE action: a sustained
-            # 8x step must not climb one replica per interval
-            want = max(live_r + 1,
-                       math.ceil(queue_depth / self.up_threshold))
+            # 8x step must not climb one replica per interval.  The
+            # pool signal steps by one — a fraction has no backlog
+            # magnitude to size from.
+            want = live_r + 1 if pooled else \
+                max(live_r + 1,
+                    math.ceil(queue_depth / self.up_threshold))
             target = min(hi, want)
             if target > live_r:
                 ctl.up_streak = 0
                 ctl.last_action_mono = now_mono
-                ctl.reason = (f"queue/replica {pressure:.1f} >= "
-                              f"{self.up_threshold:g}"
+                metric = "pool occ" if pooled else "queue/replica"
+                ctl.reason = (f"{metric} {pressure:.2f} >= {up_thr:g}"
                               + (" + shed moving" if shed_moved
                                  else ""))
                 return target
@@ -242,8 +283,9 @@ class AutoScaler:
             if target < live_r:
                 ctl.down_streak = 0
                 ctl.last_action_mono = now_mono
-                ctl.reason = (f"idle: queue/replica {pressure:.2f} < "
-                              f"{self.down_threshold:g} x"
+                metric = "pool occ" if pooled else "queue/replica"
+                ctl.reason = (f"idle: {metric} {pressure:.2f} < "
+                              f"{down_thr:g} x"
                               f"{self.down_consecutive}")
                 return target
             ctl.reason = f"at min ({lo})"
@@ -268,13 +310,15 @@ class AutoScaler:
                 ctl.reason = f"manual hold (r={tgt.get('r')})"
                 continue
             rec = read_history(self.store, lane)
-            q = self._ring_last(rec, "queue_depth")
+            signal = self.signals.get(lane, "queue")
+            gauge = "pool_occ" if signal == "pool" else "queue_depth"
+            q = self._ring_last(rec, gauge)
             shed = self._ring_last(rec, "shed")
             live_r = self._live_r(lane)
             target = self.decide_lane(
                 lane, bounds, q[1] if q else None,
                 shed[1] if shed else None, live_r, now_mono,
-                sample_ts=q[0] if q else None)
+                sample_ts=q[0] if q else None, signal=signal)
             ctl = self.lanes[lane]
             if target is None:
                 # bounds still apply with no action: a policy floor
@@ -314,6 +358,7 @@ class AutoScaler:
                    "lanes": {
                        ln: {"target": ctl.target,
                             "pressure": ctl.pressure,
+                            "signal": self.signals.get(ln, "queue"),
                             "reason": ctl.reason,
                             "up_streak": ctl.up_streak,
                             "down_streak": ctl.down_streak}

@@ -144,6 +144,16 @@ class Completer:
     decoder in tests (the daemon-level test gap called out in
     SURVEY.md §4)."""
 
+    # lane identity — the disaggregated prefill/decode lanes
+    # (engine/disagg.py) subclass this daemon and override these:
+    # LANE names the stripe map, span lane, debug prefix and devtime
+    # lane; HB_KEY the heartbeat base key; WATCH_BIT the label
+    # transition the lane wakes on (a decode lane watches
+    # DECODE_READY handoffs, not fresh INFER_REQ arrivals).
+    LANE = "completer"
+    HB_KEY = P.KEY_COMPLETE_STATS
+    WATCH_BIT = P.BIT_INFER_REQ
+
     def __init__(self, store: Store, generate_fn: GenerateFn | None = None,
                  *, model=None, tokenizer=None,
                  max_new_tokens: int = 256,
@@ -171,8 +181,8 @@ class Completer:
         # stripe-scoped too, so a restarted replica can never steal a
         # live peer's in-flight rows
         self.replica = int(replica)
-        self.stripes = P.StripeView(store, "completer", self.replica)
-        self._hb_key = P.replica_stats_key(P.KEY_COMPLETE_STATS,
+        self.stripes = P.StripeView(store, self.LANE, self.replica)
+        self._hb_key = P.replica_stats_key(self.HB_KEY,
                                            self.replica)
         self._trace_key = P.replica_stats_key(P.KEY_COMPLETE_TRACE,
                                               self.replica)
@@ -226,6 +236,12 @@ class Completer:
             weights=tenant_weights, high_water=queue_high_water,
             **({"retry_after_ms": retry_after_ms}
                if retry_after_ms is not None else {}))
+        # phase-aware deadline slack: a request whose deadline will
+        # pass before the lane's service phase even starts should
+        # fast-fail NOW instead of paying prefill first.  The prefill
+        # lane (engine/disagg.py) feeds this from a rolling prefill-
+        # wall EMA; 0.0 keeps the unified lane's exact-expiry check.
+        self.qos_slack_s = 0.0
         self.tenants = TenantLedger()
         self._had_deferred = False
         # join-backpressure memo, idx -> (slot epoch, pages needed):
@@ -257,7 +273,12 @@ class Completer:
         # (protocol.stamp_trace); batched/continuous paths aggregate
         # through the span histograms only
         self.recorder = FlightRecorder()
-        self.spans = SpanWriter(store, "completer")
+        self.spans = SpanWriter(store, self.LANE)
+        # disaggregated decode lane (engine/disagg.py): when set,
+        # run_continuous's admit() delegates to this callable —
+        # admission becomes ADOPTION of DECODE_READY handoffs and the
+        # WAITING queue belongs to the prefill lanes
+        self._lane_admit = None
         # pending spans between _prepare and _finalize, keyed by the
         # request key (every service path pairs the two); bounded by
         # in-flight work, with a hard cap against pathological leaks
@@ -296,7 +317,7 @@ class Completer:
                                        P.PRIO_COMPLETE, 30_000_000)
         except OSError:
             self._bid = -1
-        st.watch_label_register(P.BIT_INFER_REQ, self.group)
+        st.watch_label_register(self.WATCH_BIT, self.group)
         if st.header().bus_pid == 0:
             st.bus_init()
         else:
@@ -456,7 +477,8 @@ class Completer:
                 and capacity >= len(idxs):
             self._had_deferred = False
             return idxs
-        plan = self.qos.plan(rows, capacity)
+        plan = self.qos.plan(rows, capacity,
+                             slack_s=self.qos_slack_s)
         for row in plan.expired:
             self._terminal_reject(row.item,
                                   P.DEADLINE_EXPIRED_DIAGNOSTIC,
@@ -531,7 +553,7 @@ class Completer:
             if P.KEY_DEBUG not in st:
                 st.set(P.KEY_DEBUG, b"")
                 st.label_or(P.KEY_DEBUG, P.LBL_DEBUG)
-            st.append(P.KEY_DEBUG, f"[completer] {msg}\n")
+            st.append(P.KEY_DEBUG, f"[{self.LANE}] {msg}\n")
         except OSError:
             pass                      # debug channel full: not an error
 
@@ -696,8 +718,13 @@ class Completer:
         st = self.store
         span = self._live_spans.pop(key, None)
         # the request's device window (dispatch->collect wall across
-        # its decode chunks) — drain-scoped, SpanWriter.commit
-        device_ms = DEVTIME.take_lane_ms("completer")
+        # its decode chunks) — drain-scoped, SpanWriter.commit.  Split
+        # lanes drain BOTH accumulators: the paged programs register
+        # under the lane's own devtime name, the trunk + samplers stay
+        # under the canonical "completer" lane.
+        device_ms = DEVTIME.take_lane_ms(self.LANE)
+        if self.LANE != "completer":
+            device_ms += DEVTIME.take_lane_ms("completer")
         if span is None and stages:
             # tail-based retention: a slow request that carried no
             # trace stamp still keeps full INFER_STAGES detail — one
@@ -727,7 +754,11 @@ class Completer:
         except Exception:
             pass
         try:
-            st.label_clear(key, P.LBL_SERVICING)
+            # DECODE_READY cleared too: on the disaggregated decode
+            # lane a finishing row carries SERVICING|DECODE_READY and
+            # leaving the handoff bit set would invite a re-adoption
+            # of a completed request (a no-op clear elsewhere)
+            st.label_clear(key, P.LBL_SERVICING | P.LBL_DECODE_READY)
             st.label_or(key, P.LBL_READY)
             st.bump(key)
         except (KeyError, OSError):
@@ -756,6 +787,17 @@ class Completer:
                 self.store.shard_rebid(self._bid)
             except OSError:
                 pass
+
+    # -- disaggregated-lane hooks (engine/disagg.py overrides) -------------
+
+    def _lane_row_done(self, row: dict) -> None:
+        """A continuous-lane row retired (finish or mid-decode kill).
+        The decode lane deletes the row's handoff record + wire pages
+        here; the unified lane has nothing to clean up."""
+
+    def _lane_payload(self, payload: dict) -> None:
+        """Lane-specific heartbeat sections (handoff counters,
+        adoption gauges) land here just before publish."""
 
     def process_key(self, idx: int) -> bool:
         """Run one completion for slot idx.  Returns True if serviced.
@@ -1064,6 +1106,17 @@ class Completer:
             if row is not None and row.get("spans") is not None:
                 row["spans"].append([name, round(ms, 3)])
 
+        def _lane_ctx() -> dict:
+            """The adoption context a disaggregated decode lane's
+            _lane_admit hook seats rows through — everything a join
+            would have touched, snapshot-fresh (cache is rebound
+            after abort_all, so it must be read HERE, not captured
+            at loop entry)."""
+            return {"rows": rows, "fresh": fresh, "cache": cache,
+                    "serial": serial, "step": step,
+                    "worst_len": worst_len, "span": span,
+                    "finish": finish}
+
         def admit() -> int:
             """Fill free rows from waiting keys.  EVERY admission is a
             join — the prompt prefills into freshly allocated pages
@@ -1074,6 +1127,13 @@ class Completer:
             free = [r for r in range(B) if rows[r] is None]
             if not free:
                 return 0
+            if self._lane_admit is not None:
+                # disaggregated decode lane (engine/disagg.py):
+                # admission is ADOPTION of DECODE_READY handoffs at
+                # this chunk edge — the WAITING queue belongs to the
+                # prefill lanes, and a joiner's dense prefill never
+                # runs here (the whole point of the split)
+                return self._lane_admit(free, _lane_ctx())
             self.stripes.refresh()    # admission IS this lane's drain
             waiting = [i for i in st.enumerate_indices(P.LBL_INFER_REQ)
                        if self.stripes.owns(int(i))]
@@ -1324,8 +1384,9 @@ class Completer:
                         (time.perf_counter() - row["wall0"]) * 1e3)
                 self.recorder.record(tid, row["key"], wall,
                                      row["spans"])
-            cache.free_row(r)         # pages back to the pool NOW
-            rows[r] = None
+            self._lane_row_done(row)  # decode lane: retire the
+            cache.free_row(r)         # handoff record + wire pages
+            rows[r] = None            # pages back to the pool NOW
             fresh[r] = -1
 
         def kill_expired() -> int:
@@ -1347,13 +1408,15 @@ class Completer:
                 key = row["key"]
                 span_rec = self._live_spans.pop(key, None)
                 try:
-                    st.label_clear(key, P.LBL_SERVICING)
+                    st.label_clear(key, P.LBL_SERVICING
+                                   | P.LBL_DECODE_READY)
                     st.set(key, P.DEADLINE_EXPIRED_DIAGNOSTIC)
                     st.label_or(key, P.LBL_READY)
                     st.bump(key)
                 except (KeyError, OSError):
                     pass
                 self.spans.commit(span_rec, status=P.ERR_DEADLINE)
+                self._lane_row_done(row)
                 cache.free_row(r)     # pool pages back NOW
                 rows[r] = None
                 fresh[r] = -1
@@ -1859,10 +1922,18 @@ class Completer:
                     payload["pages_shard"] = shards
         if faults.armed():
             payload["faults"] = faults.stats()
-        payload["compile_events"] = DEVTIME.compile_events("completer")
-        devtime = DEVTIME.heartbeat_section("completer")
+        payload["compile_events"] = DEVTIME.compile_events(self.LANE)
+        devtime = DEVTIME.heartbeat_section(self.LANE)
+        if self.LANE != "completer":
+            # split lanes: the trunk + sampler programs register under
+            # the canonical "completer" devtime lane — their compiles
+            # and quantiles belong to this daemon's heartbeat too
+            payload["compile_events"] += \
+                DEVTIME.compile_events("completer")
+            devtime.update(DEVTIME.heartbeat_section("completer"))
         if devtime:
             payload["devtime"] = devtime
+        self._lane_payload(payload)
         DEVTIME.flush(self.store)
         if tracer.enabled:
             P.attach_trace_sections(payload, tracer, self.recorder,
@@ -2049,6 +2120,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="continuous batching: requests join/leave the "
                          "live batch at chunk boundaries instead of "
                          "waiting for whole drains (run_continuous)")
+    ap.add_argument("--phase", choices=("unified", "prefill", "decode"),
+                    default="unified",
+                    help="disaggregated serving (engine/disagg.py): "
+                         "'prefill' runs only dense bucket prefill and "
+                         "hands each committed row off at "
+                         "DECODE_READY; 'decode' adopts handoffs at "
+                         "chunk edges and runs only ragged paged "
+                         "decode — its K-deep window is never stalled "
+                         "by a joiner's prefill.  Both imply "
+                         "--continuous.  Default: the unified daemon "
+                         "that interleaves the two phases")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable cross-request prefix sharing on "
                          "the continuous lane (default on: shared "
@@ -2085,7 +2167,11 @@ def main(argv: list[str] | None = None) -> int:
     if os.environ.get("SPTPU_FORCE_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")
-    from ..utils.jaxplatform import enable_compile_cache
+    from ..utils.jaxplatform import apply_chip_pin, enable_compile_cache
+    if os.environ.get("SPTPU_CHIP_PIN"):
+        # supervisor lane placement (spt supervise --pin-chips):
+        # prefill and decode replicas land on disjoint chips
+        apply_chip_pin(os.environ["SPTPU_CHIP_PIN"])
     enable_compile_cache()
     store = Store.open(args.store, persistent=args.persistent)
     from ..models import CompletionModel, DecoderConfig
@@ -2172,7 +2258,11 @@ def main(argv: list[str] | None = None) -> int:
                  "layers, gamma=%d (drafts verify through the paged "
                  "kernel on the continuous lane)",
                  args.draft_layers, cfg.layers, args.gamma)
-    comp = Completer(store, model=model, tokenizer=tokenizer,
+    cls = Completer
+    if args.phase != "unified":
+        from .disagg import DecodeLane, PrefillLane
+        cls = PrefillLane if args.phase == "prefill" else DecodeLane
+    comp = cls(store, model=model, tokenizer=tokenizer,
                      max_new_tokens=args.max_new_tokens,
                      template=template, batch_cap=args.batch_cap,
                      page_size=args.page_size,
@@ -2190,9 +2280,10 @@ def main(argv: list[str] | None = None) -> int:
                          args.prefix_quota),
                      replica=args.replica)
     comp.attach()
+    continuous = args.continuous or args.phase != "unified"
     if args.warmup:
         t0 = time.monotonic()
-        paged = args.continuous and comp._paged_ok()
+        paged = continuous and comp._paged_ok()
         if paged:
             # the continuous lane only ever runs the paged program
             # set (paged prefill buckets + commit scatters + chunked
@@ -2215,7 +2306,7 @@ def main(argv: list[str] | None = None) -> int:
         log.info("oneshot serviced %d completions", n)
         return 0
     try:
-        if args.continuous:
+        if continuous:
             comp.run_continuous(idle_timeout_ms=args.idle_timeout_ms)
         else:
             comp.run(idle_timeout_ms=args.idle_timeout_ms)

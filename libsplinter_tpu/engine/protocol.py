@@ -23,6 +23,7 @@ LBL_SCRIPT_REQ = 0x1 << 56     # "run my script" — wakes the pipeline lane
 LBL_SEARCH_REQ = 0x1 << 57     # "search me" — wakes the search daemon
 LBL_TRACED = 0x1 << 58         # request carries a trace stamp (obs)
 LBL_DEADLINE = 0x1 << 52       # request carries a deadline stamp (QoS)
+LBL_DECODE_READY = 0x1 << 53   # prefill committed; awaiting decode adoption
 LBL_DEBUG = 0x1 << 59          # debug channel (sidecar watches this)
 LBL_INFER_REQ = 0x1 << 60      # "complete me" — wakes the completion daemon
 LBL_SERVICING = 0x1 << 61      # completion in progress
@@ -35,6 +36,7 @@ BIT_CTX_EXCEEDED = 7
 BIT_SCRIPT_REQ = 56
 BIT_SEARCH_REQ = 57
 BIT_DEADLINE = 52
+BIT_DECODE_READY = 53
 BIT_DEBUG = 59
 BIT_INFER_REQ = 60
 
@@ -116,6 +118,12 @@ KEY_EMBED_STATS = "__embedder_stats"
 KEY_COMPLETE_STATS = "__completer_stats"
 KEY_SEARCH_STATS = "__searcher_stats"
 KEY_SCRIPT_STATS = "__pipeliner_stats"
+# disaggregated completion lanes (prefill / decode split): each lane
+# type heartbeats under its own key so telemetry, `spt metrics`, and
+# the autoscaler read the two phases as separate lanes — a unified
+# completer keeps KEY_COMPLETE_STATS untouched
+KEY_PREFILL_STATS = "__prefill_stats"
+KEY_DECODE_STATS = "__decode_stats"
 # the supervisor's own heartbeat (engine/supervisor.py): per-lane
 # process state — pid, generation, restart/backoff/breaker counters,
 # and the breaker's down marker CLI clients consult before dispatching
@@ -182,9 +190,12 @@ INFER_STAGES = ("render", "generate", "commit")
 # path's.  prefix_hit = the host-side radix walk + shared-page table
 # mapping of a prefix-cache hit (engine/prefix_cache.py) — its span
 # next to `join` is how `spt trace show` attributes first-token
-# latency to cache hits vs suffix prefill.
+# latency to cache hits vs suffix prefill.  Under disaggregated
+# serving two more stages bracket the page-ownership transfer:
+# handoff = the prefill lane's export + record write + DECODE_READY
+# flip, adopt = the decode lane's claim + page import + row seating.
 CONT_INFER_STAGES = ("join", "sample", "decode", "collect", "flush",
-                     "prefix_hit")
+                     "prefix_hit", "handoff", "adopt")
 
 # the search daemon's per-drain decomposition: wake = signal to drain
 # entry (the coalescing window's scheduling cost); drain = request
@@ -334,6 +345,92 @@ REPLICA_SUFFIX = ".r"
 KEY_SCALE_POLICY = "__scale_policy"
 SCALE_TARGET_PREFIX = "__scale_tgt_"
 KEY_AUTOSCALER_STATS = "__autoscaler_stats"
+
+# --- disaggregated prefill/decode handoff ---------------------------------
+# The prefill lane commits a row's prompt K/V, samples its first
+# token, then hands the row to a decode lane THROUGH THE STORE: a
+# JSON handoff record under handoff_key(idx) (generation budget,
+# prompt ids for the re-prefill fallback, the sampled carry token,
+# byte offsets for crash truncation) plus optional raw wire pages
+# under handoff_page_key(idx, j) — the per-layer-stacked K/V bytes of
+# each committed page, so a decode lane with its OWN pool imports the
+# prefill without recomputing it (handoff_scale_key carries the int8
+# page scales when the pool is quantized).  The row's label flips
+# SERVICING -> DECODE_READY at the same moment; adoption sets
+# SERVICING on top (both bits = decode-phase in flight) and finish
+# clears everything to READY.  Crash safety both directions falls out
+# of the label machine: a died prefill lane leaves SERVICING-only
+# rows its stripe-scoped reclaim resets to WAITING (stale __ho_ keys
+# deleted with them), a died decode lane leaves SERVICING|DECODE_READY
+# rows that fall back to DECODE_READY (slot value truncated to the
+# record's prompt length; greedy decode replays byte-identically).
+# Wire keys persist until decode finish and are bounded by the lane
+# batch (one in-flight handoff set per prefill seat).
+HANDOFF_PREFIX = "__ho_"
+
+
+def handoff_key(idx: int) -> str:
+    return f"{HANDOFF_PREFIX}{idx}"
+
+
+def handoff_page_key(idx: int, j: int) -> str:
+    """Wire page j of slot idx's handoff: raw bytes, all layers
+    stacked (layers, kv_heads, page, head_dim) k then v."""
+    return f"{HANDOFF_PREFIX}{idx}.p{j}"
+
+
+def handoff_scale_key(idx: int, j: int) -> str:
+    """Wire page j's int8 scales: (layers, kv_heads) f32 k then v."""
+    return f"{HANDOFF_PREFIX}{idx}.s{j}"
+
+
+def write_handoff_record(store, idx: int, rec: dict) -> bool:
+    """Land the handoff record for slot idx (debug-labeled so the
+    sweep machinery can find strays).  Returns False when the store
+    rejects it — the prefill lane then falls back to finishing the
+    row itself rather than stranding it half-handed-off."""
+    try:
+        store.set(handoff_key(idx), json.dumps({"v": 1, **rec}))
+        store.label_or(handoff_key(idx), LBL_DEBUG)
+        return True
+    except (KeyError, OSError):
+        return False
+
+
+def read_handoff_record(store, idx: int) -> dict | None:
+    """Slot idx's handoff record, or None (absent / unparseable /
+    wrong version)."""
+    try:
+        rec = json.loads(store.get(handoff_key(idx)).rstrip(b"\0"))
+    except (KeyError, OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or rec.get("v") != 1:
+        return None
+    return rec
+
+
+def clear_handoff(store, idx: int, pages: int = 0) -> None:
+    """Retire slot idx's handoff record and its wire pages (decode
+    finish, or prefill-crash reclaim).  `pages` bounds the wire-key
+    sweep; with 0 the record's own page count is consulted first.
+    Never raises."""
+    if not pages:
+        rec = read_handoff_record(store, idx)
+        if rec is not None:
+            try:
+                pages = int(rec.get("wire_pages", 0))
+            except (TypeError, ValueError):
+                pages = 0
+    try:
+        store.unset(handoff_key(idx))
+    except (KeyError, OSError):
+        pass
+    for j in range(max(0, int(pages))):
+        for k in (handoff_page_key(idx, j), handoff_scale_key(idx, j)):
+            try:
+                store.unset(k)
+            except (KeyError, OSError):
+                pass
 
 
 def trace_stamp_key(idx: int) -> str:
@@ -994,9 +1091,11 @@ def lane_down(store, lane: str, *, max_age_s: float = 15.0) -> bool:
 
 # labels that mean "a daemon will still service (and consume the
 # stamp of) this row" — a TRACED row carrying none of them is an
-# orphan whose stamp landed after its request was serviced
+# orphan whose stamp landed after its request was serviced.
+# DECODE_READY counts: a handed-off row is still pending decode-lane
+# service, so its stamps must survive the prefill->decode gap.
 _REQ_LABELS = (LBL_EMBED_REQ | LBL_INFER_REQ | LBL_SERVICING
-               | LBL_SEARCH_REQ | LBL_SCRIPT_REQ)
+               | LBL_SEARCH_REQ | LBL_SCRIPT_REQ | LBL_DECODE_READY)
 
 
 def clear_span_stage(store, idx: int) -> None:

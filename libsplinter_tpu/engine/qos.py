@@ -198,12 +198,21 @@ class AdmissionController:
     # -- the decision ------------------------------------------------------
 
     def plan(self, waiting: Sequence[WaitingRow], capacity: int,
-             *, now: float | None = None) -> AdmissionPlan:
+             *, now: float | None = None,
+             slack_s: float = 0.0) -> AdmissionPlan:
+        """slack_s is the PHASE-AWARE deadline horizon: a lane that
+        knows admitted work pays an un-cancellable service phase first
+        (the disaggregated prefill lane's rolling prefill wall,
+        engine/disagg.py) passes that cost here, so a request whose
+        deadline lands inside it fast-fails BEFORE paying prefill
+        instead of expiring mid-phase.  0.0 is the exact-expiry check
+        every existing caller keeps."""
         now = time.time() if now is None else now
+        horizon = now + max(0.0, float(slack_s))
         plan = AdmissionPlan()
         live: list[WaitingRow] = []
         for row in waiting:
-            if row.deadline is not None and row.deadline <= now:
+            if row.deadline is not None and row.deadline <= horizon:
                 plan.expired.append(row)
             else:
                 live.append(row)

@@ -76,10 +76,13 @@ log = logging.getLogger("libsplinter_tpu.supervisor")
 
 class LaneSpec(NamedTuple):
     """One supervisable lane: child module, canonical heartbeat key,
-    and the hard replica ceiling (1 = the lane cannot stripe)."""
+    the hard replica ceiling (1 = the lane cannot stripe), and the
+    baked-in argv the lane type always passes its children (user
+    --<lane>-args append after these)."""
     module: str
     heartbeat_key: str
     max_replicas: int = 1
+    args: tuple = ()
 
 
 # lane name -> LaneSpec.  The lane names are the public vocabulary:
@@ -106,6 +109,17 @@ LANES: dict[str, LaneSpec] = {
     # a restarted controller resumes from the live policy + rings
     "autoscaler": LaneSpec("libsplinter_tpu.engine.autoscaler",
                            P.KEY_AUTOSCALER_STATS, 1),
+    # disaggregated serving (engine/disagg.py): the completer daemon
+    # split into its two phases behind the same label protocol.  The
+    # autoscaler drives them on DIFFERENT signals — prefill on queue
+    # pressure, decode on paged-pool occupancy (_publish_policy) —
+    # and --pin-chips lands their replicas on disjoint chips.
+    "prefill": LaneSpec("libsplinter_tpu.engine.completer",
+                        P.KEY_PREFILL_STATS, 4,
+                        ("--phase", "prefill")),
+    "decode": LaneSpec("libsplinter_tpu.engine.completer",
+                       P.KEY_DECODE_STATS, 4,
+                       ("--phase", "decode")),
 }
 
 
@@ -182,11 +196,16 @@ class Supervisor:
                  scale: dict[str, tuple[int, int]] | None = None,
                  scale_knobs: dict | None = None,
                  drain_deadline_s: float = 5.0,
+                 chip_pins: dict[str, str] | None = None,
                  spawn_fn=None, clock=None,
                  store: Store | None = None):
         self.store_name = store_name
         self.persistent = persistent
         self.lane_args = lane_args or {}
+        # per-lane device pin (--pin-chips): children see it as
+        # SPTPU_CHIP_PIN and bind jax.default_device before warmup, so
+        # e.g. prefill and decode replicas land on disjoint chips
+        self.chip_pins = dict(chip_pins or {})
         self.backoff_base_ms = backoff_base_ms
         self.backoff_max_ms = backoff_max_ms
         self.breaker_threshold = breaker_threshold
@@ -248,8 +267,14 @@ class Supervisor:
         per-lane bounds plus the controller knobs `spt supervise`
         was given.  Store state, so `spt scale status` and a
         restarted controller both read the same truth."""
+        # per-lane scaling SIGNAL: the disaggregated decode lane is
+        # paced by paged-pool occupancy (its backlog is adopted rows'
+        # KV residency, not queue depth); every other lane scales on
+        # the classic queue-pressure signal
         rec = {"v": 1,
-               "lanes": {ln: {"min": lo, "max": hi}
+               "lanes": {ln: {"min": lo, "max": hi,
+                              "signal": ("pool" if ln == "decode"
+                                         else "queue")}
                          for ln, (lo, hi) in self.scale.items()}}
         for k in ("interval_s", "up_threshold", "down_threshold",
                   "cooldown_s"):
@@ -270,6 +295,9 @@ class Supervisor:
             # generation of the canonical replica only; respawns and
             # scale-up replicas must prove clean service
             env.pop("SPTPU_FAULT", None)
+        pin = self.chip_pins.get(lane.name)
+        if pin:
+            env["SPTPU_CHIP_PIN"] = pin
         return env
 
     def _spawn_child(self, lane: LaneProc):
@@ -279,6 +307,7 @@ class Supervisor:
             argv.append("--persistent")
         if lane.replica > 0:
             argv += ["--replica", str(lane.replica)]
+        argv += list(LANES[lane.name].args)
         argv += self.lane_args.get(lane.name, [])
         return subprocess.Popen(argv, env=self._child_env(lane))
 
@@ -642,12 +671,24 @@ class Supervisor:
         reaped replica's OWN stripes are touched — a sibling replica
         still draining its closed share keeps its in-flight rows.
 
+        The disaggregated lanes reclaim per their handoff contract
+        (engine/disagg.py): a dead PREFILL replica's SERVICING rows
+        drop any half-written handoff wire state and re-queue to
+        WAITING (the request re-prefills — nothing was streamed from
+        a handed-off row yet); a dead DECODE replica's adopted rows
+        (SERVICING with DECODE_READY still set and an intact handoff
+        record) roll BACK to bare DECODE_READY with the slot
+        truncated to the record's prompt length, so a surviving
+        decode replica re-adopts from the carry token instead of
+        replaying partial output into the stream.
+
         Known bound: a claim that PREDATES an earlier re-stripe can
         sit in a stripe this replica no longer owned at retire time
         and is not swept here — the window is one in-flight request
         spanning two scale actions (cooldown-separated), and
         claim-owner stamping is the follow-up that would close it."""
-        if lane_name != "completer" or not closed:
+        if lane_name not in ("completer", "prefill", "decode") \
+                or not closed:
             return 0
         rec = P.read_stripe_map(self.store, lane_name)
         closed = set(closed)
@@ -666,6 +707,28 @@ class Supervisor:
                 key = st.key_at(idx)
                 if key is None:
                     continue
+                labels = st.labels_at(idx)
+                if lane_name == "decode" \
+                        and labels & P.LBL_DECODE_READY:
+                    hrec = P.read_handoff_record(st, idx)
+                    if hrec is None:
+                        # adopted row whose handoff record vanished:
+                        # nothing to resume from — full re-prefill
+                        st.label_clear(
+                            key,
+                            P.LBL_SERVICING | P.LBL_DECODE_READY)
+                        st.label_or(
+                            key, P.LBL_INFER_REQ | P.LBL_WAITING)
+                    else:
+                        plen = int(hrec.get("plen", 0))
+                        if plen and st.value_len(key) > plen:
+                            st.set(key, st.get(key)[:plen])
+                        st.label_clear(key, P.LBL_SERVICING)
+                    st.bump(key)
+                    n += 1
+                    continue
+                if lane_name == "prefill":
+                    P.clear_handoff(st, idx)
                 st.label_clear(key, P.LBL_SERVICING)
                 st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
                 n += 1
@@ -818,6 +881,32 @@ def parse_scale_spec(specs) -> dict[str, tuple[int, int]]:
     return out
 
 
+def parse_chip_pins(spec: str) -> dict[str, str]:
+    """Parse --pin-chips "prefill=0,decode=1" -> {"prefill": "0",
+    "decode": "1"}.  The value is an opaque device ordinal forwarded
+    to children as SPTPU_CHIP_PIN (utils.jaxplatform.apply_chip_pin
+    binds jax.default_device to it, degrading to a warning when the
+    host has fewer devices — so one spt invocation works on both the
+    multi-chip pod and the 1-device CI box).  A malformed spec fails
+    startup: a typo must never silently co-locate the lanes."""
+    out: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lane, sep, dev = part.partition("=")
+        lane, dev = lane.strip(), dev.strip()
+        if not sep or not lane or not dev:
+            raise ValueError(
+                f"--pin-chips wants LANE=DEVICE, got {part!r}")
+        if lane not in LANES:
+            raise ValueError(
+                f"--pin-chips names unknown lane {lane!r} "
+                f"(supervisable: {sorted(LANES)})")
+        out[lane] = dev
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     """CLI entry: python -m libsplinter_tpu.engine.supervisor
     --store NAME [--lanes embedder,searcher] [child flags via
@@ -869,6 +958,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--drain-deadline-s", type=float, default=None,
                     help="scale-down: seconds a retiring replica "
                          "gets to finish in-flight work")
+    ap.add_argument("--pin-chips", default="",
+                    metavar="LANE=DEV[,LANE=DEV]",
+                    help="per-lane device pin, e.g. "
+                         "'prefill=0,decode=1' lands the two "
+                         "disaggregated lanes on disjoint chips "
+                         "(children see SPTPU_CHIP_PIN; off-range "
+                         "pins degrade to a warning on small hosts)")
     for lane in LANES:
         ap.add_argument(f"--{lane}-args", default="",
                         help=f"extra argv for the {lane} child "
@@ -886,6 +982,11 @@ def main(argv: list[str] | None = None) -> int:
               if (val := getattr(args, name)) is not None}
     if args.keep_faults:
         sup_kw["keep_faults"] = True
+    if args.pin_chips:
+        try:
+            sup_kw["chip_pins"] = parse_chip_pins(args.pin_chips)
+        except ValueError as ex:
+            ap.error(str(ex))
     lanes = [ln.strip() for ln in args.lanes.split(",") if ln.strip()]
     if args.scale:
         knobs = {"interval_s": args.scale_interval_s,

@@ -44,6 +44,12 @@ SCRAPE_LANES: dict[str, tuple[str, int]] = {
     "completer": (P.KEY_COMPLETE_STATS, P.LBL_INFER_REQ),
     "searcher": (P.KEY_SEARCH_STATS, P.LBL_SEARCH_REQ),
     "pipeliner": (P.KEY_SCRIPT_STATS, P.LBL_SCRIPT_REQ),
+    # the disaggregated completer phases (engine/disagg.py): prefill's
+    # queue is the classic waiting-request backlog; decode's "queue"
+    # is the handed-off rows awaiting adoption — and its scaling
+    # signal is the pool_occ gauge derived below, not queue depth
+    "prefill": (P.KEY_PREFILL_STATS, P.LBL_INFER_REQ),
+    "decode": (P.KEY_DECODE_STATS, P.LBL_DECODE_READY),
 }
 
 # heartbeat counters copied into the rings when present (beyond the
@@ -55,14 +61,22 @@ _COUNTER_GAUGES = ("shed", "deferred", "deadline_expired")
 PROGRESS_FIELDS = {"embedder": "embedded",
                    "completer": "completions",
                    "searcher": "served",
-                   "pipeliner": "scripts_completed"}
-_EXTRA = {"completer": ("pages_free", "tokens", "prefix_hits",
-                        "prefix_shared_pages", "pool_mb",
-                        "pool_mb_peak", "pages_used_peak",
-                        "compile_events"),
+                   "pipeliner": "scripts_completed",
+                   "prefill": "handoffs",
+                   "decode": "completions"}
+_EXTRA = {"completer": ("pages_free", "pages_used", "tokens",
+                        "prefix_hits", "prefix_shared_pages",
+                        "pool_mb", "pool_mb_peak",
+                        "pages_used_peak", "compile_events"),
           "embedder": ("compile_count", "compile_events"),
           "searcher": ("compile_events",),
-          "pipeliner": ("scripts_active",)}
+          "pipeliner": ("scripts_active",),
+          "prefill": ("handoff_failed", "handoff_wire_mb",
+                      "prefix_hits", "prefill_wall_ema_ms",
+                      "compile_events"),
+          "decode": ("pages_free", "pages_used", "tokens",
+                     "adopted", "readopted", "adopt_backpressure",
+                     "handoff_refill", "compile_events")}
 
 DEFAULT_INTERVAL_S = 2.0
 DEFAULT_RING_LEN = 64
@@ -155,6 +169,16 @@ class TelemetrySampler:
                                                (int, float)):
                 out["progress"] = out.get("progress", 0.0) \
                     + float(snap[prog])
+            # paged-pool occupancy fraction — the decode lane's
+            # scaling signal (autoscaler `signal: "pool"`).  Each
+            # replica owns its own pool, so the fleet-WORST replica
+            # is the scaling truth (one exhausted pool refuses
+            # adoption no matter how empty its siblings are).
+            pu, pf = snap.get("pages_used"), snap.get("pages_free")
+            if isinstance(pu, (int, float)) \
+                    and isinstance(pf, (int, float)) and pu + pf > 0:
+                out["pool_occ"] = max(out.get("pool_occ", 0.0),
+                                      float(pu) / float(pu + pf))
             # stage p99s (tracing on): e2e + every published stage —
             # the quantiles section carries prefix-stripped stage
             # names; across replicas the WORST p99 is the SLO truth

@@ -858,6 +858,13 @@ class CompletionModel:
                 jnp.zeros((1, self.buckets[0]), jnp.int32), cache,
                 jnp.int32(0))
         self.params = params
+        # devtime attribution lane for the LAZY program caches below
+        # (chunk/join/paged): a disaggregated lane overwrites this
+        # ("prefill"/"decode") before warmup so its programs ledger
+        # under their phase — prefill.bucket_commit, decode.paged_chunk
+        # — while the trunk and samplers (registered eagerly, shared
+        # geometry) stay under the canonical completer.* names.
+        self.devtime_lane = "completer"
         self._fn = DEVTIME.register("completer.trunk",
                                     jax.jit(self.module.apply))
         self._rng = jax.random.PRNGKey(seed + 1)
@@ -868,6 +875,17 @@ class CompletionModel:
         self._chunk_progs: dict[tuple, Any] = {}
         self._join_progs: dict[int, Any] = {}     # continuous-batch joins
         self._paged_progs: dict[tuple, Any] = {}  # paged decode/commit
+
+    def _devname(self, short: str) -> str:
+        """The devtime registration name for a lazily built program:
+        `<devtime_lane>.<short>`.  Disaggregated lanes rename the
+        commit scatter to its phase-honest name — the prefill lane's
+        whole dense pass exists to feed that scatter, so it ledgers
+        as prefill.bucket_commit (ROADMAP's name for it), not as a
+        generic paged_commit."""
+        if self.devtime_lane != "completer" and short == "paged_commit":
+            short = "bucket_commit"
+        return f"{self.devtime_lane}.{short}"
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -966,7 +984,7 @@ class CompletionModel:
                     step, (cache, pos, rng, toks), None, length=n)
                 return cache, out                  # out: (n, bp)
 
-            fn = DEVTIME.register("completer.chunk",
+            fn = DEVTIME.register(self._devname("chunk"),
                                   jax.jit(run, donate_argnums=(1,)))
             self._chunk_progs[key] = fn
             # bound the cache: per-request sampler settings must not
@@ -1122,7 +1140,7 @@ class CompletionModel:
                     for (bk, bv), (rk, rv) in zip(batch_cache, row_cache)]
                 return new_cache, logits[0, b - 1]
 
-            fn = DEVTIME.register("completer.join",
+            fn = DEVTIME.register(self._devname("join"),
                                   jax.jit(run, donate_argnums=(1,)))
             self._join_progs[b] = fn
         return fn
@@ -1304,7 +1322,7 @@ class CompletionModel:
                     2, 0, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.paged_commit",
+                    self._devname("paged_commit"),
                     jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw))
             else:
                 def run(k_pools, v_pools, dense, bids):
@@ -1318,7 +1336,7 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(2, 0)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.paged_commit",
+                    self._devname("paged_commit"),
                     jax.jit(run, donate_argnums=(0, 1), **kw))
             self._paged_progs[key] = fn
         return fn
@@ -1411,7 +1429,7 @@ class CompletionModel:
                     2, 1, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.suffix_prefill",
+                    self._devname("suffix_prefill"),
                     jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw))
             else:
                 def run(params, k_pools, v_pools, table, length, ids,
@@ -1427,7 +1445,7 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(2, 1)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.suffix_prefill",
+                    self._devname("suffix_prefill"),
                     jax.jit(run, donate_argnums=(1, 2), **kw))
             self._paged_progs[key] = fn
         return fn
@@ -1498,7 +1516,7 @@ class CompletionModel:
                     2, 0, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.cow_copy",
+                    self._devname("cow_copy"),
                     jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw))
             else:
                 def run(k_pools, v_pools, src, dst):
@@ -1508,7 +1526,7 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(2, 0)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.cow_copy",
+                    self._devname("cow_copy"),
                     jax.jit(run, donate_argnums=(0, 1), **kw))
             self._paged_progs[key] = fn
         return fn
@@ -1543,6 +1561,237 @@ class CompletionModel:
             cache.commit_cow(row, p_idx, dst)
             n += 1
         return n
+
+    # -- disaggregated handoff (prefill lane -> decode lane) --------------
+    #
+    # The two lane types hold SEPARATE pools (separate processes, each
+    # with its own HBM envelope), so a handoff moves a row's committed
+    # pages through the host: the prefill lane gathers each page once
+    # (all layers stacked, one device->host copy per page — the same
+    # once-per-request cost class as the join itself), lands the bytes
+    # in the store, and the decode lane scatters them into its own
+    # pool at adoption.  Within ONE pool (unified lane, or a future
+    # colocated deployment) adoption stays the refcount table write
+    # map_shared already is — these programs are the cross-pool wire.
+
+    def _rep_out_shardings(self, n: int):
+        """out_shardings pinning n replicated outputs — None for an
+        unsharded pool (the jit default)."""
+        if os.environ.get("SPTPU_SEED_RECOMPILE") == "1":
+            return None
+        sh = self._pool_sharding()
+        if sh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        return (NamedSharding(sh.mesh, PartitionSpec()),) * n
+
+    def _page_export_program(self, quantized: bool = False):
+        """One program gathering pool page `bid` across every layer
+        and side into replicated (layers, KH, page, D) stacks (+ the
+        (layers, KH) scale stacks for int8 pools) — the device half
+        of a handoff export, one dispatch per page."""
+        key = ("page_export", quantized)
+        fn = self._paged_progs.get(key)
+        if fn is None:
+            if quantized:
+                def run(k_pools, v_pools, k_scales, v_scales, bid):
+                    return (jnp.stack([p[bid] for p in k_pools]),
+                            jnp.stack([p[bid] for p in v_pools]),
+                            jnp.stack([s[bid] for s in k_scales]),
+                            jnp.stack([s[bid] for s in v_scales]))
+                n_out = 4
+            else:
+                def run(k_pools, v_pools, bid):
+                    return (jnp.stack([p[bid] for p in k_pools]),
+                            jnp.stack([p[bid] for p in v_pools]))
+                n_out = 2
+            out_sh = self._rep_out_shardings(n_out)
+            kw = {} if out_sh is None else {"out_shardings": out_sh}
+            fn = DEVTIME.register(self._devname("page_export"),
+                                  jax.jit(run, **kw))
+            self._paged_progs[key] = fn
+        return fn
+
+    def _page_import_program(self, quantized: bool = False):
+        """One program scattering a handed-off page's stacked host
+        arrays into pool page `bid` across every layer and side —
+        the device half of an adoption import."""
+        key = ("page_import", quantized)
+        fn = self._paged_progs.get(key)
+        if fn is None:
+            if quantized:
+                def run(k_pools, v_pools, k_scales, v_scales,
+                        kv, vv, ks, vs, bid):
+                    return (
+                        [p.at[bid].set(kv[i])
+                         for i, p in enumerate(k_pools)],
+                        [p.at[bid].set(vv[i])
+                         for i, p in enumerate(v_pools)],
+                        [s.at[bid].set(ks[i])
+                         for i, s in enumerate(k_scales)],
+                        [s.at[bid].set(vs[i])
+                         for i, s in enumerate(v_scales)])
+
+                out_sh = self._paged_pool_out_shardings(
+                    2, 0, n_scale_lists=2)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = DEVTIME.register(
+                    self._devname("page_import"),
+                    jax.jit(run, donate_argnums=(0, 1, 2, 3), **kw))
+            else:
+                def run(k_pools, v_pools, kv, vv, bid):
+                    return (
+                        [p.at[bid].set(kv[i])
+                         for i, p in enumerate(k_pools)],
+                        [p.at[bid].set(vv[i])
+                         for i, p in enumerate(v_pools)])
+
+                out_sh = self._paged_pool_out_shardings(2, 0)
+                kw = {} if out_sh is None else {"out_shardings": out_sh}
+                fn = DEVTIME.register(
+                    self._devname("page_import"),
+                    jax.jit(run, donate_argnums=(0, 1), **kw))
+            self._paged_progs[key] = fn
+        return fn
+
+    def _page_wire_dtype(self, cache: PagedKVCache):
+        return np.dtype("int8") if cache.quantized \
+            else np.dtype(cache.k_pools[0].dtype)
+
+    def page_wire_bytes(self, cache: PagedKVCache) -> int:
+        """Bytes one exported page occupies on the wire (k + v values
+        across every layer; int8 scales ride a separate key)."""
+        cfg = self.cfg
+        return (2 * cfg.layers * cfg.kv_heads * cache.page
+                * cfg.head_dim * self._page_wire_dtype(cache).itemsize)
+
+    def export_row_pages(self, cache: PagedKVCache, row: int
+                         ) -> tuple[list[bytes], list[bytes | None]]:
+        """Host copies of every page `row`'s table maps, in table
+        order: (page_bytes, scale_bytes) lists, each page's bytes the
+        k stack then the v stack ((layers, KH, page, D) each); scale
+        entries are None for float pools.  The partial last page is
+        exported whole — adoption masks by length, exactly as the
+        ragged kernel does."""
+        n = len(cache._owned[row])
+        prog = self._page_export_program(cache.quantized)
+        pages: list[bytes] = []
+        scales: list[bytes | None] = []
+        for p_idx in range(n):
+            bid = jnp.int32(int(cache.tables[row, p_idx]))
+            if cache.quantized:
+                k, v, ks, vs = prog(cache.k_pools, cache.v_pools,
+                                    cache.k_scales, cache.v_scales,
+                                    bid)
+                pages.append(np.asarray(k).tobytes()
+                             + np.asarray(v).tobytes())
+                scales.append(np.asarray(ks).tobytes()
+                              + np.asarray(vs).tobytes())
+            else:
+                k, v = prog(cache.k_pools, cache.v_pools, bid)
+                pages.append(np.asarray(k).tobytes()
+                             + np.asarray(v).tobytes())
+                scales.append(None)
+        return pages, scales
+
+    def paged_adopt_row(self, cache: PagedKVCache, row: int,
+                        length: int, pages: list[bytes],
+                        scales: list[bytes | None] | None = None
+                        ) -> bool:
+        """Seat a handed-off row into THIS pool: grow its table to
+        cover `length` tokens, then scatter each wire page into its
+        freshly allocated block (one dispatch per page).  Returns
+        False — nothing imported, nothing allocated beyond what the
+        caller already reserved — when the pool cannot hold the row
+        (adoption backpressure: the row stays DECODE_READY).  The
+        caller is responsible for reserving the row's WORST case
+        (prompt + max_new) before importing, the same admission
+        contract paged_prefill_row rides."""
+        cfg = self.cfg
+        need = cache.pages_needed(length)
+        if len(pages) < need:
+            raise ValueError(
+                f"handoff for row {row} carries {len(pages)} pages, "
+                f"{need} needed to cover {length} tokens")
+        if not cache.ensure(row, length):
+            return False
+        prog = self._page_import_program(cache.quantized)
+        dt = self._page_wire_dtype(cache)
+        shape = (cfg.layers, cfg.kv_heads, cache.page, cfg.head_dim)
+        half = self.page_wire_bytes(cache) // 2
+        for p_idx in range(need):
+            buf = pages[p_idx]
+            if len(buf) != 2 * half:
+                raise ValueError(
+                    f"wire page {p_idx} holds {len(buf)} bytes, "
+                    f"expected {2 * half}")
+            kv = np.frombuffer(buf[:half], dt).reshape(shape)
+            vv = np.frombuffer(buf[half:], dt).reshape(shape)
+            bid = jnp.int32(int(cache.tables[row, p_idx]))
+            if cache.quantized:
+                sbuf = (scales or [None] * need)[p_idx] or b""
+                sh = (cfg.layers, cfg.kv_heads)
+                sn = cfg.layers * cfg.kv_heads * 4
+                if len(sbuf) != 2 * sn:
+                    raise ValueError(
+                        f"wire scales {p_idx} hold {len(sbuf)} bytes,"
+                        f" expected {2 * sn}")
+                ks = np.frombuffer(sbuf[:sn], np.float32).reshape(sh)
+                vs = np.frombuffer(sbuf[sn:], np.float32).reshape(sh)
+                kp, vp, ksc, vsc = prog(
+                    cache.k_pools, cache.v_pools, cache.k_scales,
+                    cache.v_scales, jnp.asarray(kv), jnp.asarray(vv),
+                    jnp.asarray(ks), jnp.asarray(vs), bid)
+                cache.k_scales, cache.v_scales = list(ksc), list(vsc)
+            else:
+                kp, vp = prog(cache.k_pools, cache.v_pools,
+                              jnp.asarray(kv), jnp.asarray(vv), bid)
+            cache.k_pools, cache.v_pools = list(kp), list(vp)
+        cache.lengths[row] = int(length)
+        return True
+
+    def warmup_handoff(self, cache: PagedKVCache, *,
+                       export: bool = True, adopt: bool = True
+                       ) -> None:
+        """Pre-compile the handoff wire programs so the first handoff
+        (or adoption) at serve time never pays a jit compile — the
+        same no-recompile contract warmup_paged pins for the serving
+        programs."""
+        with DEVTIME.warmup_phase():
+            bid = cache._alloc_page()
+            try:
+                if export:
+                    prog = self._page_export_program(cache.quantized)
+                    if cache.quantized:
+                        prog(cache.k_pools, cache.v_pools,
+                             cache.k_scales, cache.v_scales,
+                             jnp.int32(bid))
+                    else:
+                        prog(cache.k_pools, cache.v_pools,
+                             jnp.int32(bid))
+                if adopt:
+                    cfg = self.cfg
+                    dt = self._page_wire_dtype(cache)
+                    shape = (cfg.layers, cfg.kv_heads, cache.page,
+                             cfg.head_dim)
+                    z = jnp.zeros(shape, dt)
+                    prog = self._page_import_program(cache.quantized)
+                    if cache.quantized:
+                        zs = jnp.zeros((cfg.layers, cfg.kv_heads),
+                                       jnp.float32)
+                        kp, vp, ks, vs = prog(
+                            cache.k_pools, cache.v_pools,
+                            cache.k_scales, cache.v_scales, z, z,
+                            zs, zs, jnp.int32(bid))
+                        cache.k_scales = list(ks)
+                        cache.v_scales = list(vs)
+                    else:
+                        kp, vp = prog(cache.k_pools, cache.v_pools,
+                                      z, z, jnp.int32(bid))
+                    cache.k_pools = list(kp)
+                    cache.v_pools = list(vp)
+            finally:
+                cache._decref(bid)
 
     def _paged_chunk_program(self, n: int, bp: int,
                              quantized: bool = False):
@@ -1601,7 +1850,7 @@ class CompletionModel:
                     2, 2, n_scale_lists=2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.paged_chunk",
+                    self._devname("paged_chunk"),
                     jax.jit(run, donate_argnums=(1, 2, 3, 4), **kw))
             else:
                 def run(params, k_pools, v_pools, tables, lengths, rng,
@@ -1630,7 +1879,7 @@ class CompletionModel:
                 out_sh = self._paged_pool_out_shardings(2, 2)
                 kw = {} if out_sh is None else {"out_shardings": out_sh}
                 fn = DEVTIME.register(
-                    "completer.paged_chunk",
+                    self._devname("paged_chunk"),
                     jax.jit(run, donate_argnums=(1, 2), **kw))
             self._paged_progs[key] = fn
             if len(self._paged_progs) > 24:
@@ -1712,7 +1961,7 @@ class CompletionModel:
                                          self.cfg.max_len)
         return PendingChunk(out, last, n,
                             mark=DEVTIME.take_mark(
-                                "completer.paged_chunk"))
+                                self._devname("paged_chunk")))
 
     def warmup_paged(self, cache: PagedKVCache, chunk: int = 8,
                      max_prompt: int | None = None) -> None:

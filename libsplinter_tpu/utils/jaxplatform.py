@@ -63,6 +63,45 @@ def enable_compile_cache(path: str | None = None) -> None:
         pass  # cache is an optimization; never fail the caller
 
 
+def apply_chip_pin(spec: str) -> bool:
+    """Bind this process's jax.default_device to device ordinal `spec`
+    (the supervisor's --pin-chips plumbing: children receive it as
+    SPTPU_CHIP_PIN before warmup, so e.g. disaggregated prefill and
+    decode replicas land on disjoint chips and neither lane's compile
+    or HBM pressure evicts the other's working set).
+
+    Degrades, never fails: an unparsable spec or an ordinal past the
+    host's device count logs a warning and leaves placement alone —
+    the same supervise invocation must work on the multi-chip pod AND
+    the 1-device CI box.  Returns True iff the pin took effect.
+    """
+    import logging
+
+    import jax
+
+    try:
+        ordinal = int(str(spec).strip())
+    except (TypeError, ValueError):
+        logging.getLogger(__name__).warning(
+            "SPTPU_CHIP_PIN=%r is not a device ordinal; ignoring",
+            spec)
+        return False
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        devices = []
+    if not 0 <= ordinal < len(devices):
+        logging.getLogger(__name__).warning(
+            "SPTPU_CHIP_PIN=%d out of range (host has %d device(s)); "
+            "leaving default placement", ordinal, len(devices))
+        return False
+    try:
+        jax.config.update("jax_default_device", devices[ordinal])
+    except RuntimeError:
+        return False
+    return True
+
+
 def tpu_available(timeout_s: float = 60.0) -> bool:
     """Probe whether the TPU backend can be claimed, without risking an
     unbounded hang in this process.

@@ -642,8 +642,12 @@ lanes):
   Each replica heartbeat carries `replica` + a `stripe` section
   (epoch / width / owned-stripe count).
 - `__scale_policy` — supervisor-published bounds + controller knobs:
-  `{"lanes": {lane: {"min": m, "max": M}}, "up_threshold": ...,
-  "down_threshold": ..., "cooldown_s": ..., "interval_s": ...}`.
+  `{"lanes": {lane: {"min": m, "max": M, "signal":
+  "queue"|"pool"}}, "up_threshold": ..., "down_threshold": ...,
+  "cooldown_s": ..., "interval_s": ...}` (`signal` selects each
+  lane's pressure source: `queue` = queue depth per live replica —
+  every lane's default; `pool` = fleet-worst paged-pool occupancy —
+  the decode lane's memory-bound signal).
 - `__scale_tgt_<lane>` — one desired-count key per lane: `{"r": N,
   "src": "auto"|"manual", "ts": ...}` (per-lane keys: no shared
   read-modify-write map for concurrent writers to race) — written
@@ -659,6 +663,36 @@ lanes):
   optional `scale_min`/`scale_max`, per-replica `replicas`
   subsections, and the supervisor totals gain `retired` +
   `scale_events`.
+
+### Disaggregated-handoff keys (`libsplinter_tpu/engine/disagg.py`)
+
+The prefill -> decode page handoff (runbook: `docs/operations.md`
+§Disaggregated lanes) keeps its whole wire protocol in the store,
+keyed by the request's SLOT INDEX so both sides and the supervisor's
+reclaim agree on ownership without a directory:
+
+- `__ho_<idx>` — the handoff record (debug-labeled JSON, `{"v": 1,
+  "len": prompt_tokens, "ids": [...], "carry": first_sampled_token,
+  "n_tok": 1, "remaining": ..., "disp_left": ..., "plen":
+  slot_bytes_at_handoff, "t0": ..., "tenant": ..., "deadline": ...,
+  "wire_pages": N, "quant": bool}`).  The record lands LAST — after
+  the wire pages, before the `DECODE_READY` flip — so a record's
+  existence IS the adoptability contract; `plen` is the truncation
+  point crash recovery rolls a dead adopter's slot back to.
+- `__ho_<idx>.p<j>` / `__ho_<idx>.s<j>` — the row's exported KV
+  pages (and per-page int8 scales when `quant`), one key per page,
+  written only when a page fits `max_val`; `wire_pages: 0` means the
+  adopter re-prefills from `ids` instead (the `handoff_refill`
+  counter).  All `__ho_` keys leave the store with the request —
+  finish, typed reject, and both crash-recovery sweeps all clear
+  them.
+- `__prefill_stats` / `__decode_stats` — the lanes' heartbeats
+  (replica-suffixed like every elastic lane).  Prefill: `handoffs`,
+  `handoff_failed`, `handoff_wire_mb`, `prefill_wall_ema_ms` (the
+  phase-aware QoS slack).  Decode: `adopted`, `readopted`,
+  `adopt_backpressure`, `handoff_refill`, plus the pool gauges
+  (`pages_free`/`pages_used`) the telemetry sampler turns into the
+  `pool_occ` ring — the decode autoscaler's `pool` signal.
 """,
 }
 

@@ -152,6 +152,34 @@ def main() -> int:
             idle_timeout_ms=20,
             stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
         print(f"completions={comp.stats.completions}", flush=True)
+    elif role in ("prefill_lane", "decode_lane"):
+        # the disaggregated completer phases at tiny geometry: the
+        # prefill.handoff fault site fires after the wire pages are
+        # written but before the handoff record (a crash strands a
+        # half-written handoff for the reclaim sweep); decode.adopt
+        # fires after a DECODE_READY row is claimed but before its
+        # pages are imported (a crash rolls the row back to bare
+        # DECODE_READY for re-adoption).  test_disagg.py runs both
+        # lanes and asserts zero admitted loss either way.
+        import jax.numpy as jnp
+
+        from libsplinter_tpu.engine.disagg import (DecodeLane,
+                                                   PrefillLane)
+        from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                    DecoderConfig)
+
+        cfg = DecoderConfig.tiny(dtype=jnp.float32)
+        model = CompletionModel(cfg, buckets=(32,), temp=0.0, seed=1,
+                                suffix_buckets=(8,))
+        cls = PrefillLane if role == "prefill_lane" else DecodeLane
+        comp = cls(st, model=model, max_new_tokens=8,
+                   flush_tokens=4, template="none", batch_cap=4,
+                   page_size=8)
+        comp.attach()
+        comp.run_continuous(
+            idle_timeout_ms=20,
+            stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
+        print(f"completions={comp.stats.completions}", flush=True)
     elif role == "pipeliner":
         # the pipeline lane (jax-free): runs the script pump for a
         # bounded window so the pipeliner.exec / pipeliner.verb fault

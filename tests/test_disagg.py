@@ -1,0 +1,403 @@
+"""Disaggregated prefill/decode lanes (ISSUE 18).
+
+The serving contract under test: splitting the continuous completer
+into a PrefillLane (dense bucket prefill + page handoff) and a
+DecodeLane (adoption + ragged paged decode) must be INVISIBLE to
+clients — greedy bytes identical to the unified lane (including a
+joiner that lands mid-burst), zero admitted-request loss through a
+crash on either side of the handoff, and phase-aware deadlines that
+die typed BEFORE paying the phase they cannot finish in.
+
+The crash drills spawn jax-importing children under `spt supervise`
+(tests/chaos_child.py prefill_lane / decode_lane) and are marked
+slow + chaos; `make disagg-check` runs the fast tier plus the
+scripts/disagg_check.py isolation gate.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from libsplinter_tpu import Store  # noqa: E402
+from libsplinter_tpu.engine import protocol as P  # noqa: E402
+from libsplinter_tpu.engine.completer import Completer  # noqa: E402
+from libsplinter_tpu.engine.disagg import (DecodeLane,  # noqa: E402
+                                           PrefillLane)
+from libsplinter_tpu.models.decoder import (CompletionModel,  # noqa: E402
+                                            DecoderConfig)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(HERE, "chaos_child.py")
+
+KW = dict(max_new_tokens=8, flush_tokens=4, template="none",
+          batch_cap=4, page_size=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    """One tiny model for the whole module: the jit caches live on
+    the model object, so every lane after the first test runs warm."""
+    return CompletionModel(DecoderConfig.tiny(dtype=jnp.float32),
+                           buckets=(32,), temp=0.0, seed=1,
+                           suffix_buckets=(8,))
+
+
+def _mkstore(tag: str, max_val: int = 16384):
+    # max_val 16384 > page_wire_bytes(tiny f32, page=8) = 4096: wire
+    # export/import is the default path; 4096 forces the re-prefill
+    # fallback (the record's token ids) instead
+    name = f"/spt-disagg-{tag}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    Store.unlink(name)
+    return name, Store.create(name, nslots=128, max_val=max_val,
+                              vec_dim=8)
+
+
+def _submit(st, key, prompt, deadline=None):
+    st.set(key, prompt)
+    if deadline is not None:
+        P.stamp_deadline(st, key, deadline)
+    st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+    st.bump(key)
+
+
+def _await(st, keys, bit=P.LBL_READY, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(st.labels(k) & bit for k in keys):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _run_bg(daemon, stop_after=180.0):
+    th = threading.Thread(
+        target=daemon.run_continuous,
+        kwargs=dict(idle_timeout_ms=20, stop_after=stop_after),
+        daemon=True)
+    th.start()
+    return th
+
+
+def _no_handoff_keys(st):
+    """No `__ho_` record/page/scale key survives a finished request —
+    the wire keys ride LBL_DEBUG, so enumerate that label."""
+    for idx in st.enumerate_indices(P.LBL_DEBUG):
+        key = st.key_at(idx)
+        if key is not None and key.startswith(P.HANDOFF_PREFIX):
+            return False
+    return True
+
+
+def _serve(tag, daemons_fn, model, prompts, joiner=None,
+           max_val=16384):
+    """Run `prompts` (plus an optional mid-burst `joiner` submitted
+    after the first completion) to READY and return {key: bytes}."""
+    name, st = _mkstore(tag, max_val=max_val)
+    daemons = daemons_fn(st, model)
+    ths = []
+    try:
+        for d in daemons:
+            d.attach()
+        ths = [_run_bg(d) for d in daemons]
+        keys = []
+        for i, prompt in enumerate(prompts):
+            keys.append(f"q/{i}")
+            _submit(st, keys[-1], prompt)
+        if joiner is not None:
+            # mid-burst joiner: lands after the first completion while
+            # the rest of the burst is still in flight
+            assert _await(st, keys[:1]), "first completion never READY"
+            keys.append("q/join")
+            _submit(st, "q/join", joiner)
+        assert _await(st, keys), [
+            (k, hex(st.labels(k))) for k in keys]
+        out = {k: st.get(k).rstrip(b"\0") for k in keys}
+        for d in daemons:
+            d.stop()
+        for th in ths:
+            th.join(timeout=30)
+        assert _no_handoff_keys(st)
+        return out, [dict(getattr(d, "_lane_stats", {}))
+                     for d in daemons]
+    finally:
+        for d in daemons:
+            d.stop()
+        for th in ths:
+            th.join(timeout=30)
+        st.close()
+        Store.unlink(name)
+
+
+def _unified(st, model):
+    return [Completer(st, model=model, **KW)]
+
+
+def _split(st, model):
+    return [PrefillLane(st, model=model, **KW),
+            DecodeLane(st, model=model, **KW)]
+
+
+PROMPTS = ["say one thing", "list two colors ok", "count to three"]
+JOINER = "and a late joiner arrives"
+
+
+class TestByteExactness:
+    def test_split_matches_unified_with_midburst_joiner(self, model):
+        """Greedy bytes through the handoff — wire-page export/import
+        path — are identical to the unified lane's, including a
+        joiner admitted while the burst is mid-flight."""
+        uni, _ = _serve("uni", _unified, model, PROMPTS, joiner=JOINER)
+        spl, stats = _serve("spl", _split, model, PROMPTS,
+                            joiner=JOINER)
+        assert spl == uni
+        pf, dl = stats
+        assert pf["handoffs"] >= 4 and pf["handoff_failed"] == 0
+        assert dl["adopted"] == pf["handoffs"]
+        # the real wire path, not the fallback
+        assert dl["handoff_refill"] == 0
+        assert pf["handoff_wire_mb"] > 0
+
+    @pytest.mark.slow
+    def test_refill_fallback_matches_unified(self, model):
+        """A store too small for wire pages (max_val 4096 ==
+        page_wire_bytes) degrades to re-prefill-from-record — and the
+        bytes still match the unified lane exactly."""
+        uni, _ = _serve("uni4k", _unified, model, PROMPTS,
+                        max_val=4096)
+        spl, stats = _serve("spl4k", _split, model, PROMPTS,
+                            max_val=4096)
+        assert spl == uni
+        pf, dl = stats
+        assert pf["handoffs"] >= 3
+        assert dl["handoff_refill"] == pf["handoffs"]
+        assert pf["handoff_wire_mb"] == 0
+
+
+class TestPhaseAwareQoS:
+    def test_prefill_fast_fails_deadline_inside_prefill_wall(
+            self, model):
+        """A deadline that lands inside the rolling prefill-wall EMA
+        dies typed at admission — BEFORE paying prefill.  The
+        no-deadline sibling sails through to DECODE_READY."""
+        name, st = _mkstore("ff")
+        pf = PrefillLane(st, model=model, **KW)
+        th = None
+        try:
+            pf.attach()
+            # a lane that has learned prefill costs ~10 s must reject
+            # a deadline 2 s out without serving it
+            pf.qos_slack_s = 10.0
+            _submit(st, "doomed", "expires in prefill",
+                    deadline=time.time() + 2.0)
+            _submit(st, "live", "no deadline here")
+            th = _run_bg(pf)
+            assert _await(st, ["doomed"], timeout=60)
+            rec = P.parse_error_payload(st.get("doomed"))
+            assert rec["err"] == "deadline_expired"
+            assert pf.stats.deadline_expired == 1
+            # the live request got the full prefill + handoff
+            assert _await(st, ["live"], bit=P.LBL_DECODE_READY,
+                          timeout=60)
+            assert pf._lane_stats["handoffs"] == 1
+        finally:
+            pf.stop()
+            if th:
+                th.join(timeout=30)
+            st.close()
+            Store.unlink(name)
+
+    def test_decode_rejects_expired_handoff_before_adoption(
+            self, model):
+        """An expired DECODE_READY handoff dies typed at the adopt
+        edge — before consuming pool pages or a batch slot — and its
+        wire keys leave the store with it."""
+        name, st = _mkstore("exp")
+        pf = PrefillLane(st, model=model, **KW)
+        dl = DecodeLane(st, model=model, **KW)
+        tp = td = None
+        try:
+            pf.attach()
+            dl.attach()
+            _submit(st, "q", "soon to expire",
+                    deadline=time.time() + 1.5)
+            tp = _run_bg(pf)
+            assert _await(st, ["q"], bit=P.LBL_DECODE_READY,
+                          timeout=60)
+            pf.stop()
+            tp.join(timeout=30)
+            time.sleep(1.6)           # let the deadline lapse
+            td = _run_bg(dl)
+            assert _await(st, ["q"], timeout=60)
+            rec = P.parse_error_payload(st.get("q"))
+            assert rec["err"] == "deadline_expired"
+            assert dl.stats.deadline_expired == 1
+            assert dl._lane_stats["adopted"] == 0
+            assert _no_handoff_keys(st)
+        finally:
+            pf.stop()
+            dl.stop()
+            for th in (tp, td):
+                if th:
+                    th.join(timeout=30)
+            st.close()
+            Store.unlink(name)
+
+    def test_adopt_backpressure_keeps_row_decode_ready(self, model):
+        """A decode pool that cannot cover the worst-case reservation
+        leaves the handoff DECODE_READY (counted, never stranded
+        mid-decode) — the autoscaler's pool_occ signal is what turns
+        this into capacity."""
+        name, st = _mkstore("bp")
+        pf = PrefillLane(st, model=model, **KW)
+        kw = dict(KW)
+        kw["pool_pages"] = 16         # the one-window floor
+        dl = DecodeLane(st, model=model, **kw)
+        tp = td = None
+        try:
+            pf.attach()
+            dl.attach()
+            # squat 15 of the 16 pool pages on a row the lane thinks
+            # is free: the worst-case reservation (>= 2 pages) cannot
+            # fit in the 1 remaining
+            cache = dl._ensure_paged_cache()
+            assert cache.ensure(KW["batch_cap"] - 1, 15 * KW["page_size"])
+            _submit(st, "q", "too big for that pool")
+            tp = _run_bg(pf)
+            td = _run_bg(dl)
+            assert _await(st, ["q"], bit=P.LBL_DECODE_READY,
+                          timeout=60)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if dl._lane_stats["adopt_backpressure"] >= 2:
+                    break
+                time.sleep(0.05)
+            assert dl._lane_stats["adopt_backpressure"] >= 2
+            labels = st.labels("q")
+            assert labels & P.LBL_DECODE_READY
+            assert not labels & (P.LBL_SERVICING | P.LBL_READY)
+            assert dl._lane_stats["adopted"] == 0
+            # capacity returns -> the parked handoff is adopted and
+            # finishes; nothing was stranded by the wait
+            cache.free_row(KW["batch_cap"] - 1)
+            assert _await(st, ["q"], timeout=60)
+            assert dl._lane_stats["adopted"] == 1
+        finally:
+            pf.stop()
+            dl.stop()
+            for th in (tp, td):
+                if th:
+                    th.join(timeout=30)
+            st.close()
+            Store.unlink(name)
+
+
+# ------------------------------------------------------- crash drills
+
+@pytest.fixture
+def cstore():
+    name = f"/spt-disagg-chaos-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    st = Store.create(name, nslots=128, max_val=16384, vec_dim=8)
+    yield st
+    st.close()
+    Store.unlink(name)
+
+
+def _supervised_pair_recovers(cstore, fault_spec, crashed_lane,
+                              monkeypatch):
+    """Both disaggregated lanes as restartable children under `spt
+    supervise`, one of them armed to crash mid-handoff: every
+    admitted request must still converge to READY with the prompt
+    intact, the crashed lane must have been restarted, and no wire
+    key may outlive its request (zero admitted loss, nothing
+    stranded)."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    monkeypatch.setenv("SPTPU_FAULT", fault_spec)
+    monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+    cstore.set("q", "hello disaggregated")
+    cstore.label_or("q", P.LBL_INFER_REQ | P.LBL_WAITING)
+    cstore.bump("q")
+
+    holder: dict = {}
+
+    def spawn(lane):
+        role = ("prefill_lane" if lane.name == "prefill"
+                else "decode_lane")
+        return subprocess.Popen(
+            [sys.executable, CHILD, role, cstore.name],
+            env=holder["sup"]._child_env(lane))
+
+    sup = Supervisor(cstore.name, lanes=("prefill", "decode"),
+                     spawn_fn=spawn, store=cstore,
+                     backoff_base_ms=100, backoff_max_ms=2000,
+                     breaker_threshold=8, breaker_window_s=240,
+                     startup_grace_s=300)
+    holder["sup"] = sup
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 420.0})
+    t.start()
+    try:
+        deadline = time.monotonic() + 360
+        while time.monotonic() < deadline:
+            if cstore.labels("q") & P.LBL_READY:
+                break
+            time.sleep(0.25)
+        assert cstore.labels("q") & P.LBL_READY, sup.lanes
+        assert sup.lanes[crashed_lane].restarts >= 1
+        assert sup.lanes[crashed_lane].state != "down"
+        assert cstore.get("q").rstrip(b"\0").startswith(
+            b"hello disaggregated")
+        # a request submitted AFTER the crash round-trips too (the
+        # generation-2 child serves with the fault stripped)
+        cstore.set("q2", "again, disaggregated")
+        cstore.label_or("q2", P.LBL_INFER_REQ | P.LBL_WAITING)
+        cstore.bump("q2")
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if cstore.labels("q2") & P.LBL_READY:
+                break
+            time.sleep(0.25)
+        assert cstore.labels("q2") & P.LBL_READY
+        assert cstore.get("q2").rstrip(b"\0").startswith(
+            b"again, disaggregated")
+        for k in ("q", "q2"):
+            assert not cstore.labels(k) & (
+                P.LBL_INFER_REQ | P.LBL_SERVICING
+                | P.LBL_DECODE_READY)
+        assert _no_handoff_keys(cstore)
+    finally:
+        sup.stop()
+        t.join()
+        sup.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervise_recovers_prefill_handoff_crash(cstore, monkeypatch):
+    """The prefill lane crashes at prefill.handoff — wire pages
+    written, NO record, row still SERVICING.  The restarted lane's
+    stripe-scoped reclaim sweeps the orphan wire keys, re-queues the
+    row WAITING, and the second pass hands it off cleanly."""
+    _supervised_pair_recovers(cstore, "prefill.handoff:crash@1",
+                              "prefill", monkeypatch)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervise_recovers_decode_adopt_crash(cstore, monkeypatch):
+    """The decode lane crashes at decode.adopt — the handoff claimed
+    (SERVICING|DECODE_READY), nothing imported.  Recovery re-opens
+    the row to bare DECODE_READY (slot truncated to the record's
+    plen) and the restarted lane re-adopts from the surviving wire
+    pages."""
+    _supervised_pair_recovers(cstore, "decode.adopt:crash@1",
+                              "decode", monkeypatch)

@@ -309,7 +309,8 @@ class TestSupervisorScaling:
         try:
             # policy published for the controller
             pol = P.read_scale_policy(store)
-            assert pol["lanes"]["embedder"] == {"min": 1, "max": 4}
+            assert pol["lanes"]["embedder"] == {
+                "min": 1, "max": 4, "signal": "queue"}
             assert pol["up_threshold"] == 4.0
             P.write_scale_target(store, "embedder", 3, src="manual")
             sup.poll_once()

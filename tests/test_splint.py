@@ -100,7 +100,8 @@ def test_registry_extracts_live_protocol(splint):
     assert reg.stages["PIPELINE_STAGES"] == (
         "drain", "tokenize", "dispatch", "device_wait", "commit")
     assert reg.stages["CONT_INFER_STAGES"] == (
-        "join", "sample", "decode", "collect", "flush", "prefix_hit")
+        "join", "sample", "decode", "collect", "flush", "prefix_hit",
+        "handoff", "adopt")
     assert reg.keys["KEY_SEARCH_STATS"] == "__searcher_stats"
     assert reg.prefixes["SEARCH_RESULT_PREFIX"] == "__sr_"
     assert reg.prefixes["DEADLINE_STAMP_PREFIX"] == "__dl_"

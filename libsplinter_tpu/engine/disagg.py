@@ -92,12 +92,24 @@ class PrefillLane(Completer):
         mid-prefill or mid-export (the DECODE_READY flip lands LAST,
         after the record) — sweep any orphan wire keys and re-queue it
         WAITING.  The restarted stream re-renders from scratch, same
-        as the unified lane's crash story."""
+        as the unified lane's crash story.
+
+        Rows carrying DECODE_READY are past the flip and belong to
+        the decode lane (its stripe map is independent over the same
+        slot space — a live decode replica may be mid-decode on the
+        row under SERVICING|DECODE_READY): never touch their record
+        or wire pages here."""
         st = self.store
         self.stripes.refresh()
         n = 0
         for idx in st.enumerate_indices(P.LBL_SERVICING):
             if not self.stripes.owns(int(idx)):
+                continue
+            try:
+                labels = st.labels_at(idx)
+            except (KeyError, OSError):
+                continue
+            if labels & P.LBL_DECODE_READY:
                 continue
             key = st.key_at(idx)
             if key is None:
@@ -277,17 +289,28 @@ class PrefillLane(Completer):
                 + DEVTIME.take_lane_ms("completer")
             st.label_clear(key, P.LBL_SERVICING)
             st.label_or(key, P.LBL_DECODE_READY)
-            st.bump(key)
+            # the handoff has LANDED (record + DECODE_READY): from
+            # here on nothing may escape — run_continuous's failure
+            # handler would re-queue a row the decode lane already
+            # owns (WAITING|DECODE_READY with no record = the first
+            # token streams twice).  Bookkeeping errors are swallowed.
+            try:
+                st.bump(key)
+            except (KeyError, OSError):
+                pass
             wall = time.perf_counter() - tp0
-            tracer.record("infer.handoff",
-                          (time.perf_counter() - tp1) * 1e3)
-            self.spans.commit(
-                span,
-                stages={"join": round((tp1 - tp0) * 1e3, 3),
-                        "handoff": round(
-                            (time.perf_counter() - tp1) * 1e3, 3)},
-                extra={"tokens": 1},
-                device_ms=device_ms if device_ms > 0 else None)
+            try:
+                tracer.record("infer.handoff",
+                              (time.perf_counter() - tp1) * 1e3)
+                self.spans.commit(
+                    span,
+                    stages={"join": round((tp1 - tp0) * 1e3, 3),
+                            "handoff": round(
+                                (time.perf_counter() - tp1) * 1e3, 3)},
+                    extra={"tokens": 1},
+                    device_ms=device_ms if device_ms > 0 else None)
+            except Exception:
+                pass
             self._lane_stats["handoffs"] += 1
             self.stats.tokens += 1
             # the phase-aware slack: admission rejects deadlines that
@@ -345,9 +368,21 @@ class PrefillLane(Completer):
                             self.stats.faults += 1
                             self._debug(
                                 f"prefill of slot {idx} failed: {ex}")
-                            self._requeue_failed([idx])
-                            P.clear_handoff(
-                                st, idx, pages=self._max_wire_pages())
+                            try:
+                                handed = bool(
+                                    st.labels_at(idx)
+                                    & P.LBL_DECODE_READY)
+                            except (KeyError, OSError):
+                                handed = False
+                            if not handed:
+                                # only rows still on OUR side of the
+                                # flip are re-queued; a DECODE_READY
+                                # row belongs to the decode lane and
+                                # keeps its record + wire pages
+                                self._requeue_failed([idx])
+                                P.clear_handoff(
+                                    st, idx,
+                                    pages=self._max_wire_pages())
                             # the failure may have escaped a donating
                             # program: rebuild the pool outright (the
                             # unified abort_all recovery)
@@ -391,8 +426,15 @@ class DecodeLane(Completer):
         the handoff byte length (`plen` — drop the dead adopter's
         partial tail, greedy re-decode reproduces it byte-exact) and
         drop SERVICING, so any live decode replica re-adopts it from
-        the wire pages (or re-prefills from the record's ids).  A row
-        with no surviving record falls back to the WAITING queue."""
+        the wire pages (or re-prefills from the record's ids).  A
+        DECODE_READY row with no surviving record falls back to the
+        WAITING queue.
+
+        SERVICING-only rows are NOT ours: decode ownership always
+        carries SERVICING|DECODE_READY, so a bare SERVICING row is a
+        live prefill replica's in-flight claim (the two lanes' stripe
+        maps are independent over the same slot space) — touching it
+        would double-service the request."""
         st = self.store
         self.stripes.refresh()
         n = 0
@@ -402,13 +444,13 @@ class DecodeLane(Completer):
             key = st.key_at(idx)
             if key is None:
                 continue
-            rec = None
             try:
                 labels = st.labels_at(idx)
             except (KeyError, OSError):
                 continue
-            if labels & P.LBL_DECODE_READY:
-                rec = P.read_handoff_record(st, idx)
+            if not labels & P.LBL_DECODE_READY:
+                continue
+            rec = P.read_handoff_record(st, idx)
             try:
                 if rec is not None:
                     plen = int(rec.get("plen", 0))

@@ -708,8 +708,13 @@ class Supervisor:
                 if key is None:
                     continue
                 labels = st.labels_at(idx)
-                if lane_name == "decode" \
-                        and labels & P.LBL_DECODE_READY:
+                if lane_name == "decode":
+                    if not labels & P.LBL_DECODE_READY:
+                        # SERVICING-only: a live prefill replica's
+                        # in-flight claim (decode ownership always
+                        # carries SERVICING|DECODE_READY) — not this
+                        # lane's to reclaim
+                        continue
                     hrec = P.read_handoff_record(st, idx)
                     if hrec is None:
                         # adopted row whose handoff record vanished:
@@ -728,6 +733,12 @@ class Supervisor:
                     n += 1
                     continue
                 if lane_name == "prefill":
+                    if labels & P.LBL_DECODE_READY:
+                        # past the handoff flip: the row (and its
+                        # record + wire pages) now belongs to the
+                        # decode lane — a live decode replica may be
+                        # mid-decode on it
+                        continue
                     P.clear_handoff(st, idx)
                 st.label_clear(key, P.LBL_SERVICING)
                 st.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)

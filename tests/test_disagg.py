@@ -299,6 +299,137 @@ class TestPhaseAwareQoS:
             Store.unlink(name)
 
 
+def _seed_handoff(st, key, *, servicing):
+    """A handed-off row as the prefill lane leaves it: value bytes,
+    DECODE_READY (plus SERVICING when a decode replica has adopted
+    it), a v1 record, and one wire page."""
+    st.set(key, "prompt bytes")
+    st.label_or(key, P.LBL_DECODE_READY
+                | (P.LBL_SERVICING if servicing else 0))
+    idx = st.find_index(key)
+    assert P.write_handoff_record(st, idx, {
+        "len": 3, "ids": [1, 2, 3], "carry": 5, "n_tok": 1,
+        "remaining": 7, "disp_left": 7, "plen": st.value_len(key),
+        "t0": 0, "tenant": 0, "deadline": None, "wire_pages": 1,
+        "quant": False})
+    st.set(P.handoff_page_key(idx, 0), b"\x01" * 64)
+    st.label_or(P.handoff_page_key(idx, 0), P.LBL_DEBUG)
+    return idx
+
+
+class TestCrossLaneReclaim:
+    """The two lanes' stripe maps are independent over the SAME slot
+    space, so each lane's restart-time reclaim must only touch rows
+    on ITS side of the handoff flip: SERVICING-only rows belong to
+    prefill, anything carrying DECODE_READY belongs to decode.  A
+    sweep that crosses the line deletes a live replica's in-flight
+    state and double-services the request."""
+
+    def test_prefill_reclaim_skips_decode_owned_rows(self, model):
+        """A restarted prefill replica must not clobber a row a live
+        decode replica is mid-decode on (SERVICING|DECODE_READY):
+        record and wire pages survive, labels untouched.  Its own
+        died-mid-prefill SERVICING-only row is still re-queued."""
+        name, st = _mkstore("pfskip")
+        pf = PrefillLane(st, model=model, **KW)
+        try:
+            pf.attach()
+            adopted = _seed_handoff(st, "adopted", servicing=True)
+            st.set("mine", "died mid prefill")
+            st.label_or("mine", P.LBL_SERVICING)
+            assert pf._reclaim_stranded() == 1
+            labels = st.labels("adopted")
+            assert labels & P.LBL_DECODE_READY
+            assert labels & P.LBL_SERVICING
+            assert P.read_handoff_record(st, adopted) is not None
+            assert P.handoff_page_key(adopted, 0) in st
+            labels = st.labels("mine")
+            assert labels & P.LBL_WAITING and labels & P.LBL_INFER_REQ
+            assert not labels & P.LBL_SERVICING
+        finally:
+            st.close()
+            Store.unlink(name)
+
+    def test_decode_reclaim_skips_prefill_claims(self, model):
+        """A decode replica attach/restart while prefill work is in
+        flight must not touch SERVICING-only rows (a live prefill
+        replica's claims).  Its own dead adopter's row rolls back to
+        bare DECODE_READY with the slot truncated to plen."""
+        name, st = _mkstore("dlskip")
+        dl = DecodeLane(st, model=model, **KW)
+        try:
+            dl.attach()
+            st.set("claim", "being prefilled right now")
+            st.label_or("claim", P.LBL_SERVICING)
+            mine = _seed_handoff(st, "mine", servicing=True)
+            plen = P.read_handoff_record(st, mine)["plen"]
+            st.set("mine", "prompt bytes plus a dead adopter tail")
+            st.label_or("mine", P.LBL_SERVICING | P.LBL_DECODE_READY)
+            assert dl._reclaim_stranded() == 1
+            labels = st.labels("claim")
+            assert labels & P.LBL_SERVICING
+            assert not labels & P.LBL_WAITING
+            labels = st.labels("mine")
+            assert labels & P.LBL_DECODE_READY
+            assert not labels & P.LBL_SERVICING
+            assert st.value_len("mine") == plen
+        finally:
+            st.close()
+            Store.unlink(name)
+
+    def test_decode_reclaim_record_vanished_requeues(self, model):
+        """The WAITING fallback applies ONLY to rows still carrying
+        DECODE_READY whose record is gone — nothing to resume from,
+        full re-prefill."""
+        name, st = _mkstore("dlvan")
+        dl = DecodeLane(st, model=model, **KW)
+        try:
+            dl.attach()
+            idx = _seed_handoff(st, "mine", servicing=True)
+            P.clear_handoff(st, idx, pages=1)
+            assert dl._reclaim_stranded() == 1
+            labels = st.labels("mine")
+            assert labels & P.LBL_WAITING and labels & P.LBL_INFER_REQ
+            assert not labels & (P.LBL_SERVICING | P.LBL_DECODE_READY)
+        finally:
+            st.close()
+            Store.unlink(name)
+
+    def test_handoff_survives_post_flip_bookkeeping_failure(
+            self, model, monkeypatch):
+        """An error AFTER the DECODE_READY flip (spans.commit here)
+        must not reach run_continuous's failure handler — that would
+        re-queue a row the decode lane already owns, leaving
+        WAITING|DECODE_READY with no record and streaming the first
+        token twice."""
+        name, st = _mkstore("postflip")
+        pf = PrefillLane(st, model=model, **KW)
+        th = None
+
+        def boom(*a, **k):
+            raise OSError("spans ring full")
+
+        try:
+            pf.attach()
+            monkeypatch.setattr(pf.spans, "commit", boom)
+            _submit(st, "q", "post flip failure")
+            th = _run_bg(pf)
+            assert _await(st, ["q"], bit=P.LBL_DECODE_READY,
+                          timeout=60)
+            idx = st.find_index("q")
+            assert P.read_handoff_record(st, idx) is not None
+            labels = st.labels("q")
+            assert not labels & (P.LBL_WAITING | P.LBL_SERVICING)
+            assert pf._lane_stats["handoffs"] == 1
+            assert pf._lane_stats["handoff_failed"] == 0
+        finally:
+            pf.stop()
+            if th:
+                th.join(timeout=30)
+            st.close()
+            Store.unlink(name)
+
+
 # ------------------------------------------------------- crash drills
 
 @pytest.fixture

@@ -256,6 +256,50 @@ def test_poll_fault_site_live_and_survivable(sstore):
         sup.shutdown()
 
 
+def test_reclaim_closed_respects_handoff_sides(sstore):
+    """The disaggregated lanes' straggler reclaim: a dead PREFILL
+    replica's sweep must not clear_handoff + re-queue rows a live
+    decode replica has adopted (SERVICING|DECODE_READY), and a dead
+    DECODE replica's sweep must not re-queue SERVICING-only rows a
+    live prefill replica is servicing — both stripe maps cover the
+    same slot space."""
+    st = sstore
+    sup = Supervisor(st.name, lanes=("prefill", "decode"),
+                     spawn_fn=_sleeper(), store=st)
+    all_stripes = tuple(range(P.DEFAULT_STRIPE_WIDTH))
+    st.set("adopted", "prompt bytes")
+    st.label_or("adopted", P.LBL_SERVICING | P.LBL_DECODE_READY)
+    aidx = st.find_index("adopted")
+    assert P.write_handoff_record(st, aidx, {
+        "len": 3, "ids": [1, 2, 3], "carry": 5, "n_tok": 1,
+        "remaining": 7, "disp_left": 7,
+        "plen": st.value_len("adopted"), "t0": 0, "tenant": 0,
+        "deadline": None, "wire_pages": 0, "quant": False})
+    st.set("claim", "prompt bytes")
+    st.label_or("claim", P.LBL_SERVICING)
+
+    # dead prefill replica: its own SERVICING-only row re-queues,
+    # the decode-owned row (and its record) is untouchable
+    assert sup._reclaim_closed("prefill", all_stripes) == 1
+    labels = st.labels("adopted")
+    assert labels & P.LBL_SERVICING and labels & P.LBL_DECODE_READY
+    assert P.read_handoff_record(st, aidx) is not None
+    labels = st.labels("claim")
+    assert labels & P.LBL_WAITING and not labels & P.LBL_SERVICING
+
+    # dead decode replica: the adopted row rolls back to bare
+    # DECODE_READY, the prefill claim is untouchable
+    st.label_clear("claim", P.LBL_WAITING | P.LBL_INFER_REQ)
+    st.label_or("claim", P.LBL_SERVICING)
+    assert sup._reclaim_closed("decode", all_stripes) == 1
+    labels = st.labels("claim")
+    assert labels & P.LBL_SERVICING and not labels & P.LBL_WAITING
+    labels = st.labels("adopted")
+    assert labels & P.LBL_DECODE_READY
+    assert not labels & P.LBL_SERVICING
+    assert P.read_handoff_record(st, aidx) is not None
+
+
 def test_unknown_lane_rejected(sstore):
     with pytest.raises(ValueError):
         Supervisor(sstore.name, lanes=("warp-drive",), store=sstore)

@@ -93,6 +93,19 @@
 #                   (scripts/disagg_check.py) + the test_disagg.py
 #                   fast tier (byte-exactness vs the unified
 #                   completer, handoff crash drills both directions)
+#   make warm-check  tiered-KV warm-restart tier (fast, CPU): one
+#                   supervised completer lane with the host-DRAM
+#                   spill tier + persistent radix index armed,
+#                   SIGKILLed mid-loadgen — the respawn must attach
+#                   WARM (index restored, hot set readmitted from the
+#                   tier instead of re-prefilled, greedy bytes
+#                   identical across the restart), with zero admitted
+#                   loss and post-restart first-token p50 <= 2x the
+#                   pre-restart baseline
+#                   (scripts/warm_restart_check.py) + the
+#                   test_kv_tier.py fast tier (write-through spill /
+#                   readmit byte-exactness, torn-snapshot taxonomy,
+#                   capacity-drop pruning)
 #   make scale-check  elastic-lane tier (fast, CPU): stripe-map
 #                   protocol + striped replica groups (R=2 byte-
 #                   identical to R=1, no double-claims, no orphans
@@ -157,6 +170,7 @@ check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/prefix_speedup_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/scale_step_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/disagg_check.py
+	JAX_PLATFORMS=cpu $(PY) scripts/warm_restart_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py
 	JAX_PLATFORMS=cpu $(PY) scripts/compile_gate_check.py --seed-recompile
 	$(PY) -m pytest tests/ -q -m "not chaos"
@@ -194,6 +208,11 @@ disagg-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_disagg.py -q \
 		-m "not slow and not chaos"
 	JAX_PLATFORMS=cpu $(PY) scripts/disagg_check.py
+
+warm-check: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_tier.py -q \
+		-m "not slow and not chaos"
+	JAX_PLATFORMS=cpu $(PY) scripts/warm_restart_check.py
 
 quant-check: native
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py -q \
@@ -248,4 +267,4 @@ clean:
 .PHONY: all native quick check obs-check search-check decode-check \
 	chaos-check dispatch-check pod-check quant-check prefix-check \
 	qos-check pipeline-check trace-check lint-check scale-check \
-	disagg-check compile-check memcheck bench-cpu clean
+	disagg-check warm-check compile-check memcheck bench-cpu clean

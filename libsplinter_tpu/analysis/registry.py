@@ -135,6 +135,42 @@ FAULT_SITE_DOCS: dict[str, str] = {
         "replica to re-adopt from the carry token "
         "(`tests/chaos_child.py decode_lane`; "
         "`tests/test_disagg.py`)",
+    "tier.spill":
+        "the host-DRAM shadow copy of one frozen prefix page "
+        "(engine/prefix_cache.py _spill, write-through at insert and "
+        "the evictor's second chance): fires before the device "
+        "export, so a `crash` dies between \"page frozen in the "
+        "tree\" and \"shadow taken\" — the HBM copy stays "
+        "authoritative and the unshadowed page simply drops cold at "
+        "eviction instead of demoting, proving a mid-spill death "
+        "strands nothing and loses no admitted request "
+        "(`tests/chaos_child.py tier_completer`; "
+        "`tests/test_kv_tier.py::"
+        "test_supervised_mid_spill_crash_strands_nothing`)",
+    "tier.readmit":
+        "each demoted page's DRAM→HBM readmission (engine/"
+        "prefix_cache.py readmit, on a tier hit at admission): fires "
+        "after the host shadow is fetched but before the pool page "
+        "is allocated and imported — a `raise` shortens the hit (the "
+        "suffix re-prefills, `tier_readmit_failures` counts it) and "
+        "a `crash` dies mid-readmission with the shadow intact and "
+        "the node still DRAM-resident, proving the restarted lane "
+        "re-serves from a clean pool with zero stranded pages "
+        "(`tests/chaos_child.py tier_completer`; "
+        "`tests/test_kv_tier.py::"
+        "test_supervised_mid_readmit_crash_strands_nothing`)",
+    "tier.restore":
+        "the warm-restart snapshot adoption (engine/kv_tier.py "
+        "TierPersist.load): fires after EVERY byte of the persistent "
+        "snapshot has validated and right before the radix chains "
+        "are adopted — a `raise` proves the clean cold fallback "
+        "(empty tree + tier, typed `tier_restore_reason` "
+        "\"restore_failed\" in heartbeat), and a `crash` dies "
+        "mid-restore so the supervised respawn (fault stripped) "
+        "attaches warm from the SAME untouched snapshot — zero "
+        "admitted loss either way (`tests/chaos_child.py "
+        "tier_completer`; `tests/test_kv_tier.py::"
+        "test_supervised_mid_restore_crash_attaches_warm`)",
     "supervisor.poll":
         "each supervision step",
     "supervisor.retire":

@@ -174,6 +174,8 @@ class Completer:
                  prefix_cache_pages: int | None = None,
                  prefix_quotas: dict[int, int] | None = None,
                  prefix_default_quota: int | None = None,
+                 kv_tier_pages: int = 0,
+                 kv_tier_persist: str | None = None,
                  replica: int = 0):
         self.store = store
         # elastic lanes (protocol.StripeView): replica r drains only
@@ -260,6 +262,20 @@ class Completer:
         self._prefix_quotas = dict(prefix_quotas or {})
         self._prefix_default_quota = prefix_default_quota
         self.prefix_cache = None
+        # tiered KV (engine/kv_tier.py): a host-DRAM spill tier under
+        # the radix tree — _evict_one demotes zero-ref pages to host
+        # RAM instead of dropping, and a radix hit on a demoted page
+        # readmits via device_put + block-table write instead of a
+        # re-prefill.  kv_tier_persist names a file-backed store
+        # segment the warm set checkpoints into (write-record-last,
+        # epoch-bumped), so a supervised restart attaches WARM;
+        # replica 0 owns the snapshot writes, every replica loads.
+        self._tier_pages = max(0, int(kv_tier_pages))
+        self._tier_persist_name = kv_tier_persist
+        self.kv_tier = None
+        self._tier_store = None
+        self._tier_restore: tuple[int, str] = (0, "off")
+        self._tier_last_save = 0.0
         if template not in TEMPLATES:
             raise ValueError(
                 f"unknown chat template {template!r} (supported: "
@@ -318,10 +334,8 @@ class Completer:
         except OSError:
             self._bid = -1
         st.watch_label_register(self.WATCH_BIT, self.group)
-        if st.header().bus_pid == 0:
-            st.bus_init()
-        else:
-            st.bus_open()
+        st.bus_attach()   # adopts the bus when a crashed owner
+                          # left a dead pid in the header
         self.generation = P.bump_generation(st, self._hb_key)
         # compile events ledgered from here carry this generation —
         # a restart's re-warmup is distinguishable in the ring
@@ -989,7 +1003,43 @@ class Completer:
                         default_quota=self._prefix_default_quota)
                 self.prefix_cache.attach(cache)
                 cache.prefix_cache = self.prefix_cache
+                if self._tier_pages:
+                    self._bind_tier(cache)
         return self._paged_cache
+
+    def _bind_tier(self, cache) -> None:
+        """Wire the host-DRAM spill tier under the freshly-attached
+        radix tree, then (when persistence is on) load the last good
+        snapshot so THIS generation starts warm.  attach() just
+        cleared the tree + tier, so a rebuilt pool always reloads
+        from the persistent layer rather than trusting stale bids."""
+        from .kv_tier import HostTier, TierPersist, tier_geometry
+        m = self._model
+        if self.kv_tier is None:
+            self.kv_tier = HostTier(self._tier_pages)
+        self.prefix_cache.bind_tier(
+            self.kv_tier,
+            export_page=lambda bid, _c=cache, _m=m:
+                _m.export_page_bytes(_c, bid),
+            import_page=lambda bid, buf, sbuf, _c=cache, _m=m:
+                _m.import_page_bytes(_c, bid, buf, sbuf))
+        if not self._tier_persist_name:
+            return
+        geom = tier_geometry(m, cache)
+        try:
+            if self._tier_store is None:
+                self._tier_store = TierPersist(
+                    self._tier_persist_name,
+                    capacity_pages=self._tier_pages,
+                    max_len=m.cfg.max_len,
+                    page_bytes=geom["page_bytes"])
+            self._tier_restore = self._tier_store.load(
+                self.prefix_cache, self.kv_tier, geom)
+        except OSError:
+            # persistence degraded (segment unopenable) — serve cold
+            # with the in-RAM tier only; the reason reaches heartbeat
+            self._tier_store = None
+            self._tier_restore = (0, "restore_failed")
 
     def warmup_paged(self) -> None:
         """Pre-compile the continuous lane's whole program set (paged
@@ -999,9 +1049,15 @@ class Completer:
         cycles afterwards."""
         if not self._paged_ok():
             return
-        self._model.warmup_paged(self._ensure_paged_cache(),
+        cache = self._ensure_paged_cache()
+        self._model.warmup_paged(cache,
                                  chunk=max(1, self.flush_tokens),
                                  max_prompt=self._batched_budget())
+        if self.kv_tier is not None:
+            # spill/readmit ride the handoff gather/scatter programs —
+            # warm both so tier traffic never compiles post-warmup
+            # (the PR 17 no-recompile gate covers tiered lanes too)
+            self._model.warmup_handoff(cache, export=True, adopt=True)
 
     def run_continuous(self, *, idle_timeout_ms: int = 100,
                        stop_after: float | None = None) -> None:
@@ -1192,16 +1248,25 @@ class Completer:
                 # append will take
                 hit_bids: list[int] = []
                 match = 0
+                tier_nodes: list = []
                 if pc is not None and len(ids):
-                    hit_bids, match = pc.lookup(ids)
-                    if match == len(ids) and len(ids) < 2:
+                    # tier-aware walk: an HBM run, then (optionally) a
+                    # run of demoted pages whose bytes live in host
+                    # RAM — those cost a readmit (device_put + table
+                    # write) instead of a re-prefill, and the pool
+                    # pages they land in come out of the same `need`
+                    # budget the uncached suffix would have used
+                    hit_bids, match, tier_nodes = pc.lookup_tiered(ids)
+                    if (match + len(tier_nodes) * cache.page
+                            == len(ids) and len(ids) < 2):
                         # a fully-covered 1-token prompt would enter
                         # at lengths 0 — the DEAD-row sentinel; serve
                         # it as a miss (page size 1 is a test-only
                         # geometry anyway)
-                        hit_bids, match = [], 0
-                full_cover = bool(hit_bids) and match == len(ids)
-                suffix = ids[match:]
+                        hit_bids, match, tier_nodes = [], 0, []
+                match_all = match + len(tier_nodes) * cache.page
+                full_cover = ((bool(hit_bids) or bool(tier_nodes))
+                              and match_all == len(ids))
                 reserve = 0
                 if len(ids):
                     reserve = min(worst_len(len(ids))
@@ -1250,26 +1315,56 @@ class Completer:
                                      else None),
                            "wall0": time.perf_counter()}
                 ta = time.perf_counter()
-                if hit_bids:
+                if hit_bids or tier_nodes:
                     # the chaos matrix crashes HERE (mid table-
                     # mapping, after the claim): the restarted lane
                     # rebuilds pool + tree from scratch, so a death
                     # between refcount bumps can strand nothing
                     fault("completer.prefix_map")
-                    cache.map_shared(r, hit_bids)
-                    cache.lengths[r] = (len(ids) - 1 if full_cover
-                                        else match)
-                    # hit/LRU recorded only now — a denied or raced
-                    # admission must not inflate the hit rate the
-                    # runbook triages on
-                    pc.commit_hit(ids, match)
-                    pc.stats.bytes_saved += \
-                        match * cache.kv_bytes_per_token()
-                    if tenant:
-                        self.tenants.bump(tenant, "prefix_hit_pages",
-                                          len(hit_bids))
+                    if hit_bids:
+                        # pin the HBM prefix FIRST: readmission
+                        # allocations below can trigger reclaim, and
+                        # an unpinned zero-ref hit page would be fair
+                        # game for the very eviction pass serving it
+                        cache.map_shared(r, hit_bids)
+                    if tier_nodes:
+                        # DRAM hit: readmit demoted pages.  They come
+                        # back holding refcount 1; drop each to
+                        # zero-ref (tree-retained, off the free list)
+                        # then map — map_shared's 0→1 bump re-pins
+                        # them for this row with the tree reference
+                        # accounted exactly once.  A partial
+                        # readmission (pool pressure, injected fault)
+                        # just shortens the hit — the rest re-prefills
+                        tier_bids = pc.readmit(tier_nodes, cache)
+                        for b in tier_bids:
+                            cache._decref(b)
+                        if tier_bids:
+                            cache.map_shared(r, tier_bids)
+                        hit_bids = hit_bids + tier_bids
+                        match += len(tier_bids) * cache.page
+                        if len(tier_bids) < len(tier_nodes):
+                            full_cover = False
+                    if not hit_bids:
+                        pc.note_miss()   # every readmit failed
+                    else:
+                        cache.lengths[r] = (len(ids) - 1 if full_cover
+                                            else match)
+                        # hit/LRU recorded only now — a denied or
+                        # raced admission must not inflate the hit
+                        # rate the runbook triages on
+                        pc.commit_hit(ids, match)
+                        pc.stats.bytes_saved += \
+                            match * cache.kv_bytes_per_token()
+                        if tenant:
+                            self.tenants.bump(tenant,
+                                              "prefix_hit_pages",
+                                              len(hit_bids))
                 elif pc is not None and len(ids):
                     pc.note_miss()
+                # the uncached tail AFTER tier readmission: a partial
+                # readmit lengthens the suffix the prefill must cover
+                suffix = ids[match:]
                 if not cache.ensure(r, reserve):
                     # defensive: the pinned-aware gate above makes
                     # this unreachable, but a seated row WITHOUT its
@@ -1501,6 +1596,9 @@ class Completer:
                     # (evict rewritten / no-longer-waiting slots)
                     self._sweep_bp_memo()
                     self.publish_stats()
+                    # warm-layer checkpoint rides the same beat —
+                    # dirty-gated, so a quiet tier costs one flag read
+                    self._tier_checkpoint()
 
                 try:
                     if all(r is None for r in rows):
@@ -1633,8 +1731,37 @@ class Completer:
             if self.prefix_cache is not None:
                 # a stopped lane returns the WHOLE pool: cached pages
                 # are a warm-serving optimization, not a shutdown
-                # liability (the zero-leaked-pages contract)
+                # liability (the zero-leaked-pages contract).  With
+                # the tier bound, every reclaimed page DEMOTES to
+                # host RAM first — this is demote-on-retire, and the
+                # forced checkpoint below persists the full warm set
+                # so the replacement generation attaches warm
                 self.prefix_cache.reclaim(cache.n_blocks)
+            self._tier_checkpoint(force=True)
+
+    def _tier_checkpoint(self, force: bool = False) -> None:
+        """Snapshot radix index + host-tier pages into the persistent
+        segment (kv_tier.TierPersist.save: payload under the NEW
+        epoch first, index record last, old epoch swept after — a
+        torn write leaves the previous snapshot authoritative).
+        Replica 0 owns the writes; peers only load.  Beat-cadence
+        calls are dirty-gated and rate-limited; force is the retire
+        path, where the warm set must land before the process exits."""
+        if (self._tier_store is None or self.kv_tier is None
+                or self.prefix_cache is None or self.replica != 0):
+            return
+        now = time.monotonic()
+        if not force and (not self.kv_tier.dirty
+                          or now - self._tier_last_save < 5.0):
+            return
+        self._tier_last_save = now
+        from .kv_tier import tier_geometry
+        try:
+            self._tier_store.save(
+                self.prefix_cache, self.kv_tier,
+                tier_geometry(self._model, self._paged_cache))
+        except Exception as ex:
+            self._debug(f"tier checkpoint failed: {ex}")
 
     # -- drain loop --------------------------------------------------------
 
@@ -1897,6 +2024,30 @@ class Completer:
                         str(t), {})["prefix_pages"] = pages
             if tenants and "tenants" not in payload:
                 payload["tenants"] = tenants
+        if self.kv_tier is not None:
+            # tiered-KV gauges (sptpu_completer_tier_* in `spt
+            # metrics`): occupancy the autoscaler weighs against HBM
+            # pages, readmit-rate the runbook triages warm serving
+            # by, and the restore verdict (`tier_restored` pages +
+            # typed `tier_restore_reason` on a cold fallback) that
+            # tells an operator whether a restart attached warm
+            tier = self.kv_tier
+            payload["tier_pages"] = len(tier)
+            payload["tier_mb"] = round(tier.bytes_held() / 2**20, 3)
+            payload["tier_spills"] = tier.spills
+            payload["tier_spill_failures"] = tier.spill_failures
+            payload["tier_demotions"] = tier.demotions
+            payload["tier_readmits"] = tier.readmits
+            payload["tier_readmit_failures"] = tier.readmit_failures
+            payload["tier_capacity_drops"] = tier.capacity_drops
+            payload["tier_restored"] = self._tier_restore[0]
+            if self._tier_restore[1] not in ("", "off"):
+                payload["tier_restore_reason"] = self._tier_restore[1]
+            if pc is not None:
+                payload["tier_demoted"] = pc.demoted_pages()
+            if self._tier_store is not None:
+                payload["tier_snapshot_epoch"] = \
+                    self._tier_store.epoch
         if self._paged_cache is not None:
             # the pool's storage dtype + bytes MEASURED from the
             # placed device buffers (values + scales): `spt metrics`
@@ -2150,6 +2301,23 @@ def main(argv: list[str] | None = None) -> int:
                          "tenants are unbounded; over-quota inserts "
                          "evict the tenant's own zero-ref pages "
                          "first, then skip)")
+    ap.add_argument("--kv-tier-pages", type=int, default=0,
+                    help="host-DRAM KV spill tier capacity in pool "
+                         "pages (engine/kv_tier.py): evicted zero-ref "
+                         "prefix pages demote to host RAM and readmit "
+                         "via device_put + block-table write instead "
+                         "of a re-prefill (default 0: off)")
+    ap.add_argument("--kv-tier-persist", nargs="?", const="auto",
+                    default=None,
+                    help="checkpoint the radix index + host-tier "
+                         "pages into a file-backed persistent store "
+                         "segment so restarts and scale-up replicas "
+                         "attach WARM (write-record-last, epoch-"
+                         "bumped; torn snapshots fall back cold, "
+                         "typed in heartbeat).  Optional value names "
+                         "the segment; bare flag derives "
+                         "<store>-kvtier.  Replica 0 writes, all "
+                         "replicas load")
     ap.add_argument("--queue-high-water", type=int, default=None,
                     help="multi-tenant QoS: max waiting backlog — "
                          "overflow is claimed and READY-flipped with "
@@ -2278,6 +2446,11 @@ def main(argv: list[str] | None = None) -> int:
                      prefix_cache_pages=args.prefix_cache_pages,
                      prefix_quotas=parse_tenant_quotas(
                          args.prefix_quota),
+                     kv_tier_pages=args.kv_tier_pages,
+                     kv_tier_persist=(
+                         f"{args.store}-kvtier"
+                         if args.kv_tier_persist == "auto"
+                         else args.kv_tier_persist),
                      replica=args.replica)
     comp.attach()
     continuous = args.continuous or args.phase != "unified"

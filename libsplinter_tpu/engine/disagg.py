@@ -158,18 +158,24 @@ class PrefillLane(Completer):
         pc = getattr(cache, "prefix_cache", None)
         hit_bids: list[int] = []
         match = 0
+        tier_nodes: list = []
         if pc is not None and len(ids):
-            hit_bids, match = pc.lookup(ids)
-            while hit_bids and match >= len(ids):
-                # keep >= 1 suffix token to prefill: the handoff needs
-                # the last-position logits for the first sample (the
-                # unified lane's fully-covered replay trick needs a
-                # decode chunk this lane never runs)
+            hit_bids, match, tier_nodes = pc.lookup_tiered(ids)
+            # keep >= 1 suffix token to prefill: the handoff needs
+            # the last-position logits for the first sample (the
+            # unified lane's fully-covered replay trick needs a
+            # decode chunk this lane never runs).  Trim the DRAM run
+            # first — dropping a tier node costs nothing readmitted
+            # yet, dropping an HBM page forfeits committed work
+            while tier_nodes \
+                    and match + len(tier_nodes) * cache.page \
+                    >= len(ids):
+                tier_nodes = tier_nodes[:-1]
+            while hit_bids and not tier_nodes and match >= len(ids):
                 hit_bids = hit_bids[:-1]
                 match -= cache.page
-            if not hit_bids:
+            if not hit_bids and not tier_nodes:
                 match = 0
-        suffix = ids[match:]
         if len(ids):
             # peek-before-claim backpressure, prompt-only: the DECODE
             # reservation is the adopting lane's pool's problem
@@ -189,17 +195,38 @@ class PrefillLane(Completer):
             return True
         tp0 = time.perf_counter()
         row = 0                       # serial scratch row
-        if hit_bids:
+        if hit_bids or tier_nodes:
             fault("completer.prefix_map")
-            cache.map_shared(row, hit_bids)
-            cache.lengths[row] = match
-            pc.commit_hit(ids, match)
-            pc.stats.bytes_saved += match * cache.kv_bytes_per_token()
-            if tenant:
-                self.tenants.bump(tenant, "prefix_hit_pages",
-                                  len(hit_bids))
+            if hit_bids:
+                # pin the HBM prefix FIRST: readmission allocations
+                # below can trigger reclaim, and an unpinned zero-ref
+                # hit page would be fair game for that eviction pass
+                cache.map_shared(row, hit_bids)
+            if tier_nodes:
+                # DRAM hit: readmitted pages arrive holding refcount
+                # 1 — drop each to zero-ref (tree-retained), then let
+                # map_shared's 0→1 bump pin them for the scratch row.
+                # Partial readmission just lengthens the suffix
+                tier_bids = pc.readmit(tier_nodes, cache)
+                for b in tier_bids:
+                    cache._decref(b)
+                if tier_bids:
+                    cache.map_shared(row, tier_bids)
+                hit_bids = hit_bids + tier_bids
+                match += len(tier_bids) * cache.page
+            if not hit_bids:
+                pc.note_miss()       # every readmit failed
+            else:
+                cache.lengths[row] = match
+                pc.commit_hit(ids, match)
+                pc.stats.bytes_saved += \
+                    match * cache.kv_bytes_per_token()
+                if tenant:
+                    self.tenants.bump(tenant, "prefix_hit_pages",
+                                      len(hit_bids))
         elif pc is not None:
             pc.note_miss()
+        suffix = ids[match:]
         if not cache.ensure(row, len(ids)):
             # defensive (pinned-aware gate above): re-queue, same as
             # the unified admit()'s unreachable branch

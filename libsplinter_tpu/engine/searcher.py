@@ -255,10 +255,8 @@ class Searcher:
         except OSError:
             self._bid = -1
         st.watch_label_register(P.BIT_SEARCH_REQ, self.group)
-        if st.header().bus_pid == 0:
-            st.bus_init()
-        else:
-            st.bus_open()
+        st.bus_attach()   # adopts the bus when a crashed owner
+                          # left a dead pid in the header
         self.generation = P.bump_generation(st, self._hb_key)
         # compile events ledgered from here carry this generation —
         # a restart's re-warmup is distinguishable in the ring

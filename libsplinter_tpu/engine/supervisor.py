@@ -969,6 +969,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--drain-deadline-s", type=float, default=None,
                     help="scale-down: seconds a retiring replica "
                          "gets to finish in-flight work")
+    ap.add_argument("--tier-pages", type=int, default=0,
+                    metavar="N",
+                    help="arm the host-DRAM KV spill tier on every "
+                         "serving lane (completer/prefill/decode "
+                         "children get --kv-tier-pages N): evicted "
+                         "prefix pages demote to host RAM and readmit "
+                         "without a re-prefill (engine/kv_tier.py)")
+    ap.add_argument("--tier-persist", action="store_true",
+                    help="with --tier-pages: checkpoint the warm set "
+                         "into a file-backed persistent segment "
+                         "(children get bare --kv-tier-persist, i.e. "
+                         "<store>-kvtier) so supervised restarts and "
+                         "scale-up replicas attach WARM.  Replica 0 "
+                         "of each lane writes the snapshot; every "
+                         "spawn — restart or scale-up — loads it")
     ap.add_argument("--pin-chips", default="",
                     metavar="LANE=DEV[,LANE=DEV]",
                     help="per-lane device pin, e.g. "
@@ -985,6 +1000,18 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(level=logging.INFO)
     lane_args = {lane: shlex.split(getattr(args, f"{lane}_args"))
                  for lane in LANES}
+    if args.tier_persist and not args.tier_pages:
+        ap.error("--tier-persist requires --tier-pages N")
+    if args.tier_pages:
+        # tier convenience flags fan out to every serving lane; an
+        # explicit per-lane --kv-tier-pages in --<lane>-args wins
+        # (argparse keeps the last occurrence)
+        for ln in ("completer", "prefill", "decode"):
+            if ln in lane_args:
+                extra = ["--kv-tier-pages", str(args.tier_pages)]
+                if args.tier_persist:
+                    extra.append("--kv-tier-persist")
+                lane_args[ln] = extra + lane_args[ln]
     sup_kw = {name: val for name in
               ("backoff_base_ms", "backoff_max_ms",
                "breaker_threshold", "breaker_window_s",

@@ -67,16 +67,20 @@ PROGRESS_FIELDS = {"embedder": "embedded",
 _EXTRA = {"completer": ("pages_free", "pages_used", "tokens",
                         "prefix_hits", "prefix_shared_pages",
                         "pool_mb", "pool_mb_peak",
-                        "pages_used_peak", "compile_events"),
+                        "pages_used_peak", "compile_events",
+                        "tier_pages", "tier_readmits",
+                        "tier_restored"),
           "embedder": ("compile_count", "compile_events"),
           "searcher": ("compile_events",),
           "pipeliner": ("scripts_active",),
           "prefill": ("handoff_failed", "handoff_wire_mb",
                       "prefix_hits", "prefill_wall_ema_ms",
-                      "compile_events"),
+                      "compile_events", "tier_pages",
+                      "tier_readmits"),
           "decode": ("pages_free", "pages_used", "tokens",
                      "adopted", "readopted", "adopt_backpressure",
-                     "handoff_refill", "compile_events")}
+                     "handoff_refill", "compile_events",
+                     "tier_pages", "tier_readmits")}
 
 DEFAULT_INTERVAL_S = 2.0
 DEFAULT_RING_LEN = 64

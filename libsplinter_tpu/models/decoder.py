@@ -1694,6 +1694,64 @@ class CompletionModel:
                 scales.append(None)
         return pages, scales
 
+    def export_page_bytes(self, cache: PagedKVCache, bid: int
+                          ) -> tuple[bytes, bytes | None]:
+        """Host copy of ONE pool page (k stack then v stack, plus the
+        scale stacks for int8 pools) — the spill-tier demotion copy
+        (engine/kv_tier.py).  Rides the same jitted gather program as
+        the disagg handoff export, so a tier-enabled lane that warmed
+        the handoff programs never compiles here."""
+        prog = self._page_export_program(cache.quantized)
+        b = jnp.int32(int(bid))
+        if cache.quantized:
+            k, v, ks, vs = prog(cache.k_pools, cache.v_pools,
+                                cache.k_scales, cache.v_scales, b)
+            return (np.asarray(k).tobytes() + np.asarray(v).tobytes(),
+                    np.asarray(ks).tobytes()
+                    + np.asarray(vs).tobytes())
+        k, v = prog(cache.k_pools, cache.v_pools, b)
+        return (np.asarray(k).tobytes() + np.asarray(v).tobytes(),
+                None)
+
+    def import_page_bytes(self, cache: PagedKVCache, bid: int,
+                          buf: bytes,
+                          sbuf: bytes | None = None) -> None:
+        """Scatter one wire page's host bytes into pool page `bid` —
+        the tier READMISSION: a DRAM hit becomes this device_put plus
+        a block-table write instead of a re-prefill.  Same program
+        and byte layout as the disagg adoption import."""
+        cfg = self.cfg
+        prog = self._page_import_program(cache.quantized)
+        dt = self._page_wire_dtype(cache)
+        shape = (cfg.layers, cfg.kv_heads, cache.page, cfg.head_dim)
+        half = self.page_wire_bytes(cache) // 2
+        if len(buf) != 2 * half:
+            raise ValueError(
+                f"tier page holds {len(buf)} bytes, "
+                f"expected {2 * half}")
+        kv = np.frombuffer(buf[:half], dt).reshape(shape)
+        vv = np.frombuffer(buf[half:], dt).reshape(shape)
+        b = jnp.int32(int(bid))
+        if cache.quantized:
+            sh = (cfg.layers, cfg.kv_heads)
+            sn = cfg.layers * cfg.kv_heads * 4
+            if sbuf is None or len(sbuf) != 2 * sn:
+                raise ValueError(
+                    f"tier scales hold "
+                    f"{0 if sbuf is None else len(sbuf)} bytes, "
+                    f"expected {2 * sn}")
+            ks = np.frombuffer(sbuf[:sn], np.float32).reshape(sh)
+            vs = np.frombuffer(sbuf[sn:], np.float32).reshape(sh)
+            kp, vp, ksc, vsc = prog(
+                cache.k_pools, cache.v_pools, cache.k_scales,
+                cache.v_scales, jnp.asarray(kv), jnp.asarray(vv),
+                jnp.asarray(ks), jnp.asarray(vs), b)
+            cache.k_scales, cache.v_scales = list(ksc), list(vsc)
+        else:
+            kp, vp = prog(cache.k_pools, cache.v_pools,
+                          jnp.asarray(kv), jnp.asarray(vv), b)
+        cache.k_pools, cache.v_pools = list(kp), list(vp)
+
     def paged_adopt_row(self, cache: PagedKVCache, row: int,
                         length: int, pages: list[bytes],
                         scales: list[bytes | None] | None = None

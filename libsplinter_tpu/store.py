@@ -488,6 +488,27 @@ class Store:
         _ck(rc)
         return True
 
+    def bus_attach(self) -> bool:
+        """Join the event bus as owner or subscriber, whichever the
+        header calls for.  A recorded owner that died without
+        resigning (crashed lanes exit via os._exit, skipping
+        bus_close) leaves its pid in the header; pidfd_open on it
+        fails ESRCH forever, which used to kill every respawned lane
+        at attach.  Adopt the bus instead: bus_init atomically
+        installs this process as the new owner and bumps bus_gen, so
+        surviving subscribers re-attach on their next ensure-open.
+        False = no eventfd path on this host (pidfd_getfd denied) —
+        the caller's polling drain still works."""
+        if self.header().bus_pid == 0:
+            self.bus_init()
+            return True
+        try:
+            return self.bus_open()
+        except OSError:
+            # owner unreachable (dead pid, stale fd): take over
+            self.bus_init()
+            return True
+
     def bus_wait(self, timeout_ms: int) -> bool:
         rc = self._lib.spt_bus_wait(self._h, timeout_ms)
         if rc in (-errno.ETIMEDOUT, -errno.ENOTCONN, -errno.ENOSYS):

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""CI gate: a supervised kill-and-restart comes back WARM.
+
+Runs one tiered completer lane (`--kv-tier-pages` + a persistent
+`--kv-tier-persist` segment, ISSUE 19) under `spt supervise`, drives
+`spt loadgen` through it, SIGKILLs the lane MID-LOAD, and asserts the
+warm-restart contract at smoke scale:
+
+  - zero admitted-request loss through the kill (the respawned lane
+    reclaims every stranded claim — loadgen's `lost` classification);
+  - the respawn attaches WARM: the persistent radix index restores
+    (heartbeat tier_restored > 0, no typed tier_restore_reason) and
+    the hot prompts served before the kill come back via DRAM/file
+    readmission (tier_readmits > 0, prefix_hits > 0) — not re-prefill;
+  - greedy bytes for those prompts are identical across the restart;
+  - post-restart first-token p50 stays within 2x of the pre-restart
+    baseline (plus a small absolute slack so a 1-core CI box's
+    scheduler jitter cannot flake a ~5 ms baseline).  Both measured
+    windows run against a warmed lane — compile time never lands
+    inside a measured TTFT.
+
+Run: JAX_PLATFORMS=cpu python scripts/warm_restart_check.py
+(make warm-check wires it into make check.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+STORE = f"/spt-warm-check-{os.getpid()}"
+RATIO = 2.0                         # the ISSUE 19 acceptance bound
+SLACK_MS = 50.0                     # absolute floor for tiny baselines
+WARM_PROMPTS = [f"the warm set prompt number {i} stays hot"
+                for i in range(3)]
+
+
+def child(store_name: str, persist_name: str) -> int:
+    """The supervised lane: a tiny tiered completer with the
+    persistent warm layer armed (what `spt supervise --tier-pages N
+    --tier-persist` fans out at production scale)."""
+    import jax.numpy as jnp
+
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.engine.completer import Completer
+    from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                DecoderConfig)
+    st = Store.open(store_name)
+    model = CompletionModel(DecoderConfig.tiny(dtype=jnp.float32),
+                            buckets=(32,), temp=0.0, seed=1,
+                            suffix_buckets=(8,))
+    comp = Completer(st, model=model, max_new_tokens=10,
+                     flush_tokens=2, template="none", batch_cap=4,
+                     page_size=8, kv_tier_pages=64,
+                     kv_tier_persist=persist_name)
+    comp.attach()
+    comp.run_continuous(idle_timeout_ms=10, stop_after=900.0)
+    return 0
+
+
+def _ttft_p50(report: dict) -> float | None:
+    for row in report.get("prefill_burst", []):
+        sect = row.get("prefill-burst") or {}
+        if "ttft_p50_ms" in sect:
+            return sect["ttft_p50_ms"]
+    return None
+
+
+def main() -> int:
+    from libsplinter_tpu import Store
+    from libsplinter_tpu.cli.loadgen import LoadGenerator, TenantSpec
+    from libsplinter_tpu.engine import protocol as P
+    from libsplinter_tpu.engine.kv_tier import TierPersist
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    persist = f"{STORE}-kvtier"
+    Store.unlink(STORE)
+    TierPersist.unlink(persist)
+    # max_val 16384: same sizing as disagg_check — roomy values, the
+    # tier's own persistence lives in its own segment
+    store = Store.create(STORE, nslots=1024, max_val=16384, vec_dim=8)
+
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             STORE, persist])
+
+    sup = Supervisor(STORE, lanes=("completer",), spawn_fn=spawn,
+                     store=store, backoff_base_ms=100,
+                     backoff_max_ms=2000, breaker_threshold=8,
+                     breaker_window_s=120, startup_grace_s=300)
+    sup_t = threading.Thread(target=sup.run,
+                             kwargs={"poll_interval_s": 0.1,
+                                     "stop_after": 900.0})
+    sup_t.start()
+
+    def submit(key, prompt):
+        store.set(key, prompt)
+        store.label_or(key, P.LBL_INFER_REQ | P.LBL_WAITING)
+        store.bump(key)
+
+    def await_ready(keys, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(store.labels(k) & P.LBL_READY for k in keys):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def run_loadgen(seed):
+        gen = LoadGenerator(
+            store, [TenantSpec(tenant=1, rate=2.0,
+                               deadline_ms=120_000)],
+            scenario="prefill-burst", rate_profile=[(1.0, 8.0)],
+            corpus=16, seed=seed, drain_s=90.0)
+        return gen.run()
+
+    try:
+        # warm the lane AND plant the hot set the restart must revive
+        warm_keys = [f"__warm/{i}" for i in range(len(WARM_PROMPTS))]
+        for k, p in zip(warm_keys, WARM_PROMPTS):
+            submit(k, p)
+        if not await_ready(warm_keys, 240):
+            print("FAIL: warmup requests never completed")
+            return 1
+        pre_bytes = [store.get(k).rstrip(b"\0") for k in warm_keys]
+
+        rep_pre = run_loadgen(seed=31)
+        # let one more dirty-gated checkpoint beat land (5s cadence)
+        # so the snapshot covers the loadgen window's inserts too
+        time.sleep(6.0)
+
+        # SIGKILL mid-load: a third loadgen window is in flight when
+        # the lane dies — the respawn must reclaim every claim
+        holder: dict = {}
+        kt = threading.Thread(
+            target=lambda: holder.update(rep=run_loadgen(seed=32)))
+        kt.start()
+        time.sleep(2.0)
+        lane = sup.lanes["completer"]
+        gen_before = lane.generation
+        proc = lane.proc
+        if proc is None:
+            print("FAIL: no live lane process to kill")
+            return 1
+        proc.kill()                  # no checkpoint, no cleanup
+        kt.join()
+        rep_kill = holder["rep"]
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if lane.generation > gen_before and lane.pid:
+                break
+            time.sleep(0.1)
+        else:
+            print("FAIL: supervisor never respawned the lane")
+            return 1
+
+        # the SAME prompts through the respawned lane: must come back
+        # byte-identical via the restored index + readmission (and
+        # re-warm the new process so measured TTFT excludes compiles)
+        rewarm_keys = [f"__rewarm/{i}"
+                       for i in range(len(WARM_PROMPTS))]
+        for k, p in zip(rewarm_keys, WARM_PROMPTS):
+            submit(k, p)
+        if not await_ready(rewarm_keys, 240):
+            print("FAIL: post-restart requests never completed")
+            return 1
+        post_bytes = [store.get(k).rstrip(b"\0") for k in rewarm_keys]
+
+        rep_post = run_loadgen(seed=33)
+        snap = json.loads(
+            store.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+
+        p50_pre = _ttft_p50(rep_pre)
+        p50_post = _ttft_p50(rep_post)
+        lost = (rep_pre["lost"] + rep_kill["lost"]
+                + rep_post["lost"])
+        print(f"warm_check: ttft p50 pre={p50_pre} ms "
+              f"post={p50_post} ms; lost={lost}; "
+              f"restarts={lane.restarts}")
+        print(f"  tier: restored={snap.get('tier_restored')} "
+              f"readmits={snap.get('tier_readmits')} "
+              f"pages={snap.get('tier_pages')} "
+              f"reason={snap.get('tier_restore_reason', '')!r} "
+              f"prefix_hits={snap.get('prefix_hits')}")
+
+        fails = []
+        if lost:
+            fails.append(f"{lost} admitted requests LOST "
+                         "(zero-loss contract)")
+        if lane.restarts < 1:
+            fails.append("the lane never restarted (kill not seen)")
+        if post_bytes != pre_bytes:
+            fails.append("hot-prompt bytes changed across the "
+                         "restart (greedy must be identical)")
+        if not snap.get("tier_restored"):
+            fails.append("respawn attached COLD (tier_restored == 0 "
+                         "— persistent index not restored)")
+        if snap.get("tier_restore_reason"):
+            fails.append("typed cold fallback: tier_restore_reason="
+                         f"{snap['tier_restore_reason']!r}")
+        if not snap.get("tier_readmits"):
+            fails.append("no readmissions: the warm set was "
+                         "re-prefilled, not readmitted")
+        if not snap.get("prefix_hits"):
+            fails.append("radix hit rate did not recover post-"
+                         "restart (prefix_hits == 0)")
+        if p50_pre is None or p50_post is None:
+            fails.append("missing TTFT quantiles in a loadgen window")
+        else:
+            bound = max(RATIO * p50_pre, p50_pre + SLACK_MS)
+            if p50_post > bound:
+                fails.append(
+                    f"post-restart first-token p50 degraded: "
+                    f"{p50_post:.1f} ms > bound {bound:.1f} ms "
+                    f"(pre {p50_pre:.1f} ms)")
+        if fails:
+            print("warm_check: FAIL — " + "; ".join(fails))
+            return 1
+        print("warm_check: PASS — supervised kill-and-restart came "
+              "back warm (index restored, hot set readmitted, bytes "
+              "identical, first-token p50 within bound, zero loss)")
+        return 0
+    finally:
+        sup.stop()
+        sup_t.join(timeout=30)
+        sup.shutdown()
+        store.close()
+        Store.unlink(STORE)
+        TierPersist.unlink(persist)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        raise SystemExit(child(sys.argv[2], sys.argv[3]))
+    raise SystemExit(main())

@@ -115,6 +115,35 @@ def main() -> int:
             idle_timeout_ms=20,
             stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
         print(f"completions={comp.stats.completions}", flush=True)
+    elif role == "tier_completer":
+        # the tiered-KV continuous lane at tiny geometry with the
+        # host-DRAM spill tier + persistent warm layer armed: the
+        # tier.spill site fires on each frozen page's write-through
+        # shadow copy, tier.readmit on each DRAM-hit page's
+        # device_put return, and tier.restore inside the warm-attach
+        # snapshot adoption — crash drills in all three prove a death
+        # mid-spill leaves the HBM copy authoritative, a death
+        # mid-readmit leaves the shadow intact, and a death
+        # mid-restore falls back cold, all with zero admitted loss
+        # (test_kv_tier.py runs this role under `spt supervise`)
+        import jax.numpy as jnp
+
+        from libsplinter_tpu.engine.completer import Completer
+        from libsplinter_tpu.models.decoder import (CompletionModel,
+                                                    DecoderConfig)
+
+        cfg = DecoderConfig.tiny(dtype=jnp.float32)
+        model = CompletionModel(cfg, buckets=(32,), temp=0.0, seed=1,
+                                suffix_buckets=(8,))
+        comp = Completer(st, model=model, max_new_tokens=8,
+                         flush_tokens=4, template="none", batch_cap=4,
+                         page_size=8, kv_tier_pages=32,
+                         kv_tier_persist=f"{store_name}-kvtier")
+        comp.attach()
+        comp.run_continuous(
+            idle_timeout_ms=20,
+            stop_after=float(os.environ.get("SPTPU_CHAOS_RUN_S", "8")))
+        print(f"completions={comp.stats.completions}", flush=True)
     elif role == "completer_sharded":
         # the pod-sharded continuous lane at tiny geometry over a
         # virtual 8-device CPU mesh: the completer.sharded_dispatch

@@ -149,6 +149,17 @@ PROMPTS = ["say one thing", "list two colors ok", "count to three"]
 JOINER = "and a late joiner arrives"
 
 
+@pytest.fixture(scope="module")
+def sharded_model():
+    """tp=2 over the conftest's virtual 8-device CPU mesh: the wire
+    handoff must round-trip kv-head-SHARDED pools byte-exactly."""
+    from libsplinter_tpu.parallel import (ShardedCompletionModel,
+                                          make_mesh)
+    return ShardedCompletionModel(
+        DecoderConfig.tiny(dtype=jnp.float32), make_mesh(dp=4, tp=2),
+        buckets=(32,), temp=0.0, seed=1, suffix_buckets=(8,))
+
+
 class TestByteExactness:
     def test_split_matches_unified_with_midburst_joiner(self, model):
         """Greedy bytes through the handoff — wire-page export/import
@@ -162,6 +173,22 @@ class TestByteExactness:
         assert pf["handoffs"] >= 4 and pf["handoff_failed"] == 0
         assert dl["adopted"] == pf["handoffs"]
         # the real wire path, not the fallback
+        assert dl["handoff_refill"] == 0
+        assert pf["handoff_wire_mb"] > 0
+
+    def test_split_matches_unified_tp2_cpu_mesh(self, sharded_model):
+        """The page handoff across a tp=2 mesh: exported wire pages
+        gather the kv-head-sharded pool, adoption scatters it back
+        under the same sharding, and greedy bytes through the split
+        match the unified sharded lane (`make disagg-check` runs
+        this — the multichip dry-run contract from conftest)."""
+        uni, _ = _serve("uni-tp2", _unified, sharded_model, PROMPTS)
+        spl, stats = _serve("spl-tp2", _split, sharded_model, PROMPTS)
+        assert spl == uni
+        pf, dl = stats
+        assert pf["handoffs"] >= 3 and pf["handoff_failed"] == 0
+        assert dl["adopted"] == pf["handoffs"]
+        # the real wire path on the mesh, not the refill fallback
         assert dl["handoff_refill"] == 0
         assert pf["handoff_wire_mb"] > 0
 

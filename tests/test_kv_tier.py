@@ -1,0 +1,565 @@
+"""Tiered KV with warm restarts (ISSUE 19 / ROADMAP item 3): the
+host-DRAM spill tier under the radix prefix cache
+(engine/kv_tier.HostTier + PrefixCache demote/readmit) and the
+file-backed persistent warm layer (kv_tier.TierPersist) that lets a
+supervised restart or scale-up replica attach WARM.
+
+Covers: HostTier LRU/capacity mechanics, the write-through ->
+demote -> readmit cycle pinned byte-exact against a cold prefill
+(plus the page-accounting invariants at every step), the capacity-
+overflow prune cascade, tier-on vs tier-off byte-identical continuous
+serving, the two-generation warm restart (snapshot -> restore ->
+readmit, heartbeat tier_* gauges), torn-snapshot recovery at every
+byte-boundary class (header, mid-page, missing trailer, missing
+record, geometry) with the typed degradation reason surfaced in the
+heartbeat, and the three supervised chaos drills at the tier.spill /
+tier.readmit / tier.restore fault sites.  `make warm-check` runs the
+end-to-end restart gate (scripts/warm_restart_check.py) on top.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from libsplinter_tpu import Store
+from libsplinter_tpu.engine import protocol as P
+from libsplinter_tpu.engine.completer import Completer
+from libsplinter_tpu.engine.kv_tier import (INDEX_KEY, HostTier,
+                                            TierPersist, _entry_key,
+                                            _page_key, tier_geometry)
+from libsplinter_tpu.models.decoder import CompletionModel
+from libsplinter_tpu.utils import faults
+from test_prefix_cache import (CFG, HOT_PROMPT, PAGE, _attach_pc,
+                               _await_ready, _check_invariants,
+                               _mkstore, _submit)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return CompletionModel(CFG, buckets=(32, 64), temp=0.0, seed=1,
+                           suffix_buckets=(8, 16))
+
+
+def _drill_model():
+    """The exact geometry tests/chaos_child.py `tier_completer` runs,
+    so pre-seeded snapshots and greedy outputs line up across the
+    parent/child process boundary."""
+    return CompletionModel(CFG, buckets=(32,), temp=0.0, seed=1,
+                           suffix_buckets=(8,))
+
+
+# 24 tokens = 3 exact pages at PAGE=8
+PROMPT24 = (np.arange(1, 25, dtype=np.int32) % 200) + 1
+
+
+def _bind(model, cache, pc, capacity=32):
+    tier = HostTier(capacity)
+    pc.bind_tier(
+        tier,
+        export_page=lambda bid: model.export_page_bytes(cache, bid),
+        import_page=lambda bid, buf, sbuf: model.import_page_bytes(
+            cache, bid, buf, sbuf))
+    return tier
+
+
+def _seed_snapshot(model, pname):
+    """One 3-page chain, write-through shadowed, checkpointed into a
+    fresh persistent segment — the donor every torn-snapshot test
+    mangles.  Uses the SAME (capacity, max_len) the completer passes
+    so the segment is kept, not recreated, across a lane attach."""
+    cache = model.init_paged(4, page=PAGE)
+    pc = _attach_pc(cache)
+    tier = _bind(model, cache, pc)
+    model.paged_prefill_row(cache, PROMPT24, 0)
+    assert pc.insert(PROMPT24, cache, 0, tenant=3) == 3
+    geom = tier_geometry(model, cache)
+    persist = TierPersist(pname, capacity_pages=32,
+                          max_len=model.cfg.max_len,
+                          page_bytes=geom["page_bytes"])
+    assert persist.save(pc, tier, geom)
+    return persist, geom
+
+
+def _cold_target(model):
+    cache = model.init_paged(4, page=PAGE)
+    pc = _attach_pc(cache)
+    tier = _bind(model, cache, pc)
+    return cache, pc, tier
+
+
+# ------------------------------------------------------------- host tier
+
+def test_host_tier_lru_capacity_and_dirty():
+    t = HostTier(2)
+    assert len(t) == 0 and not t.dirty
+    assert t.put("a", b"AA", None) == []
+    assert t.put("b", b"BB", b"s") == []
+    assert t.dirty and t.bytes_held() == 5
+    t.dirty = False
+    # has/peek are recency-pure (a denied lookup must not refresh)
+    assert t.has("a") and t.peek("a") == (b"AA", None)
+    assert not t.dirty
+    assert t.get("a") == (b"AA", None)      # LRU touch: "a" newest
+    assert t.put("c", b"CC", None) == ["b"]  # so "b" is the victim
+    assert t.capacity_drops == 1 and t.dirty
+    t.drop("b")                              # already gone: no-op
+    t.drop("a")
+    assert not t.has("a") and len(t) == 1
+    t.clear()
+    assert len(t) == 0 and t.bytes_held() == 0
+
+
+# -------------------------------------------- spill / demote / readmit
+
+def test_write_through_demote_readmit_byte_exact(model):
+    """The tier cycle end to end at the cache level: insert takes the
+    host shadow immediately (write-through), eviction DEMOTES (node
+    survives, page returns to the pool), a later hit readmits with a
+    device_put — and the decode over readmitted pages is byte-
+    identical to a cold prefill.  Page-accounting invariants hold at
+    every step."""
+    cache = model.init_paged(4, page=PAGE)
+    pc = _attach_pc(cache)
+    tier = _bind(model, cache, pc)
+    model.paged_prefill_row(cache, PROMPT24, 0)
+    assert pc.insert(PROMPT24, cache, 0, tenant=1) == 3
+    assert tier.spills == 3 and len(tier) == 3   # write-through
+    _check_invariants(cache, pc)
+    cache.free_row(0)
+    _check_invariants(cache, pc)
+    free_before = len(cache._free)
+    assert pc.reclaim(3) == 3
+    assert tier.demotions == 3 and pc.demoted_pages() == 3
+    assert pc.shared_pages() == 0
+    assert len(cache._free) == free_before + 3
+    _check_invariants(cache, pc)
+    bids, match, nodes = pc.lookup_tiered(PROMPT24)
+    assert bids == [] and match == 0 and len(nodes) == 3
+    # readmit in path order; the refcount-1 return is transferred
+    # into the row's block table exactly like the completer does
+    got = pc.readmit(nodes, cache)
+    assert len(got) == 3 and tier.readmits == 3
+    assert pc.demoted_pages() == 0
+    for b in got:
+        cache._decref(b)
+    _check_invariants(cache, pc)
+    cache.map_shared(1, got)
+    cache.lengths[1] = len(PROMPT24) - 1
+    assert cache.ensure(1, 32)
+    _check_invariants(cache, pc)
+    toks = np.full((4,), -1, np.int32)
+    toks[1] = int(PROMPT24[-1])              # the replay token
+    out = model.paged_decode_chunk(cache, toks, 7)
+    readmitted = [int(x) for x in out[1]]
+    # baseline: cold prefill of the same prompt in a fresh pool
+    cache_b = model.init_paged(4, page=PAGE)
+    lb = model.paged_prefill_row(cache_b, PROMPT24, 0)
+    tb = np.full((4,), -1, np.int32)
+    tb[0] = int(np.argmax(lb))
+    out_b = model.paged_decode_chunk(cache_b, tb, 7)
+    cold = [int(tb[0])] + [int(x) for x in out_b[0][:6]]
+    assert readmitted == cold
+
+
+def test_capacity_drop_prunes_stranded_dram_chain(model):
+    """LRU overflow at the host tier: dropping a DRAM-resident node's
+    shadow makes it unservable, so the cache prunes it AND its
+    subtree (a chain is only servable root-first).  Also covers the
+    second-chance spill for a victim whose write-through shadow was
+    itself the overflow victim."""
+    cache = model.init_paged(4, page=PAGE)
+    pc = _attach_pc(cache)
+    tier = _bind(model, cache, pc, capacity=2)
+    model.paged_prefill_row(cache, PROMPT24, 0)
+    assert pc.insert(PROMPT24, cache, 0) == 3
+    # write-through at capacity 2: the chain ROOT's shadow was the
+    # LRU victim (root still HBM-resident, so nothing to prune yet)
+    assert tier.spills == 3 and tier.capacity_drops == 1
+    assert len(tier) == 2
+    cache.free_row(0)
+    # leaf-first demotion shadows the tail; the root's second-chance
+    # spill overflows the DRAM-resident middle node out — pruning it
+    # strands its leaf, which is pruned with it
+    assert pc.reclaim(3) == 3
+    assert tier.spills == 4 and tier.capacity_drops == 2
+    assert len(tier) == 1 and pc.demoted_pages() == 1
+    _check_invariants(cache, pc)
+    bids, match, nodes = pc.lookup_tiered(PROMPT24)
+    assert bids == [] and match == 0 and len(nodes) == 1  # root only
+
+
+# ------------------------------------------------ continuous lane A/B
+
+def test_continuous_byte_identical_tier_on_vs_off(tmp_path, model):
+    """Acceptance: greedy decode byte-identical with tiering on vs
+    off — the spill tier is pure capacity machinery, never allowed
+    to change served bytes."""
+    outs = {}
+    for tag, pages in (("off", 0), ("on", 32)):
+        name, st = _mkstore(tmp_path, f"tier-{tag}")
+        try:
+            comp = Completer(st, model=model, max_new_tokens=24,
+                             flush_tokens=2, template="none",
+                             batch_cap=4, page_size=PAGE,
+                             kv_tier_pages=pages)
+            comp.attach()
+            _submit(st, "donor", HOT_PROMPT)
+            th = threading.Thread(
+                target=comp.run_continuous,
+                kwargs=dict(idle_timeout_ms=20, stop_after=60.0),
+                daemon=True)
+            th.start()
+            assert _await_ready(st, ["donor"])
+            _submit(st, "joiner", HOT_PROMPT)
+            assert _await_ready(st, ["joiner"])
+            comp.stop()
+            th.join(timeout=15)
+            outs[tag] = (st.get("donor").rstrip(b"\0"),
+                         st.get("joiner").rstrip(b"\0"))
+            if pages:
+                assert comp.kv_tier is not None
+                assert comp.kv_tier.spills >= 3  # write-through ran
+        finally:
+            st.close()
+            Store.unlink(name)
+    assert outs["on"] == outs["off"]
+    assert outs["on"][0] == outs["on"][1]
+
+
+# ------------------------------------------------------- warm restart
+
+def test_warm_restart_restores_and_readmits(tmp_path, model):
+    """Two lane generations over one persistent segment: generation 1
+    boots cold (typed missing_record — first boot has no snapshot),
+    spills write-through, and its retirement demotes + checkpoints
+    the warm set; generation 2 attaches WARM (pages adopted from the
+    snapshot), serves the same prompt via readmission — not a
+    re-prefill — and every tier_* gauge rides the heartbeat.  Greedy
+    bytes identical across the restart."""
+    name, st = _mkstore(tmp_path, "tier-warm", nslots=256)
+    pname = f"/spt-tierwarm-{tmp_path.name}-kvtier"
+    TierPersist.unlink(pname)
+    try:
+        outs, snaps = {}, {}
+        for gen in (1, 2):
+            comp = Completer(st, model=model, max_new_tokens=8,
+                             flush_tokens=4, template="none",
+                             batch_cap=4, page_size=PAGE,
+                             kv_tier_pages=32, kv_tier_persist=pname)
+            comp.attach()
+            key = f"g{gen}"
+            _submit(st, key, HOT_PROMPT)
+            th = threading.Thread(
+                target=comp.run_continuous,
+                kwargs=dict(idle_timeout_ms=20, stop_after=60.0),
+                daemon=True)
+            th.start()
+            assert _await_ready(st, [key])
+            comp.publish_stats()
+            snaps[gen] = json.loads(
+                st.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+            comp.stop()
+            th.join(timeout=15)
+            if comp._tier_store is not None:
+                comp._tier_store.close()
+            outs[gen] = st.get(key).rstrip(b"\0")
+        assert snaps[1]["tier_restored"] == 0
+        assert snaps[1]["tier_restore_reason"] == "missing_record"
+        assert snaps[1]["tier_spills"] >= 3
+        # generation 2: warm attach + readmission, no re-prefill
+        assert snaps[2]["tier_restored"] >= 3
+        assert snaps[2]["tier_readmits"] >= 3
+        assert snaps[2]["prefix_hits"] >= 1
+        assert "tier_restore_reason" not in snaps[2]  # "" == warm
+        assert snaps[2]["tier_snapshot_epoch"] >= 1
+        for field in ("tier_pages", "tier_mb", "tier_demoted",
+                      "tier_demotions", "tier_spill_failures",
+                      "tier_readmit_failures", "tier_capacity_drops"):
+            assert field in snaps[2]
+        assert outs[1] == outs[2]
+    finally:
+        st.close()
+        Store.unlink(name)
+        TierPersist.unlink(pname)
+
+
+# ---------------------------------------------------- torn snapshots
+
+def _mangle_missing_record(st, epoch):
+    st.unset(INDEX_KEY)
+
+
+def _mangle_torn_header(st, epoch):
+    st.set(INDEX_KEY, '{"v": 1, "epoch": ')
+
+
+def _mangle_mid_page(st, epoch):
+    buf = bytes(st.get(_page_key(epoch, 1)))
+    st.set(_page_key(epoch, 1), buf[:len(buf) // 2])
+
+
+def _mangle_missing_trailer(st, epoch):
+    st.unset(_entry_key(epoch, 2))
+
+
+@pytest.mark.parametrize("mangle,reason", [
+    (_mangle_missing_record, "missing_record"),
+    (_mangle_torn_header, "torn_header"),
+    (_mangle_mid_page, "torn_page"),
+    (_mangle_missing_trailer, "torn_page"),
+], ids=["missing-record", "torn-header", "mid-page",
+        "missing-trailer"])
+def test_torn_snapshot_discarded_cold(tmp_path, model, mangle,
+                                      reason):
+    """Every byte-boundary class of a torn snapshot is detected,
+    typed, and DISCARDED — nothing is adopted, the tree and tier
+    stay empty (never half-loaded)."""
+    pname = f"/spt-tiertorn-{tmp_path.name}"
+    TierPersist.unlink(pname)
+    persist, geom = _seed_snapshot(model, pname)
+    try:
+        mangle(persist.store, persist.epoch)
+        cache2, pc2, tier2 = _cold_target(model)
+        assert persist.load(pc2, tier2, geom) == (0, reason)
+        assert pc2.demoted_pages() == 0 and len(tier2) == 0
+        assert not pc2._children
+        _check_invariants(cache2, pc2)
+    finally:
+        persist.close()
+        TierPersist.unlink(pname)
+
+
+def test_snapshot_geometry_mismatch_cold_then_warm(tmp_path, model):
+    """A restored page is raw device bytes: the slightest geometry
+    drift refuses the whole snapshot (silent garbage otherwise) —
+    and the untouched snapshot still loads warm under the geometry
+    it was taken with."""
+    pname = f"/spt-tiergeom-{tmp_path.name}"
+    TierPersist.unlink(pname)
+    persist, geom = _seed_snapshot(model, pname)
+    try:
+        cache2, pc2, tier2 = _cold_target(model)
+        bad = dict(geom, page=PAGE * 2)
+        assert persist.load(pc2, tier2, bad) == (0,
+                                                 "geometry_mismatch")
+        assert pc2.demoted_pages() == 0 and len(tier2) == 0
+        n, why = persist.load(pc2, tier2, geom)
+        assert (n, why) == (3, "")
+        assert pc2.demoted_pages() == 3 and len(tier2) == 3
+        assert tier2.restored == 3
+        _check_invariants(cache2, pc2)
+    finally:
+        persist.close()
+        TierPersist.unlink(pname)
+
+
+def test_restore_raise_falls_back_cold_typed(tmp_path, model):
+    """The tier.restore fault site fires AFTER full validation,
+    BEFORE adoption: a raise there proves the clean cold fallback
+    (empty tree + tier, typed restore_failed) and leaves the
+    snapshot itself untouched for the next attach."""
+    pname = f"/spt-tierraise-{tmp_path.name}"
+    TierPersist.unlink(pname)
+    persist, geom = _seed_snapshot(model, pname)
+    try:
+        cache2, pc2, tier2 = _cold_target(model)
+        faults.arm("tier.restore:raise@1")
+        try:
+            assert persist.load(pc2, tier2, geom) == \
+                (0, "restore_failed")
+        finally:
+            faults.disarm()
+        assert pc2.demoted_pages() == 0 and len(tier2) == 0
+        assert not pc2._children
+        # fault cleared: the SAME snapshot attaches warm
+        assert persist.load(pc2, tier2, geom) == (3, "")
+        assert pc2.demoted_pages() == 3
+    finally:
+        persist.close()
+        TierPersist.unlink(pname)
+
+
+def test_torn_snapshot_reason_reaches_heartbeat(tmp_path, model):
+    """The typed degradation reason is an operator signal: a lane
+    that attached cold off a torn snapshot says WHY in its heartbeat
+    (tier_restore_reason) — and still serves, spilling fresh."""
+    name, st = _mkstore(tmp_path, "tier-torn-hb")
+    pname = f"/spt-tiertornhb-{tmp_path.name}"
+    TierPersist.unlink(pname)
+    persist, _geom = _seed_snapshot(model, pname)
+    _mangle_torn_header(persist.store, persist.epoch)
+    persist.close()
+    try:
+        comp = Completer(st, model=model, max_new_tokens=4,
+                         flush_tokens=2, template="none",
+                         batch_cap=4, page_size=PAGE,
+                         kv_tier_pages=32, kv_tier_persist=pname)
+        comp.attach()
+        _submit(st, "t1", HOT_PROMPT)
+        th = threading.Thread(
+            target=comp.run_continuous,
+            kwargs=dict(idle_timeout_ms=20, stop_after=30.0),
+            daemon=True)
+        th.start()
+        assert _await_ready(st, ["t1"])     # cold service still works
+        comp.publish_stats()
+        snap = json.loads(st.get(P.KEY_COMPLETE_STATS).rstrip(b"\0"))
+        comp.stop()
+        th.join(timeout=15)
+        if comp._tier_store is not None:
+            comp._tier_store.close()
+        assert snap["tier_restored"] == 0
+        assert snap["tier_restore_reason"] == "torn_header"
+        assert snap["tier_spills"] >= 3
+    finally:
+        st.close()
+        Store.unlink(name)
+        TierPersist.unlink(pname)
+
+
+# ------------------------------------------------- supervised drills
+
+def _run_drill(st, name, keys, extra_key="c3"):
+    """The shared supervised window: spawn the tier_completer chaos
+    child under `spt supervise`, await every submitted key, require
+    at least one restart, then prove a post-crash round-trip and
+    that nothing is stranded claimed."""
+    from libsplinter_tpu.engine.supervisor import Supervisor
+
+    child = os.path.join(os.path.dirname(__file__), "chaos_child.py")
+    holder: dict = {}
+
+    def spawn(lane):
+        return subprocess.Popen(
+            [sys.executable, child, "tier_completer", name],
+            env=holder["sup"]._child_env(lane))
+
+    sup = Supervisor(name, lanes=("completer",), spawn_fn=spawn,
+                     store=st, backoff_base_ms=100,
+                     backoff_max_ms=2000, breaker_threshold=8,
+                     breaker_window_s=120, startup_grace_s=300)
+    holder["sup"] = sup
+    t = threading.Thread(target=sup.run,
+                         kwargs={"poll_interval_s": 0.1,
+                                 "stop_after": 240.0})
+    t.start()
+    try:
+        assert _await_ready(st, keys, timeout=180), sup.lanes
+        assert sup.lanes["completer"].restarts >= 1
+        _submit(st, extra_key, HOT_PROMPT)
+        assert _await_ready(st, [extra_key], timeout=120)
+        for k in list(keys) + [extra_key]:
+            assert not st.labels(k) & (P.LBL_INFER_REQ
+                                       | P.LBL_SERVICING)
+    finally:
+        sup.stop()
+        t.join()
+        sup.shutdown()
+
+
+def _seed_warm_generation(st, name, pname, key="w0"):
+    """Generation 0, in-process, BEFORE any fault env lands: serve
+    the hot prompt once with persistence on; retirement demotes the
+    warm set and force-checkpoints it, seeding the snapshot the
+    supervised child attaches from.  Returns the greedy bytes."""
+    comp = Completer(st, model=_drill_model(), max_new_tokens=8,
+                     flush_tokens=4, template="none", batch_cap=4,
+                     page_size=PAGE, kv_tier_pages=32,
+                     kv_tier_persist=pname)
+    comp.attach()
+    _submit(st, key, HOT_PROMPT)
+    th = threading.Thread(
+        target=comp.run_continuous,
+        kwargs=dict(idle_timeout_ms=20, stop_after=60.0),
+        daemon=True)
+    th.start()
+    assert _await_ready(st, [key])
+    comp.stop()
+    th.join(timeout=15)
+    assert comp._tier_store is not None
+    assert comp._tier_store.epoch >= 1   # the retire checkpoint
+    comp._tier_store.close()
+    return st.get(key).rstrip(b"\0")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervised_mid_spill_crash_strands_nothing(tmp_path,
+                                                    monkeypatch):
+    """The tier.spill fault site: the lane dies taking its FIRST
+    write-through shadow copy — request claimed, page bytes about to
+    leave HBM.  The HBM copy was still authoritative (the fault
+    fires before the export), so the restarted lane (fault stripped)
+    serves everything cold and re-spills cleanly — zero admitted
+    loss."""
+    name, st = _mkstore(tmp_path, "tier-chaos-spill", nslots=256)
+    pname = f"{name}-kvtier"
+    TierPersist.unlink(pname)
+    monkeypatch.setenv("SPTPU_FAULT", "tier.spill:crash@1")
+    monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+    try:
+        _submit(st, "c1", HOT_PROMPT)
+        _submit(st, "c2", HOT_PROMPT)
+        _run_drill(st, name, ["c1", "c2"])
+    finally:
+        st.close()
+        Store.unlink(name)
+        TierPersist.unlink(pname)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervised_mid_readmit_crash_strands_nothing(tmp_path,
+                                                      monkeypatch):
+    """The tier.readmit fault site: a warm-attached lane dies between
+    a DRAM hit and its device import (fault fires before the page
+    alloc).  The host shadow and the persistent snapshot are both
+    untouched, so the respawn attaches warm from the SAME snapshot,
+    readmits cleanly, and the served bytes match the pre-crash
+    generation's — zero admitted loss, no re-prefill."""
+    name, st = _mkstore(tmp_path, "tier-chaos-readmit", nslots=256)
+    pname = f"{name}-kvtier"
+    TierPersist.unlink(pname)
+    try:
+        warm_out = _seed_warm_generation(st, name, pname)
+        monkeypatch.setenv("SPTPU_FAULT", "tier.readmit:crash@1")
+        monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+        _submit(st, "c1", HOT_PROMPT)
+        _run_drill(st, name, ["c1"])
+        assert st.get("c1").rstrip(b"\0") == warm_out
+    finally:
+        st.close()
+        Store.unlink(name)
+        TierPersist.unlink(pname)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervised_mid_restore_crash_attaches_warm(tmp_path,
+                                                    monkeypatch):
+    """The tier.restore fault site: the lane dies INSIDE the warm
+    attach — snapshot fully validated, adoption about to start.
+    Nothing was mutated yet (validate-everything-first), so the
+    supervised respawn (fault stripped) attaches warm from the SAME
+    untouched snapshot and serves via readmission — zero admitted
+    loss across a crash in the restore path itself."""
+    name, st = _mkstore(tmp_path, "tier-chaos-restore", nslots=256)
+    pname = f"{name}-kvtier"
+    TierPersist.unlink(pname)
+    try:
+        warm_out = _seed_warm_generation(st, name, pname)
+        monkeypatch.setenv("SPTPU_FAULT", "tier.restore:crash@1")
+        monkeypatch.setenv("SPTPU_CHAOS_RUN_S", "600")
+        _submit(st, "r1", HOT_PROMPT)
+        _run_drill(st, name, ["r1"])
+        assert st.get("r1").rstrip(b"\0") == warm_out
+    finally:
+        st.close()
+        Store.unlink(name)
+        TierPersist.unlink(pname)

@@ -215,8 +215,8 @@ warm-check: native
 	JAX_PLATFORMS=cpu $(PY) scripts/warm_restart_check.py
 
 quant-check: native
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py -q \
-		-m "not slow"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quant_kv.py \
+		tests/test_quant_int4.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) scripts/quant_pool_bytes_check.py
 
 prefix-check: native

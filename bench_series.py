@@ -1502,15 +1502,16 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
         times.append((time.perf_counter() - t0) * 1000)
     prefill_ms = float(np.median(times))
 
-    def tokens_per_sec(ch: int, n: int) -> float:
-        model.reset()
-        model.prefill(prompt)
-        n = min(n, cfg.max_len - model.pos - ch - 1)
+    def tokens_per_sec(ch: int, n: int, m=None) -> float:
+        m = model if m is None else m
+        m.reset()
+        m.prefill(prompt)
+        n = min(n, cfg.max_len - m.pos - ch - 1)
         t0 = time.perf_counter()
         got = 0
         tok = 1
         while got < n:
-            toks = model.decode_chunk(tok, ch)
+            toks = m.decode_chunk(tok, ch)
             tok = int(toks[-1])
             got += ch
         return got / (time.perf_counter() - t0)
@@ -1555,6 +1556,8 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
     paged_skipped: list[int] = []
     paged_int8_tps: dict[str, float] = {}
     paged_int8_skipped: list[int] = []
+    paged_int4_tps: dict[str, float] = {}
+    paged_int4_skipped: list[int] = []
     paged_page = 128
     paged_pool = 8 * (-(-cfg.max_len // paged_page))
     # the SAME byte envelope holds itemsize-times the pages when the
@@ -1562,6 +1565,9 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
     # page headroom IS the quantized lane's batch-width claim
     native_bytes = np.dtype(cfg.dtype).itemsize
     paged_pool_int8 = paged_pool * native_bytes
+    # int4 packs two codes per byte: 2x int8's pages, 4x bf16's —
+    # batch 256 inside the envelope that holds bf16 batch 64 (PR 20)
+    paged_pool_int4 = paged_pool * native_bytes * 2
 
     def paged_row_budget(bsz: int, pool: int) -> int:
         """Decode tokens each row can take inside the FIXED pool.
@@ -1643,6 +1649,19 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
                         paged_int8_tps, paged_int8_skipped,
                         "paged_int8")
 
+        # int4 arm (PR 20): the SAME byte envelope once more, packed
+        # two codes per byte — the widths only the quarter-byte pool
+        # affords (bf16 batch 64's bytes hold int4 batch 256).  Env:
+        # DECODE_PAGED_INT4_SWEEP.
+        int4_default = "64" if os.environ.get("BENCH_CPU") == "1" \
+            else "64,128,256"
+        int4_sweep = [int(x) for x in os.environ.get(
+            "DECODE_PAGED_INT4_SWEEP", int4_default).split(",") if x]
+        if room("paged_int4", 120):
+            paged_sweep(int4_sweep, paged_pool_int4, "int4",
+                        paged_int4_tps, paged_int4_skipped,
+                        "paged_int4")
+
     tps_spec = accept = None
     draft_layers = 0
     if os.environ.get("DECODE_SPEC", "1") == "1" \
@@ -1670,6 +1689,29 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
             f"layers={draft_layers}/{cfg.layers}, gamma={gamma}, "
             f"acceptance={accept:.2f}; r05 before-row: 6.0 tok/s at "
             f"0.05 with the random tiny draft)")
+
+    # weights_int8 arm (PR 20): the SAME geometry with every
+    # attention/MLP kernel held per-output-channel int8
+    # (ChannelQuantDense — matmul on int8-resident weights, dequant
+    # on the f32 MXU output).  Weight reads at half bf16 bandwidth
+    # make the decode path's claim >=1.3x dense where it is
+    # weight-bandwidth bound; off-TPU this row is a MECHANICAL smoke
+    # (the graph runs, the ratio is ledgered), the TPU row is
+    # BENCH_r06 debt.  Skipped in the Q8_0 phase: the residencies
+    # are mutually exclusive.  Env: DECODE_WEIGHTS_INT8=0 skips.
+    wq_tps = None
+    if not quant and os.environ.get("DECODE_WEIGHTS_INT8", "1") == "1" \
+            and room("weights_int8", 180):
+        import dataclasses as _dc
+
+        from libsplinter_tpu.models import CompletionModel
+        log("weights_int8: warmup compile ...")
+        wq_model = CompletionModel(_dc.replace(cfg, weights_int8=True))
+        wq_model.warmup(chunk=chunk)
+        tokens_per_sec(chunk, chunk * 2, wq_model)
+        wq_tps = tokens_per_sec(chunk, n_tokens, wq_model)
+        log(f"weights_int8 decode: {wq_tps:,.1f} tok/s (chunk={chunk},"
+            f" {wq_tps / tps_chunked:.2f}x dense same-run)")
 
     return ctx.record({
         "metric": "decode_tokens_per_sec",
@@ -1724,6 +1766,38 @@ def _decode_core(ctx: SeriesCtx, quant: bool) -> dict:
                           / max(map(int, paged_tps)), 2)
                     if paged_int8_tps and paged_tps else None),
             },
+            # int4 arm (PR 20): SAME byte envelope at two codes per
+            # byte — 2x int8's pages, 4x native bf16's.  The headline
+            # row is batch 256 inside bf16 batch 64's bytes.
+            "kv_cache_paged_int4": {
+                "page": paged_page, "pool_pages": paged_pool_int4,
+                "envelope_bytes_vs_native": "equal",
+                "tokens_per_sec_by_batch": paged_int4_tps,
+                "skipped_batches": paged_int4_skipped,
+                "r05_dense_batch8_tokens_per_sec": 612.3,
+                "vs_dense_batch8": (
+                    round(max(paged_int4_tps.values()) / tps_b8, 3)
+                    if paged_int4_tps and tps_b8 > 0 else None),
+                # the 4x-batch-width-inside-the-envelope claim
+                "max_batch_vs_native": (
+                    round(max(map(int, paged_int4_tps))
+                          / max(map(int, paged_tps)), 2)
+                    if paged_int4_tps and paged_tps else None),
+            },
+            # weights_int8 arm (PR 20): per-output-channel int8
+            # weight residency, dequant on the MXU f32 output.  The
+            # acceptance bar (>=1.3x dense) is a WEIGHT-BANDWIDTH
+            # claim — off-TPU the ratio is ledgered as a mechanical
+            # smoke and the TPU row is explicit BENCH_r06 debt.
+            "weights_int8": ({
+                "tokens_per_sec": round(wq_tps, 1),
+                "vs_dense_same_run": (round(wq_tps / tps_chunked, 3)
+                                      if tps_chunked > 0 else None),
+                "target": ">=1.3x dense bf16 (TPU, weight-bandwidth "
+                          "bound)",
+                "tpu_row": "BENCH_r06 debt — this run is a CPU/"
+                           "mechanical smoke unless backend is tpu",
+            } if wq_tps is not None else None),
             "tokens_per_sec_speculative": (round(tps_spec, 1)
                                            if tps_spec else None),
             "speculative_acceptance": (round(accept, 3)

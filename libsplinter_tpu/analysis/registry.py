@@ -84,6 +84,15 @@ FAULT_SITE_DOCS: dict[str, str] = {
         "poisoned pages (`tests/chaos_child.py completer_quant`; "
         "`tests/test_crash_recovery.py::"
         "test_supervise_restores_quantized_commit_crash`)",
+    "completer.weight_quant":
+        "the daemon's per-output-channel weight-quantization step "
+        "(`--weights-int8` / `--weights int8`): fires at boot, "
+        "right before the checkpoint is converted to int8-resident "
+        "kernels (models/quant.py quantize_decoder_params "
+        "mode=\"channel\") — BEFORE any program compiles, so a "
+        "`crash` proves the supervisor restart rebuilds the "
+        "quantized tree from the float checkpoint with nothing "
+        "half-converted (`tests/test_quant_int4.py`)",
     "completer.prefix_map":
         "a prefix-cache HIT's table mapping only (continuous lane, "
         "after the claim, before map_shared bumps any refcount): a "

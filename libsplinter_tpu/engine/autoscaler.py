@@ -70,6 +70,14 @@ DEFAULT_COOLDOWN_S = 6.0
 # at 80% and the lane is only surrendered once sustained below 30%
 POOL_UP_THRESHOLD = 0.80
 POOL_DOWN_THRESHOLD = 0.30
+# PR 20: occupancy attributable to pages the KV tier READMITTED in
+# the last sampler interval is discounted from the pool signal — a
+# warm restart or a prefix-hot burst readmits whole chains in one
+# tick, and those pages are restored capital (droppable again at
+# zero recompute cost), not live demand.  The cap bounds how much a
+# pathological ring can suppress genuine saturation: the hysteresis
+# band itself (streaks, thresholds, cooldown) is untouched.
+READMIT_DISCOUNT_CAP = 0.5
 
 
 @dataclasses.dataclass
@@ -96,6 +104,10 @@ class _LaneCtl:
     last_sample_ts: float | None = None
     target: int | None = None    # last target this controller wrote
     pressure: float = 0.0
+    # the pool-signal discount applied this tick (0.0 on queue lanes
+    # and quiet tiers) — published so `spt scale status` can show WHY
+    # a readmit burst did not vote scale-up
+    readmit_discount: float = 0.0
     reason: str = "init"
 
 
@@ -198,6 +210,39 @@ class AutoScaler:
         if not isinstance(p, list) or len(p) != 2:
             return None
         return float(p[0]), float(p[1])
+
+    @staticmethod
+    def _readmit_discount(rec: dict | None) -> float:
+        """The occupancy fraction attributable to pages the KV tier
+        readmitted between the last two sampler ticks: the newest
+        step of the `tier_readmits` counter ring, rated against the
+        pool size from the same rings (pages_used + pages_free).
+        Returns 0.0 whenever any input is missing or stale — the
+        discount is an optimization on the pool signal, never a
+        reason to skip a decision."""
+        if rec is None:
+            return 0.0
+        gauges = rec.get("gauges") or {}
+
+        def pt(g, i):
+            ring = gauges.get(g)
+            if not isinstance(ring, list) or len(ring) < -i:
+                return None
+            p = ring[i]
+            if not isinstance(p, list) or len(p) != 2:
+                return None
+            try:
+                return float(p[1])
+            except (TypeError, ValueError):
+                return None
+
+        prev, last = pt("tier_readmits", -2), pt("tier_readmits", -1)
+        if prev is None or last is None or last <= prev:
+            return 0.0
+        used, free = pt("pages_used", -1), pt("pages_free", -1)
+        if used is None or free is None or used + free <= 0:
+            return 0.0
+        return min(READMIT_DISCOUNT_CAP, (last - prev) / (used + free))
 
     # -- the decision ------------------------------------------------------
 
@@ -315,10 +360,19 @@ class AutoScaler:
             q = self._ring_last(rec, gauge)
             shed = self._ring_last(rec, "shed")
             live_r = self._live_r(lane)
+            occ = q[1] if q else None
+            discount = 0.0
+            if occ is not None and signal == "pool":
+                # readmitted pages are restored capital, not demand:
+                # discount this tick's readmissions out of the pool
+                # signal BEFORE the (unchanged) hysteresis sees it
+                discount = self._readmit_discount(rec)
+                occ = max(0.0, occ - discount)
             target = self.decide_lane(
-                lane, bounds, q[1] if q else None,
+                lane, bounds, occ,
                 shed[1] if shed else None, live_r, now_mono,
                 sample_ts=q[0] if q else None, signal=signal)
+            self.lanes[lane].readmit_discount = round(discount, 3)
             ctl = self.lanes[lane]
             if target is None:
                 # bounds still apply with no action: a policy floor
@@ -359,6 +413,7 @@ class AutoScaler:
                        ln: {"target": ctl.target,
                             "pressure": ctl.pressure,
                             "signal": self.signals.get(ln, "queue"),
+                            "readmit_discount": ctl.readmit_discount,
                             "reason": ctl.reason,
                             "up_streak": ctl.up_streak,
                             "down_streak": ctl.down_streak}

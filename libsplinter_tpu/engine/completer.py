@@ -205,10 +205,13 @@ class Completer:
         # continuous lane's KV pool (per-page scales, dequant inside
         # the ragged kernel) so cache bytes per token halve vs bf16 —
         # the headroom --batch-cap/--pool-pages then spend on batch
-        # width.  None keeps the model's native dtype.
-        if kv_dtype not in (None, "bf16", "f32", "int8"):
+        # width.  "int4" packs two 4-bit codes per byte on top of the
+        # same scale discipline — a QUARTER of bf16's cache bytes, so
+        # the same pool serves 4x the batch.  None keeps the model's
+        # native dtype.
+        if kv_dtype not in (None, "bf16", "f32", "int8", "int4"):
             raise ValueError(
-                f"unknown kv_dtype {kv_dtype!r} (bf16 | f32 | int8)")
+                f"unknown kv_dtype {kv_dtype!r} (bf16 | f32 | int8 | int4)")
         self.kv_dtype = kv_dtype
         # K-deep decode overlap on the continuous lane: the chunk
         # pipeline runs K deep — dispatch chunk K, then collect the
@@ -1079,12 +1082,15 @@ class Completer:
         join_backpressure counts the deferral — backpressure, never a
         mid-decode strand.  Sharded models serve this lane too (PR 8:
         kv-head-sharded pools + shard_map'd ragged kernel,
-        parallel/serve.py), as do quantized pools (--kv-dtype int8:
-        per-page scales, dequant in-kernel) and speculative models
-        (PR 9: the wrapper implements the paged surface — drafts
-        verify through the paged kernel's multi-query stack; a
-        tripped acceptance floor swaps in the target at the next
-        idle point).  Models whose module cannot thread a mesh
+        parallel/serve.py), as do quantized pools (--kv-dtype int8
+        with per-page scales and dequant in-kernel; int4 packs two
+        codes per byte on the same discipline) and speculative
+        models (PR 9: the wrapper implements the paged surface —
+        drafts verify through the paged kernel's multi-query stack;
+        a tripped acceptance floor swaps in the target at the next
+        idle point; the lockstep target/draft pools shard on kv
+        heads like everything else, so spec-paged composes with
+        --tp).  Models whose module cannot thread a mesh
         (paged_supported False) and window-only bucket geometries
         fall back to run()."""
         if not self._paged_ok():
@@ -2177,7 +2183,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--weights",
                     help="decoder checkpoint: .safetensors (HF llama "
                          "naming) or .gguf (llama.cpp naming; geometry "
-                         "and tokenizer come from the GGUF metadata)")
+                         "and tokenizer come from the GGUF metadata).  "
+                         "The literal value 'int8' is a sentinel: no "
+                         "checkpoint, seeded-random weights held "
+                         "per-output-channel int8 (shorthand for "
+                         "--weights-int8 with no path)")
     ap.add_argument("--n-ctx", type=int, default=None,
                     help="context window / KV-cache length override "
                          "(default: the checkpoint's trained window, or "
@@ -2214,7 +2224,7 @@ def main(argv: list[str] | None = None) -> int:
                          "spend cache HBM on batch width instead of "
                          "padding; admission backpressures when the "
                          "pool is full)")
-    ap.add_argument("--kv-dtype", choices=("bf16", "f32", "int8"),
+    ap.add_argument("--kv-dtype", choices=("bf16", "f32", "int8", "int4"),
                     default=None,
                     help="paged KV pool storage dtype (continuous "
                          "serving; default: the model's native "
@@ -2225,7 +2235,11 @@ def main(argv: list[str] | None = None) -> int:
                          "attention kernel dequantizes in register, "
                          "and the freed bytes buy batch width "
                          "(--batch-cap) inside the same --pool-pages "
-                         "envelope")
+                         "envelope.  int4 packs two 4-bit codes per "
+                         "byte under the same scale discipline — a "
+                         "QUARTER of bf16's cache bytes, 4x the "
+                         "batch in the same envelope, at a coarser "
+                         "(documented) greedy-agreement tolerance")
     ap.add_argument("--inflight-depth", type=int, default=None,
                     help="continuous lane: paged decode chunk "
                          "pipeline depth — dispatch chunk K, collect "
@@ -2243,7 +2257,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--quantized", action="store_true",
                     help="int8 weight residency: keep attention/MLP "
                          "kernels in HBM as Q8_0-geometry int8 + "
-                         "per-block scales (models/quant.py)")
+                         "per-block scales (models/quant.py; "
+                         "dequantizes before the matmul)")
+    ap.add_argument("--weights-int8", action="store_true",
+                    help="PER-OUTPUT-CHANNEL int8 weight residency "
+                         "(models/quant.py ChannelQuantDense): the "
+                         "matmul runs on int8-resident kernels with "
+                         "f32 accumulation and dequantizes on the MXU "
+                         "OUTPUT — one multiply per output column, no "
+                         "per-block float weight rebuild between HBM "
+                         "and the MXU.  Mutually exclusive with "
+                         "--quantized; '--weights int8' is shorthand "
+                         "for this with seeded-random weights")
     ap.add_argument("--warmup", action="store_true",
                     help="pre-compile prefill buckets + decode "
                          "programs before serving (first requests "
@@ -2345,6 +2370,12 @@ def main(argv: list[str] | None = None) -> int:
     from ..models import CompletionModel, DecoderConfig
     tokenizer = None
     template = args.template
+    if args.weights == "int8":
+        # `--weights int8` sentinel: no checkpoint file — run the
+        # seeded-random decoder with per-output-channel int8 weight
+        # residency (the bench/docs spelling of --weights-int8)
+        args.weights = None
+        args.weights_int8 = True
     if args.weights and args.weights.endswith(".gguf"):
         from ..models.gguf import (GgufFile, decoder_config_from_gguf,
                                    load_tokenizer)
@@ -2372,8 +2403,20 @@ def main(argv: list[str] | None = None) -> int:
         # system\n\nprompt concatenation
         template = "none"
         log.info("--template auto with no GGUF metadata: using 'none'")
+    if args.quantized and args.weights_int8:
+        raise SystemExit(
+            "--quantized and --weights-int8 are mutually exclusive: "
+            "both claim the attention/MLP kernels (Q8_0 blocks vs "
+            "per-output-channel) — pick one weight residency")
     if args.quantized:
         cfg = dataclasses.replace(cfg, quantized=True)
+    if args.weights_int8:
+        # chaos site: the channel-quantization pass over the loaded
+        # checkpoint (CompletionModel.__init__ ->
+        # quantize_decoder_params(mode="channel")) — inject here so
+        # the supervisor sees the crash BEFORE any program compiles
+        fault("completer.weight_quant")
+        cfg = dataclasses.replace(cfg, weights_int8=True)
     mesh = None
     if args.tp > 1 or args.ep > 1:
         from ..parallel.mesh import make_mesh

@@ -53,6 +53,13 @@ class DecoderConfig:
     # — half bf16's weight bandwidth on the decode path.  Embeddings,
     # norms, and the LM head stay float.
     quantized: bool = False
+    # per-OUTPUT-CHANNEL int8 weight residency (models/quant.py
+    # ChannelQuantDense): the projection matmul runs on the MXU with
+    # int8 weights widened in register and dequantizes ON THE f32
+    # OUTPUT — one f32 scale per output column — instead of the Q8_0
+    # block path's dequant-before-matmul.  Mutually exclusive with
+    # `quantized` (one residency per tree).
+    weights_int8: bool = False
     # prefill chunks at/above this width attend through the causal
     # Pallas kernel (ops/flash_attention.causal_flash_attention): long
     # prompts stop materializing (B, H, S, T) logits in HBM.  0 = off.
@@ -95,16 +102,20 @@ def _tp_of(sharding) -> int:
 
 # the paged pool's storage dtypes: "int8" stores values as int8 with
 # one f32 scale per (page block, kv head) — (n_blocks, KH) — alongside
-# each pool; anything else is the dense float layout.  The scale
-# arrays stay separate from the values (not interleaved) so an
-# int4-PACKED value pool later changes only the value buffer + the
-# dequant, never the scale plumbing.
-KV_DTYPES = ("bf16", "f32", "int8")
+# each pool; "int4" PACKS two 4-bit codes per uint8 byte (the pool's
+# last axis is head_dim/2 — split-half nibble layout, see
+# ops/paged_attention.pack_int4) under the SAME per-(page, kv-head)
+# scale plumbing; anything else is the dense float layout.  The scale
+# arrays stay separate from the values (not interleaved), which is
+# exactly why int4 packing was a value-layout change only.
+KV_DTYPES = ("bf16", "f32", "int8", "int4")
 
 
 def _kv_storage(cfg: DecoderConfig, kv_dtype: str | None):
     """(label, value dtype, quantized?) for a pool's storage.  None
-    keeps the model's native activation dtype (the status quo)."""
+    keeps the model's native activation dtype (the status quo).
+    uint8 storage == int4-PACKED (two codes per byte): every consumer
+    (kernel, appends, commit, wire) keys packing off the dtype."""
     if kv_dtype is None:
         label = ("bf16" if cfg.dtype == jnp.bfloat16 else
                  "f32" if cfg.dtype == jnp.float32 else
@@ -112,6 +123,8 @@ def _kv_storage(cfg: DecoderConfig, kv_dtype: str | None):
         return label, cfg.dtype, False
     if kv_dtype == "int8":
         return "int8", jnp.int8, True
+    if kv_dtype == "int4":
+        return "int4", jnp.uint8, True
     if kv_dtype == "bf16":
         return "bf16", jnp.bfloat16, False
     if kv_dtype == "f32":
@@ -147,7 +160,14 @@ def _quant_append(pool, scales, bids, offs, x):
     Offset 0 is exactly the first write of every (re)used page, and
     any existing entries of a page being rewritten at offset 0 are
     stale by construction (they sit at positions >= the writing row's
-    length), so discarding their scale is always safe."""
+    length), so discarding their scale is always safe.
+
+    A uint8 pool is int4-PACKED (last axis D/2): the same rescale
+    discipline runs over UNPACKED codes at qmax 7 and repacks —
+    dispatch is dtype-driven so every append call site stays
+    layout-blind."""
+    if pool.dtype == jnp.uint8:
+        return _quant_append_int4(pool, scales, bids, offs, x)
     s_old = jnp.where(offs[:, None] == 0, 0.0,
                       scales[bids])                    # (B, KH)
     xf = x.astype(jnp.float32)
@@ -161,6 +181,38 @@ def _quant_append(pool, scales, bids, offs, x):
             == offs[:, None, None, None])
     pages = jnp.where(slot, qtok[:, :, None, :], pages)
     pool = pool.at[bids].set(pages.astype(jnp.int8))
+    scales = scales.at[bids].set(s_new)
+    return pool, scales
+
+
+def _quant_append_int4(pool, scales, bids, offs, x):
+    """int4-packed rescale-on-append: identical contract to the int8
+    body above (monotone per-page scales, offset-0 fresh reset, trash
+    routing) at 4-bit geometry — unpack the touched page's codes,
+    re-round at the grown scale, write the token's q4 codes into its
+    slot, repack.  Garbage nibbles on never-written tail slots unpack
+    to code -8; the rescale ratio <= 1 keeps them in [-8, 7] and the
+    ragged length mask excludes them from every read, so they never
+    need a clip.
+
+    pool: (n_blocks, KH, page, D/2) uint8; scales: (n_blocks, KH) f32;
+    x: (B, KH, D)."""
+    from ..ops.paged_attention import INT4_QMAX, pack_int4, unpack_int4
+    s_old = jnp.where(offs[:, None] == 0, 0.0,
+                      scales[bids])                    # (B, KH)
+    xf = x.astype(jnp.float32)
+    s_tok = jnp.max(jnp.abs(xf), axis=-1) / INT4_QMAX
+    s_new = jnp.maximum(s_old, s_tok)
+    safe = jnp.where(s_new > 0, s_new, 1.0)
+    pages = unpack_int4(pool[bids])                    # (B, KH, pg, D)
+    pages = jnp.round(pages * (s_old / safe)[:, :, None, None])
+    qtok = jnp.clip(jnp.round(xf / safe[:, :, None]),
+                    -INT4_QMAX, INT4_QMAX)
+    slot = (jnp.arange(pool.shape[2])[None, None, :, None]
+            == offs[:, None, None, None])
+    pages = jnp.where(slot, qtok[:, :, None, :], pages)
+    pool = pool.at[bids].set(
+        pack_int4(jnp.clip(pages, -8, 7).astype(jnp.int32)))
     scales = scales.at[bids].set(s_new)
     return pool, scales
 
@@ -222,9 +274,18 @@ class PagedKVCache:
     batch width inside the same pool-byte envelope.  The commit
     scatter quantizes whole pages (paged_prefill_row) and decode
     appends rescale-on-append (_quant_append); the ragged kernel
-    dequantizes in register (ops/paged_attention.py).  The scale
-    arrays are separate buffers so an int4-packed value pool later is
-    a value-layout change only.
+    dequantizes in register (ops/paged_attention.py).
+
+    `kv_dtype="int4"` PACKS two 4-bit codes per byte on top of the
+    same scale plumbing (the value pools become
+    (n_blocks, KH, page, head_dim/2) uint8, split-half nibble layout
+    — ops/paged_attention.pack_int4): cache HBM per token drops to
+    1/4 of bf16 (1/8 of f32), so the same pool-byte envelope holds
+    4x bf16's batch width.  Commit packs whole pages, appends
+    unpack/rescale/repack, and the ragged kernel unpacks nibbles
+    in-register inside its page loop.  The scale arrays are separate
+    buffers, which is exactly why packing changed only the value
+    layout.
 
     `sharding` (a NamedSharding, normally P(None, "tp", None, None)
     from ShardedCompletionModel) places the pools sharded on their
@@ -268,7 +329,6 @@ class PagedKVCache:
                 f"pool_pages {pool_pages} cannot hold even one full "
                 f"window ({self.pages_per_row} pages)")
         self.n_blocks = pool_pages + 1               # + the trash block
-        shape = (self.n_blocks, cfg.kv_heads, page, cfg.head_dim)
         if sharding is not None and cfg.kv_heads % _tp_of(sharding):
             raise ValueError(
                 f"the sharding's tp={_tp_of(sharding)} axis must "
@@ -277,6 +337,17 @@ class PagedKVCache:
         self.sharding = sharding
         self.kv_dtype, store_dtype, self.quantized = \
             _kv_storage(cfg, kv_dtype)
+        # int4-PACKED pools store two codes per byte: the value
+        # buffer's last axis is head_dim/2 uint8 (split-half nibble
+        # layout) — tables, lengths, scales, and the whole host-side
+        # allocator are identical to int8's
+        self.packed = store_dtype == jnp.uint8
+        if self.packed and cfg.head_dim % 2:
+            raise ValueError(
+                f"kv_dtype=\"int4\" packs two codes per byte along "
+                f"head_dim; head_dim={cfg.head_dim} must be even")
+        shape = (self.n_blocks, cfg.kv_heads, page,
+                 cfg.head_dim // 2 if self.packed else cfg.head_dim)
         # distinct buffers per layer/side: the paged programs donate
         # the pools, and XLA rejects donating one buffer twice
         zeros = _pool_zeros(shape, store_dtype, sharding)
@@ -406,7 +477,11 @@ class PagedKVCache:
 
     def kv_bytes_per_token(self) -> int:
         """KV bytes one token occupies across every layer (k + v) —
-        the factor behind the prefix cache's bytes_saved gauge."""
+        the factor behind the prefix cache's bytes_saved gauge.
+        int4-packed pools store half a byte per value."""
+        if self.packed:
+            return (self.cfg.layers * 2 * self.cfg.kv_heads
+                    * (self.cfg.head_dim // 2))
         itemsize = np.dtype(
             "int8" if self.quantized else
             "float32" if self.kv_dtype == "f32" else "uint16").itemsize
@@ -524,8 +599,12 @@ class RMSNorm(nn.Module):
 
 
 def _proj(cfg: DecoderConfig, features: int, name: str):
-    """The decoder's projection layer: nn.Dense, or QuantDense when
-    the config asks for int8 weight residency."""
+    """The decoder's projection layer: nn.Dense, QuantDense for the
+    Q8_0 block residency, or ChannelQuantDense for the per-output-
+    channel MXU path (--weights int8)."""
+    if getattr(cfg, "weights_int8", False):
+        from .quant import ChannelQuantDense
+        return ChannelQuantDense(features, dtype=cfg.dtype, name=name)
     if cfg.quantized:
         from .quant import QuantDense
         return QuantDense(features, dtype=cfg.dtype, name=name)
@@ -846,11 +925,21 @@ class CompletionModel:
                 params = load_decoder_params(weights, cfg)
             else:
                 params = load_safetensors_params(weights, cfg)
-        if params is not None and cfg.quantized:
+        if cfg.quantized and getattr(cfg, "weights_int8", False):
+            raise ValueError(
+                "quantized (Q8_0 blocks) and weights_int8 (per-channel"
+                " MXU) are two residencies for the same projections — "
+                "pick one")
+        if params is not None and (cfg.quantized
+                                   or getattr(cfg, "weights_int8",
+                                              False)):
             # float checkpoints re-quantize into the int8-resident
             # layout (idempotent: already-quantized trees pass through)
             from .quant import quantize_decoder_params
-            params = quantize_decoder_params(params)
+            params = quantize_decoder_params(
+                params,
+                mode="channel" if getattr(cfg, "weights_int8", False)
+                else "block")
         if params is None:
             cache = init_cache(cfg, 1)
             params = self.module.init(
@@ -1266,7 +1355,8 @@ class CompletionModel:
                             scale_sharding=self._pool_scale_sharding())
 
     def _paged_commit_program(self, bucket: int, page: int,
-                              quantized: bool = False):
+                              quantized: bool = False,
+                              packed: bool = False):
         """One program scattering a (1, bucket) dense prefill cache
         into pool pages at the given block ids (page-granular; the
         tail of the last page holds garbage the length mask hides
@@ -1277,12 +1367,15 @@ class CompletionModel:
         K/V would otherwise inflate the page scale for nothing), then
         each (page, kv head) gets a symmetric scale d = absmax/127
         and int8 values — the same Q8_0-style geometry as the weight
-        residency (models/quant.py), at page granularity."""
-        key = ("commit", bucket, page, quantized)
+        residency (models/quant.py), at page granularity.  PACKED
+        additionally quantizes at qmax 7 and packs whole pages two
+        codes per byte (ops/paged_attention.pack_int4)."""
+        key = ("commit", bucket, page, quantized, packed)
         fn = self._paged_progs.get(key)
         if fn is None:
             n_cp = -(-bucket // page)
             pad = n_cp * page - bucket
+            qmax = 7.0 if packed else 127.0
 
             def blocks(x, nvalid=None):
                 x = x[0]                           # (bucket, KH, D)
@@ -1299,12 +1392,15 @@ class CompletionModel:
                         bids, nvalid):
                     def q8(x):
                         xb = blocks(x, nvalid).astype(jnp.float32)
-                        d = jnp.max(jnp.abs(xb), axis=(2, 3)) / 127.0
+                        d = jnp.max(jnp.abs(xb), axis=(2, 3)) / qmax
                         q = jnp.round(
                             xb / jnp.where(d > 0, d, 1.0)[:, :, None,
                                                           None])
-                        return (jnp.clip(q, -127, 127)
-                                .astype(jnp.int8), d)
+                        q = jnp.clip(q, -qmax, qmax)
+                        if packed:
+                            from ..ops.paged_attention import pack_int4
+                            return pack_int4(q.astype(jnp.int32)), d
+                        return q.astype(jnp.int8), d
 
                     outk, outv, outks, outvs = [], [], [], []
                     for (kd, vd), kp, vp, ks, vs in zip(
@@ -1374,7 +1470,7 @@ class CompletionModel:
         bids = cache.tables[row, :n_cp].copy()
         if cache.quantized:
             kp, vp, ks, vs = self._paged_commit_program(
-                b, cache.page, True)(
+                b, cache.page, True, cache.packed)(
                 cache.k_pools, cache.v_pools, cache.k_scales,
                 cache.v_scales, dense, jnp.asarray(bids),
                 jnp.int32(P))
@@ -1655,15 +1751,30 @@ class CompletionModel:
         return fn
 
     def _page_wire_dtype(self, cache: PagedKVCache):
-        return np.dtype("int8") if cache.quantized \
-            else np.dtype(cache.k_pools[0].dtype)
+        """Wire pages carry the pool's NATIVE storage dtype — int8,
+        uint8 for int4-packed pools (the packed bytes go over the
+        wire verbatim, halving handoff and tier-shadow bytes), or the
+        float dtype."""
+        if not cache.quantized:
+            return np.dtype(cache.k_pools[0].dtype)
+        return np.dtype("uint8") if cache.packed else np.dtype("int8")
+
+    def _page_wire_shape(self, cache: PagedKVCache):
+        """One side's stacked wire-page shape — the pool's own value
+        geometry (last axis head_dim/2 for int4-packed pools), read
+        from the placed buffers so wire and pool can never skew."""
+        return (self.cfg.layers, self.cfg.kv_heads, cache.page,
+                int(cache.k_pools[0].shape[3]))
 
     def page_wire_bytes(self, cache: PagedKVCache) -> int:
         """Bytes one exported page occupies on the wire (k + v values
-        across every layer; int8 scales ride a separate key)."""
-        cfg = self.cfg
-        return (2 * cfg.layers * cfg.kv_heads * cache.page
-                * cfg.head_dim * self._page_wire_dtype(cache).itemsize)
+        across every layer; quantized scales ride a separate key).
+        int4-packed pools halve this — the wire carries the packed
+        bytes."""
+        n = 2 * self._page_wire_dtype(cache).itemsize
+        for d in self._page_wire_shape(cache):
+            n *= d
+        return n
 
     def export_row_pages(self, cache: PagedKVCache, row: int
                          ) -> tuple[list[bytes], list[bytes | None]]:
@@ -1723,7 +1834,7 @@ class CompletionModel:
         cfg = self.cfg
         prog = self._page_import_program(cache.quantized)
         dt = self._page_wire_dtype(cache)
-        shape = (cfg.layers, cfg.kv_heads, cache.page, cfg.head_dim)
+        shape = self._page_wire_shape(cache)
         half = self.page_wire_bytes(cache) // 2
         if len(buf) != 2 * half:
             raise ValueError(
@@ -1775,7 +1886,7 @@ class CompletionModel:
             return False
         prog = self._page_import_program(cache.quantized)
         dt = self._page_wire_dtype(cache)
-        shape = (cfg.layers, cfg.kv_heads, cache.page, cfg.head_dim)
+        shape = self._page_wire_shape(cache)
         half = self.page_wire_bytes(cache) // 2
         for p_idx in range(need):
             buf = pages[p_idx]
@@ -1830,8 +1941,7 @@ class CompletionModel:
                 if adopt:
                     cfg = self.cfg
                     dt = self._page_wire_dtype(cache)
-                    shape = (cfg.layers, cfg.kv_heads, cache.page,
-                             cfg.head_dim)
+                    shape = self._page_wire_shape(cache)
                     z = jnp.zeros(shape, dt)
                     prog = self._page_import_program(cache.quantized)
                     if cache.quantized:

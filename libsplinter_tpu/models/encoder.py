@@ -51,6 +51,12 @@ class EncoderConfig:
     # sequence sharded over this mesh axis and attention runs as ring
     # attention (must be applied inside shard_map with the axis bound).
     ring_axis: str | None = None
+    # per-output-channel int8 weight residency (models/quant.py
+    # ChannelQuantDense — the decoder's weights_int8 path, shared):
+    # attention/MLP kernels live as int8 + one f32 scale per output
+    # column, matmul first, dequant on the f32 output; biases,
+    # embeddings, and norms stay float.
+    weights_int8: bool = False
 
     @classmethod
     def tiny(cls, **kw) -> "EncoderConfig":
@@ -96,6 +102,18 @@ def _apply_rotary(x: jnp.ndarray, cos: jnp.ndarray,
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def _dense(cfg: EncoderConfig, features: int, name: str):
+    """The encoder's projection module: plain Dense, or the shared
+    per-output-channel int8 residency when cfg.weights_int8 (same
+    module NAME either way, so checkpoints convert in place via
+    quant.quantize_encoder_params)."""
+    if cfg.weights_int8:
+        from .quant import ChannelQuantDense
+        return ChannelQuantDense(features, dtype=cfg.dtype,
+                                 use_bias=True, name=name)
+    return nn.Dense(features, dtype=cfg.dtype, name=name)
+
+
 class SelfAttention(nn.Module):
     cfg: EncoderConfig
 
@@ -104,7 +122,7 @@ class SelfAttention(nn.Module):
         cfg = self.cfg
         head_dim = cfg.hidden // cfg.heads
         B, S, _ = x.shape
-        qkv = nn.Dense(3 * cfg.hidden, dtype=cfg.dtype, name="qkv")(x)
+        qkv = _dense(cfg, 3 * cfg.hidden, "qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, cfg.heads, head_dim)
         k = k.reshape(B, S, cfg.heads, head_dim)
@@ -132,7 +150,7 @@ class SelfAttention(nn.Module):
             from ..ops.flash_attention import _mha_jnp
             out = _mha_jnp(q, k, v, mask)
         out = out.reshape(B, S, cfg.hidden)
-        return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="out")(out)
+        return _dense(cfg, cfg.hidden, "out")(out)
 
 
 class Mlp(nn.Module):
@@ -142,13 +160,12 @@ class Mlp(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         if cfg.variant == "nomic":
-            gate = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="gate")(x)
-            up = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="up")(x)
+            gate = _dense(cfg, cfg.mlp_dim, "gate")(x)
+            up = _dense(cfg, cfg.mlp_dim, "up")(x)
             h = nn.silu(gate) * up
         else:
-            h = nn.gelu(
-                nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="up")(x))
-        return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="down")(h)
+            h = nn.gelu(_dense(cfg, cfg.mlp_dim, "up")(x))
+        return _dense(cfg, cfg.hidden, "down")(h)
 
 
 class EncoderLayer(nn.Module):
@@ -325,6 +342,12 @@ class EmbeddingModel:
             dummy = (jnp.zeros((1, self.buckets[0]), jnp.int32),
                      jnp.ones((1, self.buckets[0]), jnp.bool_))
             params = self.module.init(jax.random.PRNGKey(seed), *dummy)
+        elif cfg.weights_int8:
+            # a float tree (checkpoint or caller-supplied) under a
+            # weights_int8 module: convert kernels to {wq, wscale}
+            # in place (idempotent — already-converted trees pass)
+            from .quant import quantize_encoder_params
+            params = quantize_encoder_params(params)
         self.params = params
 
         wire = {None: None, "f16": jnp.float16,

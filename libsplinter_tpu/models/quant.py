@@ -82,6 +82,43 @@ class QuantDense(nn.Module):
         return x.astype(self.dtype) @ w
 
 
+class ChannelQuantDense(nn.Module):
+    """Bias-free Dense with PER-OUTPUT-CHANNEL int8 residency — the
+    MXU-friendly variant: params are wq (in, out) int8 + wscale
+    (out,) f32, the matmul runs FIRST (weights widened in register,
+    f32 accumulation via preferred_element_type) and dequantizes on
+    the f32 OUTPUT, one multiply per output column.  Algebraically
+    exact because the scale is constant along the contraction axis;
+    unlike QuantDense no per-block float weight tensor is ever
+    rebuilt between HBM and the MXU, so the weight read stays pure
+    int8 bandwidth.  quantize_decoder_params(mode="channel") converts
+    a float tree."""
+    features: int
+    dtype: Any
+    # the decoder's projection sites are bias-free; the encoder's
+    # BERT-family Dense layers carry one — kept float (a vector per
+    # layer, noise next to the kernel bytes) and added after dequant
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        din = x.shape[-1]
+        wq = self.param("wq", _q_init, (din, self.features))
+        ws = self.param(
+            "wscale",
+            lambda key, shape: jnp.full(
+                shape, 1.0 / (127.0 * np.sqrt(din)), jnp.float32),
+            (self.features,))
+        y = jnp.dot(x.astype(self.dtype), wq.astype(self.dtype),
+                    preferred_element_type=jnp.float32)
+        y = (y * ws).astype(self.dtype)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+            y = y + b.astype(self.dtype)
+        return y
+
+
 def quantize_kernel(kernel: np.ndarray,
                     block: int = QBLOCK) -> dict[str, np.ndarray]:
     """Float (in, out) kernel -> Q8_0-geometry {q, scale}.
@@ -108,6 +145,25 @@ def dequantize_kernel(qp: dict, block: int = QBLOCK) -> np.ndarray:
     return (q * scale[:, None, :]).reshape(nb * b, dout)
 
 
+def quantize_channel_kernel(kernel: np.ndarray) -> dict[str, np.ndarray]:
+    """Float (in, out) kernel -> per-output-channel {wq, wscale}:
+    d = max|w_column| / 127 over each OUTPUT column (the scale is
+    constant along the contraction axis, which is what lets
+    ChannelQuantDense dequantize after the matmul), q = round(w/d).
+    Max roundoff per element is d/2."""
+    w = np.asarray(kernel, np.float32)
+    d = np.abs(w).max(axis=0) / 127.0            # (out,)
+    d = np.where(d == 0, 1.0, d)                 # all-zero column
+    q = np.clip(np.round(w / d[None, :]), -127, 127).astype(np.int8)
+    return {"wq": q, "wscale": d.astype(np.float32)}
+
+
+def dequantize_channel_kernel(qp: dict) -> np.ndarray:
+    """Inverse of quantize_channel_kernel (exact for its own output)."""
+    return (np.asarray(qp["wq"], np.float32)
+            * np.asarray(qp["wscale"], np.float32)[None, :])
+
+
 def expert_weight(module: nn.Module, name: str, n_experts: int,
                   din: int, dout: int, dtype) -> jnp.ndarray:
     """Stacked expert weight (E, din, dout) for MoeMlp, materialized
@@ -128,13 +184,51 @@ def expert_weight(module: nn.Module, name: str, n_experts: int,
 # dense leaves the decoder quantizes: attention projections + MLP
 QUANT_LEAVES = ("q", "k", "v", "out", "gate", "up", "down")
 
+# dense leaves the ENCODER quantizes (EncoderConfig.weights_int8):
+# the fused qkv projection plus the same out/MLP set
+ENCODER_QUANT_LEAVES = ("qkv", "out", "gate", "up", "down")
 
-def quantize_decoder_params(params, block: int = QBLOCK):
-    """Convert a float Decoder tree (models/decoder.py) to the
-    QuantDense layout: every attention/MLP kernel becomes {q, scale},
-    stacked MoE expert tensors (models/moe.py `*_experts`) become
-    `*_experts_q` + `*_experts_scale`; embeddings, norms, routers, and
-    the LM head stay float."""
+
+def quantize_encoder_params(params):
+    """Convert a float Encoder tree (models/encoder.py) to the
+    per-output-channel layout: every attention/MLP kernel becomes
+    {wq, wscale} (ChannelQuantDense geometry), biases ride along
+    float, embeddings/norms/pooler stay float.  Idempotent like
+    quantize_decoder_params — already-converted modules (no bare
+    kernel) pass through untouched."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if (k in ENCODER_QUANT_LEAVES and isinstance(v, dict)
+                    and "kernel" in v and "wq" not in v):
+                qk = quantize_channel_kernel(np.asarray(v["kernel"]))
+                out[k] = {**qk, **{n: np.asarray(b)
+                                   for n, b in v.items()
+                                   if n != "kernel"}}
+            else:
+                out[k] = walk(v)
+        return out
+
+    p = jax.tree.map(lambda x: np.asarray(x), params["params"])
+    return {"params": jax.tree.map(jnp.asarray, walk(p))}
+
+
+def quantize_decoder_params(params, block: int = QBLOCK,
+                            mode: str = "block"):
+    """Convert a float Decoder tree (models/decoder.py) to a
+    quantized layout: every attention/MLP kernel becomes {q, scale}
+    (mode="block", the Q8_0 QuantDense geometry) or {wq, wscale}
+    (mode="channel", the per-output-channel ChannelQuantDense
+    geometry); stacked MoE expert tensors (models/moe.py `*_experts`)
+    become `*_experts_q` + `*_experts_scale` (always block — they
+    materialize through expert_weight); embeddings, norms, routers,
+    and the LM head stay float.  Idempotent: already-quantized leaves
+    (no bare {kernel}) pass through untouched."""
+    if mode not in ("block", "channel"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
 
     def walk(node):
         if not isinstance(node, dict):
@@ -143,7 +237,10 @@ def quantize_decoder_params(params, block: int = QBLOCK):
         for k, v in node.items():
             if (k in QUANT_LEAVES and isinstance(v, dict)
                     and set(v) == {"kernel"}):
-                out[k] = quantize_kernel(np.asarray(v["kernel"]), block)
+                out[k] = (
+                    quantize_channel_kernel(np.asarray(v["kernel"]))
+                    if mode == "channel" else
+                    quantize_kernel(np.asarray(v["kernel"]), block))
             elif k.endswith("_experts") and not isinstance(v, dict):
                 arr = np.asarray(v)               # (E, din, dout)
                 qs = [quantize_kernel(arr[e], block)

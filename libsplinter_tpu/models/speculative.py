@@ -136,6 +136,21 @@ def self_draft_model(target: CompletionModel,
     sub = {k: p[k] for k in ("tok_emb", "ln_out", "lm_head")}
     for i in range(draft_layers):
         sub[f"layer_{i}"] = p[f"layer_{i}"]
+    mesh = getattr(target, "mesh", None)
+    if mesh is not None:
+        # pod-sharded target -> pod-sharded draft: the truncated view
+        # must allocate ITS pools and programs under the same mesh so
+        # the fused spec step's out_shardings cover both halves.
+        # shard_decoder_params re-places the aliased subtree, but the
+        # arrays are already laid out per decoder_param_pspec (the
+        # layer_i names are identical), so the device_put is a no-op
+        # alias, not a copy.
+        from ..parallel.serve import ShardedCompletionModel
+        return ShardedCompletionModel(
+            dcfg, mesh=mesh, params={"params": sub},
+            buckets=target.buckets, top_p=target.top_p,
+            temp=target.temp, module=Decoder(dcfg, mesh=mesh),
+            kv_dtype=target.kv_dtype)
     return CompletionModel(
         dcfg, params={"params": sub}, buckets=target.buckets,
         top_p=target.top_p, temp=target.temp,
@@ -208,8 +223,19 @@ class SpecPagedCache:
         return self.target.quantized
 
     @property
+    def packed(self) -> bool:
+        return self.target.packed
+
+    @property
     def kv_dtype(self) -> str:
         return self.target.kv_dtype
+
+    @property
+    def sharding(self):
+        """The target pool's placement (None unsharded) — the paired
+        pools shard identically (both halves' init_paged thread their
+        model's _pool_sharding), so one handle represents both."""
+        return self.target.sharding
 
     @property
     def k_pools(self):                 # obs surface (shard gauges)
@@ -295,13 +321,14 @@ class SpeculativeCompletionModel:
     @property
     def paged_supported(self) -> bool:
         """True when the continuous block-paged lane can serve this
-        wrapper: both halves paged-capable and the target unsharded
-        (pod-sharded spec pools — out_shardings pinning through the
-        paired program set — are future work; the daemon falls back
-        to dense/serial for tp>1 exactly as before)."""
+        wrapper: both halves paged-capable.  Pod-sharded targets
+        compose — the paired pools shard on kv heads like every other
+        paged pool and the fused step program pins out_shardings for
+        BOTH pools (the same no-silent-recompile contract the plain
+        chunk program carries), so spec-paged decode runs under
+        --tp N unchanged."""
         return (getattr(self.target, "paged_supported", False)
-                and getattr(self.draft, "paged_supported", False)
-                and getattr(self.target, "mesh", None) is None)
+                and getattr(self.draft, "paged_supported", False))
 
     @property
     def buckets(self):
@@ -510,8 +537,27 @@ class SpeculativeCompletionModel:
             return (unzip_cache(tcache), unzip_cache(dcache), out,
                     n_valid)
 
+        # sharded pools: pin BOTH halves' output placements (pools +
+        # scales per each model's own layer count, out/n_valid
+        # replicated) — the same signature-stability contract the
+        # plain chunk program pins (SPL203); without it the first
+        # serve-time spec step after warmup silently recompiles
+        # against GSPMD-chosen output shardings
+        nsc = 2 if quantized else 0
+        t_sh = self.target._paged_pool_out_shardings(
+            2, 0, n_scale_lists=nsc)
+        out_sh = None
+        if t_sh is not None:
+            d_sh = self.draft._paged_pool_out_shardings(
+                2, 0, n_scale_lists=nsc)
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self.target._pool_sharding().mesh,
+                                PartitionSpec())
+            out_sh = (t_sh, d_sh, rep, rep)
+        kw = {} if out_sh is None else {"out_shardings": out_sh}
         fn = DEVTIME.register("completer.spec_paged_step",
-                              jax.jit(run, donate_argnums=(2, 3)))
+                              jax.jit(run, donate_argnums=(2, 3),
+                                      **kw))
         self._progs[key] = fn
         if len(self._progs) > 8:
             cur = (self.target.top_p, self.target.temp)

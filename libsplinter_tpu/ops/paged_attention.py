@@ -95,9 +95,40 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# int4-PACKED pools (kv_dtype="int4"): two 4-bit codes per uint8 byte
+# along the head dim — pool shape (n_blocks, KH, page, D//2) — with
+# the SAME per-(page block, kv head) f32 scale tables as int8 (the
+# layout note above: scales were kept separate exactly so packing is a
+# value-layout change only).  Codes are symmetric 4-bit (clip ±7,
+# scale = page-absmax/7) stored OFFSET-8 (code+8 in [1, 15]) so both
+# nibbles unpack with unsigned ops: lo = byte & 0xF, hi = byte >> 4.
+# The split-half convention — byte j holds element j (lo) and element
+# j + D//2 (hi) — makes the in-register unpack one lane-dim
+# concatenate instead of an interleave.
+INT4_QMAX = 7.0
+_INT4_BIAS = 8
+
+
+def pack_int4(q):
+    """(..., D) int codes in [-7, 7] -> (..., D//2) uint8, split-half
+    nibble layout (lo = element j, hi = element j + D//2)."""
+    D = q.shape[-1]
+    u = (q.astype(jnp.int32) + _INT4_BIAS).astype(jnp.uint8)
+    lo, hi = u[..., :D // 2], u[..., D // 2:]
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed):
+    """(..., D//2) uint8 -> (..., D) f32 codes in [-8, 7] (the exact
+    inverse of pack_int4 on its range; the kernel does the same two
+    ops in register inside the page loop)."""
+    lo = (packed & 0xF).astype(jnp.float32) - _INT4_BIAS
+    hi = (packed >> 4).astype(jnp.float32) - _INT4_BIAS
+    return jnp.concatenate([lo, hi], axis=-1)
+
 
 def _paged_kernel(*refs, page: int, scale: float, rep: int,
-                  q_tokens: int, quantized: bool):
+                  q_tokens: int, quantized: bool, packed: bool):
     """One (batch row, kv head, page) program.
 
     refs (quantized=False):
@@ -145,8 +176,21 @@ def _paged_kernel(*refs, page: int, scale: float, rep: int,
             bid = tab_ref[b, p]
             ks = ksc_ref[bid, h]
             vs = vsc_ref[bid, h]
-            k = k_ref[0, 0].astype(jnp.float32)         # (page, D) deq
-            v = v_ref[0, 0].astype(jnp.float32) * vs    # in-register
+            if packed:
+                # int4: nibble-unpack the (page, D//2) uint8 block in
+                # register — two unsigned ops + a lane concatenate —
+                # then the int8 path's scale folding applies unchanged
+                ku, vu = k_ref[0, 0], v_ref[0, 0]
+                k = jnp.concatenate(
+                    [(ku & 0xF).astype(jnp.float32),
+                     (ku >> 4).astype(jnp.float32)], axis=-1) - 8.0
+                v = (jnp.concatenate(
+                    [(vu & 0xF).astype(jnp.float32),
+                     (vu >> 4).astype(jnp.float32)], axis=-1)
+                    - 8.0) * vs
+            else:
+                k = k_ref[0, 0].astype(jnp.float32)     # (page, D) deq
+                v = v_ref[0, 0].astype(jnp.float32) * vs  # in-register
             logits = jnp.dot(q.astype(jnp.float32), k.T,
                              preferred_element_type=jnp.float32) \
                 * (scale * ks)
@@ -189,6 +233,10 @@ def _pallas_call(q4, k_pool, v_pool, scalars, *, interpret: bool,
     B, KH, R, D = q4.shape
     rep = R // q_tokens
     page = k_pool.shape[2]
+    # int4-packed pools carry D//2 uint8 bytes on the head axis; the
+    # kv block shape follows the POOL's last axis while q/out keep D
+    Dk = k_pool.shape[3]
+    packed = quantized and k_pool.dtype == jnp.uint8
     scale = 1.0 / np.sqrt(D)
     n_pre = len(scalars)
 
@@ -198,7 +246,7 @@ def _pallas_call(q4, k_pool, v_pool, scalars, *, interpret: bool,
     def _kv_map(b, h, p, *pre):
         return (pre[0][b, p], h, 0, 0)
 
-    kv_spec = pl.BlockSpec((1, 1, page, D), _kv_map,
+    kv_spec = pl.BlockSpec((1, 1, page, Dk), _kv_map,
                            memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_pre,
@@ -220,7 +268,7 @@ def _pallas_call(q4, k_pool, v_pool, scalars, *, interpret: bool,
     return pl.pallas_call(
         functools.partial(_paged_kernel, page=page, scale=scale,
                           rep=rep, q_tokens=q_tokens,
-                          quantized=quantized),
+                          quantized=quantized, packed=packed),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KH, R, D), q4.dtype),
         interpret=interpret,
@@ -253,9 +301,12 @@ def _paged_pallas_quant(q4, k_pool, v_pool, k_scales, v_scales,
 
 
 def dequantize_pool(pool, scales):
-    """(n_blocks, KH, page, D) int8 + (n_blocks, KH) f32 -> f32 values
-    (the jnp-reference/fallback dequant; the kernel does this per page
-    in register)."""
+    """(n_blocks, KH, page, D) int8 — or (n_blocks, KH, page, D//2)
+    uint8 int4-packed — + (n_blocks, KH) f32 -> f32 values (the
+    jnp-reference/fallback dequant; the kernel does this per page in
+    register)."""
+    if pool.dtype == jnp.uint8:
+        return unpack_int4(pool) * scales[:, :, None, None]
     return pool.astype(jnp.float32) * scales[:, :, None, None]
 
 
@@ -361,9 +412,12 @@ def paged_attention(q, k_pool, v_pool, tables, lengths, *,
     query head h reads kv head h // (H//KH), grouped like
     causal_flash_attention);
     k_scales/v_scales: None for float pools, or (n_blocks, KH) f32
-    per-page per-kv-head scales for int8 pools — the kernel
+    per-page per-kv-head scales for quantized pools — the kernel
     dequantizes in register inside the page loop (the scales ride
-    scalar prefetch with the tables);
+    scalar prefetch with the tables).  Quantized pools are int8, or
+    int4-PACKED when the pool dtype is uint8: (n_blocks, KH, page,
+    D//2) bytes holding two offset-8 nibbles each (split-half layout,
+    pack_int4/unpack_int4), nibble-unpacked in register;
     tables: (B, P) int32 block table — entry (b, p) is the pool block
     holding row b's tokens [p*page, (p+1)*page); unused entries point
     at the trash block 0;

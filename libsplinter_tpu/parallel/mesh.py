@@ -72,20 +72,23 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def kv_pool_sharding(mesh: Mesh) -> NamedSharding:
     """Placement of a paged KV block pool (n_blocks, kv_heads, page,
-    head_dim) for tensor-parallel decode: split on the KV-HEAD axis
-    over tp, so every device holds every page at 1/tp of its bytes
-    and the host-side page scheduler never changes (parallel/serve.py
+    head_dim — or head_dim/2 uint8 for int4-PACKED pools, which shard
+    identically because packing only narrows the unsharded last axis)
+    for tensor-parallel decode: split on the KV-HEAD axis over tp, so
+    every device holds every page at 1/tp of its bytes and the
+    host-side page scheduler never changes (parallel/serve.py
     ShardedCompletionModel._pool_sharding; the shard_map'd ragged
     kernel in ops/paged_attention.py expects exactly this spec)."""
     return NamedSharding(mesh, P(None, "tp", None, None))
 
 
 def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
-    """Placement of an int8 paged pool's per-page per-kv-head scales
-    (n_blocks, kv_heads): split on THEIR kv-head axis over tp — the
-    scales shard with the heads they scale, so the shard_map'd
-    quantized ragged kernel's scalar-prefetch tables shrink by tp
-    alongside the pools (ops/paged_attention.py)."""
+    """Placement of a quantized (int8 or int4-packed) paged pool's
+    per-page per-kv-head scales (n_blocks, kv_heads): split on THEIR
+    kv-head axis over tp — the scales shard with the heads they
+    scale, so the shard_map'd quantized ragged kernel's
+    scalar-prefetch tables shrink by tp alongside the pools
+    (ops/paged_attention.py)."""
     return NamedSharding(mesh, P(None, "tp"))
 
 
@@ -104,15 +107,27 @@ def param_pspec(path: tuple, leaf) -> P:
     """
     names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
     joined = "/".join(str(n) for n in names)
+    colp = any(k in joined for k in ("qkv", "gate", "up"))
+    rowp = any(k in joined for k in ("attn/out", "mlp/down"))
     if leaf.ndim == 2:
-        if any(k in joined for k in ("qkv", "gate", "up")) \
-                and joined.endswith("kernel"):
+        if colp and joined.endswith("kernel"):
             return P(None, "tp")          # column parallel
-        if any(k in joined for k in ("attn/out", "mlp/down")) \
-                and joined.endswith("kernel"):
+        if rowp and joined.endswith("kernel"):
             return P("tp", None)          # row parallel
+        # weights_int8 (quant.ChannelQuantDense): the int8 kernel
+        # shards exactly like the float kernel it replaced
+        if colp and joined.endswith("wq"):
+            return P(None, "tp")
+        if rowp and joined.endswith("wq"):
+            return P("tp", None)
         if "tok_emb" in joined or "pos_emb" in joined:
             return P("tp", None)          # vocab-sharded embedding
+    if leaf.ndim == 1 and joined.endswith("wscale"):
+        # per-output-channel scales shard WITH the output columns on
+        # column-parallel layers (scaling the local partial product
+        # is exact — the multiply distributes over the later psum);
+        # row-parallel outputs are full-width, so scales replicate
+        return P("tp") if colp else P()
     return P()
 
 

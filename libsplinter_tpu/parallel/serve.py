@@ -71,6 +71,19 @@ def decoder_param_pspec(path: tuple, leaf) -> P:
         if last2[1] == "q":                   # (nb, 32, out) int8
             return P(None, None, "tp") if colp else P("tp", None, None)
         return P(None, "tp") if colp else P("tp", None)   # (nb, out)
+    # per-output-channel int8 projections (models/quant.py
+    # ChannelQuantDense, --weights int8): wq is (in, out), wscale is
+    # (out,).  The scale vector shards WITH the output columns it
+    # scales on column-parallel layers; on row-parallel layers the
+    # outputs are full-width partial sums, so wscale replicates —
+    # scaling each partial sum before the psum is exact because the
+    # multiply distributes over the sum.
+    if len(last2) == 2 and last2[1] in ("wq", "wscale") \
+            and last2[0] in ("q", "k", "v", "gate", "up", "out", "down"):
+        colp = last2[0] in ("q", "k", "v", "gate", "up")
+        if last2[1] == "wq":                  # (in, out) int8
+            return P(None, "tp") if colp else P("tp", None)
+        return P("tp") if colp else P()       # (out,) f32
     if leaf.ndim == 2:
         if "router" in joined:
             return P()                        # tiny: replicate

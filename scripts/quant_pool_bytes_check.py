@@ -1,16 +1,18 @@
 #!/usr/bin/env python
-"""quant-check's byte gate: the int8 paged pool must actually be
-small — MEASURED from the placed device buffers, not computed from
+"""quant-check's byte gate: the quantized paged pools must actually
+be small — MEASURED from the placed device buffers, not computed from
 shapes — for the same page count:
 
     int8  ==  1/2 of bf16  ==  1/4 of f32   (within 10%)
+    int4  ==  1/4 of bf16  ==  1/8 of f32  ==  1/2 of int8
 
 The tolerance absorbs the per-page scale arrays ((n_blocks, KH) f32
-per layer per side — the only overhead the int8 layout adds; at
-serving page sizes they are <1% of the values).  A regression here
-means the pool silently stored floats (a dtype threading bug) or the
-scales ballooned — either way the "cache bytes are tokens/sec" claim
-of the quantized decode lane is void, so CI fails loudly.
+per layer per side — the only overhead the quantized layouts add; at
+serving page sizes they are <1% of int8's values and <2% of int4's).
+A regression here means a pool silently stored floats or unpacked
+codes (a dtype/packing threading bug) or the scales ballooned —
+either way the "cache bytes are tokens/sec" claim of the quantized
+decode lane is void, so CI fails loudly.
 
 Run: JAX_PLATFORMS=cpu python scripts/quant_pool_bytes_check.py
 (wired into `make quant-check` and `make check`).
@@ -33,23 +35,37 @@ from libsplinter_tpu.models.decoder import (DecoderConfig,  # noqa: E402
 def main() -> int:
     cfg = DecoderConfig.tiny(max_len=256)
     mb: dict[str, float] = {}
-    for kvd in ("f32", "bf16", "int8"):
+    for kvd in ("f32", "bf16", "int8", "int4"):
         cache = PagedKVCache(cfg, 4, page=32, pool_pages=32,
                              kv_dtype=kvd)
         mb[kvd] = cache.device_mb()
         assert cache.kv_dtype == kvd
+        assert cache.packed == (kvd == "int4")
     r_bf16 = mb["int8"] / mb["bf16"]
     r_f32 = mb["int8"] / mb["f32"]
+    r4_bf16 = mb["int4"] / mb["bf16"]
+    r4_f32 = mb["int4"] / mb["f32"]
+    r4_i8 = mb["int4"] / mb["int8"]
     print(f"paged pool bytes (measured from placed buffers, "
           f"{cfg.layers} layers x 33 blocks x page 32):")
     for kvd, v in mb.items():
         print(f"  {kvd:>5}: {v:8.3f} MB")
     print(f"  int8/bf16 = {r_bf16:.3f} (want 0.5 +- 10%)")
     print(f"  int8/f32  = {r_f32:.3f} (want 0.25 +- 10%)")
+    print(f"  int4/bf16 = {r4_bf16:.3f} (want 0.25 +- 10%)")
+    print(f"  int4/f32  = {r4_f32:.3f} (want 0.125 +- 10%)")
+    print(f"  int4/int8 = {r4_i8:.3f} (want 0.5 +- 10%)")
     ok = abs(r_bf16 - 0.5) < 0.05 and abs(r_f32 - 0.25) < 0.025
     if not ok:
         print("FAIL: the int8 pool does not halve bf16 / quarter f32 "
               "— storage dtype threading is broken")
+        return 1
+    ok4 = (abs(r4_bf16 - 0.25) < 0.025 and abs(r4_f32 - 0.125) < 0.0125
+           and abs(r4_i8 - 0.5) < 0.05)
+    if not ok4:
+        print("FAIL: the int4 pool does not quarter bf16 / eighth "
+              "f32 / halve int8 — nibble packing is not reaching the "
+              "placed buffers")
         return 1
     print("OK")
     return 0

@@ -192,6 +192,62 @@ class TestByteExactness:
         assert dl["handoff_refill"] == 0
         assert pf["handoff_wire_mb"] > 0
 
+    def test_split_matches_unified_int4_packed_wire(self, model):
+        """PR 20: the handoff wire carries int4 pools in their NATIVE
+        packed dtype — uint8 nibble pages plus f32 scale rows, half
+        the int8 wire and an eighth of f32 — and split greedy bytes
+        still match the unified int4 lane exactly (the wire is the
+        pool's own bytes, so packed handoff is structurally exact,
+        not tolerance-bounded)."""
+        kw4 = dict(KW, kv_dtype="int4")
+
+        def uni4(st, m):
+            return [Completer(st, model=m, **kw4)]
+
+        def spl4(st, m):
+            return [PrefillLane(st, model=m, **kw4),
+                    DecodeLane(st, model=m, **kw4)]
+
+        uni, _ = _serve("uni-i4", uni4, model, PROMPTS, joiner=JOINER)
+        spl, stats = _serve("spl-i4", spl4, model, PROMPTS,
+                            joiner=JOINER)
+        assert spl == uni
+        pf, dl = stats
+        assert pf["handoffs"] >= 4 and pf["handoff_failed"] == 0
+        assert dl["adopted"] == pf["handoffs"]
+        assert dl["handoff_refill"] == 0      # real wire, no fallback
+        # the wire itself halves vs int8 at the same page count
+        c4 = model.init_paged(2, page=8, kv_dtype="int4")
+        c8 = model.init_paged(2, page=8, kv_dtype="int8")
+        assert str(model._page_wire_dtype(c4)) == "uint8"
+        assert model.page_wire_bytes(c4) * 2 == model.page_wire_bytes(c8)
+
+    @pytest.mark.slow
+    def test_refill_fallback_matches_unified_int4(self, model):
+        """A store too small for even the PACKED wire page degrades
+        the int4 handoff to re-prefill-from-record, byte-identically
+        to the unified int4 lane — the fallback replays tokens, so it
+        is layout-blind and must survive the packed geometry."""
+        kw4 = dict(KW, kv_dtype="int4")
+        wire = model.page_wire_bytes(
+            model.init_paged(2, page=8, kv_dtype="int4"))
+
+        def uni4(st, m):
+            return [Completer(st, model=m, **kw4)]
+
+        def spl4(st, m):
+            return [PrefillLane(st, model=m, **kw4),
+                    DecodeLane(st, model=m, **kw4)]
+
+        uni, _ = _serve("uni-i4s", uni4, model, PROMPTS, max_val=wire)
+        spl, stats = _serve("spl-i4s", spl4, model, PROMPTS,
+                            max_val=wire)
+        assert spl == uni
+        pf, dl = stats
+        assert pf["handoffs"] >= 3
+        assert dl["handoff_refill"] == pf["handoffs"]
+        assert pf["handoff_wire_mb"] == 0
+
     @pytest.mark.slow
     def test_refill_fallback_matches_unified(self, model):
         """A store too small for wire pages (max_val 4096 ==

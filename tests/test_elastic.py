@@ -503,6 +503,31 @@ def _policy(store, lane="embedder", lo=1, hi=4):
         {"v": 1, "lanes": {lane: {"min": lo, "max": hi}}}))
 
 
+def _pool_policy(store, lane="decode", lo=1, hi=4):
+    store.set(P.KEY_SCALE_POLICY, json.dumps(
+        {"v": 1, "lanes": {lane: {"min": lo, "max": hi,
+                                  "signal": "pool"}}}))
+
+
+def _pool_ring(store, lane, occ, readmits=None, used=60.0,
+               free=40.0):
+    """One fresh pool-signal sampler tick: occupancy plus the pool
+    size gauges, and optionally a (prev, last) tier_readmits counter
+    pair — the inputs of the PR 20 readmit discount."""
+    base = float(next(_ring_ticks)) * 100.0
+    gauges = {"queue_depth": [[base, 0.0]],
+              "pool_occ": [[base, float(occ)]],
+              "pages_used": [[base, float(used)]],
+              "pages_free": [[base, float(free)]]}
+    if readmits is not None:
+        gauges["tier_readmits"] = [
+            [base - 1.0, float(readmits[0])],
+            [base, float(readmits[1])]]
+    store.set(P.telemetry_key(lane), json.dumps(
+        {"v": 1, "lane": lane, "interval_s": 0.1, "n": 1,
+         "ts": time.time(), "gauges": gauges}))
+
+
 def _sup_stats(store, lane="embedder", r=1):
     P.publish_heartbeat(store, P.KEY_SUPERVISOR_STATS,
                         {"polls": 1, "lanes": {lane: {
@@ -620,6 +645,71 @@ class TestAutoscaler:
             ctl.decide_once(1.0)         # window passed: cycle runs
         finally:
             faults.disarm()
+
+    def test_pool_readmit_discount_suppresses_warm_burst(self, store):
+        """PR 20: a warm-restart readmit burst inflates pool_occ with
+        pages that cost nothing to drop again — the discount keeps
+        the (unchanged) hysteresis from voting scale-up on it, while
+        the SAME occupancy with a quiet tier still scales up."""
+        from libsplinter_tpu.engine.autoscaler import (
+            POOL_UP_THRESHOLD, READMIT_DISCOUNT_CAP)
+
+        _pool_policy(store, "decode")
+        _sup_stats(store, "decode", r=1)
+        ctl = AutoScaler(store, up_consecutive=2, cooldown_s=0.0)
+        # occupancy 0.85 >= 0.80, but 10 of the 100 pages were
+        # readmitted this tick: effective 0.75 — never votes up
+        for i in range(4):
+            _pool_ring(store, "decode", 0.85,
+                       readmits=(10.0 * i, 10.0 * (i + 1)),
+                       used=85.0, free=15.0)
+            assert ctl.decide_once(float(i)) == 0
+        assert ctl.lanes["decode"].up_streak == 0
+        assert ctl.lanes["decode"].readmit_discount == 0.1
+        ctl.publish_stats()
+        snap = json.loads(store.get(
+            P.KEY_AUTOSCALER_STATS).rstrip(b"\0"))
+        assert snap["lanes"]["decode"]["readmit_discount"] == 0.1
+        # tier quiet (counter flat): the same occupancy is genuine
+        # demand and the normal two-tick up vote fires
+        for i in range(2):
+            _pool_ring(store, "decode", 0.85,
+                       readmits=(40.0, 40.0), used=85.0, free=15.0)
+            ctl.decide_once(10.0 + i)
+        assert ctl.stats.scale_ups == 1
+        assert P.read_scale_targets(store)["decode"]["r"] == 2
+        assert POOL_UP_THRESHOLD == 0.80          # band untouched
+        assert READMIT_DISCOUNT_CAP == 0.5
+
+    def test_pool_readmit_discount_capped_and_robust(self, store):
+        """The discount is bounded (a pathological counter cannot
+        hide saturation below the cap) and degrades to 0.0 on any
+        missing/stale input instead of skipping the decision."""
+        from libsplinter_tpu.engine.autoscaler import AutoScaler as A
+
+        # pure-input unit: missing rec / rings / flat counter -> 0
+        assert A._readmit_discount(None) == 0.0
+        assert A._readmit_discount({"gauges": {}}) == 0.0
+        g = {"tier_readmits": [[1.0, 5.0], [2.0, 5.0]],
+             "pages_used": [[2.0, 50.0]], "pages_free": [[2.0, 50.0]]}
+        assert A._readmit_discount({"gauges": g}) == 0.0   # flat
+        g["tier_readmits"] = [[1.0, 0.0], [2.0, 90.0]]
+        assert A._readmit_discount({"gauges": g}) == 0.5   # capped
+        g["pages_free"] = [[2.0, 0.0]]
+        g["pages_used"] = [[2.0, 0.0]]
+        assert A._readmit_discount({"gauges": g}) == 0.0   # no pool
+        # capped end to end: occ 1.0 minus the 0.5 cap stays in the
+        # dead band (no up vote, no down vote — streaks reset)
+        _pool_policy(store, "decode")
+        _sup_stats(store, "decode", r=2)
+        ctl = AutoScaler(store, up_consecutive=1,
+                         down_consecutive=1, cooldown_s=0.0)
+        _pool_ring(store, "decode", 1.0, readmits=(0.0, 90.0),
+                   used=100.0, free=0.0)
+        assert ctl.decide_once(0.0) == 0
+        assert ctl.lanes["decode"].readmit_discount == 0.5
+        assert ctl.lanes["decode"].up_streak == 0
+        assert ctl.lanes["decode"].down_streak == 0
 
     def test_heartbeat_and_scale_status(self, store, capsys):
         _policy(store)

@@ -359,6 +359,121 @@ def test_snapshot_geometry_mismatch_cold_then_warm(tmp_path, model):
         TierPersist.unlink(pname)
 
 
+# ------------------------------------------- int4-PACKED shadows (PR 20)
+
+def _packed_target(model, kvd):
+    cache = model.init_paged(4, page=PAGE, kv_dtype=kvd)
+    pc = _attach_pc(cache)
+    tier = _bind(model, cache, pc)
+    return cache, pc, tier
+
+
+def _seed_snapshot_kvd(model, pname, kvd):
+    """_seed_snapshot at an explicit kv dtype — the packed donor."""
+    cache, pc, tier = _packed_target(model, kvd)
+    model.paged_prefill_row(cache, PROMPT24, 0)
+    assert pc.insert(PROMPT24, cache, 0, tenant=3) == 3
+    geom = tier_geometry(model, cache)
+    persist = TierPersist(pname, capacity_pages=32,
+                          max_len=model.cfg.max_len,
+                          page_bytes=geom["page_bytes"])
+    assert persist.save(pc, tier, geom)
+    return persist, geom
+
+
+def test_packed_demote_readmit_decode_parity(model):
+    """int4 shadows carry the PACKED bytes verbatim — the demote ->
+    readmit cycle at the packed layout decodes byte-identically to a
+    cold int4 prefill, and the snapshot geometry halves page_bytes vs
+    int8 with a uint8 wire dtype."""
+    cache, pc, tier = _packed_target(model, "int4")
+    assert cache.packed
+    geom = tier_geometry(model, cache)
+    i8 = model.init_paged(4, page=PAGE, kv_dtype="int8")
+    assert geom["wire_dtype"] == "uint8"
+    assert geom["page_bytes"] * 2 == model.page_wire_bytes(i8)
+    model.paged_prefill_row(cache, PROMPT24, 0)
+    assert pc.insert(PROMPT24, cache, 0, tenant=1) == 3
+    assert tier.spills == 3
+    _check_invariants(cache, pc)
+    cache.free_row(0)
+    assert pc.reclaim(3) == 3
+    assert tier.demotions == 3 and pc.demoted_pages() == 3
+    _check_invariants(cache, pc)
+    bids, match, nodes = pc.lookup_tiered(PROMPT24)
+    assert bids == [] and match == 0 and len(nodes) == 3
+    got = pc.readmit(nodes, cache)
+    assert len(got) == 3 and tier.readmits == 3
+    for b in got:
+        cache._decref(b)
+    cache.map_shared(1, got)
+    cache.lengths[1] = len(PROMPT24) - 1
+    assert cache.ensure(1, 32)
+    _check_invariants(cache, pc)
+    toks = np.full((4,), -1, np.int32)
+    toks[1] = int(PROMPT24[-1])
+    out = model.paged_decode_chunk(cache, toks, 7)
+    readmitted = [int(x) for x in out[1]]
+    cache_b = model.init_paged(4, page=PAGE, kv_dtype="int4")
+    lb = model.paged_prefill_row(cache_b, PROMPT24, 0)
+    tb = np.full((4,), -1, np.int32)
+    tb[0] = int(np.argmax(lb))
+    out_b = model.paged_decode_chunk(cache_b, tb, 7)
+    cold = [int(tb[0])] + [int(x) for x in out_b[0][:6]]
+    assert readmitted == cold
+
+
+@pytest.mark.parametrize("mangle,reason", [
+    (_mangle_missing_record, "missing_record"),
+    (_mangle_torn_header, "torn_header"),
+    (_mangle_mid_page, "torn_page"),
+    (_mangle_missing_trailer, "torn_page"),
+], ids=["missing-record", "torn-header", "mid-page",
+        "missing-trailer"])
+def test_packed_torn_snapshot_taxonomy(tmp_path, model, mangle,
+                                       reason):
+    """The torn-snapshot byte-boundary taxonomy holds unchanged at
+    the PACKED page geometry (half-size pages shift every record
+    boundary — the validation must not have byte offsets baked in)."""
+    pname = f"/spt-tierp4-{tmp_path.name}"
+    TierPersist.unlink(pname)
+    persist, geom = _seed_snapshot_kvd(model, pname, "int4")
+    try:
+        mangle(persist.store, persist.epoch)
+        cache2, pc2, tier2 = _packed_target(model, "int4")
+        assert persist.load(pc2, tier2, geom) == (0, reason)
+        assert pc2.demoted_pages() == 0 and len(tier2) == 0
+        assert not pc2._children
+        _check_invariants(cache2, pc2)
+    finally:
+        persist.close()
+        TierPersist.unlink(pname)
+
+
+def test_packed_snapshot_refuses_int8_geometry(tmp_path, model):
+    """int8 and int4 snapshots are mutually unservable (wire dtype
+    AND page_bytes differ): loading either under the other's geometry
+    is a typed geometry_mismatch — and the untouched int4 snapshot
+    still attaches warm under its own."""
+    pname = f"/spt-tierx48-{tmp_path.name}"
+    TierPersist.unlink(pname)
+    persist, geom4 = _seed_snapshot_kvd(model, pname, "int4")
+    try:
+        c8, pc8, t8 = _packed_target(model, "int8")
+        geom8 = tier_geometry(model, c8)
+        assert geom8 != geom4
+        assert persist.load(pc8, t8, geom8) == (0, "geometry_mismatch")
+        assert pc8.demoted_pages() == 0 and len(t8) == 0
+        cache2, pc2, tier2 = _packed_target(model, "int4")
+        n, why = persist.load(pc2, tier2, geom4)
+        assert (n, why) == (3, "")
+        assert pc2.demoted_pages() == 3 and tier2.restored == 3
+        _check_invariants(cache2, pc2)
+    finally:
+        persist.close()
+        TierPersist.unlink(pname)
+
+
 def test_restore_raise_falls_back_cold_typed(tmp_path, model):
     """The tier.restore fault site fires AFTER full validation,
     BEFORE adoption: a raise there proves the clean cold fallback
